@@ -1,0 +1,86 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the PaddlePaddle
+capability surface, built on jax/XLA/Pallas.
+
+Architecture (vs the reference layer map, SURVEY.md §1):
+  - compute path: ops lower to XLA; hot fused ops are Pallas kernels
+  - autograd: define-by-run tape capturing jax VJPs (framework/autograd.py)
+  - static mode / jit: trace-to-jaxpr + jax.jit (paddle_tpu.jit)
+  - distributed: jax.sharding.Mesh + collectives over ICI/DCN
+    (paddle_tpu.distributed)
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    Tensor, Parameter, to_tensor, is_tensor, Place,
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad,
+    seed, set_default_dtype, get_default_dtype,
+    uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128,
+)
+from .framework import bool_ as bool  # noqa: F401  (paddle.bool)
+from .framework.dtype import convert_dtype  # noqa: F401
+
+from .ops import *  # noqa: F401,F403
+from .ops import add_n  # noqa: F401
+from . import ops  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
+from . import incubate  # noqa: F401
+from . import static  # noqa: F401
+from . import autograd  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+from . import fft  # noqa: F401
+from . import linalg  # noqa: F401
+from . import utils  # noqa: F401
+
+from .framework.io import save, load  # noqa: F401
+from .device import set_device, get_device, CPUPlace, TPUPlace, CUDAPlace  # noqa: F401
+from .jit import to_static  # noqa: F401
+
+# paddle.disable_static / enable_static parity: dygraph is the default mode
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def disable_signal_handler():
+    pass
+
+
+def get_flags(flags):
+    from .framework import flags as _f
+    return _f.get_flags(flags)
+
+
+def set_flags(flags):
+    from .framework import flags as _f
+    return _f.set_flags(flags)
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes)

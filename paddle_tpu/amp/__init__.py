@@ -1,0 +1,3 @@
+"""AMP package. Reference analog: python/paddle/amp/."""
+from .auto_cast import auto_cast, amp_guard, decorate  # noqa: F401
+from .grad_scaler import GradScaler  # noqa: F401

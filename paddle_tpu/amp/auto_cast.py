@@ -1,0 +1,144 @@
+"""AMP autocast. Reference analog: python/paddle/amp/auto_cast.py:21 and the
+eager AMP pass in generated ad_funcs (eager/amp_utils.h).
+
+TPU-first: bfloat16 is the native mixed-precision dtype — no loss scaling is
+required (GradScaler is provided for API parity and is a near-no-op for bf16).
+O1 = autocast white/black lists at op granularity; O2 = cast the whole model,
+keep master weights in the optimizer.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+__all__ = ["auto_cast", "amp_guard", "amp_cast_inputs", "decorate",
+           "WHITE_LIST", "BLACK_LIST"]
+
+_state = threading.local()
+
+# Op-level lists, mirroring the reference's O1 default lists
+# (python/paddle/fluid/dygraph/amp/auto_cast.py AMP_WHITE_LIST / BLACK_LIST).
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
+    "einsum", "linear", "addmm",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "mean", "sum", "norm",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "reduce_sum",
+    "cumsum", "pow", "square", "sigmoid_cross_entropy_with_logits",
+    "binary_cross_entropy", "nll_loss", "l1_loss", "mse_loss", "smooth_l1_loss",
+}
+
+
+class _AmpState:
+    __slots__ = ("enabled", "dtype", "level", "white", "black")
+
+    def __init__(self, enabled, dtype, level, white, black):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+def _stack():
+    s = getattr(_state, "stack", None)
+    if s is None:
+        s = _state.stack = []
+    return s
+
+
+def current_amp_state():
+    s = _stack()
+    return s[-1] if s else None
+
+
+class auto_cast:
+    """`paddle.amp.auto_cast` context manager."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"level must be O0/O1/O2, got {level}")
+        from ..framework.dtype import to_jax_dtype
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        self._st = _AmpState(enable and level != "O0", to_jax_dtype(dtype),
+                             level, white, black)
+
+    def __enter__(self):
+        _stack().append(self._st)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name: str, tensors):
+    """Called from op dispatch: cast float inputs per the active policy."""
+    st = current_amp_state()
+    if st is None or not st.enabled:
+        return tensors
+    if st.level == "O2":
+        # pure low-precision except black list
+        target = jnp.float32 if op_name in st.black else st.dtype
+    else:
+        if op_name in st.white:
+            target = st.dtype
+        elif op_name in st.black:
+            target = jnp.float32
+        else:
+            return tensors
+    out = []
+    changed = False
+    for t in tensors:
+        v = t._value
+        if jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != target:
+            # cast the raw value and alias the producer's grad node: the
+            # downstream op's VJP then emits grads in compute dtype, which
+            # accumulate into the original tensor (standard AMP behavior)
+            from ..framework.core import Tensor
+            casted = Tensor(v.astype(target), stop_gradient=t.stop_gradient)
+            casted._grad_node = t._grad_node
+            casted._out_index = t._out_index
+            if t._grad_node is None and not t.stop_gradient:
+                t._ensure_grad_node()
+                casted._grad_node = t._grad_node
+                casted._out_index = t._out_index
+            out.append(casted)
+            changed = True
+        else:
+            out.append(t)
+    return out if changed else tensors
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """`paddle.amp.decorate` — O2: cast model params to low precision.
+    Master weights live in the optimizer accumulators (see optimizer)."""
+    from ..framework.dtype import to_jax_dtype
+    jd = to_jax_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            if m is None:
+                continue
+            for p in m.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(jd)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,147 @@
+"""paddle.autograd surface. Reference analog: python/paddle/autograd/
+(backward, PyLayer, functional jacobian/hessian; incubate/autograd primapi)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.autograd import grad, no_grad, enable_grad, set_grad_enabled  # noqa: F401
+from ..framework.core import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "PyLayer", "PyLayerContext", "jacobian", "hessian", "vjp", "jvp"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    """Reference analog: eager/pylayer — save_for_backward storage."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op with user forward/backward.
+
+    Reference analog: python/paddle/autograd/py_layer.py over
+    fluid/eager/pylayer/. Implemented by registering a manual GradNode whose
+    vjp calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.autograd import GradNode, is_grad_enabled
+        from ..ops.dispatch import _make_edges
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+
+        if not is_grad_enabled() or not any(
+                not t.stop_gradient for t in tensor_inputs):
+            return out
+
+        def vjp_fn(gs):
+            gs_t = gs if isinstance(gs, tuple) else (gs,)
+            grads_in = cls.backward(
+                ctx, *[Tensor(g, stop_gradient=True) for g in gs_t])
+            if not isinstance(grads_in, (list, tuple)):
+                grads_in = (grads_in,)
+            vals = []
+            for g in grads_in:
+                vals.append(None if g is None else
+                            (g._value if isinstance(g, Tensor)
+                             else jnp.asarray(g)))
+            return tuple(vals)
+
+        node = GradNode(cls.__name__, vjp_fn, _make_edges(tensor_inputs),
+                        tuple((o.shape, o._value.dtype) for o in outs))
+        for j, o in enumerate(outs):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_index = j
+        return out if multi else outs[0]
+
+
+def _as_pure(func):
+    def pure(*vals):
+        ts = [Tensor(v, stop_gradient=True) for v in vals]
+        with no_grad():
+            out = func(*ts)
+        return out._value if isinstance(out, Tensor) else out
+    return pure
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    vals = [x._value for x in xs_l]
+    jac = jax.jacrev(_as_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    out = tuple(Tensor(j) for j in jac)
+    return out[0] if single else out
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    vals = [x._value for x in xs_l]
+    hes = jax.hessian(_as_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    if single:
+        return Tensor(hes[0][0]) if isinstance(hes, tuple) else Tensor(hes)
+    return hes
+
+
+def vjp(func, xs, v=None):
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    vals = [x._value for x in xs_l]
+    out, vjp_fn = jax.vjp(_as_pure(func), *vals)
+    if v is None:
+        cot = jnp.ones_like(out)
+    else:
+        cot = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+    grads = vjp_fn(cot)
+    grads_t = tuple(Tensor(g) for g in grads)
+    return Tensor(out), (grads_t[0] if single else grads_t)
+
+
+def jvp(func, xs, v=None):
+    single = not isinstance(xs, (list, tuple))
+    xs_l = [xs] if single else list(xs)
+    vals = [x._value for x in xs_l]
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        v_l = [v] if single else list(v)
+        tangents = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in v_l]
+    out, tangent_out = jax.jvp(_as_pure(func), tuple(vals), tuple(tangents))
+    return Tensor(out), Tensor(tangent_out)

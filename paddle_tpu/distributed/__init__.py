@@ -1,0 +1,39 @@
+"""paddle.distributed equivalent — Mesh-first distributed layer.
+
+Reference analog: python/paddle/distributed/ (Fleet, collective, launch,
+meta_parallel). TPU-first redesign per SURVEY.md §7: HybridCommunicateGroup's
+4-axis rank topology becomes a `jax.sharding.Mesh` with named axes
+("data","pipe","sharding","model","sep"); comm groups are mesh axis subsets;
+collectives are XLA ops (psum/all_gather/ppermute) over ICI.
+"""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, reduce, broadcast, scatter, alltoall, alltoall_single,
+    reduce_scatter, send, recv, isend, irecv, barrier, wait,
+    destroy_process_group, get_backend, ProcessGroupXLA,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .mesh import (  # noqa: F401
+    build_mesh, get_global_mesh, set_global_mesh,
+)
+
+from ..ops.manipulation import split as _tensor_split  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """Reference analog: paddle.distributed.spawn. On TPU the launcher is
+    `python -m paddle_tpu.distributed.launch` (one process per host)."""
+    import multiprocessing as mp
+    if nprocs in (-1, 0, None):
+        nprocs = 1
+    procs = []
+    for rank in range(nprocs):
+        p = mp.Process(target=func, args=args)
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join()

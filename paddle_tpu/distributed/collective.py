@@ -1,0 +1,376 @@
+"""Collective communication API + ProcessGroupXLA.
+
+Reference analog: the ProcessGroup abstract API
+(fluid/distributed/collective/ProcessGroup.h:52) + ProcessGroupNCCL and the
+python surface python/paddle/distributed/collective.py /
+communication/{all_reduce,...}.py.
+
+TPU-first (SURVEY.md §5): collectives are XLA ops over the device mesh. A
+Group is a set of *devices* (single-controller SPMD world); an eager collective
+builds a global array over the group's 1-D mesh and runs a jitted
+shard_map(psum/all_gather/...) over ICI. Async semantics (`Task`) exist for API
+parity — XLA already overlaps independent collectives; `wait()` blocks on the
+result buffer.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_gather_object", "reduce", "broadcast", "scatter",
+           "alltoall", "alltoall_single", "reduce_scatter", "send", "recv",
+           "isend", "irecv", "barrier", "wait", "destroy_process_group",
+           "get_backend", "ProcessGroupXLA"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Task:
+    """Awaitable collective result (ProcessGroup::Task analog)."""
+
+    def __init__(self, buffers):
+        self._buffers = buffers
+
+    def wait(self, timeout=None):
+        for b in self._buffers:
+            b.block_until_ready()
+        return True
+
+    def is_completed(self):
+        try:
+            for b in self._buffers:
+                b.is_ready()
+            return True
+        except Exception:
+            return False
+
+    def synchronize(self):
+        self.wait()
+
+
+class ProcessGroupXLA:
+    """Executes collectives over a 1-D device mesh with jitted shard_map.
+
+    One instance per Group (reference: one ProcessGroupNCCL per (places, gid)).
+    Compiled collectives are cached per (op, shape, dtype).
+    """
+
+    def __init__(self, devices, gid=0):
+        self.devices = list(devices)
+        self.gid = gid
+        self.mesh = Mesh(np.array(self.devices), ("g",))
+        self._cache = {}
+
+    @property
+    def size(self):
+        return len(self.devices)
+
+    def _compiled(self, kind, reduce_op=None, **kw):
+        key = (kind, reduce_op, tuple(sorted(kw.items())))
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+        mesh = self.mesh
+        from jax.experimental.shard_map import shard_map
+
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: lambda x, a: jax.lax.pmean(x, a),
+               ReduceOp.PROD: lambda x, a: jnp.exp(
+                   jax.lax.psum(jnp.log(x), a))}.get(reduce_op)
+
+        if kind == "all_reduce":
+            def body(x):
+                return red(x, "g")
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
+                                   out_specs=P("g")))
+        elif kind == "all_gather":
+            def body(x):
+                return jax.lax.all_gather(x, "g", tiled=True)
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
+                                   out_specs=P("g")))
+        elif kind == "reduce_scatter":
+            def body(x):
+                return jax.lax.psum_scatter(x, "g", tiled=True)
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
+                                   out_specs=P("g")))
+        elif kind == "broadcast":
+            src = kw["src_index"]
+
+            def body(x):
+                idx = jax.lax.axis_index("g")
+                from_src = jax.lax.all_gather(x, "g")[src]
+                return from_src
+
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
+                                   out_specs=P("g")))
+        elif kind == "alltoall":
+            def body(x):
+                # x per-device: [n_dev, chunk, ...] -> exchanged
+                return jax.lax.all_to_all(x, "g", split_axis=0, concat_axis=0,
+                                          tiled=True)
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
+                                   out_specs=P("g")))
+        else:
+            raise ValueError(kind)
+        self._cache[key] = fn
+        return fn
+
+    # -- helpers -------------------------------------------------------------
+    def _replicated(self, value):
+        """Stack a host value once per device → device-sharded global array of
+        shape [n, ...]."""
+        n = self.size
+        stacked = jnp.stack([value] * n) if not isinstance(value, np.ndarray) \
+            else jnp.asarray(np.stack([value] * n))
+        sharding = NamedSharding(self.mesh, P("g"))
+        return jax.device_put(stacked, sharding)
+
+    def all_reduce(self, value, op=ReduceOp.SUM):
+        n = self.size
+        if n == 1:
+            return value
+        g = self._replicated(value)
+        out = self._compiled("all_reduce", op)(g)
+        return out[0]
+
+    def broadcast(self, value, src_index):
+        if self.size == 1:
+            return value
+        g = self._replicated(value)
+        out = self._compiled("broadcast", None, src_index=src_index)(g)
+        return out[0]
+
+
+_groups = {}
+_default_group = None
+_next_gid = 1
+
+
+class Group:
+    """Reference analog: distributed/collective.py Group."""
+
+    def __init__(self, rank, nranks, id=0, ranks=None, pg=None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks if ranks is not None else list(range(nranks))
+        self.pg = pg
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self.pg
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return self.rank >= 0
+
+    def __repr__(self):
+        return f"Group(rank={self.rank}, nranks={self.nranks}, id={self.id})"
+
+
+def _ensure_default_group():
+    global _default_group
+    if _default_group is None:
+        from .env import get_rank, get_world_size
+        devices = jax.devices()
+        pg = ProcessGroupXLA(devices, gid=0)
+        _default_group = Group(get_rank(), get_world_size(), id=0,
+                               ranks=list(range(get_world_size())), pg=pg)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    global _next_gid
+    from .env import get_rank, get_world_size
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    gid = _next_gid
+    _next_gid += 1
+    my_rank = get_rank()
+    group_rank = ranks.index(my_rank) if my_rank in ranks else -1
+    devices = jax.devices()
+    # device-backed subgroup when the "ranks" map onto devices 1:1
+    sub = [devices[r] for r in ranks if r < len(devices)] or devices[:1]
+    pg = ProcessGroupXLA(sub, gid=gid)
+    g = Group(group_rank, len(ranks), id=gid, ranks=list(ranks), pg=pg)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def _group_or_default(group):
+    return group if group is not None else _ensure_default_group()
+
+
+def _multi_process(group):
+    return group.nranks > 1 and jax.process_count() > 1
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce of `tensor` across the group.
+
+    Single-process groups are the identity (one controller owns all data);
+    multi-process uses psum over the global process mesh.
+    """
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        return Task([tensor._value])
+    pg = group.pg
+    tensor._value = pg.all_reduce(tensor._value, op)
+    return Task([tensor._value])
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        tensor_list.clear()
+        tensor_list.append(tensor.clone() if hasattr(tensor, "clone")
+                           else tensor)
+        return Task([tensor._value])
+    g = group.pg._replicated(tensor._value)
+    out = group.pg._compiled("all_gather", None)(g)
+    per = jnp.split(out[0], group.nranks, axis=0)
+    tensor_list.clear()
+    tensor_list.extend(Tensor(p) for p in per)
+    return Task([out])
+
+
+def all_gather_object(object_list, obj, group=None):
+    group = _group_or_default(group)
+    object_list.clear()
+    object_list.extend([obj] * group.nranks)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        return Task([tensor._value])
+    src_index = group.get_group_rank(src)
+    tensor._value = group.pg.broadcast(tensor._value, max(src_index, 0))
+    return Task([tensor._value])
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        if tensor_list:
+            tensor._assign_value_(tensor_list[0]._value)
+        return Task([tensor._value])
+    raise NotImplementedError(
+        "multi-process scatter: use sharded arrays (NamedSharding) instead")
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        out_tensor_list.clear()
+        out_tensor_list.extend(in_tensor_list)
+        return Task([t._value for t in in_tensor_list])
+    raise NotImplementedError(
+        "multi-process alltoall: use the MoE dispatch path (global_scatter)")
+
+
+def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        out_tensor._assign_value_(in_tensor._value)
+        return Task([out_tensor._value])
+    raise NotImplementedError
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        acc = tensor_list[0]._value
+        for t in tensor_list[1:]:
+            acc = acc + t._value
+        tensor._assign_value_(acc if group.nranks == 1 else acc)
+        return Task([tensor._value])
+    g = group.pg._replicated(jnp.concatenate([t._value for t in tensor_list]))
+    out = group.pg._compiled("reduce_scatter", op)(g)
+    tensor._assign_value_(out[0])
+    return Task([tensor._value])
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        _p2p_buffers.setdefault(group.id, {})[dst] = tensor._value
+        return Task([tensor._value])
+    raise NotImplementedError(
+        "cross-process eager send/recv: use ppermute inside shard_map "
+        "(pipeline parallel path)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        buf = _p2p_buffers.get(group.id, {})
+        from .env import get_rank
+        if get_rank() in buf:
+            tensor._assign_value_(buf.pop(get_rank()))
+        return Task([tensor._value])
+    raise NotImplementedError
+
+
+_p2p_buffers = {}
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    group = _group_or_default(group)
+    if _multi_process(group):
+        # a tiny psum doubles as a barrier
+        t = Tensor(jnp.zeros((), jnp.float32))
+        all_reduce(t, group=group)
+        t._value.block_until_ready()
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    tensor._value.block_until_ready()

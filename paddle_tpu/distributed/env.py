@@ -1,0 +1,103 @@
+"""Distributed environment bring-up.
+
+Reference analog: python/paddle/distributed/parallel.py:98 (init_parallel_env:
+rank 0 starts TCPStore :265, default ProcessGroup created over it). TPU-first:
+rendezvous is the JAX distributed coordination service
+(`jax.distributed.initialize`) ≙ TCPStore; ranks are processes (one per host),
+devices form the global mesh (SURVEY.md §5 "Distributed communication
+backend" translation).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "is_initialized", "parallel_device_count"]
+
+_initialized = False
+
+
+def _env_int(*names, default=0):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return int(v)
+    return default
+
+
+def init_parallel_env():
+    """Initialize multi-process jax if a launcher provided the env, else mark
+    single-process mode. Env-var conventions mirror the reference launcher
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    nranks = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+    rank = _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    if nranks > 1 and master and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = master if ":" in master else f"{master}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nranks, process_id=rank)
+    _initialized = True
+    from .collective import _ensure_default_group
+    _ensure_default_group()
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def parallel_device_count():
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Reference analog: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

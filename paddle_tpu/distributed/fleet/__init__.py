@@ -1,0 +1,13 @@
+"""Fleet facade. Reference analog: python/paddle/distributed/fleet/fleet.py:98
+(class Fleet) — init, distributed_model, distributed_optimizer, hybrid topology."""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode,
+)
+from .fleet_base import (  # noqa: F401
+    init, is_first_worker, worker_index, worker_num, is_worker,
+    distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    _get_fleet,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401

@@ -1,0 +1,59 @@
+"""DistributedStrategy. Reference analog:
+python/paddle/distributed/fleet/base/distributed_strategy.py:110 (protobuf-
+backed config; hybrid_configs doc at :1307). Plain-python config here — the
+knobs map onto mesh axis degrees and jit options instead of graph passes.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0, "custom_white_list": [],
+            "custom_black_list": [], "use_pure_fp16": False, "level": "O1",
+            "dtype": "bfloat16",
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1,
+                                 "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.hybrid_configs = {
+            "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = False
+        self.without_graph_optimization = True
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.hybrid_configs)
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+        else:
+            object.__setattr__(self, key, value)
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()
+                  if not k.startswith("_")}
+        return f"DistributedStrategy({fields})"

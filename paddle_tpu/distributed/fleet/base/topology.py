@@ -1,0 +1,212 @@
+"""Hybrid topology over the device mesh.
+
+Reference analog: fleet/base/topology.py — ParallelMode (:26),
+CommunicateTopology (:50), HybridCommunicateGroup (:136). The reference builds
+N-D cartesian rank coordinates and a comm group per axis; here the same
+coordinate math runs over *devices* of a jax Mesh, and "groups" carry both the
+reference-style rank lists and the mesh axis names used by pjit/shard_map.
+"""
+from __future__ import annotations
+
+import itertools
+from functools import reduce
+
+import numpy as np
+import jax
+
+from ..base.distributed_strategy import DistributedStrategy
+from ...collective import new_group
+from ...mesh import build_mesh, set_global_mesh
+
+__all__ = ["ParallelMode", "CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(
+            *[range(d) for d in self._dims]))
+        self._world_size = reduce(lambda x, y: x * y, self._dims, 1)
+        self._rank2coord = dict(
+            zip(range(len(self.coordinate)), self.coordinate))
+        self._coord2rank = {c: r for r, c in self._rank2coord.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **args):
+        coord = tuple(args[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._rank2coord.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """All rank groups along `axis_name` (one list per slice of the other
+        axes)."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for other in itertools.product(*other_dims):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, i)
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        from ...env import get_rank
+        self.global_rank = get_rank()
+        self._dp_degree = self._topo.get_dim("data")
+        self._mp_degree = self._topo.get_dim("model")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep") \
+            if "sep" in self._topo.get_hybrid_group_names() else 1
+
+        # device mesh with the same degrees (TPU-native side of the topology)
+        try:
+            self.mesh = build_mesh(dp=self._dp_degree, pp=self._pp_degree,
+                                   sharding=self._sharding_degree,
+                                   sep=self._sep_degree, mp=self._mp_degree)
+            set_global_mesh(self.mesh)
+        except ValueError:
+            self.mesh = None
+
+        self._dp_group = self._build_group("data")
+        self._mp_group = self._build_group("model")
+        self._pp_group = self._build_group("pipe")
+        self._sharding_group = self._build_group("sharding")
+        self._sep_group = self._build_group("sep") if self._sep_degree > 1 \
+            else None
+        # pp p2p groups: adjacent stages
+        self._p2p_groups = None
+
+    def _build_group(self, axis):
+        comm_lists = self._topo.get_comm_list(axis)
+        my = None
+        for ranks in comm_lists:
+            g = new_group(ranks)
+            if self.global_rank in ranks:
+                my = g
+        return my if my is not None else new_group([self.global_rank])
+
+    # -- parallel mode --------------------------------------------------------
+    def _check_vpp(self):
+        return False
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # -- data parallel --------------------------------------------------------
+    def get_data_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank)[
+            self._topo.get_hybrid_group_names().index("data")]
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    # -- model parallel -------------------------------------------------------
+    def get_model_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank)[
+            self._topo.get_hybrid_group_names().index("model")]
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # -- pipeline -------------------------------------------------------------
+    def get_stage_id(self):
+        return self._topo.get_coord(self.global_rank)[
+            self._topo.get_hybrid_group_names().index("pipe")]
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # -- sharding -------------------------------------------------------------
+    def get_sharding_parallel_rank(self):
+        return self._topo.get_coord(self.global_rank)[
+            self._topo.get_hybrid_group_names().index("sharding")]
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    # -- sep ------------------------------------------------------------------
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group

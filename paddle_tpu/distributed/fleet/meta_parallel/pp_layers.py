@@ -1,0 +1,176 @@
+"""Pipeline layer partitioning.
+
+Reference analog: fleet/meta_parallel/parallel_layers/pp_layers.py —
+LayerDesc (:56), SharedLayerDesc (:76), SegmentLayers (:92),
+PipelineLayerChunk (:182), PipelineLayer (:208).
+
+The descriptor/segmentation API is identical; execution differs: stages run on
+one controller with parameters shardable over the mesh "pipe" axis, and the
+1F1B schedule lives in pipeline_parallel.py.
+"""
+from __future__ import annotations
+
+import re
+from functools import partial
+
+import numpy as np
+
+from ....nn.layer_base import Layer
+from ....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc must describe a paddle_tpu.nn.Layer")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr
+                 ="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        if self.num_items < self.num_parts:
+            raise ValueError("layer number should be greater than the number "
+                             "of partitions")
+
+    def do_segment(self):
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":")[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                name = d.layer_func.__name__ if isinstance(d, LayerDesc) \
+                    else type(d).__name__
+                if re.search(cls_name, name):
+                    weights[i] = 1
+            total = sum(weights)
+            if total % self.num_parts != 0:
+                raise ValueError(
+                    f"number of {cls_name} layers ({total}) not divisible by "
+                    f"{self.num_parts} stages")
+            per = total // self.num_parts
+            result = [0] * (self.num_parts + 1)
+            seen = 0
+            part = 1
+            for i, w in enumerate(weights):
+                seen += w
+                if part < self.num_parts and seen == per * part + 1:
+                    result[part] = i
+                    part += 1
+            result[self.num_parts] = len(weights)
+            return result
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        offset = 0
+        for i in range(num_parts):
+            result[i] = offset
+            offset += part_size + (1 if i < extra else 0)
+        result[num_parts] = num_items
+        return result
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        if num_stages is None and topology is None:
+            num_stages = 1
+        if topology is not None:
+            self._num_stages = topology.get_dim("pipe")
+            from ...env import get_rank
+            coord = topology.get_coord(get_rank())
+            self._stage_id = coord[
+                topology.get_hybrid_group_names().index("pipe")]
+        else:
+            self._num_stages = num_stages
+            self._stage_id = 0
+
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+
+        # single-controller: materialize ALL stages; stage boundaries drive
+        # the schedule and (when meshed) parameter placement over "pipe"
+        self._stage_layers = []
+        self.shared_layers = {}
+        for stage in range(self._num_stages):
+            start, end = self.segment_parts[stage], self.segment_parts[stage + 1]
+            built = []
+            for desc in self._layers_desc[start:end]:
+                if isinstance(desc, SharedLayerDesc):
+                    if desc.layer_name not in self.shared_layers:
+                        self.shared_layers[desc.layer_name] = desc.build_layer()
+                    layer = self.shared_layers[desc.layer_name]
+                    if desc.forward_func is not None:
+                        layer = _SharedForward(layer, desc.forward_func)
+                    built.append(layer)
+                elif isinstance(desc, LayerDesc):
+                    built.append(desc.build_layer())
+                else:
+                    built.append(desc)
+            self._stage_layers.append(LayerList(built))
+        self.run_function = self._stage_layers
+        self.add_sublayer("stages", LayerList(self._stage_layers))
+
+    def get_stage_from_index(self, layer_idx):
+        for stage in range(self._num_stages):
+            if self.segment_parts[stage] <= layer_idx < \
+                    self.segment_parts[stage + 1]:
+                return stage
+        return self._num_stages - 1
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def forward_stage(self, x, stage):
+        for layer in self._stage_layers[stage]:
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+    def forward(self, x):
+        for stage in range(self._num_stages):
+            x = self.forward_stage(x, stage)
+        return x
+
+
+class _SharedForward(Layer):
+    def __init__(self, layer, forward_func):
+        super().__init__()
+        self._shared = layer
+        self._forward_func = forward_func
+
+    def forward(self, *args, **kwargs):
+        return self._forward_func(self._shared, *args, **kwargs)

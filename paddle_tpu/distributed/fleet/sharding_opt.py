@@ -1,0 +1,69 @@
+"""Optimizer-state sharding (ZeRO stage 1/2) over the mesh "sharding" axis.
+
+Reference analog: fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py:28 (DygraphShardingOptimizer: each rank owns a
+slice of optimizer states) and meta_parallel/sharding/
+group_sharded_optimizer_stage2.py.
+
+TPU-first: instead of rank-owned python partitions + broadcast, accumulator
+arrays get a NamedSharding over the "sharding" mesh axis — XLA stores 1/Nth
+per device and the update runs fully sharded (the reduce-scatter/all-gather
+pattern falls out of the partitioner). This is the SURVEY.md §7 row
+"group_sharded ≙ sharding mesh axis as NamedSharding".
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..mesh import get_global_mesh
+
+__all__ = ["shard_optimizer_states", "shard_value"]
+
+
+def _spec_for(shape, mesh, axis="sharding"):
+    """Shard the largest dim divisible by the axis size; replicate otherwise."""
+    n = mesh.shape[axis]
+    if n <= 1:
+        return None
+    dims = [None] * len(shape)
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if shape[i] % n == 0 and shape[i] >= n:
+            dims[i] = axis
+            return P(*dims)
+    return None
+
+
+def shard_value(value, mesh=None, axis="sharding"):
+    mesh = mesh or get_global_mesh()
+    if mesh is None:
+        return value
+    spec = _spec_for(value.shape, mesh, axis)
+    if spec is None:
+        return value
+    return jax.device_put(value, NamedSharding(mesh, spec))
+
+
+def shard_optimizer_states(optimizer, hcg=None):
+    """Re-place existing accumulators sharded; future accumulators are sharded
+    at creation by wrapping _add_accumulator."""
+    mesh = get_global_mesh()
+    if mesh is None or mesh.shape.get("sharding", 1) <= 1:
+        return optimizer
+    for name, per_param in optimizer._accumulators.items():
+        for pname, val in per_param.items():
+            per_param[pname] = shard_value(val, mesh)
+
+    orig_add = optimizer._add_accumulator
+
+    def sharded_add(name, param, fill_value=0.0, dtype=None, shape=None):
+        out = orig_add(name, param, fill_value, dtype, shape)
+        key = param.name
+        optimizer._accumulators[name][key] = shard_value(
+            optimizer._accumulators[name][key], mesh)
+        return optimizer._accumulators[name][key]
+
+    optimizer._add_accumulator = sharded_add
+    return optimizer

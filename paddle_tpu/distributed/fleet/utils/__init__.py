@@ -1,0 +1,44 @@
+"""Fleet utils: recompute. Reference analog: fleet/recompute/recompute.py
+(RecomputeFunction PyLayer) + fleet/utils/__init__.py recompute export.
+
+TPU-first: jax.checkpoint (rematerialization) IS recompute; the wrapper keeps
+the reference API (function + args, preserve_rng_state) and dispatches the
+checkpointed function as a single tape op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor
+from ....framework import random as _random
+from ....framework.autograd import set_grad_enabled
+from ....ops.dispatch import call_op
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    other = [(i, a) for i, a in enumerate(args)
+             if not isinstance(a, Tensor)]
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    key = _random.get_rng_key()
+
+    @jax.checkpoint
+    def inner(key, *vals):
+        full = [None] * len(args)
+        for i, a in other:
+            full[i] = a
+        for i, v in zip(tensor_idx, vals):
+            full[i] = Tensor(v, stop_gradient=True)
+        with _random.tracing_key_scope(key):
+            with set_grad_enabled(False):
+                out = function(*full, **kwargs)
+        return out._value if isinstance(out, Tensor) else out
+
+    def fn(*vals):
+        return inner(key, *vals)
+    return call_op("recompute", fn, tuple(tensor_args))

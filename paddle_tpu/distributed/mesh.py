@@ -1,0 +1,61 @@
+"""Global device-mesh management.
+
+Reference analog: HybridCommunicateGroup's CommunicateTopology
+(fleet/base/topology.py:50) — an N-D cartesian rank space with axes
+["data","pipe","sharding","sep","model"]. TPU-first: the topology IS a
+jax.sharding.Mesh over physical devices; ICI-adjacency comes from jax's device
+ordering (mesh_utils for real TPU slices).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["build_mesh", "get_global_mesh", "set_global_mesh", "AXIS_ORDER"]
+
+# reference axis order (fleet/fleet.py:405: ["data","pipe","sharding","model"]
+# + "sep" in later revisions); kept as the canonical ordering here
+AXIS_ORDER = ("data", "pipe", "sharding", "sep", "model")
+
+_global_mesh = None
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None):
+    """Build a Mesh with named axes matching the hybrid topology degrees.
+
+    Axis sizes must multiply to the device count (reference check:
+    fleet/base/topology.py CommunicateTopology)."""
+    devices = list(devices if devices is not None else jax.devices())
+    degrees = {"data": dp, "pipe": pp, "sharding": sharding, "sep": sep,
+               "model": mp}
+    total = int(np.prod(list(degrees.values())))
+    if total != len(devices):
+        # allow data axis to absorb the remainder (reference: dp inferred)
+        known = pp * sharding * sep * mp
+        if len(devices) % known == 0:
+            degrees["data"] = len(devices) // known
+            total = len(devices)
+        else:
+            raise ValueError(
+                f"mesh degrees {degrees} do not match device count "
+                f"{len(devices)}")
+    shape = [degrees[a] for a in AXIS_ORDER]
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def get_global_mesh():
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh()
+    return _global_mesh
+
+
+def set_global_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh

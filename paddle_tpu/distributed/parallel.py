@@ -1,0 +1,82 @@
+"""DataParallel wrapper.
+
+Reference analog: python/paddle/fluid/dygraph/parallel.py DataParallel +
+EagerReducer bucketing (fluid/distributed/collective/reducer.cc).
+
+TPU-first: under jit the grad all-reduce fuses into the backward (XLA inserts
+one fused all-reduce per dependency frontier — the reducer's bucketing job),
+so this wrapper's eager path simply averages grads across the data-parallel
+group after backward; no bucket management is needed (SURVEY.md §7 row
+"EagerReducer").
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+from .collective import all_reduce, ReduceOp, barrier
+from .env import get_world_size
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_hooks = []
+        if get_world_size(group) > 1:
+            self._register_grad_sync()
+
+    def _register_grad_sync(self):
+        world = get_world_size(self.group)
+
+        def make_hook(param):
+            def hook(grad):
+                t = grad
+                all_reduce(t, op=ReduceOp.SUM, group=self.group)
+                t._value = t._value / world
+                return t
+            return hook
+        for p in self._layers.parameters():
+            if not p.stop_gradient:
+                self._grad_hooks.append(p.register_hook(make_hook(p)))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        world = get_world_size(self.group)
+        if world <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)
+                p.grad._value = p.grad._value / world
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self

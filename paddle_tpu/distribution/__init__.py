@@ -1,0 +1,424 @@
+"""Probability distributions. Reference analog: python/paddle/distribution/
+(4.7k LoC: Distribution, Normal, Uniform, Categorical, Beta, Dirichlet,
+kl_divergence, transforms)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.random import get_rng_key
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace", "LogNormal",
+           "Multinomial", "Gumbel", "Geometric", "Cauchy", "kl_divergence",
+           "register_kl"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale *
+                      jax.random.normal(get_rng_key(), shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(get_rng_key(), shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            l = _val(logits)
+            # paddle Categorical takes unnormalized probabilities as `logits`
+            self._probs = l / jnp.sum(l, axis=-1, keepdims=True)
+        else:
+            self._probs = _val(probs)
+        super().__init__(self._probs.shape[:-1])
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self._probs, 1e-30, None))
+        n = int(np.prod(shape)) if shape else 1
+        out = jax.random.categorical(
+            get_rng_key(), logits, shape=(n,) + self.batch_shape)
+        if shape:
+            out = out.reshape(tuple(shape) + self.batch_shape)
+        else:
+            out = out[0]
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        idx = _val(value).astype(jnp.int32)
+        p = jnp.take_along_axis(self._probs, idx[..., None], axis=-1)[..., 0]
+        return Tensor(jnp.log(jnp.clip(p, 1e-30, None)))
+
+    def probs(self, value):
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(self._probs, idx[..., None],
+                                          axis=-1)[..., 0])
+
+    def entropy(self):
+        p = self._probs
+        return Tensor(-jnp.sum(p * jnp.log(jnp.clip(p, 1e-30, None)), axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self._probs = _val(probs)
+        super().__init__(self._probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            get_rng_key(), self._probs, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        p = jnp.clip(self._probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self._probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    @property
+    def mean(self):
+        return Tensor(self._probs)
+
+    @property
+    def variance(self):
+        return Tensor(self._probs * (1 - self._probs))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.beta(get_rng_key(), self.alpha, self.beta,
+                                      shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        from jax.scipy.special import betaln
+        return Tensor((self.alpha - 1) * jnp.log(v) +
+                      (self.beta - 1) * jnp.log1p(-v) -
+                      betaln(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a) -
+                      (b - 1) * digamma(b) +
+                      (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(get_rng_key(), self.concentration,
+                                           shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), axis=-1) +
+                      gammaln(jnp.sum(a, axis=-1)) -
+                      jnp.sum(gammaln(a), axis=-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(get_rng_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(get_rng_key(), self.concentration,
+                                       shape) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _val(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v -
+                      gammaln(a))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale *
+                      jax.random.laplace(get_rng_key(), shape))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale -
+                      jnp.log(2 * self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jnp.exp(self.loc + self.scale *
+                              jax.random.normal(get_rng_key(), shape)))
+
+    def log_prob(self, value):
+        v = _val(value)
+        logv = jnp.log(v)
+        var = self.scale ** 2
+        return Tensor(-((logv - self.loc) ** 2) / (2 * var) - logv -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self._probs = _val(probs)
+        super().__init__(self._probs.shape[:-1], self._probs.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self._probs, 1e-30, None))
+        n = self.total_count
+        draws = jax.random.categorical(
+            get_rng_key(), logits, shape=(n,) + tuple(shape) + self.batch_shape)
+        k = self._probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _val(value)
+        logp = jnp.log(jnp.clip(self._probs, 1e-30, None))
+        return Tensor(gammaln(self.total_count + 1.0) -
+                      jnp.sum(gammaln(v + 1.0), axis=-1) +
+                      jnp.sum(v * logp, axis=-1))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale *
+                      jax.random.gumbel(get_rng_key(), shape))
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self._probs = _val(probs)
+        super().__init__(self._probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(jax.random.geometric(get_rng_key(), self._probs, shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        p = jnp.clip(self._probs, 1e-7, 1 - 1e-7)
+        return Tensor((v - 1) * jnp.log1p(-p) + jnp.log(p))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale *
+                      jax.random.cauchy(get_rng_key(), shape))
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.clip(p._probs, 1e-30, None)
+    qq = jnp.clip(q._probs, 1e-30, None)
+    return Tensor(jnp.sum(pp * (jnp.log(pp) - jnp.log(qq)), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p._probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q._probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) +
+                  (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))

@@ -1,0 +1,68 @@
+"""paddle.fft equivalent over jnp.fft. Reference analog:
+python/paddle/fft.py (phi fft kernels / cuFFT)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops._helpers import ensure_tensor, unary
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _wrap1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return unary(name, lambda v: jfn(v, n=n, axis=axis, norm=norm),
+                     ensure_tensor(x))
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return unary(name, lambda v: jfn(v, s=s, axes=axes, norm=norm),
+                     ensure_tensor(x))
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+
+fft2 = _wrapn("fft2", lambda v, s, axes, norm: jnp.fft.fft2(
+    v, s=s, axes=axes if axes is not None else (-2, -1), norm=norm))
+ifft2 = _wrapn("ifft2", lambda v, s, axes, norm: jnp.fft.ifft2(
+    v, s=s, axes=axes if axes is not None else (-2, -1), norm=norm))
+rfft2 = _wrapn("rfft2", lambda v, s, axes, norm: jnp.fft.rfft2(
+    v, s=s, axes=axes if axes is not None else (-2, -1), norm=norm))
+irfft2 = _wrapn("irfft2", lambda v, s, axes, norm: jnp.fft.irfft2(
+    v, s=s, axes=axes if axes is not None else (-2, -1), norm=norm))
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ..framework.core import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ..framework.core import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return unary("fftshift", lambda v: jnp.fft.fftshift(v, axes=axes),
+                 ensure_tensor(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return unary("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes),
+                 ensure_tensor(x))

@@ -1,0 +1,280 @@
+"""Define-by-run autograd engine over jax VJPs.
+
+Reference analog: paddle/fluid/eager/ — GradNodeBase/Edge (grad_node_info.h:168,50),
+engine RunBackward (backward.cc:105, in-degree map + ready queue), accumulation
+node (eager/accumulation/), hooks (hooks.h).
+
+TPU-first design: instead of hand-written per-op grad kernels, each forward op
+captures its VJP via `jax.vjp` at dispatch time (residuals live as jax arrays on
+device). Backward is the same topo-ordered ready-queue walk as the reference,
+but every node's backward is a single XLA-compiled callable.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode", "AccumulationNode", "run_backward", "grad",
+    "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set(flag: bool):
+    _state.grad_enabled = flag
+
+
+class set_grad_enabled:
+    """Context manager / decorator toggling grad tracking."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _set(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        _set(self._prev)
+        return False
+
+
+class _GradModeDecorator:
+    mode = False
+
+    def __init__(self, func=None):
+        self._func = func
+
+    def __call__(self, *args, **kwargs):
+        if self._func is not None:
+            with set_grad_enabled(self.mode):
+                return self._func(*args, **kwargs)
+        # `@no_grad()` usage: instance called with the function to wrap
+        if len(args) == 1 and callable(args[0]) and not kwargs:
+            return type(self)(args[0])
+        raise TypeError("no_grad: expected a callable to wrap")
+
+    def __enter__(self):
+        self._ctx = set_grad_enabled(self.mode)
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class no_grad(_GradModeDecorator):
+    """`paddle.no_grad` — usable as context manager or decorator."""
+    mode = False
+
+
+class enable_grad(_GradModeDecorator):
+    mode = True
+
+
+class GradNode:
+    """One node per forward op invocation.
+
+    Holds the op's vjp callable, edges to producer nodes (one per tensor input),
+    and output metadata so missing output grads can be zero-filled.
+    """
+
+    __slots__ = ("name", "vjp_fn", "edges", "out_avals", "pending",
+                 "out_hooks", "retain_count")
+
+    def __init__(self, name, vjp_fn, edges, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        # edges[i] = (producer_node, producer_out_index) or None (stop_gradient)
+        self.edges = edges
+        # out_avals[j] = (shape, jnp dtype) of forward output j
+        self.out_avals = out_avals
+        self.pending = {}       # out_index -> accumulated incoming grad
+        self.out_hooks = {}     # out_index -> [callable]
+        self.retain_count = 0
+
+    # -- engine interface ---------------------------------------------------
+    def add_grad(self, out_index: int, g):
+        cur = self.pending.get(out_index)
+        self.pending[out_index] = g if cur is None else cur + g
+
+    def collect_input_grads(self):
+        """Run hooks, zero-fill missing output grads, call vjp; returns tuple of
+        grads aligned with self.edges."""
+        outs = []
+        for j, (shape, dt) in enumerate(self.out_avals):
+            g = self.pending.get(j)
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                for hook in self.out_hooks.get(j, ()):
+                    newg = hook(g)
+                    if newg is not None:
+                        g = newg
+            outs.append(g)
+        self.pending = {}
+        arg = tuple(outs) if len(outs) > 1 else outs[0]
+        grads = self.vjp_fn(arg)
+        if not isinstance(grads, tuple):
+            grads = (grads,)
+        return grads
+
+    def release(self):
+        self.vjp_fn = None
+        self.pending = {}
+
+
+class AccumulationNode(GradNode):
+    """Terminal node for a leaf tensor: writes into tensor.grad.
+
+    Reference analog: eager/accumulation/accumulation_node.h.
+    """
+
+    __slots__ = ("tensor_ref",)
+
+    def __init__(self, tensor):
+        import weakref
+        super().__init__("accumulation", None, (), ((tensor.shape, tensor._value.dtype),))
+        self.tensor_ref = weakref.ref(tensor)
+
+    def accumulate(self):
+        t = self.tensor_ref()
+        g = self.pending.get(0)
+        self.pending = {}
+        if t is None or g is None:
+            return
+        # paddle.grad() restricts accumulation to its requested inputs so
+        # other leaves' .grad is not polluted (GeneralGrad semantics)
+        allowed = getattr(_state, "grad_filter", None)
+        if allowed is not None and id(t) not in allowed:
+            return
+        for hook in self.out_hooks.get(0, ()):
+            newg = hook(g)
+            if newg is not None:
+                g = newg
+        for hook in t._hooks:
+            # tensor-level hooks registered via Tensor.register_hook receive
+            # and may replace the grad (paddle semantics)
+            from .core import Tensor
+            res = hook(Tensor(g, stop_gradient=True))
+            if res is not None:
+                g = res._value if hasattr(res, "_value") else jnp.asarray(res)
+        if t.grad is None:
+            from .core import Tensor
+            t.grad = Tensor(g, stop_gradient=True)
+            t.grad.name = t.name + "@GRAD" if t.name else "grad"
+        else:
+            t.grad._value = t.grad._value + g
+
+
+def _count_dependencies(root: GradNode):
+    """BFS the reachable subgraph; in_degree[node] = #edges into it from
+    reachable nodes. Mirrors backward.cc:22 getInDegreeMap."""
+    in_degree = {}
+    seen = {root}
+    q = deque([root])
+    while q:
+        node = q.popleft()
+        for edge in node.edges:
+            if edge is None:
+                continue
+            nxt = edge[0]
+            in_degree[nxt] = in_degree.get(nxt, 0) + 1
+            if nxt not in seen:
+                seen.add(nxt)
+                q.append(nxt)
+    return in_degree, seen
+
+
+def run_backward(root_node: GradNode, root_index: int, seed_grad,
+                 retain_graph: bool = False):
+    """Topo-ordered ready-queue walk from a single root output.
+
+    Reference analog: egr::RunBackward (eager/backward.cc:105).
+    """
+    in_degree, reachable = _count_dependencies(root_node)
+    root_node.add_grad(root_index, seed_grad)
+    ready = deque([root_node])
+    # nodes whose in-degree never reaches 0 cannot fire; with a DAG from a
+    # single root this terminates with all reachable nodes fired.
+    while ready:
+        node = ready.popleft()
+        if isinstance(node, AccumulationNode):
+            node.accumulate()
+            continue
+        grads = node.collect_input_grads()
+        if not retain_graph:
+            node.release()
+        for edge, g in zip(node.edges, grads):
+            if edge is None or g is None:
+                continue
+            nxt, out_idx = edge
+            nxt.add_grad(out_idx, g)
+            in_degree[nxt] -= 1
+            if in_degree[nxt] == 0:
+                ready.append(nxt)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """`paddle.grad` equivalent: grads of outputs w.r.t. inputs without touching
+    .grad. Reference analog: eager/general_grad.h (GeneralGrad).
+
+    Implementation: temporarily swap AccumulationNode capture — we hook input
+    tensors' nodes by running a normal backward into fresh buffers.
+    """
+    from .core import Tensor
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported yet")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    # stash and clear existing grads on inputs; run backward; read; restore.
+    # A grad filter keeps accumulation away from leaves outside `inputs`.
+    stash = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    _state.grad_filter = {id(t) for t in inputs}
+    # shared nodes must survive across the per-output backward runs
+    retain = bool(retain_graph) or len(outputs) > 1
+    try:
+        for out, gout in zip(outputs, grad_outputs):
+            if out._grad_node is None:
+                continue
+            seed = (jnp.ones(out.shape, out._value.dtype)
+                    if gout is None else jnp.asarray(gout._value if isinstance(gout, Tensor) else gout))
+            run_backward(out._grad_node, out._out_index, seed,
+                         retain_graph=retain)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"One of the differentiated tensors ({t.name}) appears "
+                        "to not have been used in the graph; set allow_unused=True "
+                        "to return None for it.")
+                results.append(None)
+            else:
+                g = t.grad
+                g.stop_gradient = True
+                results.append(g)
+        return results
+    finally:
+        _state.grad_filter = None
+        for t, old in stash:
+            t.grad = old

@@ -1,0 +1,75 @@
+"""Global flags. Reference analog: paddle/fluid/platform/flags.cc (76 exported
+FLAGS via PADDLE_DEFINE_EXPORTED_*) + paddle.set_flags/get_flags
+(global_value_getter_setter.cc). Env vars `FLAGS_*` seed initial values.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["define_flag", "set_flags", "get_flags", "FLAGS"]
+
+_lock = threading.Lock()
+_FLAGS: dict[str, object] = {}
+_DEFS: dict[str, tuple] = {}
+
+
+def define_flag(name, default, help_str=""):
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _DEFS[name] = (default, help_str)
+    _FLAGS[name] = value
+    return value
+
+
+# Core flags mirroring the reference set (platform/flags.cc)
+define_flag("FLAGS_check_nan_inf", False,
+            "scan op outputs for NaN/Inf (nan_inf_utils.h analog)")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: fail on nan/inf")
+define_flag("FLAGS_benchmark", False, "sync after each op for timing")
+define_flag("FLAGS_use_flash_attention", True,
+            "route eligible attention through the Pallas flash kernel")
+define_flag("FLAGS_allocator_strategy", "xla",
+            "memory is managed by XLA/PJRT (informational)")
+define_flag("FLAGS_cudnn_deterministic", False, "determinism hint")
+define_flag("FLAGS_embedding_deterministic", 0, "determinism hint")
+define_flag("FLAGS_max_inplace_grad_add", 0, "compat no-op")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op (XLA GC)")
+
+
+class _FlagsView:
+    def __getattr__(self, name):
+        full = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
+        try:
+            return _FLAGS[full]
+        except KeyError:
+            raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        full = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
+        with _lock:
+            _FLAGS[full] = value
+
+
+FLAGS = _FlagsView()
+
+
+def set_flags(flags: dict):
+    with _lock:
+        for k, v in flags.items():
+            _FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}

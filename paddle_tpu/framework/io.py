@@ -1,0 +1,75 @@
+"""paddle.save / paddle.load. Reference analog:
+python/paddle/framework/io.py:640 (save) / :882 (load) — pickle protocol with
+tensors converted to numpy payloads; nested state dict structures preserved.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_PROTOCOL_KEY = "__paddle_tpu_tensor__"
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return {_PROTOCOL_KEY: "parameter", "data": obj.numpy(),
+                "name": obj.name, "trainable": obj.trainable}
+    if isinstance(obj, Tensor):
+        return {_PROTOCOL_KEY: "tensor", "data": obj.numpy(),
+                "name": obj.name, "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        tag = obj.get(_PROTOCOL_KEY)
+        if tag == "parameter":
+            if return_numpy:
+                return obj["data"]
+            p = Parameter(obj["data"], name=obj["name"],
+                          trainable=obj.get("trainable", True))
+            return p
+        if tag == "tensor":
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], name=obj["name"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    dirname = os.path.dirname(path)
+    if dirname and not os.path.isdir(dirname):
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    if hasattr(path, "read"):
+        data = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+    return _unpack(data, return_numpy)

@@ -1,0 +1,91 @@
+"""Global RNG management over jax PRNG keys.
+
+Reference analog: paddle/phi/core/generator.h (global Generator per device) and
+python/paddle/fluid/framework.py seed handling. TPU-first: a functional PRNG key
+is split per sampling call; under `to_static`/jit tracing, keys come from a
+traced key context (threaded by the jitted step) so compiled random ops do not
+bake in a constant key.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "get_rng_key", "split_key", "default_generator",
+           "tracing_key_scope", "RNGKeyContext"]
+
+
+class _GlobalGenerator:
+    """Stateful generator: holds a jax PRNG key, splits off a fresh subkey per use."""
+
+    def __init__(self, seed_val: int = 0):
+        self._lock = threading.Lock()
+        self._key = jax.random.key(seed_val)
+        self.initial_seed = seed_val
+
+    def manual_seed(self, seed_val: int):
+        with self._lock:
+            self._key = jax.random.key(int(seed_val))
+            self.initial_seed = int(seed_val)
+        return self
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+default_generator = _GlobalGenerator(0)
+
+_tracing_ctx = threading.local()
+
+
+class RNGKeyContext:
+    """Context holding a (possibly traced) key that random ops consume.
+
+    Used by jitted train steps: the step function receives an explicit key and
+    installs it here so `dropout` etc. pull traced randomness instead of the
+    global stateful generator (which would be baked as a constant under trace).
+    """
+
+    def __init__(self, key):
+        self.key = key
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+class tracing_key_scope:
+    def __init__(self, key):
+        self._ctx = RNGKeyContext(key)
+
+    def __enter__(self):
+        stack = getattr(_tracing_ctx, "stack", None)
+        if stack is None:
+            stack = _tracing_ctx.stack = []
+        stack.append(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _tracing_ctx.stack.pop()
+        return False
+
+
+def seed(seed_val: int):
+    """`paddle.seed` equivalent — reseed the global generator."""
+    return default_generator.manual_seed(seed_val)
+
+
+def get_rng_key():
+    """Return a fresh PRNG key: from the innermost tracing scope if active,
+    else from the global stateful generator."""
+    stack = getattr(_tracing_ctx, "stack", None)
+    if stack:
+        return stack[-1].next_key()
+    return default_generator.next_key()
+
+
+def split_key(n: int):
+    return jax.random.split(get_rng_key(), n)

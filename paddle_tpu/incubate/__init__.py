@@ -1,0 +1,3 @@
+"""paddle.incubate equivalent."""
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401

@@ -1,0 +1,21 @@
+"""Primitive-op AD (forward mode). Reference analog:
+python/paddle/incubate/autograd/primapi.py (:22 forward_grad, :105 grad).
+TPU-first: jax.jvp/jax.grad are the primitive transforms."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...autograd import grad, jvp as _jvp  # noqa: F401
+
+__all__ = ["forward_grad", "grad", "jvp"]
+
+jvp = _jvp
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode gradients (JVP) of outputs w.r.t. inputs."""
+    raise NotImplementedError(
+        "forward_grad over recorded eager graphs is not supported; use "
+        "paddle_tpu.autograd.jvp(func, xs, v) with an explicit function")

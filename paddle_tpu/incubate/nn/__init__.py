@@ -1,0 +1,15 @@
+"""Fused transformer layers.
+
+Reference analog: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention :191, FusedFeedForward :478,
+FusedTransformerEncoderLayer :706, FusedMultiTransformer :997) over the
+hand-fused CUDA ops in fluid/operators/fused/.
+
+TPU-first: "fused" means one jitted region whose attention core is the Pallas
+flash kernel and whose FFN/LN/residual chain is one XLA fusion cluster — the
+compiler does the epilogue fusion the reference hand-wrote.
+"""
+from .fused_transformer import (  # noqa: F401
+    FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+    FusedMultiTransformer,
+)

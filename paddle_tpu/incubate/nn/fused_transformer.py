@@ -1,0 +1,190 @@
+"""Fused transformer building blocks (see package docstring for design)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer_base import Layer
+from ...nn.initializer_util import materialize_parameter
+from ...nn import initializer as I
+from ...nn import functional as F
+from ...nn.layer.container import LayerList
+from ...ops import manipulation as manip
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: incubate/nn/layer/fused_transformer.py:191 over
+    fused_attention_op.cu — pre/post-LN + QKV proj + MHA core + out proj +
+    residual, as one fused region."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        self.qkv_weight = materialize_parameter(
+            [3, num_heads, self.head_dim, embed_dim], qkv_weight_attr,
+            self._dtype, default_initializer=I.XavierUniform())
+        self.qkv_bias = materialize_parameter(
+            [3, num_heads, self.head_dim], qkv_bias_attr, self._dtype,
+            is_bias=True)
+        self.linear_weight = materialize_parameter(
+            [embed_dim, embed_dim], linear_weight_attr, self._dtype,
+            default_initializer=I.XavierUniform())
+        self.linear_bias = materialize_parameter(
+            [embed_dim], linear_bias_attr, self._dtype, is_bias=True)
+        self.pre_ln_scale = materialize_parameter(
+            [embed_dim], pre_ln_scale_attr, self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = materialize_parameter(
+            [embed_dim], pre_ln_bias_attr, self._dtype, is_bias=True)
+        self.ln_scale = materialize_parameter(
+            [embed_dim], ln_scale_attr, self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = materialize_parameter(
+            [embed_dim], ln_bias_attr, self._dtype, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        residual = query
+        x = query
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self._epsilon)
+        b, n = x.shape[0], x.shape[1]
+        # qkv: [B,N,E] @ [E, 3*H*D] -> [B,N,3,H,D]
+        qkv_w = manip.reshape(
+            manip.transpose(self.qkv_weight, [3, 0, 1, 2]),
+            [self.embed_dim, 3 * self.embed_dim])
+        qkv = F.linear(x, qkv_w,
+                       manip.reshape(self.qkv_bias, [3 * self.embed_dim]))
+        qkv = manip.reshape(qkv, [b, n, 3, self.num_heads, self.head_dim])
+        q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), 2)
+        k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), 2)
+        v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), 2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0,
+            training=self.training)
+        out = manip.reshape(out, [b, n, self.embed_dim])
+        out = F.linear(out, self.linear_weight, self.linear_bias)
+        out = F.dropout(out, self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Reference: fused_transformer.py:478 over fused_feedforward_op.cu."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._d_model = d_model
+        self._epsilon = epsilon
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = act_dropout_rate if act_dropout_rate \
+            is not None else dropout_rate
+        self._act = activation
+        self.normalize_before = normalize_before
+        self.linear1_weight = materialize_parameter(
+            [d_model, dim_feedforward], linear1_weight_attr, self._dtype,
+            default_initializer=I.XavierUniform())
+        self.linear1_bias = materialize_parameter(
+            [dim_feedforward], linear1_bias_attr, self._dtype, is_bias=True)
+        self.linear2_weight = materialize_parameter(
+            [dim_feedforward, d_model], linear2_weight_attr, self._dtype,
+            default_initializer=I.XavierUniform())
+        self.linear2_bias = materialize_parameter(
+            [d_model], linear2_bias_attr, self._dtype, is_bias=True)
+        self.ln1_scale = materialize_parameter(
+            [d_model], ln1_scale_attr, self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.ln1_bias = materialize_parameter(
+            [d_model], ln1_bias_attr, self._dtype, is_bias=True)
+        self.ln2_scale = materialize_parameter(
+            [d_model], ln2_scale_attr, self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.ln2_bias = materialize_parameter(
+            [d_model], ln2_bias_attr, self._dtype, is_bias=True)
+
+    def forward(self, src, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = F.layer_norm(src, [self._d_model], self.ln1_scale,
+                               self.ln1_bias, self._epsilon)
+        act = getattr(F, self._act)
+        src = act(F.linear(src, self.linear1_weight, self.linear1_bias))
+        src = F.dropout(src, self._act_dropout_rate, training=self.training)
+        src = F.linear(src, self.linear2_weight, self.linear2_bias)
+        src = F.dropout(src, self._dropout_rate, training=self.training)
+        src = residual + src
+        if not self.normalize_before:
+            src = F.layer_norm(src, [self._d_model], self.ln2_scale,
+                               self.ln2_bias, self._epsilon)
+        return src
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference: fused_transformer.py:706."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
+            else attn_dropout_rate
+        act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """Reference: fused_transformer.py:997 (fused_multi_transformer op) — the
+    inference-serving stacked-decoder block."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5, name=None, **unused):
+        super().__init__()
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate,
+                activation, normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=attn_mask)
+        return out

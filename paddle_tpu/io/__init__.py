@@ -1,0 +1,17 @@
+"""Data loading. Reference analog: python/paddle/fluid/reader.py:312
+(DataLoader), fluid/dataloader/ (Dataset, samplers, multiprocess iter), and the
+C++ buffered_reader (operators/reader/buffered_reader.cc) for device
+double-buffering.
+
+TPU-first: workers produce numpy batches on host threads; a prefetch queue
+overlaps host batch assembly with device compute (the buffered_reader role).
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, BatchSampler,
+    DistributedBatchSampler, WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

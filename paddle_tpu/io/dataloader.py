@@ -1,0 +1,146 @@
+"""DataLoader with threaded prefetch.
+
+Reference analog: python/paddle/fluid/reader.py:312 (DataLoader),
+fluid/dataloader/dataloader_iter.py (worker iterators), and the C++
+double-buffering reader (operators/reader/buffered_reader.cc).
+
+TPU-first: batches are assembled by a thread pool (numpy is GIL-releasing for
+the copy-heavy parts) and staged through a bounded prefetch queue so host input
+processing overlaps device compute. Device transfer happens lazily on first
+use (jnp.asarray), which XLA pipelines.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.core import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([b._value for b in batch]))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return tuple(default_collate_fn(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class _PrefetchIterator:
+    _STOP = object()
+
+    def __init__(self, produce_batches, prefetch=2):
+        self._q = queue.Queue(maxsize=max(prefetch, 1))
+        self._exc = None
+        self._thread = threading.Thread(target=self._run,
+                                        args=(produce_batches,), daemon=True)
+        self._thread.start()
+
+    def _run(self, produce_batches):
+        try:
+            for b in produce_batches():
+                self._q.put(b)
+        except BaseException as e:  # propagate to consumer
+            self._exc = e
+        finally:
+            self._q.put(self._STOP)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._STOP:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if self._iterable_mode:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+    def _produce(self):
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if self.batch_size and len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        if self.num_workers > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                def fetch(indices):
+                    return self.collate_fn(
+                        [self.dataset[i] for i in indices])
+                # windowed map keeps at most num_workers*prefetch futures alive
+                futures = []
+                it = iter(self.batch_sampler)
+                depth = self.num_workers * max(self.prefetch_factor, 1)
+                try:
+                    for _ in range(depth):
+                        futures.append(pool.submit(fetch, next(it)))
+                except StopIteration:
+                    it = None
+                while futures:
+                    yield futures.pop(0).result()
+                    if it is not None:
+                        try:
+                            futures.append(pool.submit(fetch, next(it)))
+                        except StopIteration:
+                            it = None
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.use_buffer_reader:
+            return _PrefetchIterator(self._produce,
+                                     prefetch=self.prefetch_factor)
+        return self._produce()

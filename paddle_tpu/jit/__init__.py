@@ -1,0 +1,2 @@
+from .api import to_static, not_to_static, ignore_module, TracedLayer, save, load  # noqa: F401
+from .train_step import TrainStep  # noqa: F401

@@ -1,0 +1,131 @@
+"""TrainStep: a fully-fused jitted training step.
+
+Reference analog: the whole dygraph hot loop (forward ad_funcs + RunBackward +
+optimizer ops) collapsed into one XLA executable — the TPU-first answer to the
+reference's per-op C++ dispatch war (phi README §1.2).
+
+    step = TrainStep(model, loss_fn, optimizer)
+    loss = step(batch_x, batch_y)          # one compiled fwd+bwd+update
+
+Parameters and optimizer slots live as donated pytrees across steps; the
+model's wrapper tensors are refreshed after each call so eager inspection
+(state_dict, p.numpy()) still works.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import random as _random
+from ..framework.autograd import set_grad_enabled
+
+__all__ = ["TrainStep"]
+
+
+class TrainStep:
+    def __init__(self, model, loss_fn, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._jitted = None
+        self._params = None
+        self._acc_names = None
+        self._donate = donate
+
+    def _build(self, example_args):
+        model = self.model
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        params = [p for p in model.parameters() if not p.stop_gradient]
+        buffers = [b for _, b in model.named_buffers()]
+        self._params = params
+        self._buffers = buffers
+        opt._create_accumulators(params)
+        acc_names = sorted(opt._accumulators.keys())
+        self._acc_names = acc_names
+
+        def pure_loss(pvals, bvals, args, key):
+            saved_p = [p._value for p in params]
+            saved_b = [b._value for b in buffers]
+            saved_flags = [p.stop_gradient for p in params]
+            try:
+                for p, v in zip(params, pvals):
+                    p._value = v
+                    p.stop_gradient = True
+                for b, v in zip(buffers, bvals):
+                    b._value = v
+                targs = [Tensor(a, stop_gradient=True) for a in args]
+                with _random.tracing_key_scope(key):
+                    with set_grad_enabled(False):
+                        out = model(*targs[:-1]) if loss_fn is not None \
+                            else model(*targs)
+                        loss = loss_fn(out, targs[-1]) if loss_fn is not None \
+                            else out
+                new_b = [b._value for b in buffers]
+                return loss._value, new_b
+            finally:
+                for p, v, sg in zip(params, saved_p, saved_flags):
+                    p._value = v
+                    p.stop_gradient = sg
+                for b, v in zip(buffers, saved_b):
+                    b._value = v
+
+        # bake per-param decay flags for AdamW/Lamb before tracing
+        if hasattr(opt, "_decay_skip"):
+            opt._current_decay_flags = [p.name not in opt._decay_skip
+                                        for p in params]
+        elif hasattr(opt, "_decay_flags"):
+            opt._current_decay_flags = [opt._decay_flags.get(p.name, True)
+                                        for p in params]
+
+        def step(pvals, accs, bvals, args, lr, step_count, key):
+            (loss, new_b), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(pvals, bvals, args, key)
+            new_p, new_accs = [], []
+            for pv, gv, ac in zip(pvals, grads, accs):
+                acc_dict = dict(zip(acc_names, ac))
+                np_, na_ = opt._single_update(pv, gv, acc_dict, lr, step_count)
+                new_p.append(np_)
+                new_accs.append([na_[n] for n in acc_names])
+            return loss, new_p, new_accs, new_b
+
+        # donate accumulators by default; donating params would invalidate
+        # user-held aliases of p._value (detach() shares storage). Pass
+        # donate="all" for maximum-memory-efficiency training loops that
+        # never alias parameters.
+        donate = (0, 1, 2) if self._donate == "all" else \
+            ((1,) if self._donate else ())
+        self._jitted = jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *args):
+        arg_vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                    for a in args]
+        if self._jitted is None:
+            self._build(arg_vals)
+        params = self._params
+        opt = self.optimizer
+        acc_names = self._acc_names
+        opt._create_accumulators(params)
+        if not hasattr(opt, "_step_count"):
+            opt._step_count = 0
+        opt._step_count += 1
+
+        pvals = [p._value for p in params]
+        accs = [[opt._accumulators[n][p.name] for n in acc_names]
+                for p in params]
+        bvals = [b._value for b in self._buffers]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_count = jnp.asarray(opt._step_count, jnp.int32)
+        key = _random.get_rng_key()
+
+        loss, new_p, new_accs, new_b = self._jitted(
+            pvals, accs, bvals, arg_vals, lr, step_count, key)
+        for p, v in zip(params, new_p):
+            p._value = v
+        for p, ac in zip(params, new_accs):
+            for n, v in zip(acc_names, ac):
+                opt._accumulators[n][p.name] = v
+        for b, v in zip(self._buffers, new_b):
+            b._value = v
+        return Tensor(loss)

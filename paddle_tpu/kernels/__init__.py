@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hot fused ops.
+
+Reference analog: paddle/fluid/operators/fused/ (fused_attention_op.cu,
+fused_feedforward_op.cu, fused_softmax_mask). Here each is a Pallas kernel
+targeting MXU/VMEM directly.
+"""
+from . import flash_attention  # noqa: F401

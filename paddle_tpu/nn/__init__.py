@@ -1,0 +1,19 @@
+"""paddle.nn equivalent surface."""
+from .layer_base import Layer  # noqa: F401
+from .initializer_util import ParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+from ..framework.core import Parameter  # noqa: F401

@@ -1,0 +1,103 @@
+"""Gradient clipping. Reference analog: python/paddle/fluid/clip.py
+(ClipGradByGlobalNorm etc.), applied by the optimizer before update."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g._value.astype(jnp.float32) * scale)
+                                  .astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32))) for g in grads]
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def __call__(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gnorm = self._global_norm([g for _, g in clippable])
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value.astype(jnp.float32) * scale)
+                                      .astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        norms = [jnp.max(jnp.abs(g._value)) for g in grads]
+        total = norms[0]
+        for n in norms[1:]:
+            total = jnp.maximum(total, n)
+    else:
+        sq = [jnp.sum(jnp.power(jnp.abs(g._value.astype(jnp.float32)),
+                                norm_type)) for g in grads]
+        acc = sq[0]
+        for s in sq[1:]:
+            acc = acc + s
+        total = jnp.power(acc, 1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad._value = (p.grad._value.astype(jnp.float32) * scale) \
+                .astype(p.grad._value.dtype)
+    return Tensor(total)

@@ -1,0 +1,10 @@
+"""paddle.nn.functional equivalent."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import scaled_dot_product_attention  # noqa: F401
+
+from ...ops.manipulation import pad  # noqa: F401  (paddle exposes F.pad)

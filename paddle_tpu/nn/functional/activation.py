@@ -1,0 +1,233 @@
+"""Activation functionals. Reference analog: python/paddle/nn/functional/
+activation.py over phi activation kernels. All are single fused XLA
+expressions (VPU-friendly, fused into adjacent matmuls by XLA)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, unary, binary, call_op
+from ...ops.registry import register_op
+
+__all__ = ["relu", "relu_", "relu6", "gelu", "sigmoid", "tanh", "softmax",
+           "log_softmax", "silu", "swish", "hardswish", "hardsigmoid",
+           "leaky_relu", "elu", "celu", "selu", "prelu", "softplus",
+           "softsign", "hardtanh", "mish", "tanhshrink", "hardshrink",
+           "softshrink", "glu", "maxout", "thresholded_relu", "log_sigmoid",
+           "gumbel_softmax", "rrelu"]
+
+
+@register_op("relu", "activation", ref="phi/kernels/activation_kernel.h")
+def relu(x, name=None):
+    return unary("relu", lambda v: jnp.maximum(v, 0), x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._grad_node, x._out_index = out._value, out._grad_node, out._out_index
+    return x
+
+
+@register_op("relu6", "activation")
+def relu6(x, name=None):
+    return unary("relu6", lambda v: jnp.clip(v, 0, 6), x)
+
+
+@register_op("gelu", "activation")
+def gelu(x, approximate=False, name=None):
+    return unary("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+@register_op("sigmoid", "activation")
+def sigmoid(x, name=None):
+    return unary("sigmoid", jax.nn.sigmoid, x)
+
+
+@register_op("tanh_act", "activation")
+def tanh(x, name=None):
+    return unary("tanh", jnp.tanh, x)
+
+
+@register_op("softmax", "activation")
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+    jd = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if jd is not None:
+            v = v.astype(jd)
+        return jax.nn.softmax(v, axis=axis)
+    return unary("softmax", fn, x)
+
+
+@register_op("log_softmax", "activation")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_jax_dtype
+    jd = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if jd is not None:
+            v = v.astype(jd)
+        return jax.nn.log_softmax(v, axis=axis)
+    return unary("log_softmax", fn, x)
+
+
+@register_op("silu", "activation")
+def silu(x, name=None):
+    return unary("silu", jax.nn.silu, x)
+
+
+@register_op("swish", "activation")
+def swish(x, name=None):
+    return unary("swish", jax.nn.silu, x)
+
+
+@register_op("hardswish", "activation")
+def hardswish(x, name=None):
+    return unary("hardswish", lambda v: v * jnp.clip(v + 3, 0, 6) / 6, x)
+
+
+@register_op("hardsigmoid", "activation")
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return unary("hardsigmoid", lambda v: jnp.clip(slope * v + offset, 0, 1), x)
+
+
+@register_op("leaky_relu", "activation")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return unary("leaky_relu",
+                 lambda v: jnp.where(v >= 0, v, negative_slope * v), x)
+
+
+@register_op("elu", "activation")
+def elu(x, alpha=1.0, name=None):
+    return unary("elu", lambda v: jax.nn.elu(v, alpha=alpha), x)
+
+
+@register_op("celu", "activation")
+def celu(x, alpha=1.0, name=None):
+    return unary("celu", lambda v: jax.nn.celu(v, alpha=alpha), x)
+
+
+@register_op("selu", "activation")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return unary("selu",
+                 lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+@register_op("prelu", "activation")
+def prelu(x, weight, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+
+    def fn(v, w):
+        if w.size > 1:
+            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v >= 0, v, w * v)
+    return call_op("prelu", fn, (x, weight))
+
+
+@register_op("softplus", "activation")
+def softplus(x, beta=1, threshold=20, name=None):
+    return unary("softplus",
+                 lambda v: jnp.where(beta * v > threshold, v,
+                                     jnp.log1p(jnp.exp(beta * v)) / beta), x)
+
+
+@register_op("softsign", "activation")
+def softsign(x, name=None):
+    return unary("softsign", jax.nn.soft_sign, x)
+
+
+@register_op("hardtanh", "activation")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return unary("hardtanh", lambda v: jnp.clip(v, min, max), x)
+
+
+@register_op("mish", "activation")
+def mish(x, name=None):
+    return unary("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), x)
+
+
+@register_op("tanhshrink", "activation")
+def tanhshrink(x, name=None):
+    return unary("tanhshrink", lambda v: v - jnp.tanh(v), x)
+
+
+@register_op("hardshrink", "activation")
+def hardshrink(x, threshold=0.5, name=None):
+    return unary("hardshrink",
+                 lambda v: jnp.where(jnp.abs(v) > threshold, v, 0), x)
+
+
+@register_op("softshrink", "activation")
+def softshrink(x, threshold=0.5, name=None):
+    return unary("softshrink",
+                 lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0)), x)
+
+
+@register_op("glu", "activation")
+def glu(x, axis=-1, name=None):
+    return unary("glu", lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+@register_op("maxout", "activation")
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = list(v.shape)
+        new_shape[ax:ax + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return unary("maxout", fn, x)
+
+
+@register_op("thresholded_relu", "activation")
+def thresholded_relu(x, threshold=1.0, name=None):
+    return unary("thresholded_relu",
+                 lambda v: jnp.where(v > threshold, v, 0), x)
+
+
+@register_op("log_sigmoid", "activation")
+def log_sigmoid(x, name=None):
+    return unary("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+@register_op("gumbel_softmax", "activation")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import get_rng_key
+    x = ensure_tensor(x)
+    g = jax.random.gumbel(get_rng_key(), x._value.shape, jnp.float32)
+
+    def fn(v):
+        y = jax.nn.softmax((v + g.astype(v.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx,
+                                        jnp.ones((), y.dtype), axis=axis,
+                                        inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return unary("gumbel_softmax", fn, x)
+
+
+@register_op("rrelu", "activation")
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...framework.random import get_rng_key
+    x = ensure_tensor(x)
+    if training:
+        a = jax.random.uniform(get_rng_key(), x._value.shape, jnp.float32,
+                               lower, upper)
+    else:
+        a = (lower + upper) / 2.0
+
+    def fn(v):
+        slope = a.astype(v.dtype) if hasattr(a, "astype") else a
+        return jnp.where(v >= 0, v, slope * v)
+    return unary("rrelu", fn, x)

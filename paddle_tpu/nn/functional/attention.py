@@ -1,0 +1,68 @@
+"""Scaled-dot-product attention functional.
+
+Reference analog: the fused attention path (fluid/operators/fused/
+fused_attention_op.cu, fmha_ref.h). TPU-first: defaults to the Pallas
+flash-attention kernel on TPU (paddle_tpu/kernels/flash_attention.py) and a
+plain XLA softmax(QK^T)V fallback elsewhere / for odd shapes.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, call_op
+from ...ops.registry import register_op
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0):
+    # q,k,v: [B, N, H, D] (paddle layout: batch, seq, heads, head_dim)
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, N, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhnd,bhmd->bhnm", qt, kt) * scale
+    if is_causal:
+        n, m = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((n, m), bool))
+        scores = jnp.where(causal, scores, jnp.asarray(-1e30, scores.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_.dtype:
+            scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1) \
+        .astype(scores.dtype)
+    out = jnp.einsum("bhnm,bhmd->bhnd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@register_op("scaled_dot_product_attention", "fused",
+             ref="fluid/operators/fused/fused_attention_op.cu")
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """query/key/value: [batch, seq, num_heads, head_dim] (paddle convention).
+
+    On TPU with flash-eligible shapes this runs the Pallas flash-attention
+    kernel; otherwise the XLA fallback (still one fused HLO cluster).
+    """
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    mask_v = ensure_tensor(attn_mask)._value if attn_mask is not None else None
+
+    from ...kernels import flash_attention as fa
+    if fa.is_eligible(q._value, k._value, v._value, mask_v, dropout_p):
+        def fn(qq, kk, vv):
+            return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
+                                           scale=scale)
+        return call_op("flash_attention", fn, (q, k, v))
+
+    def fn(qq, kk, vv):
+        return _plain_attention(qq, kk, vv, mask_v, is_causal, scale)
+    return call_op("scaled_dot_product_attention", fn, (q, k, v))

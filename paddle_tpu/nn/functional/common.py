@@ -1,0 +1,356 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, etc.
+
+Reference analog: python/paddle/nn/functional/common.py + input.py. TPU-first:
+linear is a plain jnp.matmul the MXU eats directly; dropout uses functional
+PRNG keys (traced-key scope under jit)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...framework.random import get_rng_key
+from ...framework.dtype import to_jax_dtype
+from ...ops._helpers import ensure_tensor, unary, binary, nary, call_op
+from ...ops.registry import register_op
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "cosine_similarity", "pairwise_distance",
+           "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+           "interpolate", "upsample", "unfold", "fold", "label_smooth",
+           "bilinear", "class_center_sample", "normalize"]
+
+
+@register_op("linear", "nn", ref="fluid ops: matmul_v2 + elementwise_add")
+def linear(x, weight, bias=None, name=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    if bias is None:
+        return call_op("linear", lambda v, w: jnp.matmul(v, w), (x, weight))
+    bias = ensure_tensor(bias)
+    return call_op("linear", lambda v, w, b: jnp.matmul(v, w) + b,
+                   (x, weight, bias))
+
+
+@register_op("dropout", "nn")
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return unary("dropout", lambda v: v * (1.0 - p), x)
+        return x.clone() if isinstance(x, Tensor) else x
+    if p == 1.0:
+        return unary("dropout", lambda v: jnp.zeros_like(v), x)
+    key = get_rng_key()
+    shape = list(x._value.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        mask_shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+
+    def fn(v):
+        m = keep.astype(v.dtype)
+        if mode == "upscale_in_train":
+            return v * m / jnp.asarray(1.0 - p, v.dtype)
+        return v * m
+    return unary("dropout", fn, x)
+
+
+def _dropout_nd(x, p, training, data_format, spatial_dims, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x.clone()
+    shape = list(x._value.shape)
+    if data_format.endswith("C"):  # NHWC / NDHWC: channel last
+        mask_shape = [shape[0]] + [1] * spatial_dims + [shape[-1]]
+    else:
+        mask_shape = [shape[0], shape[1]] + [1] * spatial_dims
+    keep = jax.random.bernoulli(get_rng_key(), 1.0 - p, mask_shape)
+
+    def fn(v):
+        return v * keep.astype(v.dtype) / jnp.asarray(1.0 - p, v.dtype)
+    return unary("dropout_nd", fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return _dropout_nd(x, p, training, data_format, 2, name)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return _dropout_nd(x, p, training, data_format, 3, name)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x.clone()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(get_rng_key(), 1.0 - p, x._value.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+
+    def fn(v):
+        m = keep
+        return a * jnp.where(m, v, jnp.asarray(alpha_p, v.dtype)) + b
+    return unary("alpha_dropout", fn, x)
+
+
+@register_op("embedding", "nn", ref="phi/kernels/embedding_kernel.h")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    idx = x._value
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return unary("embedding", fn, weight)
+
+
+@register_op("one_hot", "nn", differentiable=False)
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.nn.one_hot(x._value, num_classes, dtype=jnp.float32))
+
+
+@register_op("cosine_similarity", "nn")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return binary("cosine_similarity", fn, ensure_tensor(x1), ensure_tensor(x2))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1,
+                                 keepdims=keepdim), 1.0 / p)
+    return binary("pairwise_distance", fn, ensure_tensor(x), ensure_tensor(y))
+
+
+@register_op("pixel_shuffle", "nn")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return unary("pixel_shuffle", fn, ensure_tensor(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return unary("pixel_unshuffle", fn, ensure_tensor(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return unary("channel_shuffle", fn, ensure_tensor(x))
+
+
+@register_op("interpolate", "nn")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = ensure_tensor(x)
+    v_shape = x._value.shape
+    channel_last = data_format.endswith("C") and data_format != "NCHW"
+    spatial = v_shape[1:-1] if channel_last else v_shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.numpy().tolist()
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                       for s in (size if isinstance(size, (list, tuple))
+                                 else [size])]
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_spatial = [int(s * f) for s, f in zip(spatial, scale_factor)]
+        else:
+            out_spatial = [int(s * scale_factor) for s in spatial]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(v):
+        if channel_last:
+            out_shape = (v.shape[0],) + tuple(out_spatial) + (v.shape[-1],)
+        else:
+            out_shape = v.shape[:2] + tuple(out_spatial)
+        if mode == "nearest":
+            return jax.image.resize(v, out_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via manual gather
+            return _resize_align_corners(v, out_shape, jmode, channel_last)
+        return jax.image.resize(v, out_shape, method=jmode)
+    return unary("interpolate", fn, x)
+
+
+def _resize_align_corners(v, out_shape, method, channel_last):
+    sp_axes = list(range(1, v.ndim - 1)) if channel_last else \
+        list(range(2, v.ndim))
+    out = v
+    for ax in sp_axes:
+        in_n = out.shape[ax]
+        out_n = out_shape[ax]
+        if in_n == out_n:
+            continue
+        if out_n == 1:
+            idx = jnp.zeros((1,), jnp.float32)
+        else:
+            idx = jnp.linspace(0.0, in_n - 1.0, out_n)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, in_n - 1)
+        w = (idx - lo).astype(v.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = out_n
+        w = w.reshape(shape)
+        lo_vals = jnp.take(out, lo, axis=ax)
+        hi_vals = jnp.take(out, hi, axis=ax)
+        out = lo_vals * (1 - w) + hi_vals * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+@register_op("unfold", "nn")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+
+    def to2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    ks, st, dl = to2(kernel_sizes), to2(strides), to2(dilations)
+    pd = to2(paddings)
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = v[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [n, c, k*k, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return unary("unfold", fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = ensure_tensor(x)
+
+    def to2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    os_, ks, st, dl = to2(output_sizes), to2(kernel_sizes), to2(strides), \
+        to2(dilations)
+    pd = to2(paddings)
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def fn(v):
+        n, ckk, l = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(
+                    v[:, :, i, j])
+        return out[:, :, pd[0]: ph - pd[2], pd[1]: pw - pd[3]]
+    return unary("fold", fn, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    k = label.shape[-1]
+
+    def fn(v):
+        if prior_dist is not None:
+            pd = prior_dist._value if isinstance(prior_dist, Tensor) \
+                else jnp.asarray(prior_dist)
+            return (1 - epsilon) * v + epsilon * pd
+        return (1 - epsilon) * v + epsilon / k
+    return unary("label_smooth", fn, label)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def fn(a, b, w):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out
+    out = nary("bilinear", fn, (x1, x2, weight))
+    if bias is not None:
+        out = out + ensure_tensor(bias)
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    label = ensure_tensor(label)
+    pos = np.unique(np.asarray(label._value))
+    num_extra = max(0, num_samples - len(pos))
+    all_classes = np.arange(num_classes)
+    neg_pool = np.setdiff1d(all_classes, pos)
+    rng = np.random.default_rng(0)
+    extra = rng.choice(neg_pool, size=min(num_extra, len(neg_pool)),
+                       replace=False) if num_extra else np.empty(0, np.int64)
+    sampled = np.sort(np.concatenate([pos, extra]).astype(np.int64))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[np.asarray(label._value)]
+    return Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled))
+
+
+@register_op("normalize", "nn")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return unary("normalize",
+                 lambda v: v / jnp.maximum(
+                     jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis,
+                                       keepdims=True), 1.0 / p), epsilon), x)

@@ -1,0 +1,224 @@
+"""Convolution functionals over jax.lax.conv_general_dilated.
+
+Reference analog: python/paddle/nn/functional/conv.py over phi conv kernels
+(conv_kernel.h, gpudnn). TPU-first: one lax conv op per call — XLA lowers it
+onto the MXU with its own im2col-free tiling; no cudnn-algo selection needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, call_op
+from ...ops.registry import register_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n):
+    """Returns (lax_padding, is_same) where lax_padding is 'SAME'/'VALID' or
+    explicit [(lo,hi)] per spatial dim."""
+    if isinstance(padding, str):
+        return padding.upper(), padding.upper() == "SAME"
+    if isinstance(padding, int):
+        return [(padding, padding)] * n, False
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding], False
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)], False
+    # paddle also accepts [[0,0],[0,0],[lo,hi],...] including batch/channel
+    if len(padding) == n + 2:
+        return [tuple(p) for p in padding[2:]], False
+    return [tuple(p) for p in padding], False
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+        ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n,
+          op_name):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    pad, _ = _norm_padding(padding, n)
+    dn_spec = _dim_numbers(n, channel_last)
+
+    def fn(v, w, *maybe_bias):
+        # paddle weight layout is [out_c, in_c/groups, *spatial] (OIHW-style);
+        # lax wants per dn_spec — OIHW works directly for channel-first, and
+        # for channel-last we transpose to HWIO.
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (1, 0)
+            w = jnp.transpose(w, perm)
+        dn = lax.conv_dimension_numbers(v.shape, w.shape, dn_spec)
+        out = lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16.dtype
+            else None)
+        out = out.astype(v.dtype)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return call_op(op_name, fn, (x, weight, ensure_tensor(bias)))
+    return call_op(op_name, fn, (x, weight))
+
+
+@register_op("conv1d", "conv", ref="phi/kernels/conv_kernel.h")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, df, 1,
+                 "conv1d")
+
+
+@register_op("conv2d", "conv", ref="phi/kernels/conv_kernel.h")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2, "conv2d")
+
+
+@register_op("conv3d", "conv")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, n, op_name,
+                    output_size=None):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    pad, is_same = _norm_padding(padding, n)
+    out_pad = _norm_tuple(output_padding, n) if output_padding else (0,) * n
+    dn_spec = _dim_numbers(n, channel_last)
+
+    def fn(v, w, *maybe_bias):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *spatial]
+        if channel_last:
+            perm = tuple(range(2, 2 + n)) + (0, 1)  # spatial..., I, O
+            wt = jnp.transpose(w, perm)
+        else:
+            wt = w
+        if isinstance(pad, str):
+            lax_pad = pad
+        else:
+            # gradient-of-conv padding: effective kernel k_eff = d*(k-1)+1
+            lax_pad = []
+            for i in range(n):
+                k_eff = dilations[i] * (w.shape[2 + i] - 1) + 1
+                lo, hi = pad[i]
+                lax_pad.append((k_eff - 1 - lo,
+                                k_eff - 1 - hi + out_pad[i]))
+        if groups == 1:
+            dn = lax.conv_dimension_numbers(
+                v.shape,
+                wt.shape if channel_last else
+                (w.shape[1], w.shape[0]) + w.shape[2:],
+                dn_spec)
+            # lax transposed conv: dilate lhs by stride
+            kernel = wt if channel_last else jnp.swapaxes(w, 0, 1)
+            kernel = jnp.flip(kernel, axis=tuple(range(n)) if channel_last
+                              else tuple(range(2, 2 + n)))
+            out = lax.conv_general_dilated(
+                v, kernel, window_strides=(1,) * n, padding=lax_pad,
+                lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=dn)
+        else:
+            outs = []
+            vg = jnp.split(v, groups, axis=-1 if channel_last else 1)
+            wgs = jnp.split(w, groups, axis=0)
+            for gi in range(groups):
+                wk = jnp.swapaxes(wgs[gi], 0, 1)
+                if channel_last:
+                    wk = jnp.transpose(wgs[gi], tuple(range(2, 2 + n)) + (0, 1))
+                    wk = jnp.flip(wk, axis=tuple(range(n)))
+                else:
+                    wk = jnp.flip(wk, axis=tuple(range(2, 2 + n)))
+                dn = lax.conv_dimension_numbers(vg[gi].shape, wk.shape, dn_spec)
+                outs.append(lax.conv_general_dilated(
+                    vg[gi], wk, window_strides=(1,) * n, padding=lax_pad,
+                    lhs_dilation=strides, rhs_dilation=dilations,
+                    dimension_numbers=dn))
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        out = call_op(op_name, fn, (x, weight, ensure_tensor(bias)))
+    else:
+        out = call_op(op_name, fn, (x, weight))
+    if output_size is not None:
+        # crop/verify to requested spatial size
+        want = output_size if isinstance(output_size, (list, tuple)) \
+            else [output_size] * n
+        sl = [slice(None)] * out.ndim
+        base = 1 if channel_last else 2
+        for i in range(n):
+            sl[base + i] = slice(0, int(want[i]))
+        from ...ops.manipulation import strided_slice  # noqa
+        out = out[tuple(sl)]
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, df, 1, "conv1d_transpose",
+                           output_size)
+
+
+@register_op("conv2d_transpose", "conv")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2,
+                           "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3,
+                           "conv3d_transpose", output_size)

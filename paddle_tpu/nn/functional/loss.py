@@ -1,0 +1,373 @@
+"""Loss functionals. Reference analog: python/paddle/nn/functional/loss.py
+over phi cross_entropy/bce/... kernels."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, unary, binary, nary, call_op
+from ...ops.registry import register_op
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+           "binary_cross_entropy", "binary_cross_entropy_with_logits",
+           "mse_loss", "l1_loss", "smooth_l1_loss", "kl_div", "margin_ranking_loss",
+           "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
+           "triplet_margin_loss", "log_loss", "square_error_cost",
+           "sigmoid_focal_loss", "dice_loss", "npair_loss"]
+
+
+def _apply_reduction(out_fn, reduction):
+    if reduction == "mean":
+        return lambda *a: jnp.mean(out_fn(*a))
+    if reduction == "sum":
+        return lambda *a: jnp.sum(out_fn(*a))
+    return out_fn
+
+
+@register_op("cross_entropy", "loss",
+             ref="phi/kernels/cross_entropy_kernel.h; python/paddle/nn/functional/loss.py cross_entropy")
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    n_classes = input.shape[axis]
+
+    if soft_label:
+        def fn(logits, lab, *w):
+            logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+                else jnp.log(jnp.clip(logits, 1e-30, None))
+            loss = -jnp.sum(lab * logp, axis=axis)
+            if w:
+                cw = jnp.sum(lab * w[0], axis=axis)
+                loss = loss * cw
+            return loss
+        args = (input, label) if weight is None else \
+            (input, label, ensure_tensor(weight))
+        return call_op("cross_entropy", _apply_reduction(fn, reduction), args)
+
+    lab_v = label._value
+    if lab_v.ndim == input.ndim and lab_v.shape[axis] == 1:
+        lab_v = jnp.squeeze(lab_v, axis)
+
+    def fn(logits, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.clip(logits, 1e-30, None))
+        lab_idx = jnp.clip(lab_v, 0, n_classes - 1).astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lab_idx, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis)
+        if label_smoothing > 0.0:
+            smooth = jnp.mean(logp, axis=axis)
+            nll = -(1.0 - label_smoothing) * picked - label_smoothing * smooth
+        else:
+            nll = -picked
+        valid = (lab_v != ignore_index)
+        nll = jnp.where(valid, nll, 0.0)
+        if w:
+            cw = jnp.take(w[0], lab_idx, axis=0)
+            nll = nll * cw
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, cw, 0.0))
+                return jnp.sum(nll) / jnp.maximum(denom, 1e-12)
+        if reduction == "mean":
+            denom = jnp.sum(valid.astype(logits.dtype))
+            return jnp.sum(nll) / jnp.maximum(denom, 1.0)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    args = (input,) if weight is None else (input, ensure_tensor(weight))
+    return call_op("cross_entropy", fn, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    from .activation import softmax as softmax_fn
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # reference returns loss with trailing 1-dim
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+@register_op("nll_loss", "loss")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    lab_v = label._value
+
+    def fn(logp, *w):
+        lab_idx = jnp.clip(lab_v, 0, logp.shape[1] - 1).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, lab_idx[:, None], axis=1)[:, 0] \
+            if logp.ndim == 2 else jnp.take_along_axis(
+                logp, jnp.expand_dims(lab_idx, 1), axis=1).squeeze(1)
+        nll = -picked
+        valid = lab_v != ignore_index
+        nll = jnp.where(valid, nll, 0.0)
+        if w:
+            cw = jnp.take(w[0], lab_idx, axis=0)
+            nll = nll * cw
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, cw, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(
+                jnp.sum(valid.astype(logp.dtype)), 1.0)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+    args = (input,) if weight is None else (input, ensure_tensor(weight))
+    return call_op("nll_loss", fn, args)
+
+
+@register_op("binary_cross_entropy", "loss")
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return loss
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return call_op("binary_cross_entropy", _apply_reduction(fn, reduction),
+                   tuple(args))
+
+
+@register_op("binary_cross_entropy_with_logits", "loss")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    pw = ensure_tensor(pos_weight)._value if pos_weight is not None else None
+
+    def fn(x, y, *w):
+        # numerically-stable BCE-with-logits
+        neg_abs = -jnp.abs(x)
+        base = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(neg_abs))
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(x)
+            log_sig_neg = jax.nn.log_sigmoid(-x)
+            base = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if w:
+            base = base * w[0]
+        return base
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return call_op("binary_cross_entropy_with_logits",
+                   _apply_reduction(fn, reduction), tuple(args))
+
+
+@register_op("mse_loss", "loss")
+def mse_loss(input, label, reduction="mean", name=None):
+    return call_op("mse_loss",
+                   _apply_reduction(lambda a, b: jnp.square(a - b), reduction),
+                   (ensure_tensor(input), ensure_tensor(label)))
+
+
+@register_op("l1_loss", "loss")
+def l1_loss(input, label, reduction="mean", name=None):
+    return call_op("l1_loss",
+                   _apply_reduction(lambda a, b: jnp.abs(a - b), reduction),
+                   (ensure_tensor(input), ensure_tensor(label)))
+
+
+@register_op("smooth_l1_loss", "loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        return jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return call_op("smooth_l1_loss", _apply_reduction(fn, reduction),
+                   (ensure_tensor(input), ensure_tensor(label)))
+
+
+@register_op("kl_div", "loss")
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y):
+        loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        return loss
+    base = _apply_reduction(fn, reduction if reduction != "batchmean" else "sum")
+    out = call_op("kl_div", base,
+                  (ensure_tensor(input), ensure_tensor(label)))
+    if reduction == "batchmean":
+        out = out / ensure_tensor(input).shape[0]
+    return out
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, y):
+        return jnp.maximum(-y * (a - b) + margin, 0.0)
+    return call_op("margin_ranking_loss", _apply_reduction(fn, reduction),
+                   (ensure_tensor(input), ensure_tensor(other),
+                    ensure_tensor(label)))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(x, y):
+        return jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+    return call_op("hinge_embedding_loss", _apply_reduction(fn, reduction),
+                   (ensure_tensor(input), ensure_tensor(label)))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return call_op("cosine_embedding_loss", _apply_reduction(fn, reduction),
+                   (ensure_tensor(input1), ensure_tensor(input2),
+                    ensure_tensor(label)))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v + epsilon), p),
+                                     axis=-1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return jnp.maximum(d_pos - d_neg + margin, 0.0)
+    return call_op("triplet_margin_loss", _apply_reduction(fn, reduction),
+                   (ensure_tensor(input), ensure_tensor(positive),
+                    ensure_tensor(negative)))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+    return call_op("log_loss", fn, (ensure_tensor(input), ensure_tensor(label)))
+
+
+def square_error_cost(input, label):
+    return call_op("square_error_cost", lambda a, b: jnp.square(a - b),
+                   (ensure_tensor(input), ensure_tensor(label)))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(x, y, *n):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return loss
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        args.append(ensure_tensor(normalizer))
+    return call_op("sigmoid_focal_loss", _apply_reduction(fn, reduction),
+                   tuple(args))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    lab_v = label._value
+
+    def fn(p):
+        y = jax.nn.one_hot(lab_v.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = 2.0 * jnp.sum(p * y, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y, axis=reduce_dims)
+        return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
+    return call_op("dice_loss", fn, (input,))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor = ensure_tensor(anchor)
+    positive = ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+    lab = labels._value.reshape(-1)
+
+    def fn(a, p):
+        batch = a.shape[0]
+        sim = a @ p.T
+        same = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+    return call_op("npair_loss", fn, (anchor, positive))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via dynamic-programming forward algorithm (lax.scan over time)."""
+    log_probs = ensure_tensor(log_probs)     # [T, B, C] (paddle layout)
+    labels = ensure_tensor(labels)           # [B, L]
+    in_len = ensure_tensor(input_lengths)._value
+    lab_len = ensure_tensor(label_lengths)._value
+    lab = labels._value
+
+    def fn(lp):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended label sequence with blanks
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        first_lab = lp[0, jnp.arange(B), ext[:, 1]]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_lab, neg_inf))
+
+        def logaddexp(a, b):
+            return jnp.logaddexp(a, b)
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            ext_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+            allow_skip = (ext != blank) & (ext != ext_shift2)
+            merged = logaddexp(alpha, a_shift1)
+            merged = jnp.where(allow_skip, logaddexp(merged, a_shift2), merged)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze once past each sequence's input length
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new_alpha, alpha), None
+
+        alpha_T, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        end1 = jnp.take_along_axis(alpha_T, (2 * lab_len)[:, None].astype(jnp.int32),
+                                   axis=1)[:, 0]
+        end2 = jnp.take_along_axis(alpha_T, (2 * lab_len - 1)[:, None].astype(jnp.int32),
+                                   axis=1)[:, 0]
+        ll = jnp.logaddexp(end1, end2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return call_op("ctc_loss", fn, (log_probs,))

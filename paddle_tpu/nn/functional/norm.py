@@ -1,0 +1,223 @@
+"""Normalization functionals. Reference analog: python/paddle/nn/functional/
+norm.py over phi layer_norm/batch_norm kernels. TPU-first: plain jnp reductions
+that XLA fuses; batch-norm running stats are updated functionally on the
+wrapper tensors."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, call_op, unary
+from ...ops.registry import register_op
+
+__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
+           "local_response_norm", "rms_norm"]
+
+
+@register_op("layer_norm", "norm", ref="phi/kernels/layer_norm_kernel.h")
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def fn(v, *wb):
+        m = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - m) * jax.lax.rsqrt(var + epsilon))
+        out = out.astype(v.dtype)
+        if len(wb) >= 1:
+            out = out * wb[0]
+        if len(wb) == 2:
+            out = out + wb[1]
+        return out
+
+    inputs = [x]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        if weight is None:
+            # normalize-then-bias without scale: pass ones for scale slot
+            def fn_b(v, b):
+                m = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+                var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+                out = ((v.astype(jnp.float32) - m) *
+                       jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+                return out + b
+            return call_op("layer_norm", fn_b, (x, ensure_tensor(bias)))
+        inputs.append(ensure_tensor(bias))
+    return call_op("layer_norm", fn, tuple(inputs))
+
+
+@register_op("rms_norm", "norm")
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v, *w):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)) \
+            .astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    if weight is not None:
+        return call_op("rms_norm", fn, (x, ensure_tensor(weight)))
+    return call_op("rms_norm", fn, (x,))
+
+
+@register_op("batch_norm", "norm", ref="phi/kernels/batch_norm_kernel.h")
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = ensure_tensor(x)
+    channel_axis = x.ndim - 1 if data_format.endswith("C") and \
+        data_format != "NCHW" else 1
+    if x.ndim == 2:
+        channel_axis = 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # update running stats in place on the wrapper, outside the grad graph
+        # (reference semantics: running = momentum*running + (1-momentum)*batch)
+        mean_obs = jnp.mean(x._value.astype(jnp.float32), axis=reduce_axes)
+        var_obs = jnp.var(x._value.astype(jnp.float32), axis=reduce_axes)
+        if running_mean is not None:
+            rm = running_mean._value.astype(jnp.float32)
+            running_mean._value = (momentum * rm + (1 - momentum) * mean_obs) \
+                .astype(running_mean._value.dtype)
+        if running_var is not None:
+            n = x.size // x.shape[channel_axis]
+            unbiased = var_obs * n / max(n - 1, 1)
+            rv = running_var._value.astype(jnp.float32)
+            running_var._value = (momentum * rv + (1 - momentum) * unbiased) \
+                .astype(running_var._value.dtype)
+        frozen_mean = frozen_var = None
+    else:
+        frozen_mean = ensure_tensor(running_mean)._value.astype(jnp.float32)
+        frozen_var = ensure_tensor(running_var)._value.astype(jnp.float32)
+
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+
+    def fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        if use_batch_stats:
+            # batch stats inside the traced fn so grads flow through mean/var
+            m = jnp.mean(vf, axis=reduce_axes).reshape(shape)
+            var = jnp.var(vf, axis=reduce_axes).reshape(shape)
+        else:
+            m = frozen_mean.reshape(shape)
+            var = frozen_var.reshape(shape)
+        out = ((vf - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    inputs = [x]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return call_op("batch_norm", fn, tuple(inputs))
+
+
+@register_op("instance_norm", "norm")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_axis = 1
+    reduce_axes = tuple(range(2, x.ndim))
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+
+    def fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=reduce_axes, keepdims=True)
+        var = jnp.var(vf, axis=reduce_axes, keepdims=True)
+        out = ((vf - m) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    inputs = [x]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return call_op("instance_norm", fn, tuple(inputs))
+
+
+@register_op("group_norm", "norm")
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format.endswith("C") and data_format != "NCHW"
+    ch_axis = x.ndim - 1 if channel_last else 1
+    c = x.shape[ch_axis]
+    shape = [1] * x.ndim
+    shape[ch_axis] = c
+
+    def fn(v, *wb):
+        if channel_last:
+            vm = jnp.moveaxis(v, -1, 1)
+        else:
+            vm = v
+        n = vm.shape[0]
+        grouped = vm.reshape((n, num_groups, c // num_groups) + vm.shape[2:])
+        gf = grouped.astype(jnp.float32)
+        axes = tuple(range(2, gf.ndim))
+        m = jnp.mean(gf, axis=axes, keepdims=True)
+        var = jnp.var(gf, axis=axes, keepdims=True)
+        out = ((gf - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
+        out = out.reshape(vm.shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    inputs = [x]
+    if weight is not None:
+        inputs.append(ensure_tensor(weight))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+    return call_op("group_norm", fn, tuple(inputs))
+
+
+@register_op("local_response_norm", "norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        sq = jnp.square(v)
+        ch_axis = 1
+        c = v.shape[ch_axis]
+        half = size // 2
+        pad_width = [(0, 0)] * v.ndim
+        pad_width[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + c, axis=ch_axis)
+        div = jnp.power(k + alpha * acc, beta)
+        return v / div
+    return unary("local_response_norm", fn, x)

@@ -1,0 +1,275 @@
+"""Pooling functionals over lax.reduce_window.
+
+Reference analog: python/paddle/nn/functional/pooling.py over phi pool kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, unary, call_op
+from ...ops.registry import register_op
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _norm(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, is_avg,
+          exclusive=True, ceil_mode=False, channel_last=False, op_name="pool"):
+    x = ensure_tensor(x)
+    kernel = _norm(kernel, n)
+    stride = _norm(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _norm(padding, n)
+        pads = [(pi, pi) for pi in p]
+
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        base_pad = [(0, 0)] + (pads or [(0, 0)] * n) + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        base_pad = [(0, 0), (0, 0)] + (pads or [(0, 0)] * n)
+
+    def fn(v):
+        if pad_mode == "SAME":
+            padding_cfg = "SAME"
+        elif pad_mode == "VALID":
+            padding_cfg = "VALID"
+        else:
+            padding_cfg = base_pad
+            if ceil_mode:
+                padding_cfg = list(base_pad)
+                off = 1 if channel_last else 2
+                for i in range(n):
+                    dim = v.shape[off + i]
+                    lo, hi = padding_cfg[off + i]
+                    out_f = (dim + lo + hi - kernel[i]) / stride[i] + 1
+                    out_c = int(np.ceil(out_f))
+                    need = (out_c - 1) * stride[i] + kernel[i] - (dim + lo + hi)
+                    padding_cfg[off + i] = (lo, hi + max(need, 0))
+        # init must be a CONCRETE scalar (not a traced jnp array) so jax
+        # recognizes the monoid and keeps reduce_window differentiable
+        # under jit(grad(...))
+        zero = np.zeros((), v.dtype)[()]
+        if is_avg:
+            if exclusive and (pads or ceil_mode):
+                ones = jnp.ones_like(v)
+                s = lax.reduce_window(v, zero, lax.add,
+                                      window, strides, padding_cfg)
+                c = lax.reduce_window(ones, zero, lax.add,
+                                      window, strides, padding_cfg)
+                return s / c
+            s = lax.reduce_window(v, zero, lax.add,
+                                  window, strides, padding_cfg)
+            return s / np.prod(kernel)
+        return lax.reduce_window(v, np.asarray(init, v.dtype)[()], reducer,
+                                 window, strides, padding_cfg)
+    return unary(op_name, fn, x)
+
+
+@register_op("max_pool2d", "pooling", ref="phi/kernels/pool_kernel.h")
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, lax.max, -np.inf, False,
+                ceil_mode=ceil_mode, channel_last=data_format == "NHWC",
+                op_name="max_pool2d")
+    if return_mask:
+        mask = _max_pool_mask(ensure_tensor(x), kernel_size, stride, padding, 2,
+                              data_format == "NHWC")
+        return out, mask
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, lax.max, -np.inf, False,
+                ceil_mode=ceil_mode, op_name="max_pool1d")
+    if return_mask:
+        mask = _max_pool_mask(ensure_tensor(x), kernel_size, stride, padding, 1,
+                              False)
+        return out, mask
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, lax.max, -np.inf, False,
+                ceil_mode=ceil_mode, channel_last=data_format == "NDHWC",
+                op_name="max_pool3d")
+    if return_mask:
+        mask = _max_pool_mask(ensure_tensor(x), kernel_size, stride, padding, 3,
+                              data_format == "NDHWC")
+        return out, mask
+    return out
+
+
+def _max_pool_mask(x, kernel, stride, padding, n, channel_last):
+    """Indices of max elements (flattened per spatial window input)."""
+    kernel_t = _norm(kernel, n)
+    stride_t = _norm(stride if stride is not None else kernel, n)
+    p = _norm(padding if not isinstance(padding, str) else 0, n)
+    v = x._value
+    spatial_off = 1 if channel_last else 2
+    spatial = v.shape[spatial_off:spatial_off + n]
+    flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+    shape = [1] * v.ndim
+    for i in range(n):
+        shape[spatial_off + i] = spatial[i]
+    idx_map = jnp.broadcast_to(flat_idx.reshape(shape), v.shape)
+
+    if channel_last:
+        window = (1,) + kernel_t + (1,)
+        strides = (1,) + stride_t + (1,)
+        pads = [(0, 0)] + [(pi, pi) for pi in p] + [(0, 0)]
+    else:
+        window = (1, 1) + kernel_t
+        strides = (1, 1) + stride_t
+        pads = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+
+    def select(acc, cur):
+        acc_v, acc_i = acc
+        cur_v, cur_i = cur
+        take_cur = cur_v > acc_v
+        return (jnp.where(take_cur, cur_v, acc_v),
+                jnp.where(take_cur, cur_i, acc_i))
+
+    _, mask = lax.reduce_window(
+        (v, idx_map.astype(jnp.int32)),
+        (jnp.asarray(-np.inf, v.dtype), jnp.asarray(0, jnp.int32)),
+        select, window, strides, pads)
+    return Tensor(mask.astype(jnp.int64))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, lax.add, 0, True,
+                 exclusive=exclusive, ceil_mode=ceil_mode,
+                 op_name="avg_pool1d")
+
+
+@register_op("avg_pool2d", "pooling")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    if divisor_override:
+        x = ensure_tensor(x)
+        kernel_t = _norm(kernel_size, 2)
+        out = _pool(x, kernel_size, stride, padding, 2, lax.add, 0, False,
+                    channel_last=data_format == "NHWC", op_name="avg_pool2d")
+        return out * (1.0 / divisor_override)
+    return _pool(x, kernel_size, stride, padding, 2, lax.add, 0, True,
+                 exclusive=exclusive, ceil_mode=ceil_mode,
+                 channel_last=data_format == "NHWC", op_name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, lax.add, 0, True,
+                 exclusive=exclusive, ceil_mode=ceil_mode,
+                 channel_last=data_format == "NDHWC", op_name="avg_pool3d")
+
+
+def _adaptive_pool(x, output_size, n, is_avg, channel_last, op_name,
+                   return_mask=False):
+    x = ensure_tensor(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * n
+    output_size = tuple(int(o) if o is not None else None for o in output_size)
+    spatial_off = 1 if channel_last else 2
+    in_spatial = x._value.shape[spatial_off:spatial_off + n]
+    output_size = tuple(o if o is not None else s
+                        for o, s in zip(output_size, in_spatial))
+
+    def fn(v):
+        out = v
+        for i in range(n):
+            ax = spatial_off + i
+            in_n, out_n = in_spatial[i], output_size[i]
+            # adaptive windows: start = floor(j*in/out), end = ceil((j+1)*in/out)
+            starts = [int(np.floor(j * in_n / out_n)) for j in range(out_n)]
+            ends = [int(np.ceil((j + 1) * in_n / out_n)) for j in range(out_n)]
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = lax.slice_in_dim(out, s, e, axis=ax)
+                red = jnp.mean(seg, axis=ax, keepdims=True) if is_avg \
+                    else jnp.max(seg, axis=ax, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=ax)
+        return out
+    out = unary(op_name, fn, x)
+    if return_mask:
+        # compute indices by brute comparison per output cell
+        mask = _adaptive_max_mask(x, output_size, n, channel_last)
+        return out, mask
+    return out
+
+
+def _adaptive_max_mask(x, output_size, n, channel_last):
+    v = np.asarray(x._value)
+    spatial_off = 1 if channel_last else 2
+    in_spatial = v.shape[spatial_off:spatial_off + n]
+    flat = np.arange(int(np.prod(in_spatial))).reshape(in_spatial)
+    out_idx = np.zeros(v.shape[:spatial_off] + tuple(output_size), np.int64)
+    # iterate output cells (host-side; mask path is a rarely-hot debug feature)
+    from itertools import product
+    for cell in product(*[range(o) for o in output_size]):
+        sl = tuple(
+            slice(int(np.floor(c * i / o)), int(np.ceil((c + 1) * i / o)))
+            for c, i, o in zip(cell, in_spatial, output_size))
+        window = v[(Ellipsis,) + sl] if channel_last else \
+            v[(slice(None), slice(None)) + sl]
+        w2 = window.reshape(window.shape[:spatial_off] + (-1,))
+        am = w2.argmax(axis=-1)
+        widx = flat[sl].reshape(-1)
+        out_idx[(slice(None), slice(None)) + cell] = widx[am]
+    return Tensor(jnp.asarray(out_idx))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, True, False,
+                          "adaptive_avg_pool1d")
+
+
+@register_op("adaptive_avg_pool2d", "pooling")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, True, data_format == "NHWC",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, True, data_format == "NDHWC",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, False,
+                          "adaptive_max_pool1d", return_mask)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, False,
+                          "adaptive_max_pool2d", return_mask)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, False,
+                          "adaptive_max_pool3d", return_mask)

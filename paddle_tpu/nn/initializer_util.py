@@ -1,0 +1,59 @@
+"""ParamAttr + parameter materialization.
+
+Reference analog: python/paddle/fluid/param_attr.py (ParamAttr) and
+LayerHelper.create_parameter.
+"""
+from __future__ import annotations
+
+from ..framework.core import Parameter
+from ..framework.dtype import to_jax_dtype
+from . import initializer as I
+
+__all__ = ["ParamAttr", "materialize_parameter"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, (list, tuple)):
+            return [ParamAttr._to_attr(a) for a in arg]
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, I.Initializer):
+            return ParamAttr(initializer=arg)
+        if arg is False:
+            return False
+        raise TypeError(f"Unsupported param attr: {arg!r}")
+
+
+def materialize_parameter(shape, attr=None, dtype="float32", is_bias=False,
+                          default_initializer=None):
+    """Create an initialized Parameter (returns None if attr is False)."""
+    if attr is False:
+        return None
+    attr = ParamAttr._to_attr(attr)
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    shape = [int(s) for s in shape]
+    value = init(tuple(shape), to_jax_dtype(dtype))
+    p = Parameter(value, name=attr.name, trainable=attr.trainable)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p

@@ -1,0 +1,240 @@
+"""Norm layers. Reference analog: python/paddle/nn/layer/norm.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ..initializer_util import materialize_parameter
+from .. import initializer as I
+from .. import functional as F
+from ...framework.core import Tensor
+
+__all__ = ["LayerNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+           "BatchNorm3D", "SyncBatchNorm", "GroupNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm", "RMSNorm",
+           "SpectralNorm"]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = materialize_parameter(
+            self._normalized_shape, attr=weight_attr, dtype=self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.bias = materialize_parameter(
+            self._normalized_shape, attr=bias_attr, dtype=self._dtype,
+            is_bias=True)
+
+    def forward(self, input):
+        return F.layer_norm(input, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}, epsilon={self._epsilon}"
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = materialize_parameter(
+            [hidden_size], attr=weight_attr, dtype=self._dtype,
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = materialize_parameter(
+            [num_features], attr=weight_attr, dtype=self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.bias = materialize_parameter(
+            [num_features], attr=bias_attr, dtype=self._dtype, is_bias=True)
+        self._mean = Tensor(jnp.zeros([num_features], jnp.float32),
+                            persistable=True)
+        self._variance = Tensor(jnp.ones([num_features], jnp.float32),
+                                persistable=True)
+        self.register_buffer("_mean", self._mean)
+        self.register_buffer("_variance", self._variance)
+
+    def forward(self, input):
+        return F.batch_norm(input, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.
+
+    Reference analog: python/paddle/nn/layer/norm.py SyncBatchNorm over
+    sync_batch_norm_op. TPU-first: under pjit/shard_map the batch axis is a
+    mesh axis; stats sync happens automatically via psum when traced inside
+    shard_map. In eager single-process mode it behaves like BatchNorm.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            out = cls(layer._num_features, layer._momentum, layer._epsilon,
+                      data_format=layer._data_format)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+        for name, sub in list(layer._sub_layers.items()):
+            converted = cls.convert_sync_batchnorm(sub)
+            if converted is not sub:
+                out.add_sublayer(name, converted)
+        return out
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = materialize_parameter(
+            [num_channels], attr=weight_attr, dtype=self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.bias = materialize_parameter(
+            [num_channels], attr=bias_attr, dtype=self._dtype, is_bias=True)
+
+    def forward(self, input):
+        return F.group_norm(input, self._num_groups, self._epsilon,
+                            self.weight, self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is False or bias_attr is False:
+            self.scale = None
+            self.bias = None
+        else:
+            self.scale = materialize_parameter(
+                [num_features], attr=weight_attr, dtype=self._dtype,
+                default_initializer=I.Constant(1.0))
+            self.bias = materialize_parameter(
+                [num_features], attr=bias_attr, dtype=self._dtype, is_bias=True)
+
+    def forward(self, input):
+        return F.instance_norm(input, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, input):
+        return F.local_response_norm(input, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor via power iteration.
+    Reference: python/paddle/nn/layer/norm.py SpectralNorm (spectral_norm op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = materialize_parameter(
+            [h], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = materialize_parameter(
+            [w], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        from ...ops._helpers import ensure_tensor, call_op
+        x = ensure_tensor(x)
+        dim = self._dim
+        u_t, v_t = self.weight_u, self.weight_v
+
+        # power iteration outside the grad graph
+        wm = jnp.moveaxis(x._value, dim, 0).reshape(x.shape[dim], -1) \
+            .astype(jnp.float32)
+        u = u_t._value
+        v = v_t._value
+        for _ in range(self._power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + self._eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + self._eps)
+        u_t._value = u
+        v_t._value = v
+
+        def fn(w):
+            wmat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            sigma = u @ (wmat.astype(jnp.float32) @ v)
+            return w / sigma.astype(w.dtype)
+        return call_op("spectral_norm", fn, (x,))

@@ -1,0 +1,367 @@
+"""Recurrent layers over lax.scan.
+
+Reference analog: python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU over the
+cudnn rnn op / rnn_op). TPU-first: the time loop is a single `lax.scan`
+(compiler-friendly static control flow), gates are fused matmuls.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ..initializer_util import materialize_parameter
+from .. import initializer as I
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, call_op
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN", "LSTM",
+           "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        state_shape = [batch, self.hidden_size]
+        from ...ops.creation import full
+        return full(state_shape, init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = materialize_parameter([hidden_size, input_size],
+                                               weight_ih_attr, self._dtype,
+                                               default_initializer=u)
+        self.weight_hh = materialize_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, self._dtype,
+                                               default_initializer=u)
+        self.bias_ih = materialize_parameter([hidden_size], bias_ih_attr,
+                                             self._dtype, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = materialize_parameter([hidden_size], bias_hh_attr,
+                                             self._dtype, is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else \
+            (lambda v: jnp.maximum(v, 0))
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = call_op("simple_rnn_cell", fn,
+                    (ensure_tensor(inputs), ensure_tensor(states),
+                     self.weight_ih, self.weight_hh, self.bias_ih,
+                     self.bias_hh))
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = materialize_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, self._dtype,
+                                               default_initializer=u)
+        self.weight_hh = materialize_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, self._dtype,
+                                               default_initializer=u)
+        self.bias_ih = materialize_parameter([4 * hidden_size], bias_ih_attr,
+                                             self._dtype, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = materialize_parameter([4 * hidden_size], bias_hh_attr,
+                                             self._dtype, is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+            states = (h, c)
+        h_prev, c_prev = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        from ...ops._helpers import call_op_multi
+        h, c = call_op_multi("lstm_cell", fn,
+                             (ensure_tensor(inputs), ensure_tensor(h_prev),
+                              ensure_tensor(c_prev), self.weight_ih,
+                              self.weight_hh, self.bias_ih, self.bias_hh), 2)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = materialize_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, self._dtype,
+                                               default_initializer=u)
+        self.weight_hh = materialize_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, self._dtype,
+                                               default_initializer=u)
+        self.bias_ih = materialize_parameter([3 * hidden_size], bias_ih_attr,
+                                             self._dtype, is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = materialize_parameter([3 * hidden_size], bias_hh_attr,
+                                             self._dtype, is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = call_op("gru_cell", fn,
+                    (ensure_tensor(inputs), ensure_tensor(states),
+                     self.weight_ih, self.weight_hh, self.bias_ih,
+                     self.bias_hh))
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over time with lax.scan. Reference: nn/layer/rnn.py RNN."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager loop keeping the cell abstraction (the multi-layer wrappers
+        # below use the fused scan path)
+        inputs = ensure_tensor(inputs)
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        from ...ops.manipulation import stack, unbind
+        xs = unbind(inputs, axis)
+        for t in order:
+            out, states = self.cell(xs[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fw_states = self.rnn_fw(inputs, st_fw)
+        out_bw, bw_states = self.rnn_bw(inputs, st_bw)
+        from ...ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (fw_states, bw_states)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent net with a fused
+    lax.scan over time per layer/direction."""
+
+    MODE = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        gate_mult = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = "_reverse" if d == 1 else ""
+                wi = materialize_parameter([gate_mult * hidden_size, in_sz],
+                                           weight_ih_attr, self._dtype,
+                                           default_initializer=u)
+                wh = materialize_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    self._dtype, default_initializer=u)
+                bi = materialize_parameter([gate_mult * hidden_size],
+                                           bias_ih_attr, self._dtype,
+                                           is_bias=True, default_initializer=u)
+                bh = materialize_parameter([gate_mult * hidden_size],
+                                           bias_hh_attr, self._dtype,
+                                           is_bias=True, default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def _cell_step(self, mode):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c_new = f * c + i * g
+                h_new = o * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+        elif mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                xg = x @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h_new = (1 - z) * n + z * h
+                return (h_new,), h_new
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else \
+                (lambda v: jnp.maximum(v, 0))
+
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                h_new = act(x @ wi.T + bi + h @ wh.T + bh)
+                return (h_new,), h_new
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        num_dirs = 2 if self.bidirect else 1
+        mode = self.MODE
+        step = self._cell_step(mode)
+        is_lstm = mode == "LSTM"
+        time_major = self.time_major
+        num_layers = self.num_layers
+        hidden = self.hidden_size
+
+        flat_weights = [w for group in self._all_weights for w in group]
+
+        def fn(x, *weights):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # -> [T, B, C]
+            batch = x.shape[1]
+            h_states = []
+            c_states = []
+            out = x
+            wi_idx = 0
+            for layer in range(num_layers):
+                dir_outs = []
+                for d in range(num_dirs):
+                    wi, wh, bi, bh = weights[wi_idx:wi_idx + 4]
+                    wi_idx += 4
+                    h0 = jnp.zeros((batch, hidden), x.dtype)
+                    carry = (h0, jnp.zeros((batch, hidden), x.dtype)) \
+                        if is_lstm else (h0,)
+                    seq = jnp.flip(out, 0) if d == 1 else out
+
+                    def scan_fn(c, xt, _wi=wi, _wh=wh, _bi=bi, _bh=bh):
+                        return step(c, xt, _wi, _wh, _bi, _bh)
+                    final, ys = jax.lax.scan(scan_fn, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    h_states.append(final[0])
+                    if is_lstm:
+                        c_states.append(final[1])
+                out = jnp.concatenate(dir_outs, axis=-1) if num_dirs == 2 \
+                    else dir_outs[0]
+            h_all = jnp.stack(h_states)  # [L*D, B, H]
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return out, h_all, jnp.stack(c_states)
+            return out, h_all
+
+        from ...ops._helpers import call_op_multi
+        n_out = 3 if is_lstm else 2
+        outs = call_op_multi(f"rnn_{mode.lower()}", fn,
+                             tuple([inputs] + flat_weights), n_out)
+        if is_lstm:
+            return outs[0], (outs[1], outs[2])
+        return outs[0], outs[1]
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self.MODE = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,127 @@
+"""Op corpus assembly + Tensor method patching.
+
+Reference analog: python/paddle/tensor/__init__.py (tensor_method_func list)
+and pybind/eager_math_op_patch.cc (operator overloads on the eager Tensor).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from . import creation, math, logic, manipulation, linalg, search, random_ops
+from . import einsum_op
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .einsum_op import einsum  # noqa: F401
+from .registry import all_ops, get_op, register_op, override_kernel  # noqa: F401
+from ._helpers import ensure_tensor
+
+
+# ---------------------------------------------------------------------------
+# Tensor operator overloads (eager_math_op_patch.cc analog)
+# ---------------------------------------------------------------------------
+
+def _patch_operators():
+    T = Tensor
+    T.__add__ = lambda self, other: math.add(self, other)
+    T.__radd__ = lambda self, other: math.add(other, self)
+    T.__sub__ = lambda self, other: math.subtract(self, other)
+    T.__rsub__ = lambda self, other: math.subtract(other, self)
+    T.__mul__ = lambda self, other: math.multiply(self, other)
+    T.__rmul__ = lambda self, other: math.multiply(other, self)
+    T.__truediv__ = lambda self, other: math.divide(self, other)
+    T.__rtruediv__ = lambda self, other: math.divide(other, self)
+    T.__floordiv__ = lambda self, other: math.floor_divide(self, other)
+    T.__rfloordiv__ = lambda self, other: math.floor_divide(other, self)
+    T.__mod__ = lambda self, other: math.mod(self, other)
+    T.__rmod__ = lambda self, other: math.mod(other, self)
+    T.__pow__ = lambda self, other: math.pow(self, other)
+    T.__rpow__ = lambda self, other: math.pow(other, self)
+    T.__matmul__ = lambda self, other: math.matmul(self, other)
+    T.__rmatmul__ = lambda self, other: math.matmul(other, self)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__invert__ = lambda self: logic.logical_not(self) \
+        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_not(self)
+    T.__and__ = lambda self, other: logic.logical_and(self, other) \
+        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_and(self, other)
+    T.__or__ = lambda self, other: logic.logical_or(self, other) \
+        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_or(self, other)
+    T.__xor__ = lambda self, other: logic.logical_xor(self, other) \
+        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_xor(self, other)
+    T.__eq__ = lambda self, other: logic.equal(self, other)
+    T.__ne__ = lambda self, other: logic.not_equal(self, other)
+    T.__lt__ = lambda self, other: logic.less_than(self, other)
+    T.__le__ = lambda self, other: logic.less_equal(self, other)
+    T.__gt__ = lambda self, other: logic.greater_than(self, other)
+    T.__ge__ = lambda self, other: logic.greater_equal(self, other)
+
+    def _getitem(self, item):
+        from .dispatch import call_op
+
+        def norm_item(it):
+            if isinstance(it, Tensor):
+                v = it._value
+                return v
+            if isinstance(it, (list,)):
+                return jnp.asarray(it)
+            if isinstance(it, tuple):
+                return tuple(norm_item(i) for i in it)
+            return it
+        nit = norm_item(item)
+        return call_op("getitem", lambda v: v[nit], (self,))
+
+    def _setitem(self, item, value):
+        def norm_item(it):
+            if isinstance(it, Tensor):
+                return it._value
+            if isinstance(it, list):
+                return jnp.asarray(it)
+            if isinstance(it, tuple):
+                return tuple(norm_item(i) for i in it)
+            return it
+        nit = norm_item(item)
+        val = value._value if isinstance(value, Tensor) else value
+        self._value = self._value.at[nit].set(val)
+
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # -- method attachment (tensor_method_func analog) ----------------------
+    method_sources = [creation, math, logic, manipulation, linalg, search,
+                      random_ops]
+    skip = {"to_tensor", "meshgrid", "zeros", "ones", "full", "arange",
+            "linspace", "logspace", "eye", "empty", "rand", "randn", "randint",
+            "uniform", "normal", "randperm", "tril_indices", "triu_indices",
+            "complex", "vander", "scatter_nd", "einsum"}
+    for mod in method_sources:
+        for fname in getattr(mod, "__all__", []):
+            if fname in skip or hasattr(T, fname):
+                continue
+            fn = getattr(mod, fname)
+            if callable(fn):
+                setattr(T, fname, fn)
+    # explicit useful aliases
+    T.matmul = math.matmul
+    T.mm = math.mm
+    T.dot = math.dot
+    T.norm = linalg.norm
+
+
+_patch_operators()
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors. Reference: paddle.add_n (sum_op)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    from ._helpers import nary
+    import functools
+    import operator
+    return nary("add_n", lambda *vs: functools.reduce(operator.add, vs),
+                list(inputs))

@@ -1,0 +1,64 @@
+"""Shared helpers for op wrappers."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import get_default_dtype, to_jax_dtype
+from .dispatch import call_op, call_op_multi
+
+__all__ = ["ensure_tensor", "unary", "binary", "nary", "scalar_or_value",
+           "call_op", "call_op_multi", "axis_tuple"]
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (numbers.Number, np.bool_)):
+        return Tensor(jnp.asarray(x))
+    return Tensor(jnp.asarray(x, dtype=to_jax_dtype(dtype) if dtype else None))
+
+
+def unary(name, fn, x):
+    """Dispatch fn(x) where all non-tensor args are closed over in fn."""
+    return call_op(name, fn, (ensure_tensor(x),))
+
+
+def binary(name, fn, x, y):
+    """Dispatch fn(x, y), keeping python scalars as closures (they carry no
+    grad and shouldn't force weak-type promotion surprises)."""
+    x_is_t = isinstance(x, Tensor)
+    y_is_t = isinstance(y, Tensor)
+    if x_is_t and y_is_t:
+        return call_op(name, fn, (x, y))
+    if x_is_t:
+        return call_op(name, lambda a: fn(a, y if isinstance(y, numbers.Number)
+                                          else jnp.asarray(y)), (x,))
+    if y_is_t:
+        return call_op(name, lambda b: fn(x if isinstance(x, numbers.Number)
+                                          else jnp.asarray(x), b), (y,))
+    return call_op(name, fn, (ensure_tensor(x), ensure_tensor(y)))
+
+
+def nary(name, fn, tensors):
+    return call_op(name, fn, tuple(ensure_tensor(t) for t in tensors))
+
+
+def scalar_or_value(v):
+    """Extract a python scalar / numpy value from Tensor-or-scalar attrs."""
+    if isinstance(v, Tensor):
+        return v._value
+    return v
+
+
+def axis_tuple(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(a % ndim for a in axis)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return (axis % ndim,)

@@ -1,0 +1,185 @@
+"""Tensor creation ops. Reference analog: python/paddle/tensor/creation.py
+backed by phi full/arange/eye/... kernels (phi/kernels/full_kernel.h etc.)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_tensor
+from ..framework.dtype import get_default_dtype, to_jax_dtype
+from .registry import register_op
+from ._helpers import ensure_tensor, unary, call_op, scalar_or_value
+
+__all__ = [
+    "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
+    "arange", "linspace", "logspace", "eye", "empty", "empty_like", "assign",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "clone", "to_tensor",
+    "tril_indices", "triu_indices", "complex",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return to_jax_dtype(default or get_default_dtype())
+    return to_jax_dtype(dtype)
+
+
+@register_op("zeros", "creation", ref="python/paddle/tensor/creation.py")
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+@register_op("ones", "creation")
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+@register_op("full", "creation")
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = scalar_or_value(fill_value)
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+@register_op("zeros_like", "creation")
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.zeros(x._value.shape, _dt(dtype) if dtype else x._value.dtype))
+
+
+@register_op("ones_like", "creation")
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.ones(x._value.shape, _dt(dtype) if dtype else x._value.dtype))
+
+
+@register_op("full_like", "creation")
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.full(x._value.shape, scalar_or_value(fill_value),
+                           _dt(dtype) if dtype else x._value.dtype))
+
+
+@register_op("arange", "creation")
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = scalar_or_value(start)
+    end = scalar_or_value(end)
+    step = scalar_or_value(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+@register_op("linspace", "creation")
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(scalar_or_value(start), scalar_or_value(stop),
+                               int(scalar_or_value(num)), dtype=_dt(dtype)))
+
+
+@register_op("logspace", "creation")
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(scalar_or_value(start), scalar_or_value(stop),
+                               int(scalar_or_value(num)), base=base,
+                               dtype=_dt(dtype)))
+
+
+@register_op("eye", "creation")
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@register_op("empty", "creation")
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+@register_op("empty_like", "creation")
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@register_op("assign", "creation")
+def assign(x, output=None):
+    x = ensure_tensor(x)
+    out = unary("assign", lambda v: jnp.asarray(v), x)
+    if output is not None:
+        output._assign_value_(out._value)
+        return output
+    return out
+
+
+@register_op("diag", "creation")
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        def fn(v):
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return unary("diag", fn, x)
+    return unary("diag", lambda v: jnp.diag(v, k=offset), x)
+
+
+@register_op("diagflat", "creation")
+def diagflat(x, offset=0, name=None):
+    return unary("diagflat", lambda v: jnp.diagflat(v, k=offset), ensure_tensor(x))
+
+
+@register_op("tril", "creation")
+def tril(x, diagonal=0, name=None):
+    return unary("tril", lambda v: jnp.tril(v, k=diagonal), ensure_tensor(x))
+
+
+@register_op("triu", "creation")
+def triu(x, diagonal=0, name=None):
+    return unary("triu", lambda v: jnp.triu(v, k=diagonal), ensure_tensor(x))
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col if col is not None else row)
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+@register_op("meshgrid", "creation")
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    tensors = [ensure_tensor(a) for a in args]
+    outs = jnp.meshgrid(*[t._value for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+@register_op("clone", "creation")
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+@register_op("complex", "creation")
+def complex(real, imag, name=None):
+    from ._helpers import binary
+    return binary("complex", jax.lax.complex, ensure_tensor(real), ensure_tensor(imag))

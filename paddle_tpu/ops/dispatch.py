@@ -1,0 +1,125 @@
+"""Op dispatch: the single funnel every eager op call goes through.
+
+Reference analog: the generated `<op>_ad_func` forwards
+(eager/auto_code_generator/generator/eager_gen.py:1217) — AMP cast, kernel
+call, GradNode creation + Edge wiring. TPU-first: the "kernel" is a jax
+callable; when grad is required the VJP is captured at forward time via
+`jax.vjp`, so residuals are device arrays and backward is XLA-compiled.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import GradNode, is_grad_enabled
+
+__all__ = ["call_op", "call_op_multi"]
+
+
+def _values(tensors):
+    return tuple(t._value for t in tensors)
+
+
+def _differentiable(t):
+    return (not t.stop_gradient) and jnp.issubdtype(t._value.dtype, jnp.inexact)
+
+
+def _requires_grad(tensors):
+    return is_grad_enabled() and any(_differentiable(t) for t in tensors)
+
+
+def _amp_transform(op_name, tensors):
+    """Apply AMP autocast policy if active (mirrors eager amp_utils.h)."""
+    from ..amp.auto_cast import amp_cast_inputs
+    return amp_cast_inputs(op_name, tensors)
+
+
+def _make_edges(tensors):
+    edges = []
+    for t in tensors:
+        if not _differentiable(t):
+            edges.append(None)
+        else:
+            node = t._grad_node if t._grad_node is not None else t._ensure_grad_node()
+            edges.append((node, t._out_index))
+    return edges
+
+
+def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Tensor:
+    """Dispatch a single-output op. `fn` maps jax values -> jax value; all
+    non-tensor arguments must already be closed over in `fn`."""
+    inputs = _amp_transform(name, inputs)
+    vals = _values(inputs)
+    if not _requires_grad(inputs):
+        return Tensor(fn(*vals), stop_gradient=True)
+
+    diff_mask = [_differentiable(t) for t in inputs]
+    if all(diff_mask):
+        out_val, vjp_fn = jax.vjp(fn, *vals)
+        wrapped_vjp = vjp_fn
+    else:
+        # only differentiate w.r.t. non-stop-gradient inputs; close over the rest
+        diff_idx = [i for i, d in enumerate(diff_mask) if d]
+
+        def partial_fn(*diff_vals):
+            full = list(vals)
+            for i, v in zip(diff_idx, diff_vals):
+                full[i] = v
+            return fn(*full)
+
+        out_val, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
+
+        def wrapped_vjp(g, _vjp=vjp_fn, _idx=diff_idx, _n=len(inputs)):
+            partial = _vjp(g)
+            full = [None] * _n
+            for i, pg in zip(_idx, partial):
+                full[i] = pg
+            return tuple(full)
+
+    node = GradNode(name, wrapped_vjp, _make_edges(inputs),
+                    ((out_val.shape, out_val.dtype),))
+    out = Tensor(out_val, stop_gradient=False)
+    out._grad_node = node
+    out._out_index = 0
+    return out
+
+
+def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
+                  num_outputs: int) -> list:
+    """Dispatch an op whose fn returns a tuple of `num_outputs` jax values."""
+    inputs = _amp_transform(name, inputs)
+    vals = _values(inputs)
+    if not _requires_grad(inputs):
+        out_vals = fn(*vals)
+        return [Tensor(v, stop_gradient=True) for v in out_vals]
+
+    diff_mask = [_differentiable(t) for t in inputs]
+    diff_idx = [i for i, d in enumerate(diff_mask) if d]
+
+    def partial_fn(*diff_vals):
+        full = list(vals)
+        for i, v in zip(diff_idx, diff_vals):
+            full[i] = v
+        return fn(*full)
+
+    out_vals, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
+
+    def wrapped_vjp(gs, _vjp=vjp_fn, _idx=diff_idx, _n=len(inputs)):
+        partial = _vjp(gs)
+        full = [None] * _n
+        for i, pg in zip(_idx, partial):
+            full[i] = pg
+        return tuple(full)
+
+    node = GradNode(name, wrapped_vjp, _make_edges(inputs),
+                    tuple((v.shape, v.dtype) for v in out_vals))
+    outs = []
+    for j, v in enumerate(out_vals):
+        t = Tensor(v, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = j
+        outs.append(t)
+    return outs

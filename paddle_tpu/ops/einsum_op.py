@@ -1,0 +1,16 @@
+"""einsum. Reference analog: python/paddle/tensor/einsum.py (pure-python
+planner over matmul); here XLA's native einsum lowering does the planning."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+from ._helpers import ensure_tensor, nary
+
+__all__ = ["einsum"]
+
+
+@register_op("einsum", "math")
+def einsum(equation, *operands):
+    tensors = [ensure_tensor(o) for o in operands]
+    return nary("einsum", lambda *vs: jnp.einsum(equation, *vs), tensors)

@@ -1,0 +1,301 @@
+"""Linear algebra ops. Reference analog: python/paddle/tensor/linalg.py backed
+by phi linalg kernels (svd/qr/cholesky/...). On TPU, decompositions lower to
+XLA's linalg custom calls."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .registry import register_op
+from ._helpers import ensure_tensor, unary, binary, nary, call_op, call_op_multi
+
+__all__ = [
+    "norm", "dist", "cond", "inv", "pinv", "det", "slogdet", "svd", "qr",
+    "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
+    "cholesky", "cholesky_solve", "solve", "triangular_solve", "lstsq", "lu",
+    "cross", "histogram", "bincount", "multi_dot", "corrcoef", "cov",
+    "householder_product", "vander", "pca_lowrank",
+]
+
+
+@register_op("norm", "linalg")
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis is None:
+            flat = v.reshape(-1)
+            if p in ("fro", 2, 2.0):
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p in ("inf", float("inf"), np.inf):
+                return jnp.max(jnp.abs(flat))
+            if p in ("-inf", float("-inf"), -np.inf):
+                return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(v.dtype))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro" or (isinstance(ax, tuple) and p in (2, 2.0)):
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p in ("inf", float("inf"), np.inf):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p in ("-inf", float("-inf"), -np.inf):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(v), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax,
+                                 keepdims=keepdim), 1.0 / p)
+    return unary("norm", fn, x)
+
+
+@register_op("dist", "linalg")
+def dist(x, y, p=2, name=None):
+    return binary("dist", lambda a, b: _pnorm_flat(a - b, p), x, y)
+
+
+def _pnorm_flat(v, p):
+    flat = v.reshape(-1)
+    if p in ("inf", float("inf"), np.inf):
+        return jnp.max(jnp.abs(flat))
+    if p in ("-inf", float("-inf"), -np.inf):
+        return jnp.min(jnp.abs(flat))
+    if p == 0:
+        return jnp.sum((flat != 0).astype(flat.dtype))
+    if p == 1:
+        return jnp.sum(jnp.abs(flat))
+    if p == 2:
+        return jnp.sqrt(jnp.sum(flat * flat))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+
+
+@register_op("cond", "linalg")
+def cond(x, p=None, name=None):
+    return unary("cond", lambda v: jnp.linalg.cond(v, p=p), ensure_tensor(x))
+
+
+@register_op("inv", "linalg")
+def inv(x, name=None):
+    return unary("inv", jnp.linalg.inv, ensure_tensor(x))
+
+
+@register_op("pinv", "linalg")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return unary("pinv", lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                                   hermitian=hermitian),
+                 ensure_tensor(x))
+
+
+@register_op("det", "linalg")
+def det(x, name=None):
+    return unary("det", jnp.linalg.det, ensure_tensor(x))
+
+
+@register_op("slogdet", "linalg")
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        s, l = jnp.linalg.slogdet(v)
+        return jnp.stack([s, l]) if s.ndim == 0 else jnp.stack([s, l])
+    return unary("slogdet", fn, x)
+
+
+@register_op("svd", "linalg")
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    return call_op_multi("svd", fn, (x,), num_outputs=3)
+
+
+@register_op("qr", "linalg")
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return unary("qr", lambda v: jnp.linalg.qr(v, mode="r"), x)
+
+    def fn(v):
+        q, r = jnp.linalg.qr(v, mode=mode)
+        return q, r
+    return call_op_multi("qr", fn, (x,), num_outputs=2)
+
+
+@register_op("eig", "linalg", differentiable=False)
+def eig(x, name=None):
+    w, v = np.linalg.eig(np.asarray(ensure_tensor(x)._value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+@register_op("eigh", "linalg")
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        w, vec = jnp.linalg.eigh(v, UPLO=UPLO)
+        return w, vec
+    return call_op_multi("eigh", fn, (x,), num_outputs=2)
+
+
+@register_op("eigvals", "linalg", differentiable=False)
+def eigvals(x, name=None):
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(ensure_tensor(x)._value))))
+
+
+@register_op("eigvalsh", "linalg")
+def eigvalsh(x, UPLO="L", name=None):
+    return unary("eigvalsh", lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO),
+                 ensure_tensor(x))
+
+
+@register_op("matrix_power", "linalg")
+def matrix_power(x, n, name=None):
+    return unary("matrix_power", lambda v: jnp.linalg.matrix_power(v, n),
+                 ensure_tensor(x))
+
+
+@register_op("matrix_rank", "linalg", differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.linalg.matrix_rank(x._value, rtol=tol).astype(jnp.int64))
+
+
+@register_op("cholesky", "linalg")
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return unary("cholesky", fn, ensure_tensor(x))
+
+
+@register_op("cholesky_solve", "linalg")
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, chol):
+        c = jnp.swapaxes(chol, -1, -2) if upper else chol
+        z = jax.scipy.linalg.solve_triangular(c, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(c, -1, -2), z, lower=False)
+    return binary("cholesky_solve", fn, x, y)
+
+
+@register_op("solve", "linalg")
+def solve(x, y, name=None):
+    return binary("solve", jnp.linalg.solve, x, y)
+
+
+@register_op("triangular_solve", "linalg")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return binary("triangular_solve", fn, x, y)
+
+
+@register_op("lstsq", "linalg", differentiable=False)
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank.astype(jnp.int64)), Tensor(sv))
+
+
+@register_op("lu", "linalg", differentiable=False)
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    x = ensure_tensor(x)
+    lu_mat, piv = jsl.lu_factor(x._value)
+    outs = [Tensor(lu_mat), Tensor((piv + 1).astype(jnp.int32))]
+    if get_infos:
+        outs.append(Tensor(jnp.zeros((), jnp.int32)))
+    return tuple(outs)
+
+
+@register_op("cross", "linalg")
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return binary("cross", lambda a, b: jnp.cross(a, b, axis=axis), x, y)
+
+
+@register_op("histogram", "linalg", differentiable=False)
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = ensure_tensor(input)._value.reshape(-1)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+    if lo is None:
+        lo = float(jnp.min(x))
+        hi = float(jnp.max(x))
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(jnp.int64))
+
+
+@register_op("bincount", "linalg", differentiable=False)
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)._value
+    w = ensure_tensor(weights)._value if weights is not None else None
+    n = int(jnp.max(x)) + 1 if x.size else 0
+    length = max(n, minlength)
+    return Tensor(jnp.bincount(x, weights=w, length=length))
+
+
+@register_op("multi_dot", "linalg")
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return nary("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), tensors)
+
+
+@register_op("corrcoef", "linalg")
+def corrcoef(x, rowvar=True, name=None):
+    return unary("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar),
+                 ensure_tensor(x))
+
+
+@register_op("cov", "linalg")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = ensure_tensor(fweights)._value if fweights is not None else None
+    aw = ensure_tensor(aweights)._value if aweights is not None else None
+    return unary("cov", lambda v: jnp.cov(v, rowvar=rowvar,
+                                          ddof=1 if ddof else 0,
+                                          fweights=fw, aweights=aw),
+                 ensure_tensor(x))
+
+
+@register_op("householder_product", "linalg")
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for i in range(n):
+            v = jnp.zeros(a.shape[:-1], a.dtype).at[..., i].set(1.0)
+            v = v.at[..., i + 1:].set(a[..., i + 1:, i])
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * \
+                (v[..., :, None] @ v[..., None, :])
+            q = q @ h
+        return q[..., :, :n] if m > n else q
+    return binary("householder_product", fn, x, tau)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return unary("vander", lambda v: jnp.vander(v, N=n, increasing=increasing),
+                 ensure_tensor(x))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    if q is None:
+        q = min(6, v.shape[-2], v.shape[-1])
+    if center:
+        v = v - jnp.mean(v, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(v, full_matrices=False)
+    return (Tensor(u[..., :q]), Tensor(s[..., :q]),
+            Tensor(jnp.swapaxes(vh, -1, -2)[..., :q]))

@@ -1,0 +1,58 @@
+"""Comparison / logical / bitwise ops.
+
+Reference analog: python/paddle/tensor/logic.py + phi compare/logical kernels.
+All comparison outputs are bool tensors and non-differentiable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .registry import register_op
+from ._helpers import ensure_tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "is_tensor",
+]
+
+
+def _cmp(name, fn):
+    @register_op(name, "logic", differentiable=False)
+    def op(x, y=None, name=None, _fn=fn):
+        xv = ensure_tensor(x)._value
+        if y is None:
+            return Tensor(_fn(xv))
+        yv = ensure_tensor(y)._value
+        return Tensor(_fn(xv, yv))
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+logical_not = _cmp("logical_not", jnp.logical_not)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _cmp("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+@register_op("is_empty", "logic", differentiable=False)
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,628 @@
+"""Shape/layout manipulation ops.
+
+Reference analog: python/paddle/tensor/manipulation.py backed by phi
+reshape/transpose/concat/gather/scatter kernels. TPU-first: gather/scatter use
+jax `.at[]` functional updates (XLA scatter), keeping everything static-shaped
+where possible; dynamic-shape ops (nonzero/unique/masked_select) are host-sync
+points, documented as such.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import to_jax_dtype
+from .registry import register_op
+from ._helpers import ensure_tensor, unary, binary, nary, call_op, call_op_multi
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "stack", "split", "chunk",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "tile", "flip", "roll",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add", "index_put",
+    "slice", "strided_slice", "take_along_axis", "put_along_axis",
+    "masked_select", "masked_fill", "where", "unbind", "unique",
+    "unique_consecutive", "pad", "repeat_interleave", "rot90", "moveaxis",
+    "swapaxes", "as_complex", "as_real", "cast", "tensordot", "unstack",
+    "take", "tolist", "crop", "fill_diagonal_", "view", "view_as", "unfold",
+    "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter", "diagonal_scatter",
+]
+
+
+@register_op("reshape", "manipulation", ref="phi/kernels/reshape_kernel.h")
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    return unary("reshape", lambda v: jnp.reshape(v, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, ensure_tensor(other).shape)
+
+
+@register_op("transpose", "manipulation")
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = [int(p) for p in perm]
+    return unary("transpose", lambda v: jnp.transpose(v, perm), x)
+
+
+@register_op("cast", "manipulation")
+def cast(x, dtype):
+    return ensure_tensor(x).astype(dtype)
+
+
+@register_op("concat", "manipulation")
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return nary("concat", lambda *vs: jnp.concatenate(vs, axis=axis), tensors)
+
+
+@register_op("stack", "manipulation")
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return nary("stack", lambda *vs: jnp.stack(vs, axis=axis), tensors)
+
+
+@register_op("split", "manipulation")
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"paddle.split: axis {axis} size {dim} is not divisible by "
+                f"num_or_sections={n}")
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - builtins_sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(v):
+        return tuple(jax.lax.slice_in_dim(v, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return call_op_multi("split", fn, (x,), num_outputs=len(sizes))
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+@register_op("chunk", "manipulation")
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@register_op("squeeze", "manipulation")
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % max(x.ndim, 1) for a in axes if x.shape[a] == 1) or None
+        if ax is None:
+            return x.clone()
+    return unary("squeeze", lambda v: jnp.squeeze(v, axis=ax), x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._grad_node, x._out_index = out._value, out._grad_node, out._out_index
+    return x
+
+
+@register_op("unsqueeze", "manipulation")
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+    def fn(v):
+        for a in sorted(axes):
+            v = jnp.expand_dims(v, a)
+        return v
+    return unary("unsqueeze", fn, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._grad_node, x._out_index = out._value, out._grad_node, out._out_index
+    return x
+
+
+@register_op("flatten", "manipulation")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = x.shape
+    new_shape = shape[:s] + [int(np.prod(shape[s:e + 1]) or 1)] + shape[e + 1:]
+    return unary("flatten", lambda v: jnp.reshape(v, new_shape), x)
+
+
+@register_op("expand", "manipulation")
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    # -1 means keep the original dim
+    xshape = ([1] * (len(shape) - x.ndim)) + x.shape
+    tgt = [xs if s == -1 else s for s, xs in zip(shape, xshape)]
+    return unary("expand", lambda v: jnp.broadcast_to(v, tgt), x)
+
+
+@register_op("expand_as", "manipulation")
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+@register_op("broadcast_to", "manipulation")
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register_op("broadcast_tensors", "manipulation")
+def broadcast_tensors(input, name=None):
+    tensors = [ensure_tensor(t) for t in input]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in tensors])
+    return [expand(t, list(shape)) for t in tensors]
+
+
+@register_op("tile", "manipulation")
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.numpy().tolist()
+    reps = [int(r.item()) if isinstance(r, Tensor) else int(r)
+            for r in repeat_times]
+    return unary("tile", lambda v: jnp.tile(v, reps), x)
+
+
+@register_op("flip", "manipulation")
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return unary("flip", lambda v: jnp.flip(v, axis=ax), x)
+
+
+@register_op("roll", "manipulation")
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    return unary("roll", lambda v: jnp.roll(v, shifts, axis=axis), x)
+
+
+@register_op("gather", "manipulation", ref="phi/kernels/gather_kernel.h")
+def gather(x, index, axis=0, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return unary("gather", lambda v: jnp.take(v, idx, axis=axis), x)
+
+
+@register_op("gather_nd", "manipulation")
+def gather_nd(x, index, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+
+    def fn(v):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return v[ind]
+    return unary("gather_nd", fn, x)
+
+
+@register_op("scatter", "manipulation")
+def scatter(x, index, updates, overwrite=True, name=None):
+    x = ensure_tensor(x)
+    updates = ensure_tensor(updates)
+    idx = ensure_tensor(index)._value.reshape(-1)
+
+    def fn(v, u):
+        if overwrite:
+            return v.at[idx].set(u)
+        return v.at[idx].set(0).at[idx].add(u)
+    return call_op("scatter", fn, (x, updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value, x._grad_node, x._out_index = out._value, out._grad_node, out._out_index
+    return x
+
+
+@register_op("scatter_nd", "manipulation")
+def scatter_nd(index, updates, shape, name=None):
+    updates = ensure_tensor(updates)
+    idx = ensure_tensor(index)._value
+    shape = [int(s) for s in shape]
+
+    def fn(u):
+        z = jnp.zeros(shape, u.dtype)
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return z.at[ind].add(u)
+    return unary("scatter_nd", fn, updates)
+
+
+@register_op("scatter_nd_add", "manipulation")
+def scatter_nd_add(x, index, updates, name=None):
+    x = ensure_tensor(x)
+    updates = ensure_tensor(updates)
+    idx = ensure_tensor(index)._value
+
+    def fn(v, u):
+        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        return v.at[ind].add(u)
+    return call_op("scatter_nd_add", fn, (x, updates))
+
+
+@register_op("index_select", "manipulation")
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+@register_op("index_sample", "manipulation")
+def index_sample(x, index, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+
+    def fn(v):
+        return jnp.take_along_axis(v, idx, axis=1)
+    return unary("index_sample", fn, x)
+
+
+@register_op("index_add", "manipulation")
+def index_add(x, index, axis, value, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx = ensure_tensor(index)._value
+
+    def fn(v, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        return jnp.moveaxis(vm.at[idx].add(um), 0, axis)
+    return call_op("index_add", fn, (x, value))
+
+
+@register_op("index_put", "manipulation")
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    ind = tuple(ensure_tensor(i)._value for i in indices)
+
+    def fn(v, u):
+        return v.at[ind].add(u) if accumulate else v.at[ind].set(u)
+    return call_op("index_put", fn, (x, value))
+
+
+@register_op("slice", "manipulation")
+def slice(input, axes, starts, ends, name=None):
+    x = ensure_tensor(input)
+    sl = [jnp.s_[:]] * x.ndim
+    import builtins
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        dim = x.shape[ax]
+        s = builtins.max(s + dim, 0) if s < 0 else builtins.min(s, dim)
+        e = builtins.max(e + dim, 0) if e < 0 else builtins.min(e, dim)
+        sl[ax] = jnp.s_[s:e]
+    sl = tuple(sl)
+    return unary("slice", lambda v: v[sl], x)
+
+
+@register_op("strided_slice", "manipulation")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    sl = [jnp.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = jnp.s_[s:e:st]
+    sl = tuple(sl)
+    return unary("strided_slice", lambda v: v[sl], x)
+
+
+@register_op("take_along_axis", "manipulation")
+def take_along_axis(arr, indices, axis, name=None):
+    arr = ensure_tensor(arr)
+    idx = ensure_tensor(indices)._value
+    return unary("take_along_axis",
+                 lambda v: jnp.take_along_axis(v, idx, axis=axis), arr)
+
+
+@register_op("put_along_axis", "manipulation")
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr = ensure_tensor(arr)
+    values = ensure_tensor(values)
+    idx = ensure_tensor(indices)._value
+
+    def scatter_indices(v):
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = list(grids)
+        full_idx[axis] = idx
+        return tuple(full_idx)
+
+    def fn(v, u):
+        u2 = jnp.broadcast_to(u, idx.shape).astype(v.dtype)
+        if reduce == "assign":
+            return v.at[scatter_indices(v)].set(u2)
+        if reduce == "add":
+            return v.at[scatter_indices(v)].add(u2)
+        if reduce in ("mul", "multiply"):
+            return v.at[scatter_indices(v)].multiply(u2)
+        raise NotImplementedError(f"put_along_axis reduce={reduce!r}")
+    return call_op("put_along_axis", fn, (arr, values))
+
+
+@register_op("masked_select", "manipulation", differentiable=False)
+def masked_select(x, mask, name=None):
+    x = ensure_tensor(x)
+    m = np.asarray(ensure_tensor(mask)._value)
+    return Tensor(jnp.asarray(np.asarray(x._value)[np.broadcast_to(m, np.asarray(x._value).shape)]))
+
+
+@register_op("masked_fill", "manipulation")
+def masked_fill(x, mask, value, name=None):
+    x = ensure_tensor(x)
+    m = ensure_tensor(mask)._value
+    if isinstance(value, Tensor):
+        return call_op("masked_fill",
+                       lambda v, val: jnp.where(m, val.astype(v.dtype), v),
+                       (x, value))
+    return unary("masked_fill",
+                 lambda v: jnp.where(m, jnp.asarray(value, v.dtype), v), x)
+
+
+@register_op("where", "manipulation")
+def where(condition, x=None, y=None, name=None):
+    cond = ensure_tensor(condition)._value
+    if x is None and y is None:
+        nz = jnp.nonzero(cond if cond.dtype == jnp.bool_.dtype else cond != 0)
+        return tuple(Tensor(i[:, None].astype(jnp.int64)) for i in nz)
+    return binary("where", lambda a, b: jnp.where(cond, a, b),
+                  ensure_tensor(x), ensure_tensor(y))
+
+
+@register_op("unbind", "manipulation")
+def unbind(input, axis=0, name=None):
+    x = ensure_tensor(input)
+    n = x.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(v, i, i + 1, axis=axis),
+                                 axis=axis) for i in range(n))
+    return call_op_multi("unbind", fn, (x,), num_outputs=n)
+
+
+unstack = unbind
+
+
+@register_op("unique", "manipulation", differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(np.asarray(x._value), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+@register_op("unique_consecutive", "manipulation", differentiable=False)
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = np.asarray(ensure_tensor(x)._value)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    keep = np.ones(x.shape[axis], dtype=bool)
+    sliced = np.moveaxis(x, axis, 0)
+    keep[1:] = np.any(sliced[1:] != sliced[:-1],
+                      axis=tuple(range(1, sliced.ndim)))
+    out = np.moveaxis(sliced[keep], 0, axis)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, x.shape[axis] if x.ndim else len(keep)))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@register_op("pad", "manipulation")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle "all-dim" layout: [d0_l, d0_r, d1_l, d1_r, ...]
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims (torch-style from last
+        # dim backwards), honoring data_format for 4D/5D NCHW/NHWC
+        widths = [(0, 0)] * nd
+        npairs = len(pad) // 2
+        if data_format.endswith("C") and nd >= 3:  # NHWC / NDHWC
+            dims = list(range(1, 1 + npairs))
+            dims = dims[::-1]
+        else:
+            dims = list(range(nd - 1, nd - 1 - npairs, -1))
+        for i, d in enumerate(dims):
+            widths[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return unary("pad", lambda v: jnp.pad(v, widths, mode="constant",
+                                              constant_values=value), x)
+    return unary("pad", lambda v: jnp.pad(v, widths, mode=jmode), x)
+
+
+@register_op("repeat_interleave", "manipulation")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        repeats = repeats.numpy()
+        total = int(repeats.sum())
+        return unary("repeat_interleave",
+                     lambda v: jnp.repeat(v, jnp.asarray(repeats), axis=axis,
+                                          total_repeat_length=total), x)
+    return unary("repeat_interleave",
+                 lambda v: jnp.repeat(v, repeats, axis=axis), x)
+
+
+@register_op("rot90", "manipulation")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return unary("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)),
+                 ensure_tensor(x))
+
+
+@register_op("moveaxis", "manipulation")
+def moveaxis(x, source, destination, name=None):
+    return unary("moveaxis", lambda v: jnp.moveaxis(v, source, destination),
+                 ensure_tensor(x))
+
+
+@register_op("swapaxes", "manipulation")
+def swapaxes(x, axis0, axis1, name=None):
+    return unary("swapaxes", lambda v: jnp.swapaxes(v, axis0, axis1),
+                 ensure_tensor(x))
+
+
+transpose_2 = swapaxes  # alias used by some paddle code as paddle.transpose variants
+
+
+@register_op("as_complex", "manipulation")
+def as_complex(x, name=None):
+    return unary("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+                 ensure_tensor(x))
+
+
+@register_op("as_real", "manipulation")
+def as_real(x, name=None):
+    return unary("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)],
+                                                axis=-1), ensure_tensor(x))
+
+
+@register_op("tensordot", "manipulation")
+def tensordot(x, y, axes=2, name=None):
+    return binary("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
+                  ensure_tensor(x), ensure_tensor(y))
+
+
+@register_op("take", "manipulation")
+def take(x, index, mode="raise", name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value
+    jmode = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
+    return unary("take", lambda v: jnp.take(v.reshape(-1), idx.reshape(-1),
+                                            mode=jmode).reshape(idx.shape), x)
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+@register_op("crop", "manipulation")
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shape = [int(s) for s in (shape or x.shape)]
+    offsets = [int(o) for o in (offsets or [0] * x.ndim)]
+    sl = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return unary("crop", lambda v: v[sl], x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = ensure_tensor(x)
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n - (offset if offset > 0 else 0))
+    x._value = x._value.at[..., i, i + offset].set(value) if offset >= 0 else \
+        x._value.at[..., i - offset, i].set(value)
+    return x
+
+
+@register_op("unfold", "manipulation")
+def unfold(x, axis, size, step, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        n = (v.shape[axis] - size) // step + 1
+        slices = [jax.lax.slice_in_dim(v, i * step, i * step + size, axis=axis)
+                  for i in range(n)]
+        return jnp.stack(slices, axis=axis if axis >= 0 else v.ndim + axis)
+    return unary("unfold", fn, x)
+
+
+def _atleast(n):
+    def op(*inputs, name=None):
+        fn = {1: jnp.atleast_1d, 2: jnp.atleast_2d, 3: jnp.atleast_3d}[n]
+        outs = [unary(f"atleast_{n}d", fn, ensure_tensor(t)) for t in inputs]
+        return outs[0] if len(outs) == 1 else outs
+    return op
+
+
+atleast_1d = _atleast(1)
+atleast_2d = _atleast(2)
+atleast_3d = _atleast(3)
+
+
+@register_op("select_scatter", "manipulation")
+def select_scatter(x, values, axis, index, name=None):
+    x = ensure_tensor(x)
+    values = ensure_tensor(values)
+
+    def fn(v, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(vm.at[index].set(u.astype(v.dtype)), 0, axis)
+    return call_op("select_scatter", fn, (x, values))
+
+
+@register_op("diagonal_scatter", "manipulation")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+
+    def fn(v, u):
+        n = u.shape[-1]
+        i = jnp.arange(n)
+        vm = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        if offset >= 0:
+            vm = vm.at[..., i, i + offset].set(u)
+        else:
+            vm = vm.at[..., i - offset, i].set(u)
+        return jnp.moveaxis(vm, (-2, -1), (axis1, axis2))
+    return call_op("diagonal_scatter", fn, (x, y))

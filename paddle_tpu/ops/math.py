@@ -1,0 +1,597 @@
+"""Elementwise math, matmul, and reductions.
+
+Reference analog: python/paddle/tensor/math.py (24k LoC corpus root) backed by
+phi elementwise/reduce/matmul kernels. TPU-first: each op is one jnp/lax
+expression XLA fuses; reductions keep static shapes for MXU-friendly layouts.
+"""
+from __future__ import annotations
+
+import math as _math
+import numbers
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import to_jax_dtype, get_default_dtype
+from .registry import register_op
+from ._helpers import ensure_tensor, unary, binary, nary, call_op, axis_tuple, \
+    scalar_or_value
+
+__all__ = [
+    # binary elementwise
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "heaviside",
+    "floor_mod", "inner", "outer", "kron", "lerp", "gcd", "lcm", "nextafter",
+    "copysign", "ldexp", "hypot",
+    # unary elementwise
+    "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10", "log1p", "abs",
+    "neg", "sign", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "ceil", "floor", "round", "trunc",
+    "reciprocal", "square", "erf", "erfinv", "lgamma", "digamma", "logit",
+    "frac", "rad2deg", "deg2rad", "angle", "conj", "real", "imag", "scale",
+    "nan_to_num", "sgn", "i0", "i0e", "i1", "i1e", "polygamma", "sinc",
+    # clip / misc
+    "clip", "stanh", "multiplex", "increment",
+    # matmul family
+    "matmul", "mm", "bmm", "dot", "mv", "addmm", "t", "inner", "outer",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "std", "var", "median", "nanmedian",
+    "nanmean", "nansum", "logsumexp", "amax", "amin", "all", "any", "count_nonzero",
+    # cumulative
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "diff",
+    # comparisons returning bool handled in logic.py; numeric checks here
+    "isfinite", "isinf", "isnan", "isneginf", "isposinf", "isreal",
+    "allclose", "isclose", "equal_all", "trace", "diagonal",
+]
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+
+@register_op("add", "math", ref="phi/kernels/elementwise_add_kernel.h")
+def add(x, y, name=None):
+    return binary("add", jnp.add, x, y)
+
+
+@register_op("subtract", "math")
+def subtract(x, y, name=None):
+    return binary("subtract", jnp.subtract, x, y)
+
+
+@register_op("multiply", "math")
+def multiply(x, y, name=None):
+    return binary("multiply", jnp.multiply, x, y)
+
+
+@register_op("divide", "math")
+def divide(x, y, name=None):
+    return binary("divide", jnp.divide, x, y)
+
+
+@register_op("floor_divide", "math")
+def floor_divide(x, y, name=None):
+    return binary("floor_divide", jnp.floor_divide, x, y)
+
+
+@register_op("mod", "math")
+def mod(x, y, name=None):
+    return binary("mod", jnp.mod, x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+@register_op("pow", "math")
+def pow(x, y, name=None):
+    return binary("pow", jnp.power, x, y)
+
+
+@register_op("maximum", "math")
+def maximum(x, y, name=None):
+    return binary("maximum", jnp.maximum, x, y)
+
+
+@register_op("minimum", "math")
+def minimum(x, y, name=None):
+    return binary("minimum", jnp.minimum, x, y)
+
+
+@register_op("fmax", "math")
+def fmax(x, y, name=None):
+    return binary("fmax", jnp.fmax, x, y)
+
+
+@register_op("fmin", "math")
+def fmin(x, y, name=None):
+    return binary("fmin", jnp.fmin, x, y)
+
+
+@register_op("atan2", "math")
+def atan2(x, y, name=None):
+    return binary("atan2", jnp.arctan2, x, y)
+
+
+@register_op("heaviside", "math")
+def heaviside(x, y, name=None):
+    return binary("heaviside", jnp.heaviside, x, y)
+
+
+@register_op("gcd", "math", differentiable=False)
+def gcd(x, y, name=None):
+    return binary("gcd", jnp.gcd, x, y)
+
+
+@register_op("lcm", "math", differentiable=False)
+def lcm(x, y, name=None):
+    return binary("lcm", jnp.lcm, x, y)
+
+
+@register_op("nextafter", "math", differentiable=False)
+def nextafter(x, y, name=None):
+    return binary("nextafter", jnp.nextafter, x, y)
+
+
+@register_op("copysign", "math")
+def copysign(x, y, name=None):
+    return binary("copysign", jnp.copysign, x, y)
+
+
+@register_op("ldexp", "math")
+def ldexp(x, y, name=None):
+    return binary("ldexp", jnp.ldexp, x, y)
+
+
+@register_op("hypot", "math")
+def hypot(x, y, name=None):
+    return binary("hypot", jnp.hypot, x, y)
+
+
+@register_op("lerp", "math")
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return nary("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight))
+    return binary("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+def _u(name, fn):
+    @register_op(name, "math")
+    def op(x, name=None, _fn=fn, _opname=name):
+        return unary(_opname, _fn, x)
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+sqrt = _u("sqrt", jnp.sqrt)
+rsqrt = _u("rsqrt", jax.lax.rsqrt)
+exp = _u("exp", jnp.exp)
+expm1 = _u("expm1", jnp.expm1)
+log = _u("log", jnp.log)
+log2 = _u("log2", jnp.log2)
+log10 = _u("log10", jnp.log10)
+log1p = _u("log1p", jnp.log1p)
+abs = _u("abs", jnp.abs)
+neg = _u("neg", jnp.negative)
+sign = _u("sign", jnp.sign)
+sgn = _u("sgn", jnp.sign)
+sin = _u("sin", jnp.sin)
+cos = _u("cos", jnp.cos)
+tan = _u("tan", jnp.tan)
+asin = _u("asin", jnp.arcsin)
+acos = _u("acos", jnp.arccos)
+atan = _u("atan", jnp.arctan)
+sinh = _u("sinh", jnp.sinh)
+cosh = _u("cosh", jnp.cosh)
+tanh = _u("tanh", jnp.tanh)
+asinh = _u("asinh", jnp.arcsinh)
+acosh = _u("acosh", jnp.arccosh)
+atanh = _u("atanh", jnp.arctanh)
+ceil = _u("ceil", jnp.ceil)
+floor = _u("floor", jnp.floor)
+round = _u("round", jnp.round)
+trunc = _u("trunc", jnp.trunc)
+reciprocal = _u("reciprocal", jnp.reciprocal)
+square = _u("square", jnp.square)
+erf = _u("erf", jax.scipy.special.erf)
+erfinv = _u("erfinv", jax.scipy.special.erfinv)
+lgamma = _u("lgamma", jax.scipy.special.gammaln)
+digamma = _u("digamma", jax.scipy.special.digamma)
+frac = _u("frac", lambda v: v - jnp.trunc(v))
+rad2deg = _u("rad2deg", jnp.rad2deg)
+deg2rad = _u("deg2rad", jnp.deg2rad)
+angle = _u("angle", jnp.angle)
+conj = _u("conj", jnp.conj)
+real = _u("real", jnp.real)
+imag = _u("imag", jnp.imag)
+i0 = _u("i0", jax.scipy.special.i0)
+i0e = _u("i0e", jax.scipy.special.i0e)
+i1 = _u("i1", jax.scipy.special.i1)
+i1e = _u("i1e", jax.scipy.special.i1e)
+sinc = _u("sinc", jnp.sinc)
+isreal = _u("isreal", jnp.isreal)
+
+
+@register_op("polygamma", "math")
+def polygamma(x, n, name=None):
+    return unary("polygamma", lambda v: jax.scipy.special.polygamma(n, v), x)
+
+
+@register_op("logit", "math")
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return unary("logit", fn, x)
+
+
+@register_op("nan_to_num", "math")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return unary("nan_to_num",
+                 lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), x)
+
+
+@register_op("scale", "math")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scalar_or_value(scale)
+    if bias_after_scale:
+        out = unary("scale", lambda v: v * jnp.asarray(s, v.dtype) + jnp.asarray(bias, v.dtype), x)
+    else:
+        out = unary("scale", lambda v: (v + jnp.asarray(bias, v.dtype)) * jnp.asarray(s, v.dtype), x)
+    return out
+
+
+@register_op("stanh", "math")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return unary("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+@register_op("clip", "math")
+def clip(x, min=None, max=None, name=None):
+    mn = scalar_or_value(min)
+    mx = scalar_or_value(max)
+    return unary("clip", lambda v: jnp.clip(v, mn, mx), x)
+
+
+@register_op("increment", "math")
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    x._value = x._value + jnp.asarray(value, x._value.dtype)
+    return x
+
+
+@register_op("multiplex", "math")
+def multiplex(inputs, index, name=None):
+    idx = ensure_tensor(index)._value.reshape(-1)
+    def fn(*vals):
+        stacked = jnp.stack(vals)           # [n, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx, rows]
+    return nary("multiplex", fn, list(inputs))
+
+
+# ---------------------------------------------------------------------------
+# matmul family — the MXU path
+# ---------------------------------------------------------------------------
+
+@register_op("matmul", "math", ref="phi/kernels/matmul_kernel.h")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return binary("matmul", fn, x, y)
+
+
+@register_op("mm", "math")
+def mm(input, mat2, name=None):
+    return binary("matmul", jnp.matmul, input, mat2)
+
+
+@register_op("bmm", "math")
+def bmm(x, y, name=None):
+    return binary("matmul", jnp.matmul, x, y)
+
+
+@register_op("dot", "math")
+def dot(x, y, name=None):
+    return binary("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+@register_op("mv", "math")
+def mv(x, vec, name=None):
+    return binary("matmul", jnp.matmul, x, vec)
+
+
+@register_op("addmm", "math")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return nary("addmm", lambda i, a, b: beta * i + alpha * (a @ b),
+                (input, x, y))
+
+
+@register_op("t", "math")
+def t(input, name=None):
+    x = ensure_tensor(input)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports <=2-D tensors")
+    return unary("t", lambda v: v.T, x)
+
+
+@register_op("inner", "math")
+def inner(x, y, name=None):
+    return binary("inner", jnp.inner, x, y)
+
+
+@register_op("outer", "math")
+def outer(x, y, name=None):
+    return binary("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+@register_op("kron", "math")
+def kron(x, y, name=None):
+    return binary("kron", jnp.kron, x, y)
+
+
+@register_op("trace", "math")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                              axis2=axis2), x)
+
+
+@register_op("diagonal", "math")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return unary("diagonal", lambda v: jnp.diagonal(v, offset=offset,
+                                                    axis1=axis1, axis2=axis2), x)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduce(name, jfn, x, axis=None, keepdim=False, dtype=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+    def fn(v):
+        out = jfn(v, axis=ax, keepdims=keepdim) if jd is None else \
+            jfn(v, axis=ax, keepdims=keepdim, dtype=jd)
+        return out
+    return unary(name, fn, x)
+
+
+@register_op("sum", "reduction", ref="phi/kernels/reduce_sum_kernel.h")
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if dtype is None and x._value.dtype in (jnp.int32.dtype, jnp.bool_.dtype):
+        dtype = "int64"
+    return _reduce("sum", jnp.sum, x, axis, keepdim, dtype)
+
+
+@register_op("mean", "reduction")
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("mean", jnp.mean, x, axis, keepdim)
+
+
+@register_op("max", "reduction")
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("max", jnp.max, x, axis, keepdim)
+
+
+@register_op("min", "reduction")
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("min", jnp.min, x, axis, keepdim)
+
+
+@register_op("amax", "reduction")
+def amax(x, axis=None, keepdim=False, name=None):
+    return _reduce("amax", jnp.max, x, axis, keepdim)
+
+
+@register_op("amin", "reduction")
+def amin(x, axis=None, keepdim=False, name=None):
+    return _reduce("amin", jnp.min, x, axis, keepdim)
+
+
+@register_op("prod", "reduction")
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce("prod", jnp.prod, x, axis, keepdim, dtype)
+
+
+@register_op("nanmean", "reduction")
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _reduce("nanmean", jnp.nanmean, x, axis, keepdim)
+
+
+@register_op("nansum", "reduction")
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _reduce("nansum", jnp.nansum, x, axis, keepdim, dtype)
+
+
+@register_op("std", "reduction")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    ddof = 1 if unbiased else 0
+    return unary("std", lambda v: jnp.std(v, axis=ax, ddof=ddof,
+                                          keepdims=keepdim), x)
+
+
+@register_op("var", "reduction")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    ddof = 1 if unbiased else 0
+    return unary("var", lambda v: jnp.var(v, axis=ax, ddof=ddof,
+                                          keepdims=keepdim), x)
+
+
+@register_op("median", "reduction")
+def median(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = None if axis is None else axis
+    return unary("median", lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x)
+
+
+@register_op("nanmedian", "reduction")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return unary("nanmedian", lambda v: jnp.nanmedian(v, axis=axis,
+                                                      keepdims=keepdim), x)
+
+
+@register_op("logsumexp", "reduction")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    return unary("logsumexp", lambda v: jax.scipy.special.logsumexp(
+        v, axis=ax, keepdims=keepdim), x)
+
+
+@register_op("all", "reduction", differentiable=False)
+def all(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    return Tensor(jnp.all(x._value, axis=ax, keepdims=keepdim))
+
+
+@register_op("any", "reduction", differentiable=False)
+def any(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    return Tensor(jnp.any(x._value, axis=ax, keepdims=keepdim))
+
+
+@register_op("count_nonzero", "reduction", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis_tuple(axis, x.ndim)
+    return Tensor(jnp.count_nonzero(x._value, axis=ax, keepdims=keepdim)
+                  .astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# cumulative
+# ---------------------------------------------------------------------------
+
+@register_op("cumsum", "math")
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype) if dtype else None
+    if axis is None:
+        return unary("cumsum", lambda v: jnp.cumsum(v.reshape(-1), dtype=jd), x)
+    return unary("cumsum", lambda v: jnp.cumsum(v, axis=axis, dtype=jd), x)
+
+
+@register_op("cumprod", "math")
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype) if dtype else None
+    return unary("cumprod", lambda v: jnp.cumprod(v, axis=dim, dtype=jd), x)
+
+
+@register_op("cummax", "math", differentiable=False)
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    v = x._value if axis is not None else x._value.reshape(-1)
+    ax = axis if axis is not None else 0
+    # running argmax via associative scan over (value, index) pairs
+    n = v.shape[ax]
+    idx = jnp.arange(n).reshape([-1 if i == ax % v.ndim else 1
+                                 for i in range(v.ndim)])
+    idx = jnp.broadcast_to(idx, v.shape)
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv >= av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    vals, inds = jax.lax.associative_scan(combine, (v, idx), axis=ax)
+    return Tensor(vals), Tensor(inds.astype(to_jax_dtype(dtype)))
+
+
+@register_op("cummin", "math", differentiable=False)
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    v = x._value if axis is not None else x._value.reshape(-1)
+    ax = axis if axis is not None else 0
+    n = v.shape[ax]
+    idx = jnp.arange(n).reshape([-1 if i == ax % v.ndim else 1
+                                 for i in range(v.ndim)])
+    idx = jnp.broadcast_to(idx, v.shape)
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv <= av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    vals, inds = jax.lax.associative_scan(combine, (v, idx), axis=ax)
+    return Tensor(vals), Tensor(inds.astype(to_jax_dtype(dtype)))
+
+
+@register_op("logcumsumexp", "math")
+def logcumsumexp(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    def fn(v):
+        vv = v if axis is not None else v.reshape(-1)
+        ax = axis if axis is not None else 0
+        return jax.lax.cumlogsumexp(vv, axis=ax)
+    return unary("logcumsumexp", fn, x)
+
+
+@register_op("diff", "math")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return unary("diff", lambda v: jnp.diff(v, n=n, axis=axis,
+                                            prepend=pre, append=app), x)
+
+
+# ---------------------------------------------------------------------------
+# float-status checks
+# ---------------------------------------------------------------------------
+
+def _check(name, fn):
+    @register_op(name, "math", differentiable=False)
+    def op(x, name=None, _fn=fn):
+        return Tensor(_fn(ensure_tensor(x)._value))
+    op.__name__ = name
+    return op
+
+
+isfinite = _check("isfinite", jnp.isfinite)
+isinf = _check("isinf", jnp.isinf)
+isnan = _check("isnan", jnp.isnan)
+isneginf = _check("isneginf", jnp.isneginf)
+isposinf = _check("isposinf", jnp.isposinf)
+
+
+@register_op("allclose", "math", differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+@register_op("isclose", "math", differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol,
+                              equal_nan=equal_nan))
+
+
+@register_op("equal_all", "math", differentiable=False)
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if x.shape != y.shape:
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.array_equal(x._value, y._value))

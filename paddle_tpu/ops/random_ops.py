@@ -1,0 +1,149 @@
+"""Random sampling ops. Reference analog: python/paddle/tensor/random.py over
+phi uniform/gaussian kernels + the global Generator. TPU-first: functional jax
+PRNG keys split from the framework generator (see framework/random.py); under
+jit tracing, keys come from the traced-key scope so compiled steps get fresh
+randomness."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import to_jax_dtype, get_default_dtype
+from ..framework.random import get_rng_key
+from .registry import register_op
+from ._helpers import ensure_tensor, scalar_or_value
+
+__all__ = ["rand", "randn", "randint", "randint_like", "uniform", "normal",
+           "standard_normal", "randperm", "bernoulli", "multinomial",
+           "poisson", "exponential_", "uniform_", "normal_", "gauss"]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _dt(dtype):
+    return to_jax_dtype(dtype or get_default_dtype())
+
+
+@register_op("rand", "random", differentiable=False)
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(get_rng_key(), _shape_list(shape),
+                                     _dt(dtype)))
+
+
+@register_op("randn", "random", differentiable=False)
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(get_rng_key(), _shape_list(shape),
+                                    _dt(dtype)))
+
+
+standard_normal = randn
+
+
+@register_op("randint", "random", differentiable=False)
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(get_rng_key(), _shape_list(shape),
+                                     low, high, to_jax_dtype(dtype)))
+
+
+@register_op("randint_like", "random", differentiable=False)
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    dt = to_jax_dtype(dtype) if dtype else x._value.dtype
+    return Tensor(jax.random.randint(get_rng_key(), x._value.shape, low, high)
+                  .astype(dt))
+
+
+@register_op("uniform", "random", differentiable=False)
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else get_rng_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), _dt(dtype),
+                                     minval=scalar_or_value(min),
+                                     maxval=scalar_or_value(max)))
+
+
+@register_op("normal", "random", differentiable=False)
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)._value if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std)._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, "shape") else (),
+            s.shape if hasattr(s, "shape") else ())
+        return Tensor(m + s * jax.random.normal(get_rng_key(), shp,
+                                                _dt(None)))
+    shp = _shape_list(shape) if shape is not None else []
+    return Tensor(mean + std * jax.random.normal(get_rng_key(), shp, _dt(None)))
+
+
+gauss = normal
+
+
+@register_op("randperm", "random", differentiable=False)
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(get_rng_key(), n)
+                  .astype(to_jax_dtype(dtype)))
+
+
+@register_op("bernoulli", "random", differentiable=False)
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.bernoulli(get_rng_key(), x._value)
+                  .astype(x._value.dtype))
+
+
+@register_op("multinomial", "random", differentiable=False)
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    logits = jnp.log(jnp.clip(v / jnp.sum(v, axis=-1, keepdims=True),
+                              1e-30, None))
+    if replacement:
+        out = jax.random.categorical(get_rng_key(), logits,
+                                     shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(get_rng_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+@register_op("poisson", "random", differentiable=False)
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jax.random.poisson(get_rng_key(), x._value)
+                  .astype(x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    x._value = jax.random.exponential(get_rng_key(), x._value.shape,
+                                      x._value.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x = ensure_tensor(x)
+    key = jax.random.key(seed) if seed else get_rng_key()
+    x._value = jax.random.uniform(key, x._value.shape, x._value.dtype,
+                                  minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x = ensure_tensor(x)
+    x._value = mean + std * jax.random.normal(get_rng_key(), x._value.shape,
+                                              x._value.dtype)
+    return x

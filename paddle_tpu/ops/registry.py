@@ -1,0 +1,52 @@
+"""Op schema registry — single source of truth for the op corpus.
+
+Reference analog: paddle/phi/api/yaml/{ops,legacy_ops}.yaml + KernelFactory
+(phi/core/kernel_factory.h:268). TPU-first: instead of per-backend kernel
+variants keyed by (Backend, Layout, DataType), every op has one jax
+implementation that XLA lowers for the active platform; the registry exists for
+introspection, parity auditing, and pluggable overrides (e.g. swapping a Pallas
+kernel in for a hot op).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = ["OpDef", "register_op", "get_op", "all_ops", "override_kernel"]
+
+
+@dataclass
+class OpDef:
+    name: str
+    category: str                       # math / creation / manipulation / ...
+    fn: Optional[Callable] = None       # the python-level op entry point
+    differentiable: bool = True
+    ref: str = ""                       # reference citation (file:line)
+    overrides: dict = field(default_factory=dict)  # e.g. {"pallas": fn}
+
+
+_REGISTRY: dict[str, OpDef] = {}
+
+
+def register_op(name: str, category: str, differentiable: bool = True,
+                ref: str = ""):
+    """Decorator registering a python op entry point into the corpus table."""
+    def deco(fn):
+        _REGISTRY[name] = OpDef(name=name, category=category, fn=fn,
+                                differentiable=differentiable, ref=ref)
+        return fn
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def all_ops() -> dict[str, OpDef]:
+    return dict(_REGISTRY)
+
+
+def override_kernel(name: str, impl_name: str, fn: Callable):
+    """Install an alternative implementation (e.g. a Pallas kernel) for an op.
+    Reference analog: custom kernel plug-in (phi/core/custom_kernel.cc)."""
+    _REGISTRY[name].overrides[impl_name] = fn

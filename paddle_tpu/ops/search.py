@@ -1,0 +1,144 @@
+"""Search / sort ops. Reference analog: python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import to_jax_dtype
+from .registry import register_op
+from ._helpers import ensure_tensor, unary, call_op, call_op_multi
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+           "nonzero", "kthvalue", "mode", "index_sample", "bucketize"]
+
+
+@register_op("argmax", "search", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    out = jnp.argmax(v if axis is not None else v.reshape(-1), axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return Tensor(out.astype(to_jax_dtype(dtype)))
+
+
+@register_op("argmin", "search", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    out = jnp.argmin(v if axis is not None else v.reshape(-1), axis=axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return Tensor(out.astype(to_jax_dtype(dtype)))
+
+
+@register_op("argsort", "search", differentiable=False)
+def argsort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    v = x._value
+    idx = jnp.argsort(v, axis=axis, descending=descending)
+    return Tensor(idx.astype(jnp.int64))
+
+
+@register_op("sort", "search")
+def sort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    return unary("sort", lambda v: jnp.sort(v, axis=axis,
+                                            descending=descending), x)
+
+
+@register_op("topk", "search")
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else axis
+
+    def fn(v):
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    # indices are non-differentiable; dispatch values through autograd and
+    # compute indices alongside
+    vals, idx = fn(x._value)
+    if x.stop_gradient:
+        return Tensor(vals), Tensor(idx.astype(jnp.int64))
+    out_vals = call_op("topk", lambda v: fn(v)[0], (x,))
+    return out_vals, Tensor(idx.astype(jnp.int64))
+
+
+@register_op("searchsorted", "search", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    s = ensure_tensor(sorted_sequence)._value
+    v = ensure_tensor(values)._value
+    side = "right" if right else "left"
+    if s.ndim == 1:
+        out = jnp.searchsorted(s, v, side=side)
+    else:
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+            flat_s, flat_v).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+bucketize = searchsorted
+
+
+@register_op("nonzero", "search", differentiable=False)
+def nonzero(x, as_tuple=False, name=None):
+    x = ensure_tensor(x)
+    nz = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+@register_op("kthvalue", "search")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        sv = jnp.sort(v, axis=axis)
+        out = jnp.take(sv, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+    vals = call_op("kthvalue", fn, (x,))
+    idx_v = jnp.take(jnp.argsort(x._value, axis=axis), k - 1, axis=axis)
+    if keepdim:
+        idx_v = jnp.expand_dims(idx_v, axis)
+    return vals, Tensor(idx_v.astype(jnp.int64))
+
+
+@register_op("mode", "search", differentiable=False)
+def mode(x, axis=-1, keepdim=False, name=None):
+    xv = np.asarray(ensure_tensor(x)._value)
+    xm = np.moveaxis(xv, axis, -1)
+    flat = xm.reshape(-1, xm.shape[-1])
+    vals = np.empty(flat.shape[0], xv.dtype)
+    inds = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        inds[i] = np.where(row == best)[0][-1]
+    out_shape = xm.shape[:-1]
+    vals = vals.reshape(out_shape)
+    inds = inds.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        inds = np.expand_dims(inds, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(inds))
+
+
+@register_op("index_sample_search", "search")
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)

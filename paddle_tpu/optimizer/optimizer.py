@@ -1,0 +1,183 @@
+"""Optimizer base. Reference analog: python/paddle/optimizer/optimizer.py
+(class Optimizer: accumulators, grad clip, regularization, LR scheduling).
+
+TPU-first: `step()` gathers (param, grad, accumulator) pytrees and applies ONE
+jitted update function with buffer donation — the whole optimizer update is a
+single fused XLA executable per parameter-group structure, not per-op eager
+dispatch (reference analog: fused optimizer ops like
+fluid/operators/optimizers/distributed_fused_lamb_op.cu).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode "
+                "(pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._name = name
+        if isinstance(weight_decay, (float, int)) and weight_decay:
+            from .regularizer import L2Decay
+            self.regularization = L2Decay(float(weight_decay))
+        else:
+            self.regularization = weight_decay
+        self._accumulators = defaultdict(dict)  # name -> {param_name: value}
+        self._jitted_update = {}
+
+    # -- learning rate ------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None,
+                         shape=None):
+        key = param.name
+        if key not in self._accumulators[name]:
+            shp = shape if shape is not None else param._value.shape
+            dt = dtype if dtype is not None else param._value.dtype
+            self._accumulators[name][key] = jnp.full(shp, fill_value, dt)
+        return self._accumulators[name][key]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- subclass interface -------------------------------------------------
+    def _create_accumulators(self, params):
+        pass
+
+    def _single_update(self, pval, grad, accs, lr, step_count):
+        """Pure function: (param, grad, {accs}, lr) -> (new_param, {new_accs}).
+        Subclasses implement this; it gets jit-compiled over the whole
+        parameter list in one go."""
+        raise NotImplementedError
+
+    def _extra_cache_key(self):
+        """Subclass hook: anything baked into the traced update as a constant
+        (e.g. per-param decay flags) MUST be part of the jit cache key."""
+        return ()
+
+    # -- main entry points --------------------------------------------------
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if not p.stop_gradient or p.grad is not None]
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        if not params_grads:
+            return
+        if self.regularization is not None:
+            params_grads = [
+                (p, self.regularization.apply(p, g)) for p, g in params_grads]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._create_accumulators([p for p, _ in params_grads])
+        self._apply_optimize(params_grads)
+
+    def _apply_optimize(self, params_grads):
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        acc_names = sorted(self._accumulators.keys())
+        step_key = "_step_count"
+        if not hasattr(self, step_key):
+            self._step_count = 0
+        self._step_count += 1
+        step_count = jnp.asarray(self._step_count, jnp.int32)
+
+        pvals = [p._value for p, _ in params_grads]
+        gvals = [g._value for _, g in params_grads]
+        accs = [[self._accumulators[n].get(p.name) for n in acc_names]
+                for p, _ in params_grads]
+
+        structure_key = (len(params_grads),
+                         tuple((v.shape, str(v.dtype)) for v in pvals),
+                         tuple(acc_names),
+                         self._extra_cache_key())
+        update = self._jitted_update.get(structure_key)
+        if update is None:
+            single = self._single_update
+
+            def batch_update(pvals, gvals, accs, lr, step_count):
+                new_p, new_a = [], []
+                for pv, gv, ac in zip(pvals, gvals, accs):
+                    acc_dict = dict(zip(acc_names, ac))
+                    np_, na_ = single(pv, gv, acc_dict, lr, step_count)
+                    new_p.append(np_)
+                    new_a.append([na_[n] for n in acc_names])
+                return new_p, new_a
+
+            # only accumulator buffers are donated: param buffers may be
+            # aliased by user-held tensors (detach() shares storage), and
+            # donating them would invalidate those aliases
+            update = jax.jit(batch_update, donate_argnums=(2,))
+            self._jitted_update[structure_key] = update
+
+        new_pvals, new_accs = update(pvals, gvals, accs, lr, step_count)
+        for (p, _), npv, nac in zip(params_grads, new_pvals, new_accs):
+            p._value = npv
+            for n, v in zip(acc_names, nac):
+                self._accumulators[n][p.name] = v
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        state = {}
+        for name, per_param in self._accumulators.items():
+            for pname, val in per_param.items():
+                state[f"{pname}_{name}"] = Tensor(val)
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        state["_step_count"] = getattr(self, "_step_count", 0)
+        return state
+
+    def set_state_dict(self, state_dict):
+        if "LR_Scheduler" in state_dict and \
+                isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        self._step_count = int(state_dict.get("_step_count", 0))
+        self._create_accumulators(self._parameter_list)
+        for name, per_param in self._accumulators.items():
+            for pname in list(per_param.keys()):
+                key = f"{pname}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    arr = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    per_param[pname] = arr
+
+    load_state_dict = set_state_dict

@@ -1,0 +1,300 @@
+"""Concrete optimizers. Reference analog: python/paddle/optimizer/{sgd,momentum,
+adam,adamw,lamb,rmsprop,adagrad,adadelta,adamax}.py over the device-side
+optimizer ops (fluid/operators/optimizers/). Each `_single_update` is a pure
+jax function jit-fused over the full parameter list by the base class.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "RMSProp", "Adagrad",
+           "Adadelta", "Adamax"]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _single_update(self, p, g, accs, lr, step):
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _single_update(self, p, g, accs, lr, step):
+        v = accs["velocity"]
+        g = g.astype(v.dtype)
+        v_new = self._momentum * v + g
+        if self._use_nesterov:
+            upd = g + self._momentum * v_new
+        else:
+            upd = v_new
+        return p - lr.astype(p.dtype) * upd.astype(p.dtype), \
+            {"velocity": v_new}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment1", p, dtype=jnp.float32)
+            self._add_accumulator("moment2", p, dtype=jnp.float32)
+            if self._multi_precision and p._value.dtype != jnp.float32.dtype:
+                if p.name not in self._accumulators["master_weight"]:
+                    self._accumulators["master_weight"][p.name] = \
+                        p._value.astype(jnp.float32)
+
+    def _adam_core(self, p, g, m1, m2, lr, step, master=None):
+        gf = g.astype(jnp.float32)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m1n = b1 * m1 + (1 - b1) * gf
+        m2n = b2 * m2 + (1 - b2) * gf * gf
+        t = step.astype(jnp.float32)
+        bc1 = 1 - jnp.power(b1, t)
+        bc2 = 1 - jnp.power(b2, t)
+        lr_t = lr * jnp.sqrt(bc2) / bc1
+        base = master if master is not None else p.astype(jnp.float32)
+        new_master = base - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+        return new_master.astype(p.dtype), m1n, m2n, new_master
+
+    def _single_update(self, p, g, accs, lr, step):
+        master = accs.get("master_weight")
+        new_p, m1, m2, new_master = self._adam_core(
+            p, g, accs["moment1"], accs["moment2"], lr, step, master)
+        out = {"moment1": m1, "moment2": m2}
+        if master is not None:
+            out["master_weight"] = new_master
+        return new_p, out
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._decay_skip = set()
+        if apply_decay_param_fun is not None:
+            for p in self._parameter_list:
+                if not apply_decay_param_fun(p.name):
+                    self._decay_skip.add(p.name)
+
+    def _apply_optimize(self, params_grads):
+        # apply decoupled decay per-param (skip set respected), then adam
+        self._current_decay_flags = [p.name not in self._decay_skip
+                                     for p, _ in params_grads]
+        super()._apply_optimize(params_grads)
+
+    def _extra_cache_key(self):
+        # flags are baked into the trace via pop(0) — key the cache on them
+        return tuple(getattr(self, "_current_decay_flags", ()) or ())
+
+    def _single_update(self, p, g, accs, lr, step):
+        # decay folded into the fused update via flag list (consumed in order)
+        flag = self._current_decay_flags.pop(0) \
+            if getattr(self, "_current_decay_flags", None) else True
+        master = accs.get("master_weight")
+        base = master if master is not None else p.astype(jnp.float32)
+        if flag and self._coeff:
+            decayed = base * (1.0 - lr * self._coeff)
+        else:
+            decayed = base
+        if master is not None:
+            accs = dict(accs, master_weight=decayed)
+            new_p, m1, m2, new_master = self._adam_core(
+                p, g, accs["moment1"], accs["moment2"], lr, step, decayed)
+        else:
+            new_p, m1, m2, new_master = self._adam_core(
+                decayed.astype(p.dtype), g, accs["moment1"], accs["moment2"],
+                lr, step, None)
+        out = {"moment1": m1, "moment2": m2}
+        if master is not None:
+            out["master_weight"] = new_master
+        return new_p, out
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._decay_flags = {}
+        for p in self._parameter_list:
+            self._decay_flags[p.name] = not (
+                exclude_from_weight_decay_fn is not None and
+                exclude_from_weight_decay_fn(p))
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment1", p, dtype=jnp.float32)
+            self._add_accumulator("moment2", p, dtype=jnp.float32)
+
+    def _apply_optimize(self, params_grads):
+        self._current_decay_flags = [self._decay_flags.get(p.name, True)
+                                     for p, _ in params_grads]
+        super()._apply_optimize(params_grads)
+
+    def _extra_cache_key(self):
+        return tuple(getattr(self, "_current_decay_flags", ()) or ())
+
+    def _single_update(self, p, g, accs, lr, step):
+        flag = self._current_decay_flags.pop(0) \
+            if getattr(self, "_current_decay_flags", None) else True
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m1 = b1 * accs["moment1"] + (1 - b1) * gf
+        m2 = b2 * accs["moment2"] + (1 - b2) * gf * gf
+        t = step.astype(jnp.float32)
+        m1_hat = m1 / (1 - jnp.power(b1, t))
+        m2_hat = m2 / (1 - jnp.power(b2, t))
+        r = m1_hat / (jnp.sqrt(m2_hat) + eps)
+        if flag and self._wd:
+            r = r + self._wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m1, "moment2": m2}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("mean_square", p, dtype=jnp.float32)
+            self._add_accumulator("momentum_acc", p, dtype=jnp.float32)
+            if self._centered:
+                self._add_accumulator("mean_grad", p, dtype=jnp.float32)
+
+    def _single_update(self, p, g, accs, lr, step):
+        gf = g.astype(jnp.float32)
+        ms = self._rho * accs["mean_square"] + (1 - self._rho) * gf * gf
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * accs["mean_grad"] + (1 - self._rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * accs["momentum_acc"] + lr * gf / denom
+        out["momentum_acc"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), out
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment", p, fill_value=self._init_acc,
+                                  dtype=jnp.float32)
+
+    def _single_update(self, p, g, accs, lr, step):
+        gf = g.astype(jnp.float32)
+        m = accs["moment"] + gf * gf
+        new_p = p.astype(jnp.float32) - lr * gf / (jnp.sqrt(m) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("avg_squared_grad", p, dtype=jnp.float32)
+            self._add_accumulator("avg_squared_update", p, dtype=jnp.float32)
+
+    def _single_update(self, p, g, accs, lr, step):
+        gf = g.astype(jnp.float32)
+        rho, eps = self._rho, self._epsilon
+        asg = rho * accs["avg_squared_grad"] + (1 - rho) * gf * gf
+        update = gf * jnp.sqrt(accs["avg_squared_update"] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * accs["avg_squared_update"] + (1 - rho) * update * update
+        new_p = p.astype(jnp.float32) - lr * update
+        return new_p.astype(p.dtype), {"avg_squared_grad": asg,
+                                       "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment", p, dtype=jnp.float32)
+            self._add_accumulator("inf_norm", p, dtype=jnp.float32)
+
+    def _single_update(self, p, g, accs, lr, step):
+        gf = g.astype(jnp.float32)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * accs["moment"] + (1 - b1) * gf
+        u = jnp.maximum(b2 * accs["inf_norm"], jnp.abs(gf))
+        t = step.astype(jnp.float32)
+        lr_t = lr / (1 - jnp.power(b1, t))
+        new_p = p.astype(jnp.float32) - lr_t * m / (u + eps)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": u}

@@ -1,0 +1,40 @@
+"""Weight-decay regularizers. Reference analog: python/paddle/regularizer.py
+(applied by appending to the gradient before the update). Per-parameter
+regularizers (ParamAttr.regularizer) override the optimizer-level one,
+mirroring the reference's precedence rule."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _term(self, param, dtype):
+        raise NotImplementedError
+
+    def apply(self, param, grad):
+        if grad is None:
+            return grad
+        reg = getattr(param, "regularizer", None)
+        if reg is not None and reg is not self:
+            return reg.apply_own(param, grad)
+        return self.apply_own(param, grad)
+
+    def apply_own(self, param, grad):
+        return Tensor(grad._value + self._term(param, grad._value.dtype))
+
+
+class L2Decay(_Decay):
+    def _term(self, param, dtype):
+        return self.coeff * param._value.astype(dtype)
+
+
+class L1Decay(_Decay):
+    def _term(self, param, dtype):
+        return self.coeff * jnp.sign(param._value).astype(dtype)

@@ -1,0 +1,260 @@
+"""Profiler. Reference analog: python/paddle/profiler/profiler.py:339
+(Profiler, ProfilerState, export_chrome_tracing) over platform/profiler/ C++
+tracers (HostTracer + CudaTracer/CUPTI).
+
+TPU-first: host events are recorded by a lightweight in-process recorder
+(HostTracer analog); device timeline comes from the jax/XLA profiler
+(xplane → TensorBoard/perfetto), the CUPTI analog. `timer` provides the
+ips/tokens-per-second benchmark hooks (reference: profiler/timer.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "benchmark"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _HostEventRecorder:
+    """Thread-local event collection (platform/profiler/host_event_recorder.h
+    analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def add(self, name, ts, dur, tid):
+        with self._lock:
+            self.events.append({"name": name, "ts": ts, "dur": dur,
+                                "tid": tid, "ph": "X", "pid": os.getpid(),
+                                "cat": "host"})
+
+    def drain(self):
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+_recorder = _HostEventRecorder()
+_active_profiler = None
+
+
+class RecordEvent:
+    """Scoped host event (reference: profiler/event_tracing.h RecordEvent +
+    python profiler/utils.py RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns() / 1000.0
+
+    def end(self):
+        if self._begin is None:
+            return
+        now = time.perf_counter_ns() / 1000.0
+        _recorder.add(self.name, self._begin, now - self._begin,
+                      threading.get_ident())
+        self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(closed, ready, record, repeat=0, skip_first=0):
+    total = closed + ready + record
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        prof._export_path = path
+        prof.export(path)
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU]
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._step = 0
+        self._events = []
+        self._jax_trace_dir = None
+        self._state = ProfilerState.CLOSED
+
+    def start(self):
+        global _active_profiler
+        _active_profiler = self
+        _recorder.drain()
+        self._state = ProfilerState.RECORD
+        if not self.timer_only and ProfilerTarget.TPU in self.targets:
+            import tempfile
+            import jax
+            self._jax_trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            try:
+                jax.profiler.start_trace(self._jax_trace_dir)
+            except Exception:
+                self._jax_trace_dir = None
+        return self
+
+    def stop(self):
+        global _active_profiler
+        self._events.extend(_recorder.drain())
+        if self._jax_trace_dir:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        self._state = ProfilerState.CLOSED
+        _active_profiler = None
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+        return self
+
+    def step(self, num_samples=None):
+        self._step += 1
+        self._events.extend(_recorder.drain())
+        benchmark().step(num_samples)
+
+    def step_info(self, unit=None):
+        return benchmark().step_info(unit)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path, format="json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms",
+                       "jax_trace_dir": self._jax_trace_dir}, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        from collections import defaultdict
+        agg = defaultdict(lambda: [0, 0.0])
+        for e in self._events:
+            agg[e["name"]][0] += 1
+            agg[e["name"]][1] += e["dur"]
+        lines = [f"{'name':<40} {'calls':>8} {'total_us':>12}"]
+        for name, (calls, dur) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40} {calls:>8} {dur:>12.1f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)
+
+
+class _Benchmark:
+    """ips/throughput tracker (reference: python/paddle/profiler/timer.py)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._last = None
+        self._steps = 0
+        self._total_time = 0.0
+        self._total_samples = 0
+        self._window = []
+
+    def begin(self):
+        self.reset()
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            dt = now - self._last
+            self._total_time += dt
+            self._steps += 1
+            if num_samples:
+                self._total_samples += num_samples
+                self._window.append((num_samples, dt))
+                if len(self._window) > 100:
+                    self._window.pop(0)
+        self._last = now
+
+    def step_info(self, unit=None):
+        if not self._steps:
+            return "no steps recorded"
+        avg = self._total_time / self._steps
+        ips = ""
+        if self._window:
+            n = sum(w[0] for w in self._window)
+            t = sum(w[1] for w in self._window)
+            ips = f" ips: {n / t:.3f} {unit or 'samples'}/s"
+        return f"batch_cost: {avg:.5f} s{ips}"
+
+    @property
+    def ips(self):
+        if self._total_time == 0:
+            return 0.0
+        return self._total_samples / self._total_time
+
+    def end(self):
+        self._last = None
+
+
+_benchmark = _Benchmark()
+
+
+def benchmark():
+    return _benchmark

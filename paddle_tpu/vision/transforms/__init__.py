@@ -1,0 +1,261 @@
+"""Vision transforms (numpy/CHW based). Reference analog:
+python/paddle/vision/transforms/."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "RandomResizedCrop", "BrightnessTransform",
+           "to_tensor", "normalize", "resize", "hflip", "vflip"]
+
+
+def _to_numpy(img):
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _to_numpy(pic)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[None] if data_format == "CHW" else arr[..., None]
+    elif arr.ndim == 3 and data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr.astype(np.float32))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _to_numpy(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    if isinstance(img, Tensor):
+        return Tensor(arr)
+    return arr
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _to_numpy(img)
+    channel_last = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+    h, w = (arr.shape[:2] if channel_last or arr.ndim == 2
+            else arr.shape[1:3])
+    if isinstance(size, numbers.Number):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    # simple nearest/linear resize via jax.image on host
+    import jax
+    import jax.numpy as jnp
+    a = jnp.asarray(arr, jnp.float32)
+    if arr.ndim == 2:
+        out = jax.image.resize(a, (oh, ow), method=interpolation
+                               if interpolation != "nearest" else "nearest")
+    elif channel_last:
+        out = jax.image.resize(a, (oh, ow, arr.shape[-1]),
+                               method=interpolation)
+    else:
+        out = jax.image.resize(a, (arr.shape[0], oh, ow),
+                               method=interpolation)
+    out_np = np.asarray(out)
+    if arr.dtype == np.uint8:
+        out_np = np.clip(out_np, 0, 255).astype(np.uint8)
+    return out_np
+
+
+def hflip(img):
+    arr = _to_numpy(img)
+    return arr[..., ::-1].copy() if arr.ndim >= 2 else arr
+
+
+def vflip(img):
+    arr = _to_numpy(img)
+    if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+        return arr[::-1].copy()
+    return arr[..., ::-1, :].copy() if arr.ndim == 3 else arr[::-1].copy()
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        channel_last = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[:2] if channel_last or arr.ndim == 2
+                else arr.shape[1:3])
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        if channel_last or arr.ndim == 2:
+            return arr[i:i + th, j:j + tw]
+        return arr[:, i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        channel_last = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[:2] if channel_last or arr.ndim == 2
+                else arr.shape[1:3])
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        if channel_last or arr.ndim == 2:
+            return arr[i:i + th, j:j + tw]
+        return arr[:, i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return _to_numpy(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _to_numpy(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _to_numpy(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, numbers.Number):
+            padding = [padding] * 4
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        l, t, r, b = (self.padding if len(self.padding) == 4
+                      else self.padding * 2)
+        channel_last = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        if channel_last:
+            return np.pad(arr, ((t, b), (l, r), (0, 0)),
+                          constant_values=self.fill)
+        if arr.ndim == 2:
+            return np.pad(arr, ((t, b), (l, r)), constant_values=self.fill)
+        return np.pad(arr, ((0, 0), (t, b), (l, r)),
+                      constant_values=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        channel_last = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[:2] if channel_last or arr.ndim == 2
+                else arr.shape[1:3])
+        area = h * w
+        for _ in range(10):
+            target_area = area * np.random.uniform(*self.scale)
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                if channel_last or arr.ndim == 2:
+                    crop = arr[i:i + ch, j:j + cw]
+                else:
+                    crop = arr[:, i:i + ch, j:j + cw]
+                return resize(crop, self.size, self.interpolation)
+        return resize(arr, self.size, self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = arr * factor
+        return np.clip(out, 0, 255 if arr.max() > 1 else 1.0)

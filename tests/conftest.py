@@ -1,0 +1,33 @@
+"""Test env: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's fake-backend fixture strategy
+(python/paddle/fluid/tests/custom_runtime/ CustomCPU plugin): tests run
+against a pluggable non-accelerator backend so CI needs no TPU; the driver
+separately dry-runs the multi-chip path.
+"""
+import os
+
+# force CPU even when the session env preselects a TPU platform. jax may
+# already be imported (sitecustomize), so set both the env var and the config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
+assert jax.device_count() == 8, "tests expect an 8-device virtual CPU mesh"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    np.random.seed(0)
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    yield

@@ -1,0 +1,152 @@
+"""Autograd engine tests (reference analog: eager backward tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_grad_accumulation_two_paths():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3 + x * x  # dy/dx = 3 + 2x = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_repeated_backward_accumulates():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0, 5.0])
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=True)
+    ((x * y).sum()).backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_no_grad_decorator():
+    @paddle.no_grad()
+    def f(t):
+        return t * 2
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    assert f(x).stop_gradient
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not write .grad
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    loss = (a * 2).sum() + (b * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[2, 2, 2], [3, 3, 3]])
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_register_hook_on_leaf():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    seen = {}
+
+    def hook(g):
+        seen["g"] = g.numpy().copy()
+        return g * 10
+
+    x.register_hook(hook)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(seen["g"], [2.0, 2.0])
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+
+def test_hook_remove():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    h = x.register_hook(lambda g: g * 100)
+    h.remove()
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    (x * 2).sum().backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_diamond_dependency():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    a = x * 2
+    b = a * 3
+    c = a * 4
+    (b + c).backward()  # d/dx = 2*3 + 2*4 = 14
+    np.testing.assert_allclose(x.grad.numpy(), [14.0])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+
+def test_jacobian_vjp_jvp():
+    from paddle_tpu.autograd import jacobian, vjp, jvp
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    jac = jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]), atol=1e-5)
+    out, g = vjp(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0], atol=1e-5)
+    out, tangent = jvp(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(float(tangent), 6.0, atol=1e-5)

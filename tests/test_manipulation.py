@@ -1,0 +1,163 @@
+"""Manipulation-op tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+RNG = np.random.default_rng(1)
+
+
+def randf(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_reshape_transpose():
+    x = randf(2, 3, 4)
+    check_forward(paddle.reshape, lambda a: a.reshape(4, 6), [x],
+                  shape=[4, 6])
+    check_forward(paddle.transpose, lambda a: a.transpose(2, 0, 1), [x],
+                  perm=[2, 0, 1])
+    check_grad(paddle.reshape, [randf(2, 6)], shape=[3, 4])
+
+
+def test_concat_stack_split():
+    a, b = randf(2, 3), randf(2, 3)
+    out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+    out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.stack([a, b], 1))
+    parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+    np.testing.assert_allclose(parts[0].numpy(), a[:, :1])
+    np.testing.assert_allclose(parts[1].numpy(), a[:, 1:])
+
+
+def test_concat_grad():
+    a, b = randf(2, 2), randf(2, 2)
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    out = paddle.concat([ta, tb], axis=0)
+    (out * 2).sum().backward()
+    np.testing.assert_allclose(ta.grad.numpy(), np.full((2, 2), 2.0))
+    np.testing.assert_allclose(tb.grad.numpy(), np.full((2, 2), 2.0))
+
+
+def test_squeeze_unsqueeze_flatten():
+    x = randf(2, 1, 3)
+    assert paddle.squeeze(paddle.to_tensor(x), 1).shape == [2, 3]
+    assert paddle.unsqueeze(paddle.to_tensor(x), 0).shape == [1, 2, 1, 3]
+    assert paddle.flatten(paddle.to_tensor(randf(2, 3, 4)), 1).shape == [2, 12]
+
+
+def test_expand_tile():
+    x = randf(1, 3)
+    assert paddle.expand(paddle.to_tensor(x), [4, 3]).shape == [4, 3]
+    np.testing.assert_allclose(
+        paddle.tile(paddle.to_tensor(x), [2, 2]).numpy(), np.tile(x, (2, 2)))
+
+
+def test_gather_scatter():
+    x = randf(5, 3)
+    idx = np.array([0, 2, 4])
+    out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[idx])
+
+    base = np.zeros((5, 3), np.float32)
+    upd = randf(2, 3)
+    out = paddle.scatter(paddle.to_tensor(base),
+                         paddle.to_tensor(np.array([1, 3])),
+                         paddle.to_tensor(upd))
+    np.testing.assert_allclose(out.numpy()[[1, 3]], upd)
+
+
+def test_gather_nd_scatter_nd():
+    x = randf(3, 4)
+    idx = np.array([[0, 1], [2, 3]])
+    out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+    upd = np.array([5.0, 6.0], np.float32)
+    out = paddle.scatter_nd(paddle.to_tensor(idx), paddle.to_tensor(upd),
+                            [3, 4])
+    assert float(out.numpy()[0, 1]) == 5.0
+
+
+def test_getitem_setitem():
+    x = randf(4, 5)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t[1].numpy(), x[1])
+    np.testing.assert_allclose(t[1:3, 2:].numpy(), x[1:3, 2:])
+    np.testing.assert_allclose(t[:, -1].numpy(), x[:, -1])
+    t[0] = 0.0
+    assert np.all(t.numpy()[0] == 0)
+    # boolean mask read
+    mask = x > 0
+    np.testing.assert_allclose(
+        paddle.masked_select(paddle.to_tensor(x),
+                             paddle.to_tensor(mask)).numpy(), x[mask])
+
+
+def test_getitem_grad():
+    x = randf(4, 4)
+    t = paddle.to_tensor(x, stop_gradient=False)
+    t[1:3].sum().backward()
+    expected = np.zeros((4, 4), np.float32)
+    expected[1:3] = 1
+    np.testing.assert_allclose(t.grad.numpy(), expected)
+
+
+def test_pad():
+    x = randf(2, 3)
+    out = paddle.to_tensor(x)
+    padded = paddle.ops.manipulation.pad(out, [1, 1, 2, 2])
+    assert padded.shape == [4, 7]
+    x4 = randf(1, 2, 3, 3)
+    padded = paddle.ops.manipulation.pad(paddle.to_tensor(x4), [1, 1, 1, 1])
+    assert padded.shape == [1, 2, 5, 5]
+
+
+def test_where_flip_roll():
+    x = randf(3, 3)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.flip(t, [0]).numpy(), x[::-1])
+    np.testing.assert_allclose(paddle.roll(t, 1, axis=0).numpy(),
+                               np.roll(x, 1, 0))
+
+
+def test_take_along_put_along():
+    x = randf(3, 4)
+    idx = np.argsort(x, axis=1)
+    out = paddle.take_along_axis(paddle.to_tensor(x),
+                                 paddle.to_tensor(idx), 1)
+    np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1))
+    put = paddle.put_along_axis(paddle.to_tensor(np.zeros((2, 2), np.float32)),
+                                paddle.to_tensor(np.array([[0], [1]])),
+                                paddle.to_tensor(np.array([[5.0], [6.0]],
+                                                          np.float32)), 1)
+    np.testing.assert_allclose(put.numpy(), [[5, 0], [0, 6]])
+
+
+def test_cast_astype():
+    x = paddle.to_tensor(np.array([1.7, 2.3], np.float32))
+    assert x.astype("int32").numpy().dtype == np.int32
+    assert paddle.cast(x, "float64").numpy().dtype == np.float64
+    assert x.astype(paddle.bfloat16).dtype.name == "bfloat16"
+
+
+def test_unbind_chunk():
+    x = randf(3, 4)
+    parts = paddle.unbind(paddle.to_tensor(x), 0)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].numpy(), x[1])
+    chunks = paddle.chunk(paddle.to_tensor(x), 2, axis=1)
+    np.testing.assert_allclose(chunks[0].numpy(), x[:, :2])
+
+
+def test_repeat_interleave_einsum():
+    x = randf(2, 3)
+    np.testing.assert_allclose(
+        paddle.repeat_interleave(paddle.to_tensor(x), 2, axis=0).numpy(),
+        np.repeat(x, 2, 0))
+    a, b = randf(3, 4), randf(4, 5)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                      paddle.to_tensor(b)).numpy(), a @ b, atol=1e-4)

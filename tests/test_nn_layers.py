@@ -1,0 +1,243 @@
+"""nn.Layer mechanics + layer forward checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_layer_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    assert len(net.parameters()) == 4
+    assert len(net.sublayers()) == 3
+
+
+def test_state_dict_roundtrip():
+    net = nn.Linear(4, 3)
+    sd = net.state_dict()
+    net2 = nn.Linear(4, 3)
+    missing, unexpected = net2.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_allclose(net2.weight.numpy(), net.weight.numpy())
+
+
+def test_train_eval_mode():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    x = paddle.ones([10, 4])
+    np.testing.assert_allclose(net[1](x).numpy(), x.numpy())
+    net.train()
+    assert net[1].training
+
+
+def test_forward_hooks():
+    net = nn.Linear(2, 2)
+    calls = []
+    h = net.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    net(paddle.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    net(paddle.ones([1, 2]))
+    assert calls == [1]
+
+
+def test_linear_matches_numpy():
+    net = nn.Linear(3, 4)
+    x = np.random.rand(5, 3).astype(np.float32)
+    out = net(paddle.to_tensor(x))
+    expected = x @ net.weight.numpy() + net.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expected, atol=1e-5)
+
+
+def test_conv2d_shape_and_grad():
+    conv = nn.Conv2D(3, 6, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 8, 8])
+    x.stop_gradient = False
+    out = conv(x)
+    assert out.shape == [2, 6, 4, 4]
+    out.sum().backward()
+    assert conv.weight.grad is not None
+    assert x.grad.shape == [2, 3, 8, 8]
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    w = conv.weight.numpy()
+    out = conv(paddle.to_tensor(x)).numpy()
+    # manual valid conv
+    expected = np.zeros((1, 1, 2, 2), np.float32)
+    for i in range(2):
+        for j in range(2):
+            expected[0, 0, i, j] = np.sum(x[0, 0, i:i+2, j:j+2] * w[0, 0])
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    out = bn(x)
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-4)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8])
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), np.zeros(4), atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_embedding_grad_scatter():
+    emb = nn.Embedding(5, 3)
+    idx = paddle.to_tensor(np.array([0, 0, 1]))
+    out = emb(idx)
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(g[0], 2 * np.ones(3), atol=1e-5)
+    np.testing.assert_allclose(g[1], np.ones(3), atol=1e-5)
+    np.testing.assert_allclose(g[2], np.zeros(3), atol=1e-5)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)
+    np.testing.assert_allclose(mp(x).numpy().reshape(2, 2),
+                               [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2)
+    np.testing.assert_allclose(ap(x).numpy().reshape(2, 2),
+                               [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)
+    np.testing.assert_allclose(float(aap(x).numpy()), 7.5)
+
+
+def test_activations_match_numpy():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+    np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)),
+                               atol=1e-6)
+    sm = F.softmax(paddle.to_tensor(x.reshape(1, -1))).numpy()
+    e = np.exp(x - x.max())
+    np.testing.assert_allclose(sm[0], e / e.sum(), atol=1e-6)
+
+
+def test_losses():
+    pred = paddle.to_tensor(np.array([[2.0, 1.0], [0.5, 3.0]], np.float32))
+    lab = paddle.to_tensor(np.array([0, 1]))
+    l = F.cross_entropy(pred, lab)
+    p = np.exp(pred.numpy())
+    p = p / p.sum(-1, keepdims=True)
+    expected = -np.log(p[[0, 1], [0, 1]]).mean()
+    np.testing.assert_allclose(float(l), expected, atol=1e-5)
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([1.5, 1.0], np.float32))
+    np.testing.assert_allclose(float(F.mse_loss(a, b)),
+                               ((np.array([1., 2.]) -
+                                 np.array([1.5, 1.])) ** 2).mean(), atol=1e-6)
+    np.testing.assert_allclose(float(F.l1_loss(a, b)), 0.75, atol=1e-6)
+
+
+def test_cross_entropy_ignore_index():
+    pred = paddle.randn([4, 5])
+    lab = paddle.to_tensor(np.array([0, -100, 2, -100]))
+    l = F.cross_entropy(pred, lab, ignore_index=-100)
+    lab2 = paddle.to_tensor(np.array([0, 2]))
+    pred2 = paddle.to_tensor(pred.numpy()[[0, 2]])
+    l2 = F.cross_entropy(pred2, lab2)
+    np.testing.assert_allclose(float(l), float(l2), atol=1e-5)
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+    assert len(s) == 3
+    out = s(paddle.ones([4, 2]))
+    assert out.shape == [4, 1]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    # grads flow to every distinct layer
+    out.sum().backward()
+    grads = [p.grad is not None for p in enc.parameters()]
+    assert all(grads)
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.randn([3, 5, 4])
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 5, 8]
+    assert h.shape == [2, 3, 8]
+    gru = nn.GRU(4, 8, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [3, 5, 16]
+
+    out.sum().backward()
+    assert all(p.grad is not None for p in gru.parameters())
+
+
+def test_rnn_cells():
+    cell = nn.LSTMCell(4, 6)
+    x = paddle.randn([2, 4])
+    h, (hn, cn) = cell(x)
+    assert h.shape == [2, 6]
+    rnn = nn.RNN(nn.GRUCell(4, 6))
+    out, st = rnn(paddle.randn([2, 3, 4]))
+    assert out.shape == [2, 3, 6]
+
+
+def test_clip_grad_by_global_norm():
+    p = nn.Parameter(np.ones(4, np.float32))
+    from paddle_tpu.framework.core import Tensor
+    g = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p, g)])
+    norm = np.linalg.norm(out[0][1].numpy())
+    np.testing.assert_allclose(norm, 1.0, atol=1e-5)

@@ -1,0 +1,203 @@
+"""Math-op forward/grad checks (OpTest methodology)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+
+RNG = np.random.default_rng(0)
+
+
+def randf(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def randpos(*shape):
+    return (RNG.random(shape).astype(np.float32) + 0.5)
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("op,npop", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    ])
+    def test_forward(self, op, npop):
+        check_forward(op, npop, [randf(3, 4), randpos(3, 4)])
+
+    def test_broadcast(self):
+        check_forward(paddle.add, np.add, [randf(3, 4), randf(4)])
+        check_forward(paddle.multiply, np.multiply, [randf(2, 1, 4),
+                                                     randf(3, 1)])
+
+    def test_grad_add_mul(self):
+        check_grad(paddle.add, [randf(3, 4), randf(3, 4)])
+        check_grad(paddle.multiply, [randf(3, 4), randf(3, 4)])
+        check_grad(paddle.divide, [randf(3, 4), randpos(3, 4)])
+
+    def test_scalar_operand(self):
+        x = paddle.to_tensor(randf(3, 4))
+        np.testing.assert_allclose((x + 2.0).numpy(), x.numpy() + 2.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose((2.0 * x).numpy(), 2.0 * x.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose((1.0 / (x + 10)).numpy(),
+                                   1.0 / (x.numpy() + 10), rtol=1e-5)
+
+    def test_pow_mod(self):
+        check_forward(paddle.pow, np.power, [randpos(3, 3), randf(3, 3)],
+                      atol=1e-4, rtol=1e-4)
+        check_forward(paddle.mod, np.mod, [randpos(4), randpos(4)])
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("op,npop", [
+        (paddle.exp, np.exp), (paddle.tanh, np.tanh), (paddle.sin, np.sin),
+        (paddle.cos, np.cos), (paddle.abs, np.abs), (paddle.floor, np.floor),
+        (paddle.ceil, np.ceil), (paddle.square, np.square),
+    ])
+    def test_forward(self, op, npop):
+        check_forward(op, npop, [randf(3, 4)], atol=1e-5)
+
+    def test_log_sqrt(self):
+        check_forward(paddle.log, np.log, [randpos(3, 4)], atol=1e-5)
+        check_forward(paddle.sqrt, np.sqrt, [randpos(3, 4)], atol=1e-5)
+        check_grad(paddle.log, [randpos(3, 3)])
+        check_grad(paddle.sqrt, [randpos(3, 3)])
+
+    def test_grad_elementwise(self):
+        check_grad(paddle.tanh, [randf(3, 3)])
+        check_grad(paddle.exp, [randf(3, 3) * 0.5])
+        check_grad(paddle.square, [randf(3, 3)])
+
+    def test_clip(self):
+        check_forward(paddle.clip, lambda a: np.clip(a, -0.5, 0.5),
+                      [randf(4, 4)], min=-0.5, max=0.5)
+
+
+class TestMatmul:
+    def test_forward(self):
+        check_forward(paddle.matmul, np.matmul, [randf(3, 4), randf(4, 5)],
+                      atol=1e-4)
+        check_forward(paddle.matmul, lambda a, b: np.matmul(a, b),
+                      [randf(2, 3, 4), randf(2, 4, 5)], atol=1e-4)
+
+    def test_transpose_flags(self):
+        a, b = randf(4, 3), randf(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, atol=1e-4)
+
+    def test_grad(self):
+        check_grad(paddle.matmul, [randf(3, 4), randf(4, 2)], atol=1e-2,
+                   rtol=1e-2)
+
+    def test_dot_outer(self):
+        check_forward(paddle.dot, lambda a, b: np.sum(a * b, -1),
+                      [randf(5), randf(5)], atol=1e-5)
+        check_forward(paddle.outer, np.outer, [randf(3), randf(4)], atol=1e-5)
+
+
+class TestReductions:
+    @pytest.mark.parametrize("op,npop", [
+        (paddle.sum, np.sum), (paddle.mean, np.mean), (paddle.max, np.max),
+        (paddle.min, np.min), (paddle.prod, np.prod),
+    ])
+    def test_full(self, op, npop):
+        check_forward(op, npop, [randf(3, 4)], atol=1e-5)
+
+    def test_axis_keepdim(self):
+        x = randf(3, 4, 5)
+        check_forward(paddle.sum, lambda a: np.sum(a, axis=1), [x],
+                      atol=1e-5, axis=1)
+        check_forward(paddle.mean, lambda a: np.mean(a, axis=(0, 2),
+                                                     keepdims=True),
+                      [x], atol=1e-5, axis=[0, 2], keepdim=True)
+
+    def test_grad(self):
+        check_grad(paddle.sum, [randf(3, 4)])
+        check_grad(paddle.mean, [randf(3, 4)], axis=1)
+        check_grad(lambda x: paddle.logsumexp(x, axis=-1), [randf(3, 4)])
+
+    def test_std_var(self):
+        x = randf(5, 6)
+        check_forward(paddle.std, lambda a: np.std(a, ddof=1), [x], atol=1e-5)
+        check_forward(paddle.var, lambda a: np.var(a, ddof=1), [x], atol=1e-5)
+
+    def test_cumsum(self):
+        x = randf(3, 4)
+        check_forward(paddle.cumsum, lambda a: np.cumsum(a, axis=1), [x],
+                      atol=1e-5, axis=1)
+        check_forward(paddle.cumsum, lambda a: np.cumsum(a.reshape(-1)), [x],
+                      atol=1e-5)
+
+
+class TestLogic:
+    def test_compare(self):
+        a, b = randf(3, 4), randf(3, 4)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((ta > tb).numpy(), a > b)
+        np.testing.assert_array_equal((ta == ta).numpy(), a == a)
+        np.testing.assert_array_equal(
+            paddle.logical_and(ta > 0, tb > 0).numpy(), (a > 0) & (b > 0))
+
+    def test_allclose_equal_all(self):
+        a = randf(3)
+        assert bool(paddle.allclose(paddle.to_tensor(a), paddle.to_tensor(a)))
+        assert bool(paddle.equal_all(paddle.to_tensor(a), paddle.to_tensor(a)))
+        assert not bool(paddle.equal_all(paddle.to_tensor(a),
+                                         paddle.to_tensor(a + 1)))
+
+
+class TestLinalg:
+    def test_inv_det(self):
+        x = randf(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        check_forward(paddle.linalg.inv, np.linalg.inv, [x], atol=1e-4)
+        check_forward(paddle.linalg.det, np.linalg.det, [x], atol=1e-3,
+                      rtol=1e-3)
+
+    def test_svd_qr_cholesky(self):
+        x = randf(5, 3)
+        u, s, v = paddle.linalg.svd(paddle.to_tensor(x))
+        recon = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(recon, x, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(x))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-4)
+        spd = x.T @ x + 3 * np.eye(3, dtype=np.float32)
+        l = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-4)
+
+    def test_norm_solve(self):
+        x = randf(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = randf(3, 2)
+        sol = paddle.linalg.solve(paddle.to_tensor(x), paddle.to_tensor(b))
+        np.testing.assert_allclose(x @ sol.numpy(), b, atol=1e-4)
+        check_forward(paddle.linalg.norm, np.linalg.norm, [randf(4, 5)],
+                      atol=1e-5)
+
+
+class TestSearchSort:
+    def test_argmax_topk(self):
+        x = randf(4, 6)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(paddle.argmax(t, axis=1).numpy(),
+                                      np.argmax(x, axis=1))
+        vals, idx = paddle.topk(t, 3, axis=1)
+        expected = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), expected, atol=1e-6)
+
+    def test_sort_unique(self):
+        x = np.array([3, 1, 2, 1, 3], np.float32)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x)).numpy(),
+                                   np.sort(x))
+        u = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+
+    def test_nonzero_where(self):
+        x = np.array([[1, 0], [0, 2]], np.float32)
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(nz.numpy(), [[0, 0], [1, 1]])
+        out = paddle.where(paddle.to_tensor(x) > 0, paddle.to_tensor(x),
+                           paddle.zeros([2, 2]))
+        np.testing.assert_allclose(out.numpy(), np.where(x > 0, x, 0))
