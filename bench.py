@@ -1,0 +1,94 @@
+"""Benchmark: GPT-2 124M training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Metric: tokens/sec/chip through the fully-fused jitted train step (bf16
+compute, f32 master weights in AdamW). vs_baseline = achieved MFU / 0.45
+(the BASELINE.md north-star MFU target).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip():
+    """bf16 peak for the local chip. TPU v5 lite (v5e): 197 TFLOP/s."""
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # conservative default
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.models import (GPTForCausalLM, gpt2_124m,
+                                            GPTPretrainingCriterion)
+    from paddle_tpu.jit import TrainStep
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    seq = 1024
+    batch = 8 if on_tpu else 2
+    steps = 10 if on_tpu else 2
+
+    paddle.seed(0)
+    cfg = gpt2_124m(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                    max_position_embeddings=seq)
+    model = GPTForCausalLM(cfg)
+    n_params = model.num_params()
+    if on_tpu:
+        model.bfloat16()            # bf16 weights; f32 master in AdamW
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                 parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+    criterion = GPTPretrainingCriterion()
+    step = TrainStep(model, lambda logits, y: criterion(logits, y), opt)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    x = paddle.Tensor(ids, stop_gradient=True)
+    y = paddle.Tensor(labels, stop_gradient=True)
+
+    # warmup / compile
+    loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    final = float(loss)  # blocks on the last step
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / elapsed
+
+    flops_per_token = model.flops_per_token(seq, training=True)
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {"mfu": round(mfu, 4), "loss": round(final, 3),
+                  "batch": batch, "seq": seq, "params": n_params,
+                  "platform": jax.devices()[0].platform},
+    }))
+
+
+if __name__ == "__main__":
+    main()

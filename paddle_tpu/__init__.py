@@ -10,7 +10,14 @@ Architecture (vs the reference layer map, SURVEY.md §1):
 """
 from __future__ import annotations
 
+import sys as _sys
+
 __version__ = "0.1.0"
+
+# deep transformer stacks exceed the default interpreter recursion limit
+# during jax tracing/linearization
+if _sys.getrecursionlimit() < 10000:
+    _sys.setrecursionlimit(10000)
 
 from .framework import (  # noqa: F401
     Tensor, Parameter, to_tensor, is_tensor, Place,

@@ -1,3 +1,4 @@
 """paddle.incubate equivalent."""
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
+from . import models  # noqa: F401

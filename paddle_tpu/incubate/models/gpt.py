@@ -1,0 +1,297 @@
+"""GPT model family (decoder-only transformer LM).
+
+Reference analog: the Fleet GPT-3 training path the reference was built for
+(SURVEY.md north star; mp layers fleet/layers/mpu/mp_layers.py + fused
+transformer ops fluid/operators/fused/). Model configs follow the standard
+GPT-2 124M / GPT-3 1.3B / 6.7B shapes from BASELINE.md.
+
+TPU-first design:
+  - attention core routes through F.scaled_dot_product_attention → Pallas
+    flash kernel when eligible (bf16, block-aligned seq);
+  - hybrid parallelism is expressed as NamedShardings over the global mesh
+    (`shard_gpt`): embedding/vocab and qkv/ffn columns on the "model" axis,
+    activations on "data" (+ sequence on "sep" when present) — XLA inserts the
+    Megatron collectives;
+  - everything trains through one jitted step (paddle_tpu.jit.TrainStep or
+    the sharded variant in __graft_entry__).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...nn.layer_base import Layer
+from ...nn.layer.container import LayerList
+from ...nn.layer.common import Linear, Embedding, Dropout
+from ...nn.layer.norm import LayerNorm
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.initializer_util import ParamAttr
+from ...ops import manipulation as manip
+from ...framework.core import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+           "gpt2_124m", "gpt3_1p3b", "gpt3_6p7b", "shard_gpt"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304            # padded to a multiple of 128 for MXU
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = True
+
+
+def gpt2_124m(**overrides):
+    return GPTConfig(**{**dict(hidden_size=768, num_hidden_layers=12,
+                               num_attention_heads=12, intermediate_size=3072),
+                        **overrides})
+
+
+def gpt3_1p3b(**overrides):
+    return GPTConfig(**{**dict(hidden_size=2048, num_hidden_layers=24,
+                               num_attention_heads=16, intermediate_size=8192,
+                               max_position_embeddings=2048),
+                        **overrides})
+
+
+def gpt3_6p7b(**overrides):
+    return GPTConfig(**{**dict(hidden_size=4096, num_hidden_layers=32,
+                               num_attention_heads=32, intermediate_size=16384,
+                               max_position_embeddings=2048),
+                        **overrides})
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.hidden_size = config.hidden_size
+        init = I.Normal(0.0, config.initializer_range)
+        self.qkv_proj = Linear(config.hidden_size, 3 * config.hidden_size,
+                               weight_attr=ParamAttr(initializer=init))
+        self.out_proj = Linear(config.hidden_size, config.hidden_size,
+                               weight_attr=ParamAttr(initializer=init))
+        self.dropout_p = config.attention_probs_dropout_prob
+        self.resid_dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        b, n = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = manip.reshape(qkv, [b, n, 3, self.num_heads, self.head_dim])
+        q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), 2)
+        k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), 2)
+        v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), 2)
+        if cache is not None:
+            pk, pv = cache
+            k = manip.concat([pk, k], axis=1)
+            v = manip.concat([pv, v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout_p if self.training else 0.0,
+            training=self.training)
+        out = manip.reshape(out, [b, n, self.hidden_size])
+        out = self.resid_dropout(self.out_proj(out))
+        return (out, cache) if cache is not None else out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size,
+                            weight_attr=ParamAttr(initializer=init))
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x),
+                                               approximate=True)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, cache = self.attn(self.ln_1(x), cache)
+        else:
+            a = self.attn(self.ln_1(x))
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return (x, cache) if cache is not None else x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size,
+                             weight_attr=ParamAttr(initializer=init))
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        b, n = input_ids.shape[0], input_ids.shape[1]
+        past_len = caches[0][0].shape[1] if caches is not None else 0
+        if position_ids is None:
+            pos = Tensor(jnp.arange(past_len, past_len + n,
+                                    dtype=jnp.int32)[None, :])
+        else:
+            pos = position_ids
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        if caches is None:
+            for block in self.h:
+                x = block(x)
+            return self.ln_f(x)
+        new_caches = []
+        for block, cache in zip(self.h, caches):
+            x, c = block(x, cache)
+            new_caches.append(c)
+        return self.ln_f(x), new_caches
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def gen_caches(self, batch_size, dtype="float32"):
+        """Empty KV caches for incremental decoding."""
+        from ...ops.creation import zeros
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [(zeros([batch_size, 0, cfg.num_attention_heads, head_dim],
+                       dtype),
+                 zeros([batch_size, 0, cfg.num_attention_heads, head_dim],
+                       dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        if caches is None:
+            hidden = self.gpt(input_ids, position_ids)
+        else:
+            hidden, caches = self.gpt(input_ids, position_ids, caches)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            # tied: logits = hidden @ wte^T
+            logits = F.linear(hidden,
+                              manip.transpose(self.gpt.wte.weight, [1, 0]))
+        return logits if caches is None else (logits, caches)
+
+    def num_params(self, include_embeddings=True):
+        total = 0
+        for _, p in self.named_parameters():
+            if not include_embeddings and "wte" in _:
+                continue
+            total += p.size
+        return total
+
+    def flops_per_token(self, seq_len, training=True):
+        """Model FLOPs per token, PaLM-appendix counting: training =
+        6N + 12*L*h*s (fwd+bwd), inference = 2N + 4*L*h*s."""
+        n = self.num_params()
+        cfg = self.config
+        attn_fwd = 4 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        if training:
+            return 6 * n + 3 * attn_fwd
+        return 2 * n + attn_fwd
+
+
+class GPTPretrainingCriterion(Layer):
+    """Language-model loss (next-token cross entropy)."""
+
+    def __init__(self, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        b, n, v = logits.shape
+        flat = manip.reshape(logits, [b * n, v])
+        flat_lab = manip.reshape(labels, [b * n])
+        return F.cross_entropy(flat, flat_lab,
+                               ignore_index=self.ignore_index)
+
+
+# ---------------------------------------------------------------------------
+# Hybrid-parallel sharding rules
+# ---------------------------------------------------------------------------
+
+def shard_gpt(model: GPTForCausalLM, mesh, dtype=None):
+    """Annotate GPT parameters with NamedShardings over `mesh`.
+
+    Megatron placement (SURVEY.md §7 row "mp layers"): qkv and fc_in are
+    column-parallel (out-dim on "model"), out_proj and fc_out are row-parallel
+    (in-dim on "model"), embeddings vocab-parallel. Remaining axes are left to
+    the partitioner; optimizer state inherits shardings from params and is
+    further sharded over "sharding" by the sharded optimizer.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(p, spec):
+        if p is None:
+            return
+        val = p._value
+        if dtype is not None:
+            val = val.astype(dtype)
+        p._value = jax.device_put(val, NamedSharding(mesh, spec))
+
+    rules = [
+        ("wte.weight", P("model", None)),
+        ("wpe.weight", P()),
+        ("qkv_proj.weight", P(None, "model")),
+        ("qkv_proj.bias", P("model")),
+        ("out_proj.weight", P("model", None)),
+        ("out_proj.bias", P()),
+        ("fc_in.weight", P(None, "model")),
+        ("fc_in.bias", P("model")),
+        ("fc_out.weight", P("model", None)),
+        ("fc_out.bias", P()),
+        ("lm_head.weight", P(None, "model")),
+        ("ln_", P()),
+    ]
+    for name, p in model.named_parameters():
+        spec = None
+        for pat, s in rules:
+            if pat in name:
+                spec = s
+                break
+        put(p, spec if spec is not None else P())
+    return model

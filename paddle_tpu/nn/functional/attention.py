@@ -19,7 +19,8 @@ from ...ops.registry import register_op
 __all__ = ["scaled_dot_product_attention"]
 
 
-def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0):
+def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0,
+                     dropout_key=None):
     # q,k,v: [B, N, H, D] (paddle layout: batch, seq, heads, head_dim)
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, N, D]
     kt = jnp.swapaxes(k, 1, 2)
@@ -27,8 +28,12 @@ def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0):
     scores = jnp.einsum("bhnd,bhmd->bhnm", qt, kt) * scale
     if is_causal:
         n, m = scores.shape[-2], scores.shape[-1]
-        causal = jnp.tril(jnp.ones((n, m), bool))
-        scores = jnp.where(causal, scores, jnp.asarray(-1e30, scores.dtype))
+        # bottom-right alignment: with cached keys (m > n), query i sits at
+        # absolute position i + (m - n) and may attend to keys <= that
+        q_pos = jnp.arange(n)[:, None] + (m - n)
+        k_pos = jnp.arange(m)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores,
+                           jnp.asarray(-1e30, scores.dtype))
     if mask is not None:
         if mask.dtype == jnp.bool_.dtype:
             scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
@@ -36,6 +41,10 @@ def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0):
             scores = scores + mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1) \
         .astype(scores.dtype)
+    if dropout_p and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = probs * keep.astype(probs.dtype) / \
+            jnp.asarray(1.0 - dropout_p, probs.dtype)
     out = jnp.einsum("bhnm,bhmd->bhnd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 
@@ -63,6 +72,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                            scale=scale)
         return call_op("flash_attention", fn, (q, k, v))
 
+    drop_key = None
+    if dropout_p and training:
+        from ...framework.random import get_rng_key
+        drop_key = get_rng_key()
+
     def fn(qq, kk, vv):
-        return _plain_attention(qq, kk, vv, mask_v, is_causal, scale)
+        return _plain_attention(qq, kk, vv, mask_v, is_causal, scale,
+                                dropout_p if training else 0.0, drop_key)
     return call_op("scaled_dot_product_attention", fn, (q, k, v))
