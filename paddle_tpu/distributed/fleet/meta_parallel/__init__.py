@@ -9,4 +9,7 @@ from .mp_ops import _c_identity, _c_concat, _c_split, _mp_allreduce, split  # no
 from .pp_layers import LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .parallel_wrappers import TensorParallel, ShardingParallel  # noqa: F401
+from .sep_parallel import (  # noqa: F401
+    ring_attention, ulysses_attention, sep_attention, SEP_AXIS,
+)
 from .hybrid_optimizer import HybridParallelOptimizer, HybridParallelClipGrad  # noqa: F401

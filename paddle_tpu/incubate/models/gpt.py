@@ -85,6 +85,7 @@ class GPTAttention(Layer):
         self.out_proj = Linear(config.hidden_size, config.hidden_size,
                                weight_attr=ParamAttr(initializer=init))
         self.dropout_p = config.attention_probs_dropout_prob
+        self.use_flash_attention = config.use_flash_attention
         self.resid_dropout = Dropout(config.hidden_dropout_prob)
 
     def forward(self, x, cache=None):
@@ -102,7 +103,8 @@ class GPTAttention(Layer):
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0,
-            training=self.training)
+            training=self.training,
+            use_flash_attention=self.use_flash_attention)
         out = manip.reshape(out, [b, n, self.hidden_size])
         out = self.resid_dropout(self.out_proj(out))
         return (out, cache) if cache is not None else out
@@ -191,10 +193,14 @@ class GPTForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def gen_caches(self, batch_size, dtype="float32"):
-        """Empty KV caches for incremental decoding."""
+    def gen_caches(self, batch_size, dtype=None):
+        """Empty KV caches for incremental decoding. dtype defaults to the
+        model's parameter dtype (so bf16 models get bf16 caches)."""
         from ...ops.creation import zeros
         cfg = self.config
+        if dtype is None:
+            params = self.parameters()
+            dtype = params[0].dtype if params else "float32"
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         return [(zeros([batch_size, 0, cfg.num_attention_heads, head_dim],
                        dtype),

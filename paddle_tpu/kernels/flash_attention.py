@@ -58,6 +58,10 @@ def is_eligible(q, k, v, mask, dropout_p):
     m = k.shape[1]
     if d not in (64, 128, 256):
         return False
+    if n != m:
+        # kv-cache decode/prefill shapes (m > n) use bottom-right causal
+        # alignment; this kernel's masking is top-left self-attention only
+        return False
     if n % 128 != 0 or m % 128 != 0:
         return False
     from ..framework.flags import FLAGS
@@ -158,7 +162,10 @@ def _plain_attention_vjp(q, k, v, causal, scale):
     s = jnp.einsum("bhnd,bhmd->bhnm", qt, kt) * scale
     if causal:
         n, m = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((n, m), bool))
+        # bottom-right alignment, matching _plain_attention (only n == m
+        # reaches the flash path today, where the two coincide)
+        q_pos = jnp.arange(n)[:, None] + (m - n)
+        mask = q_pos >= jnp.arange(m)[None, :]
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhnm,bhmd->bhnd", p, vt)

@@ -53,7 +53,8 @@ def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0,
              ref="fluid/operators/fused/fused_attention_op.cu")
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, name=None,
+                                 use_flash_attention=None):
     """query/key/value: [batch, seq, num_heads, head_dim] (paddle convention).
 
     On TPU with flash-eligible shapes this runs the Pallas flash-attention
@@ -65,8 +66,31 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     scale = 1.0 / math.sqrt(q.shape[-1])
     mask_v = ensure_tensor(attn_mask)._value if attn_mask is not None else None
 
+    # sequence/context parallelism: inside an SPMD trace binding the "sep"
+    # axis, q/k/v are sequence shards — use ring attention so no chip ever
+    # materializes the full sequence (paddle_tpu sep_parallel; the reference
+    # has no sequence parallelism, SURVEY.md §5)
+    from ...distributed.fleet.meta_parallel.mp_ops import in_spmd_axis
+    if in_spmd_axis("sep"):
+        eff_dropout = dropout_p if training else 0.0
+        if mask_v is not None or eff_dropout:
+            # a shard-local dense fallback would attend only to this chip's
+            # keys — globally wrong. Fail loudly instead.
+            raise NotImplementedError(
+                "sequence-parallel attention (sep axis) supports causal/full "
+                "attention without attn_mask or attention dropout; got "
+                f"attn_mask={attn_mask is not None}, dropout_p={dropout_p}")
+
+        def fn(qq, kk, vv):
+            from ...distributed.fleet.meta_parallel.sep_parallel import (
+                ring_attention)
+            return ring_attention(qq, kk, vv, "sep", causal=is_causal,
+                                  scale=scale)
+        return call_op("ring_attention", fn, (q, k, v))
+
     from ...kernels import flash_attention as fa
-    if fa.is_eligible(q._value, k._value, v._value, mask_v, dropout_p):
+    if use_flash_attention is not False and \
+            fa.is_eligible(q._value, k._value, v._value, mask_v, dropout_p):
         def fn(qq, kk, vv):
             return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
                                            scale=scale)

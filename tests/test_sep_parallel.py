@@ -1,0 +1,114 @@
+"""Context/sequence parallelism: ring attention + Ulysses vs dense reference.
+
+Runs on the 8-device virtual CPU mesh (conftest). The reference snapshot has
+no sequence parallelism (SURVEY.md §5) — correctness is checked against the
+framework's own dense attention.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.distributed.fleet.meta_parallel.sep_parallel import (
+    ring_attention, ulysses_attention)
+from paddle_tpu.nn.functional.attention import _plain_attention
+
+B, N, H, D = 2, 32, 4, 16
+SEP = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:SEP]), ("sep",))
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.standard_normal((B, N, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _ref(q, k, v, causal):
+    return _plain_attention(q, k, v, None, causal, 1.0 / (D ** 0.5))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    mesh = _mesh()
+    fn = shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "sep", causal=causal),
+        mesh=mesh, in_specs=P(None, "sep"), out_specs=P(None, "sep"))
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v,
+                                                                causal)),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    q, k, v = _qkv(1)
+    mesh = _mesh()
+    fn = shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, "sep", causal=causal),
+        mesh=mesh, in_specs=P(None, "sep"), out_specs=P(None, "sep"))
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v,
+                                                                causal)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gradients_match_dense():
+    q, k, v = _qkv(2)
+    mesh = _mesh()
+
+    def ring_loss(a, b, c):
+        fn = shard_map(
+            lambda x, y, z: ring_attention(x, y, z, "sep", causal=True),
+            mesh=mesh, in_specs=P(None, "sep"), out_specs=P(None, "sep"))
+        return jnp.sum(fn(a, b, c) ** 2)
+
+    def dense_loss(a, b, c):
+        return jnp.sum(_ref(a, b, c, True) ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_ring_attention_eight_way():
+    """Full 8-way split, one query position per shard pair."""
+    q, k, v = _qkv(3)
+    mesh = Mesh(np.array(jax.devices()), ("sep",))
+    fn = shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "sep", causal=True),
+        mesh=mesh, in_specs=P(None, "sep"), out_specs=P(None, "sep"))
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q, k, v, True)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sdpa_dispatches_to_ring_under_sep_axis():
+    """nn.functional.scaled_dot_product_attention auto-routes to ring
+    attention when traced inside a shard_map binding the sep axis."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.core import Tensor
+
+    q, k, v = _qkv(4)
+    mesh = _mesh()
+
+    def local(a, b, c):
+        return F.scaled_dot_product_attention(
+            Tensor(a, stop_gradient=True), Tensor(b, stop_gradient=True),
+            Tensor(c, stop_gradient=True), is_causal=True)._value
+
+    fn = shard_map(local, mesh=mesh, in_specs=P(None, "sep"),
+                   out_specs=P(None, "sep"))
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q, k, v, True)),
+                               rtol=2e-5, atol=2e-5)
