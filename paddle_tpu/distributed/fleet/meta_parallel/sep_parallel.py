@@ -135,6 +135,9 @@ def sep_attention(q, k, v, causal=False, scale=None, mode="ring",
                   axis_name=SEP_AXIS):
     """Dispatch helper: ring or ulysses when inside an SPMD trace binding the
     sep axis; dense fallback otherwise (so model code is mode-agnostic)."""
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sep attention mode {mode!r}; "
+                         "expected 'ring' or 'ulysses'")
     if in_spmd_axis(axis_name):
         if mode == "ulysses":
             return ulysses_attention(q, k, v, axis_name, causal, scale)

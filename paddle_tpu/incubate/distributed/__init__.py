@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed equivalent (MoE model layers)."""
+from . import models  # noqa: F401

@@ -1,0 +1,4 @@
+"""Mixture-of-Experts. Reference analog:
+python/paddle/incubate/distributed/models/moe/ (MoELayer + gates)."""
+from .moe_layer import MoELayer  # noqa: F401
+from .gate import top1_dispatch, top2_dispatch, naive_dispatch  # noqa: F401

@@ -1,0 +1,86 @@
+"""MoE router gates: top-1 (Switch) and top-2 (GShard) capacity dispatch.
+
+Reference analog: python/paddle/incubate/distributed/models/moe/gate/
+({naive,switch,gshard}_gate.py). The reference routes with argsort +
+global_scatter (dynamic token counts per expert); TPU-first routing instead
+builds *static-shape* dispatch/combine tensors [tokens, experts, capacity] —
+the GShard formulation — so everything stays jit-able and MXU-friendly; token
+overflow beyond an expert's capacity is dropped (standard GShard semantics).
+
+All functions are pure jnp: gates [T, E] (f32 softmax probs) -> (dispatch
+mask D [T, E, C] one-hot, combine weights W [T, E, C], aux load-balance
+loss scalar).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["top1_dispatch", "top2_dispatch", "naive_dispatch"]
+
+
+def _positions_in_expert(mask, offset=None):
+    """0-based arrival position of each token within its expert's queue.
+    mask: [T, E] one-hot float. Returns int32 [T, E] (valid where mask==1)."""
+    pos = jnp.cumsum(mask, axis=0) - mask           # tokens before me
+    if offset is not None:
+        pos = pos + offset[None, :]
+    return pos.astype(jnp.int32)
+
+
+def _aux_loss(gates, mask1):
+    """GShard/Switch load-balance loss: E * Σ_e mean_prob_e * mean_assign_e."""
+    e = gates.shape[-1]
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(gates.dtype), axis=0)
+    return jnp.sum(me * ce) * e
+
+
+def top1_dispatch(gates, capacity):
+    """Switch-Transformer routing: each token to its argmax expert."""
+    t, e = gates.shape
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)
+    aux = _aux_loss(gates, mask1)
+    pos1 = _positions_in_expert(mask1)
+    keep1 = mask1 * (pos1 < capacity).astype(gates.dtype)
+    disp = keep1[..., None] * jax.nn.one_hot(pos1, capacity,
+                                             dtype=gates.dtype)
+    g1 = jnp.sum(gates * mask1, axis=-1)            # prob of chosen expert
+    combine = g1[:, None, None] * disp
+    return disp, combine, aux
+
+
+def top2_dispatch(gates, capacity):
+    """GShard top-2 routing with renormalized combine weights."""
+    t, e = gates.shape
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)
+    gates2 = gates * (1.0 - mask1)                  # mask out the winner
+    idx2 = jnp.argmax(gates2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+    aux = _aux_loss(gates, mask1)
+
+    pos1 = _positions_in_expert(mask1)
+    # second choices queue behind every first choice for the same expert
+    count1 = jnp.sum(mask1, axis=0)
+    pos2 = _positions_in_expert(mask2, offset=count1)
+    keep1 = mask1 * (pos1 < capacity).astype(gates.dtype)
+    keep2 = mask2 * (pos2 < capacity).astype(gates.dtype)
+
+    g1 = jnp.sum(gates * mask1, axis=-1)
+    g2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    oh1 = keep1[..., None] * jax.nn.one_hot(pos1, capacity, dtype=gates.dtype)
+    oh2 = keep2[..., None] * jax.nn.one_hot(pos2, capacity, dtype=gates.dtype)
+    disp = oh1 + oh2
+    combine = g1[:, None, None] * oh1 + g2[:, None, None] * oh2
+    return disp, combine, aux
+
+
+def naive_dispatch(gates, capacity):
+    """NaiveGate: top-1 without load-balance loss (reference naive_gate.py)."""
+    disp, combine, _ = top1_dispatch(gates, capacity)
+    return disp, combine, jnp.zeros((), gates.dtype)

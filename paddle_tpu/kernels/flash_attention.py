@@ -44,7 +44,7 @@ def _on_tpu():
 FLASH_MIN_SEQ = 2048
 
 
-def is_eligible(q, k, v, mask, dropout_p):
+def is_eligible(q, k, v, mask, dropout_p, is_causal=False):
     """Flash path requires: TPU, no explicit mask (causal flag ok), no dropout,
     block-friendly seq lengths and head_dim, and long-enough sequences that
     blockwise streaming beats XLA's fused N^2 attention."""
@@ -58,9 +58,10 @@ def is_eligible(q, k, v, mask, dropout_p):
     m = k.shape[1]
     if d not in (64, 128, 256):
         return False
-    if n != m:
+    if is_causal and n != m:
         # kv-cache decode/prefill shapes (m > n) use bottom-right causal
-        # alignment; this kernel's masking is top-left self-attention only
+        # alignment; this kernel's causal masking is top-left (n == m) only.
+        # Non-causal cross-attention has no mask, so any n/m is fine.
         return False
     if n % 128 != 0 or m % 128 != 0:
         return False

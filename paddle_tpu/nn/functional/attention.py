@@ -88,9 +88,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                   scale=scale)
         return call_op("ring_attention", fn, (q, k, v))
 
+    eff_dropout = dropout_p if training else 0.0
     from ...kernels import flash_attention as fa
     if use_flash_attention is not False and \
-            fa.is_eligible(q._value, k._value, v._value, mask_v, dropout_p):
+            fa.is_eligible(q._value, k._value, v._value, mask_v, eff_dropout,
+                           is_causal=is_causal):
         def fn(qq, kk, vv):
             return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
                                            scale=scale)
