@@ -1,0 +1,360 @@
+// TCPStore: socket key-value rendezvous store.
+//
+// Reference analog: paddle/fluid/distributed/store/tcp_store.h:117 (TCPStore
+// master on rank 0, clients over TCP; set/get/add/wait/barrier) and
+// tcp_utils.cc. TPU-native role: bootstrap rendezvous for multi-host jobs
+// (the jax coordination-service analog kept native so launch/elastic tooling
+// can rendezvous before any JAX runtime exists) and a general KV/barrier
+// fabric for the launch CLI and tests.
+//
+// Protocol (length-prefixed, little-endian):
+//   request:  u8 op | u32 key_len | key bytes | u64 arg | u32 val_len | val
+//   response: i64 code | u32 val_len | val bytes
+// Ops: 0=SET 1=GET 2=ADD 3=WAIT 4=DELETE 5=PING
+// GET code: 0 found, -1 missing. WAIT blocks server-side until key exists or
+// arg (timeout ms, 0 = forever) elapses; code 0 ok, -2 timeout.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct StoreData {
+  std::map<std::string, std::vector<uint8_t>> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+  std::mutex conn_mu;
+  StoreData data;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, int64_t code, const uint8_t* val, uint32_t len) {
+  std::vector<uint8_t> out(sizeof(int64_t) + sizeof(uint32_t) + len);
+  std::memcpy(out.data(), &code, sizeof(code));
+  std::memcpy(out.data() + 8, &len, sizeof(len));
+  if (len) std::memcpy(out.data() + 12, val, len);
+  return write_full(fd, out.data(), out.size());
+}
+
+void serve_loop(Server* s, int fd);
+
+// single exit point closes fd exactly once; server_stop only shutdown()s
+// tracked fds to wake blocked reads, never closes them
+void serve_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  serve_loop(s, fd);
+  ::close(fd);
+}
+
+void serve_loop(Server* s, int fd) {
+  for (;;) {
+    uint8_t op;
+    uint32_t key_len;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &key_len, 4)) break;
+    if (key_len > (1u << 20)) break;
+    std::string key(key_len, '\0');
+    if (key_len && !read_full(fd, key.data(), key_len)) break;
+    uint64_t arg;
+    uint32_t val_len;
+    if (!read_full(fd, &arg, 8) || !read_full(fd, &val_len, 4)) break;
+    if (val_len > (1u << 30)) break;
+    std::vector<uint8_t> val(val_len);
+    if (val_len && !read_full(fd, val.data(), val_len)) break;
+
+    switch (op) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(s->data.mu);
+          s->data.kv[key] = std::move(val);
+        }
+        s->data.cv.notify_all();
+        if (!send_resp(fd, 0, nullptr, 0)) return;
+        break;
+      }
+      case 1: {  // GET
+        std::unique_lock<std::mutex> lk(s->data.mu);
+        auto it = s->data.kv.find(key);
+        if (it == s->data.kv.end()) {
+          lk.unlock();
+          if (!send_resp(fd, -1, nullptr, 0)) return;
+        } else {
+          std::vector<uint8_t> copy = it->second;
+          lk.unlock();
+          if (!send_resp(fd, 0, copy.data(),
+                         static_cast<uint32_t>(copy.size())))
+            return;
+        }
+        break;
+      }
+      case 2: {  // ADD (value stored as decimal string, like the reference)
+        int64_t newv;
+        {
+          std::lock_guard<std::mutex> lk(s->data.mu);
+          int64_t cur = 0;
+          auto it = s->data.kv.find(key);
+          if (it != s->data.kv.end()) {
+            cur = std::strtoll(
+                std::string(it->second.begin(), it->second.end()).c_str(),
+                nullptr, 10);
+          }
+          newv = cur + static_cast<int64_t>(arg);
+          std::string sv = std::to_string(newv);
+          s->data.kv[key] = std::vector<uint8_t>(sv.begin(), sv.end());
+        }
+        s->data.cv.notify_all();
+        if (!send_resp(fd, newv, nullptr, 0)) return;
+        break;
+      }
+      case 3: {  // WAIT
+        std::unique_lock<std::mutex> lk(s->data.mu);
+        auto pred = [&] { return s->data.kv.count(key) > 0 || s->stop; };
+        bool ok;
+        if (arg == 0) {
+          s->data.cv.wait(lk, pred);
+          ok = s->data.kv.count(key) > 0;
+        } else {
+          ok = s->data.cv.wait_for(lk, std::chrono::milliseconds(arg), pred) &&
+               s->data.kv.count(key) > 0;
+        }
+        lk.unlock();
+        if (!send_resp(fd, ok ? 0 : -2, nullptr, 0)) return;
+        break;
+      }
+      case 4: {  // DELETE
+        int64_t erased;
+        {
+          std::lock_guard<std::mutex> lk(s->data.mu);
+          erased = static_cast<int64_t>(s->data.kv.erase(key));
+        }
+        if (!send_resp(fd, erased, nullptr, 0)) return;
+        break;
+      }
+      case 5: {  // PING
+        if (!send_resp(fd, 0, nullptr, 0)) return;
+        break;
+      }
+      default:
+        send_resp(fd, -3, nullptr, 0);
+        return;
+    }
+  }
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    sockaddr_in addr{};
+    socklen_t alen = sizeof(addr);
+    int fd = ::accept(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    if (fd < 0) {
+      if (s->stop) return;
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    s->conn_fds.push_back(fd);
+    s->conn_threads.emplace_back(serve_conn, s, fd);
+  }
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one outstanding request per client handle
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- server
+void* pd_store_server_start(int port, int* actual_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 512) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  if (actual_port) *actual_port = s->port;
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+void pd_store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return;
+  s->stop = true;
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  s->data.cv.notify_all();  // wake WAIT ops (their pred checks s->stop)
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // wake blocked reads, then join every connection thread before freeing
+  // the Server they point at (each thread closes its own fd on exit)
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->conn_threads) {
+    if (t.joinable()) t.join();
+  }
+  delete s;
+}
+
+// ---------------------------------------------------------------- client
+void* pd_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void pd_store_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (!c) return;
+  ::close(c->fd);
+  delete c;
+}
+
+static int64_t request(Client* c, uint8_t op, const char* key, uint64_t arg,
+                       const uint8_t* val, uint32_t val_len,
+                       std::vector<uint8_t>* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t key_len = static_cast<uint32_t>(std::strlen(key));
+  std::vector<uint8_t> req(1 + 4 + key_len + 8 + 4 + val_len);
+  size_t off = 0;
+  req[off++] = op;
+  std::memcpy(req.data() + off, &key_len, 4);
+  off += 4;
+  std::memcpy(req.data() + off, key, key_len);
+  off += key_len;
+  std::memcpy(req.data() + off, &arg, 8);
+  off += 8;
+  std::memcpy(req.data() + off, &val_len, 4);
+  off += 4;
+  if (val_len) std::memcpy(req.data() + off, val, val_len);
+  if (!write_full(c->fd, req.data(), req.size())) return -100;
+  int64_t code;
+  uint32_t rlen;
+  if (!read_full(c->fd, &code, 8) || !read_full(c->fd, &rlen, 4)) return -100;
+  if (rlen > (1u << 30)) return -100;
+  if (out) {
+    out->resize(rlen);
+    if (rlen && !read_full(c->fd, out->data(), rlen)) return -100;
+  } else if (rlen) {
+    std::vector<uint8_t> sink(rlen);
+    if (!read_full(c->fd, sink.data(), rlen)) return -100;
+  }
+  return code;
+}
+
+int64_t pd_store_set(void* handle, const char* key, const uint8_t* val,
+                     uint32_t val_len) {
+  return request(static_cast<Client*>(handle), 0, key, 0, val, val_len,
+                 nullptr);
+}
+
+// returns value length (>=0) and copies min(len, buf_len) bytes into buf;
+// -1 if missing, -100 on transport error
+int64_t pd_store_get(void* handle, const char* key, uint8_t* buf,
+                     uint32_t buf_len) {
+  std::vector<uint8_t> out;
+  int64_t code =
+      request(static_cast<Client*>(handle), 1, key, 0, nullptr, 0, &out);
+  if (code < 0) return code;
+  uint32_t n = static_cast<uint32_t>(out.size());
+  if (buf && buf_len) std::memcpy(buf, out.data(), std::min(n, buf_len));
+  return static_cast<int64_t>(n);
+}
+
+int64_t pd_store_add(void* handle, const char* key, int64_t delta) {
+  return request(static_cast<Client*>(handle), 2, key,
+                 static_cast<uint64_t>(delta), nullptr, 0, nullptr);
+}
+
+int64_t pd_store_wait(void* handle, const char* key, uint64_t timeout_ms) {
+  return request(static_cast<Client*>(handle), 3, key, timeout_ms, nullptr, 0,
+                 nullptr);
+}
+
+int64_t pd_store_delete(void* handle, const char* key) {
+  return request(static_cast<Client*>(handle), 4, key, 0, nullptr, 0, nullptr);
+}
+
+int64_t pd_store_ping(void* handle) {
+  return request(static_cast<Client*>(handle), 5, "", 0, nullptr, 0, nullptr);
+}
+
+}  // extern "C"

@@ -1,0 +1,179 @@
+// Threadpool + bounded blocking queue + parallel batch collation.
+//
+// Reference analog: the lock-free WorkQueue under
+// paddle/fluid/framework/new_executor/workqueue/ (executor task scheduling)
+// and operators/reader/buffered_reader.cc + lod_tensor_blocking_queue.h (the
+// bounded producer/consumer pipe feeding the device). TPU-native role: XLA
+// owns on-device scheduling, so the native work here is the HOST side of the
+// input pipeline — a GIL-free bounded queue for DataLoader prefetch and a
+// threadpool that collates sample arrays into batch buffers with parallel
+// memcpy (the hot loop of host-side data feeding).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ThreadPool {
+  std::vector<std::thread> workers;
+  std::deque<std::function<void()>> tasks;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool stop = false;
+
+  explicit ThreadPool(int n) {
+    for (int i = 0; i < n; ++i) {
+      workers.emplace_back([this] {
+        for (;;) {
+          std::function<void()> task;
+          {
+            std::unique_lock<std::mutex> lk(mu);
+            cv.wait(lk, [this] { return stop || !tasks.empty(); });
+            if (stop && tasks.empty()) return;
+            task = std::move(tasks.front());
+            tasks.pop_front();
+          }
+          task();
+        }
+      });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    for (auto& w : workers) w.join();
+  }
+
+  void submit(std::function<void()> f) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      tasks.push_back(std::move(f));
+    }
+    cv.notify_one();
+  }
+};
+
+struct BoundedQueue {
+  std::deque<uint64_t> items;
+  size_t capacity;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  bool closed = false;
+
+  explicit BoundedQueue(size_t cap) : capacity(cap) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- pool
+void* pd_pool_create(int num_threads) {
+  if (num_threads <= 0) num_threads = 1;
+  return new ThreadPool(num_threads);
+}
+
+void pd_pool_destroy(void* pool) { delete static_cast<ThreadPool*>(pool); }
+
+// Copy n blocks (srcs[i], sizes[i]) -> dsts[i] in parallel; blocks until all
+// copies finish. Used for batch collation: dsts point into one contiguous
+// batch buffer, srcs are the per-sample arrays.
+void pd_pool_parallel_memcpy(void* pool, void** dsts, const void** srcs,
+                             const uint64_t* sizes, int n) {
+  auto* p = static_cast<ThreadPool*>(pool);
+  // completion state on the heap, shared by workers and waiter, so the last
+  // worker's notify can never race the waiter's stack unwinding
+  struct Done {
+    std::mutex mu;
+    std::condition_variable cv;
+    int remaining;
+  };
+  auto done = std::make_shared<Done>();
+  done->remaining = n;
+  for (int i = 0; i < n; ++i) {
+    void* dst = dsts[i];
+    const void* src = srcs[i];
+    uint64_t size = sizes[i];
+    p->submit([done, dst, src, size] {
+      std::memcpy(dst, src, size);
+      std::lock_guard<std::mutex> lk(done->mu);
+      if (--done->remaining == 0) done->cv.notify_one();
+    });
+  }
+  std::unique_lock<std::mutex> lk(done->mu);
+  done->cv.wait(lk, [&] { return done->remaining == 0; });
+}
+
+// ---------------------------------------------------------------- queue
+void* pd_queue_create(uint64_t capacity) {
+  return new BoundedQueue(capacity ? capacity : 1);
+}
+
+void pd_queue_destroy(void* q) { delete static_cast<BoundedQueue*>(q); }
+
+void pd_queue_close(void* qh) {
+  auto* q = static_cast<BoundedQueue*>(qh);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+// 0 = ok, -1 = timeout, -2 = closed
+int pd_queue_push(void* qh, uint64_t item, int64_t timeout_ms) {
+  auto* q = static_cast<BoundedQueue*>(qh);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lk, pred);
+  } else if (!q->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return -1;
+  }
+  if (q->closed) return -2;
+  q->items.push_back(item);
+  lk.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// 0 = ok, -1 = timeout, -2 = closed-and-drained
+int pd_queue_pop(void* qh, uint64_t* item, int64_t timeout_ms) {
+  auto* q = static_cast<BoundedQueue*>(qh);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lk, pred);
+  } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed and drained
+  *item = q->items.front();
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  return 0;
+}
+
+uint64_t pd_queue_size(void* qh) {
+  auto* q = static_cast<BoundedQueue*>(qh);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return q->items.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,384 @@
+"""Native runtime core: TCPStore, ThreadPool, BoundedQueue, host tracer.
+
+Reference analog: the C++ runtime under paddle/fluid/distributed/store/
+(TCPStore), framework/new_executor/workqueue/, operators/reader/
+(buffered_reader), and platform/profiler/host_event_recorder.h, exposed to
+Python via pybind (`core.TCPStore` etc.). Here the native library is built
+from csrc/ by g++ at first use and bound via ctypes; every class has a
+pure-Python fallback so the framework works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import queue as _pyqueue
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ._build import load_library, build_library
+
+__all__ = ["TCPStore", "ThreadPool", "BoundedQueue", "native_available",
+           "host_tracer", "parallel_collate"]
+
+
+def native_available():
+    return load_library() is not None
+
+
+# --------------------------------------------------------------------- store
+class TCPStore:
+    """Socket KV store for rendezvous (reference: store/tcp_store.h:117).
+
+    host_name/port point at the master; the rank with is_master=True also
+    runs the server thread. API: set/get/add/wait/delete_key + barrier.
+    """
+
+    def __init__(self, host_name="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30.0):
+        self._lib = load_library()
+        self._server = None
+        self._world_size = world_size
+        self._timeout_ms = int(timeout * 1000)
+        self._barrier_round = 0
+        if self._lib is None:
+            raise RuntimeError(
+                "native core unavailable (no g++?); TCPStore requires the "
+                "native runtime — see paddle_tpu/core/_build.py")
+        if is_master:
+            actual = ctypes.c_int(0)
+            self._server = self._lib.pd_store_server_start(
+                port, ctypes.byref(actual))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = actual.value
+        self.host = host_name
+        self.port = port
+        self._client = self._lib.pd_store_client_connect(
+            host_name.encode(), port, self._timeout_ms)
+        if not self._client:
+            if self._server:
+                self._lib.pd_store_server_stop(self._server)
+            raise RuntimeError(
+                f"TCPStore: cannot connect to {host_name}:{port}")
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) \
+            if value else None
+        rc = self._lib.pd_store_set(self._client, key.encode(), buf,
+                                    len(value))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed: {rc}")
+
+    def get(self, key, wait=True):
+        if wait:
+            self.wait([key])
+        n = self._lib.pd_store_get(self._client, key.encode(), None, 0)
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) transport error")
+        buf = (ctypes.c_uint8 * int(n))()
+        n2 = self._lib.pd_store_get(self._client, key.encode(), buf, int(n))
+        if n2 < 0:
+            raise RuntimeError(f"TCPStore.get({key!r}) transport error")
+        return bytes(buf[:int(n2)])
+
+    def add(self, key, value=1):
+        rc = self._lib.pd_store_add(self._client, key.encode(), int(value))
+        if rc <= -100:
+            raise RuntimeError(f"TCPStore.add({key!r}) transport error")
+        return int(rc)
+
+    def wait(self, keys, timeout=None):
+        tmo = self._timeout_ms if timeout is None else int(timeout * 1000)
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            rc = self._lib.pd_store_wait(self._client, k.encode(), tmo)
+            if rc == -2:
+                raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
+            if rc != 0:
+                raise RuntimeError(f"TCPStore.wait({k!r}) failed: {rc}")
+
+    def delete_key(self, key):
+        return bool(self._lib.pd_store_delete(self._client, key.encode()))
+
+    def barrier(self, tag=""):
+        """All world_size participants block until everyone arrives."""
+        self._barrier_round += 1
+        key = f"__barrier/{tag}/{self._barrier_round}"
+        arrived = self.add(key, 1)
+        if arrived >= self._world_size:
+            self.set(key + "/done", b"1")
+        self.wait([key + "/done"])
+
+    def __del__(self):
+        lib, client, server = getattr(self, "_lib", None), \
+            getattr(self, "_client", None), getattr(self, "_server", None)
+        if lib is None:
+            return
+        try:
+            if client:
+                lib.pd_store_client_close(client)
+            if server:
+                lib.pd_store_server_stop(server)
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------- pool
+class ThreadPool:
+    """Native threadpool (reference: new_executor/workqueue). Used for
+    GIL-free parallel memcpy in batch collation."""
+
+    def __init__(self, num_threads):
+        self._lib = load_library()
+        self._native = None
+        if self._lib is not None:
+            self._native = self._lib.pd_pool_create(num_threads)
+        self._n = num_threads
+
+    @property
+    def is_native(self):
+        return self._native is not None
+
+    def parallel_memcpy(self, dsts, srcs, sizes):
+        """Copy srcs[i] -> dsts[i] (ctypes pointers / ints) concurrently."""
+        n = len(dsts)
+        if self._native is not None:
+            DA = (ctypes.c_void_p * n)(*dsts)
+            SA = (ctypes.c_void_p * n)(*srcs)
+            ZA = (ctypes.c_uint64 * n)(*sizes)
+            self._lib.pd_pool_parallel_memcpy(self._native, DA, SA, ZA, n)
+        else:
+            for d, s, z in zip(dsts, srcs, sizes):
+                ctypes.memmove(d, s, z)
+
+    def close(self):
+        if self._native is not None:
+            self._lib.pd_pool_destroy(self._native)
+            self._native = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_collate_pool = None
+_collate_lock = threading.Lock()
+
+
+def _get_collate_pool():
+    global _collate_pool
+    with _collate_lock:
+        if _collate_pool is None:
+            _collate_pool = ThreadPool(4)
+        return _collate_pool
+
+
+# parallel stacking pays for itself only on big batches; below this, np.stack
+# wins on dispatch overhead
+_COLLATE_MIN_BYTES = 1 << 20
+
+
+def parallel_collate(arrays):
+    """np.stack(arrays) with the copies done by the native threadpool.
+    Reference analog: buffered_reader.cc assembling device batches."""
+    first = np.ascontiguousarray(arrays[0])
+    total = first.nbytes * len(arrays)
+    if total < _COLLATE_MIN_BYTES or not native_available() or \
+            any(a.shape != first.shape or a.dtype != first.dtype
+                for a in arrays):
+        # np.stack raises the proper error for ragged / mixed-dtype batches
+        return np.stack(arrays)
+    out = np.empty((len(arrays),) + first.shape, dtype=first.dtype)
+    pool = _get_collate_pool()
+    step = first.nbytes
+    base = out.ctypes.data
+    contig = [np.ascontiguousarray(a) for a in arrays]
+    dsts = [base + i * step for i in range(len(contig))]
+    srcs = [a.ctypes.data for a in contig]
+    sizes = [step] * len(contig)
+    pool.parallel_memcpy(dsts, srcs, sizes)
+    return out
+
+
+# --------------------------------------------------------------------- queue
+class BoundedQueue:
+    """Bounded blocking queue (reference: lod_tensor_blocking_queue.h).
+    Items are arbitrary Python objects; the blocking/wakeup machinery is
+    native so producers/consumers don't contend on the GIL."""
+
+    def __init__(self, capacity):
+        self._lib = load_library()
+        self._native = None
+        self._objs = {}
+        self._obj_lock = threading.Lock()
+        self._next_token = 0
+        if self._lib is not None:
+            self._native = self._lib.pd_queue_create(capacity)
+        else:
+            self._pyq = _pyqueue.Queue(maxsize=capacity)
+            self._closed = False
+
+    @property
+    def is_native(self):
+        return self._native is not None
+
+    def push(self, obj, timeout=None):
+        if self._native is None:
+            self._pyq.put(obj, timeout=timeout)
+            return True
+        with self._obj_lock:
+            token = self._next_token
+            self._next_token += 1
+            self._objs[token] = obj
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.pd_queue_push(self._native, token, tmo)
+        if rc != 0:
+            with self._obj_lock:
+                self._objs.pop(token, None)
+            if rc == -1:
+                raise _pyqueue.Full()
+            return False  # closed
+        return True
+
+    def pop(self, timeout=None):
+        """Returns the object; raises queue.Empty on timeout, StopIteration
+        when closed and drained."""
+        if self._native is None:
+            if self._closed and self._pyq.empty():
+                raise StopIteration
+            try:
+                item = self._pyq.get(timeout=timeout)
+            except _pyqueue.Empty:
+                if self._closed:
+                    raise StopIteration from None
+                raise
+            if item is _CLOSE_SENTINEL:
+                self._closed = True
+                raise StopIteration
+            return item
+        token = ctypes.c_uint64(0)
+        tmo = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.pd_queue_pop(self._native, ctypes.byref(token), tmo)
+        if rc == -1:
+            raise _pyqueue.Empty()
+        if rc == -2:
+            raise StopIteration
+        with self._obj_lock:
+            return self._objs.pop(token.value)
+
+    def close(self):
+        if self._native is None:
+            self._closed = True
+            try:
+                self._pyq.put_nowait(_CLOSE_SENTINEL)
+            except _pyqueue.Full:
+                pass
+            return
+        self._lib.pd_queue_close(self._native)
+
+    def qsize(self):
+        if self._native is None:
+            return self._pyq.qsize()
+        return int(self._lib.pd_queue_size(self._native))
+
+    def __del__(self):
+        # close first so any thread still blocked in push/pop wakes and
+        # returns before the native queue (mutex/condvars) is freed. Owners
+        # with producer threads must join them before dropping the queue
+        # (see io.dataloader._PrefetchIterator.close).
+        try:
+            if getattr(self, "_native", None) is not None:
+                self._lib.pd_queue_close(self._native)
+                self._lib.pd_queue_destroy(self._native)
+                self._native = None
+        except Exception:
+            pass
+
+
+class _Sentinel:
+    pass
+
+
+_CLOSE_SENTINEL = _Sentinel()
+
+
+# -------------------------------------------------------------------- tracer
+class _HostTracer:
+    """Thin wrapper over the native host event recorder (reference:
+    platform/profiler/host_event_recorder.h). Used by paddle_tpu.profiler.
+    The library loads lazily on first use so `import paddle_tpu` never
+    triggers the g++ build."""
+
+    def __init__(self):
+        self._name_cache = {}
+
+    @property
+    def _lib(self):
+        return load_library()
+
+    @property
+    def is_native(self):
+        return self._lib is not None
+
+    def enable(self, on=True):
+        if self._lib is not None:
+            self._lib.pd_trace_enable(1 if on else 0)
+
+    def enabled(self):
+        return self._lib is not None and \
+            bool(self._lib.pd_trace_is_enabled())
+
+    def name_id(self, name):
+        nid = self._name_cache.get(name)
+        if nid is None:
+            nid = self._lib.pd_trace_register_name(name.encode())
+            self._name_cache[name] = nid
+        return nid
+
+    def now_ns(self):
+        if self._lib is not None:
+            return int(self._lib.pd_trace_now_ns())
+        return time.perf_counter_ns()
+
+    def span(self, name, begin_ns, end_ns):
+        if self._lib is not None:
+            self._lib.pd_trace_span(self.name_id(name), begin_ns, end_ns)
+
+    def harvest(self):
+        """Returns list of (name, begin_ns, end_ns, tid)."""
+        if self._lib is None:
+            return []
+        pending = int(self._lib.pd_trace_pending())
+        if pending == 0:
+            return []
+        buf = (ctypes.c_uint64 * (pending * 4))()
+        n = int(self._lib.pd_trace_harvest(buf, pending))
+        out = []
+        name_buf = ctypes.create_string_buffer(512)
+        id2name = {}
+        for i in range(n):
+            nid = int(buf[i * 4])
+            if nid not in id2name:
+                ln = self._lib.pd_trace_name(nid, name_buf, 512)
+                id2name[nid] = name_buf.value.decode() if ln >= 0 else str(nid)
+            out.append((id2name[nid], int(buf[i * 4 + 1]),
+                        int(buf[i * 4 + 2]), int(buf[i * 4 + 3])))
+        return out
+
+
+host_tracer = _HostTracer()
+
+
+def find_free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

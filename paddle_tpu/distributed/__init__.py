@@ -16,6 +16,7 @@ from .collective import (  # noqa: F401
     destroy_process_group, get_backend, ProcessGroupXLA,
 )
 from .parallel import DataParallel  # noqa: F401
+from ..core import TCPStore  # noqa: F401  (reference: core.TCPStore)
 from . import fleet  # noqa: F401
 from .mesh import (  # noqa: F401
     build_mesh, get_global_mesh, set_global_mesh,

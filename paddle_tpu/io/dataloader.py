@@ -26,7 +26,8 @@ __all__ = ["DataLoader", "default_collate_fn"]
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        from ..core import parallel_collate
+        return Tensor(parallel_collate(batch))
     if isinstance(sample, Tensor):
         import jax.numpy as jnp
         return Tensor(jnp.stack([b._value for b in batch]))
@@ -43,10 +44,13 @@ def default_collate_fn(batch):
 
 
 class _PrefetchIterator:
-    _STOP = object()
+    """Producer thread fills a bounded queue; blocking/wakeup runs in the
+    native core's BoundedQueue (reference: buffered_reader.cc +
+    lod_tensor_blocking_queue.h) with a queue.Queue fallback."""
 
     def __init__(self, produce_batches, prefetch=2):
-        self._q = queue.Queue(maxsize=max(prefetch, 1))
+        from ..core import BoundedQueue
+        self._q = BoundedQueue(max(prefetch, 1))
         self._exc = None
         self._thread = threading.Thread(target=self._run,
                                         args=(produce_batches,), daemon=True)
@@ -55,22 +59,36 @@ class _PrefetchIterator:
     def _run(self, produce_batches):
         try:
             for b in produce_batches():
-                self._q.put(b)
+                if not self._q.push(b):
+                    return  # consumer closed the queue
         except BaseException as e:  # propagate to consumer
             self._exc = e
         finally:
-            self._q.put(self._STOP)
+            self._q.close()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
-        if item is self._STOP:
+        try:
+            return self._q.pop()
+        except StopIteration:
             if self._exc is not None:
-                raise self._exc
-            raise StopIteration
-        return item
+                raise self._exc from None
+            raise
+
+    def close(self):
+        """Wake a blocked producer and join it; must run before the native
+        queue is freed (an abandoned producer blocked in push would
+        otherwise race queue destruction)."""
+        self._q.close()
+        self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class DataLoader:

@@ -68,14 +68,24 @@ class RecordEvent:
         self._begin = None
 
     def begin(self):
-        self._begin = time.perf_counter_ns() / 1000.0
+        from ..core import host_tracer
+        if host_tracer.is_native:
+            self._begin = host_tracer.now_ns()
+        else:
+            self._begin = time.perf_counter_ns()
 
     def end(self):
         if self._begin is None:
             return
-        now = time.perf_counter_ns() / 1000.0
-        _recorder.add(self.name, self._begin, now - self._begin,
-                      threading.get_ident())
+        from ..core import host_tracer
+        if host_tracer.is_native:
+            # hot path: one ctypes call into the native recorder
+            host_tracer.span(self.name, self._begin, host_tracer.now_ns())
+        else:
+            now = time.perf_counter_ns()
+            _recorder.add(self.name, self._begin / 1000.0,
+                          (now - self._begin) / 1000.0,
+                          threading.get_ident())
         self._begin = None
 
     def __enter__(self):
@@ -134,6 +144,9 @@ class Profiler:
         global _active_profiler
         _active_profiler = self
         _recorder.drain()
+        from ..core import host_tracer
+        host_tracer.harvest()          # discard pre-start events
+        host_tracer.enable(True)
         self._state = ProfilerState.RECORD
         if not self.timer_only and ProfilerTarget.TPU in self.targets:
             import tempfile
@@ -145,9 +158,20 @@ class Profiler:
                 self._jax_trace_dir = None
         return self
 
+    def _drain_native(self):
+        from ..core import host_tracer
+        for name, b_ns, e_ns, tid in host_tracer.harvest():
+            self._events.append({"name": name, "ts": b_ns / 1000.0,
+                                 "dur": (e_ns - b_ns) / 1000.0, "tid": tid,
+                                 "ph": "X", "pid": os.getpid(),
+                                 "cat": "host"})
+
     def stop(self):
         global _active_profiler
         self._events.extend(_recorder.drain())
+        self._drain_native()
+        from ..core import host_tracer
+        host_tracer.enable(False)
         if self._jax_trace_dir:
             import jax
             try:
@@ -163,6 +187,7 @@ class Profiler:
     def step(self, num_samples=None):
         self._step += 1
         self._events.extend(_recorder.drain())
+        self._drain_native()
         benchmark().step(num_samples)
 
     def step_info(self, unit=None):
