@@ -152,9 +152,11 @@ int64_t pd_trace_name(uint32_t id, char* buf, uint64_t buf_len) {
   std::lock_guard<std::mutex> lk(g_recorder.mu);
   if (id >= g_recorder.names.size()) return -1;
   const std::string& s = g_recorder.names[id];
-  uint64_t n = s.size() < buf_len - 1 ? s.size() : buf_len - 1;
-  std::memcpy(buf, s.data(), n);
-  buf[n] = '\0';
+  if (buf != nullptr && buf_len > 0) {
+    uint64_t n = s.size() < buf_len - 1 ? s.size() : buf_len - 1;
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
   return static_cast<int64_t>(s.size());
 }
 

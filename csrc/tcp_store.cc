@@ -15,6 +15,7 @@
 // arg (timeout ms, 0 = forever) elapses; code 0 ok, -2 timeout.
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -83,11 +84,22 @@ bool send_resp(int fd, int64_t code, const uint8_t* val, uint32_t len) {
 void serve_loop(Server* s, int fd);
 
 // single exit point closes fd exactly once; server_stop only shutdown()s
-// tracked fds to wake blocked reads, never closes them
+// tracked fds to wake blocked reads, never closes them. The fd is removed
+// from conn_fds under conn_mu BEFORE close so a later connection reusing the
+// same fd number can't be shutdown() by server_stop.
 void serve_conn(Server* s, int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   serve_loop(s, fd);
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        s->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
 }
 
@@ -258,24 +270,35 @@ void pd_store_server_stop(void* handle) {
 void* pd_store_client_connect(const char* host, int port, int timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 1);
+  std::string port_str = std::to_string(port);
   for (;;) {
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) return nullptr;
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(static_cast<uint16_t>(port));
-    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-      ::close(fd);
-      return nullptr;
+    // getaddrinfo so cluster hostnames ("worker-0", "localhost") work, not
+    // just numeric IPv4 literals; re-resolved per attempt so DNS changes
+    // during bring-up are picked up
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    int connected_fd = -1;
+    if (::getaddrinfo(host, port_str.c_str(), &hints, &res) == 0) {
+      for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+        int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+          connected_fd = fd;
+          break;
+        }
+        ::close(fd);
+      }
+      ::freeaddrinfo(res);
     }
-    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    if (connected_fd >= 0) {
       int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      ::setsockopt(connected_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       auto* c = new Client();
-      c->fd = fd;
+      c->fd = connected_fd;
       return c;
     }
-    ::close(fd);
     if (std::chrono::steady_clock::now() >= deadline) return nullptr;
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
@@ -339,9 +362,37 @@ int64_t pd_store_get(void* handle, const char* key, uint8_t* buf,
   return static_cast<int64_t>(n);
 }
 
-int64_t pd_store_add(void* handle, const char* key, int64_t delta) {
-  return request(static_cast<Client*>(handle), 2, key,
-                 static_cast<uint64_t>(delta), nullptr, 0, nullptr);
+// new counter value lands in *result; returns 0 ok, -100 transport error.
+// (out-param keeps the full int64 range for counter values — no in-band
+// sentinel collision)
+int64_t pd_store_add(void* handle, const char* key, int64_t delta,
+                     int64_t* result) {
+  Client* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t key_len = static_cast<uint32_t>(std::strlen(key));
+  uint64_t arg = static_cast<uint64_t>(delta);
+  std::vector<uint8_t> req(1 + 4 + key_len + 8 + 4);
+  size_t off = 0;
+  req[off++] = 2;  // ADD
+  std::memcpy(req.data() + off, &key_len, 4);
+  off += 4;
+  std::memcpy(req.data() + off, key, key_len);
+  off += key_len;
+  std::memcpy(req.data() + off, &arg, 8);
+  off += 8;
+  uint32_t zero = 0;
+  std::memcpy(req.data() + off, &zero, 4);
+  if (!write_full(c->fd, req.data(), req.size())) return -100;
+  int64_t code;
+  uint32_t rlen;
+  if (!read_full(c->fd, &code, 8) || !read_full(c->fd, &rlen, 4)) return -100;
+  if (rlen > (1u << 30)) return -100;
+  if (rlen) {
+    std::vector<uint8_t> sink(rlen);
+    if (!read_full(c->fd, sink.data(), rlen)) return -100;
+  }
+  if (result) *result = code;
+  return 0;
 }
 
 int64_t pd_store_wait(void* handle, const char* key, uint64_t timeout_ms) {

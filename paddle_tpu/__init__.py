@@ -91,3 +91,8 @@ def set_flags(flags):
 def summary(net, input_size=None, dtypes=None):
     from .hapi.summary import summary as _summary
     return _summary(net, input_size, dtypes)
+
+
+from . import hapi  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402

@@ -76,25 +76,35 @@ class TCPStore:
     def get(self, key, wait=True):
         if wait:
             self.wait([key])
-        n = self._lib.pd_store_get(self._client, key.encode(), None, 0)
-        if n == -1:
-            raise KeyError(key)
-        if n < 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) transport error")
-        buf = (ctypes.c_uint8 * int(n))()
-        n2 = self._lib.pd_store_get(self._client, key.encode(), buf, int(n))
-        if n2 < 0:
-            raise RuntimeError(f"TCPStore.get({key!r}) transport error")
-        return bytes(buf[:int(n2)])
+        # each pd_store_get is ONE RPC whose returned length matches the bytes
+        # it copied; loop growing the buffer until the whole value fits, so a
+        # value overwritten with a longer one mid-call is never truncated
+        buf_len = 256
+        while True:
+            buf = (ctypes.c_uint8 * buf_len)()
+            n = self._lib.pd_store_get(self._client, key.encode(), buf,
+                                       buf_len)
+            if n == -1:
+                raise KeyError(key)
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) transport error")
+            if n <= buf_len:
+                return bytes(buf[:int(n)])
+            buf_len = int(n)
 
     def add(self, key, value=1):
-        rc = self._lib.pd_store_add(self._client, key.encode(), int(value))
-        if rc <= -100:
+        result = ctypes.c_int64(0)
+        rc = self._lib.pd_store_add(self._client, key.encode(), int(value),
+                                    ctypes.byref(result))
+        if rc != 0:
             raise RuntimeError(f"TCPStore.add({key!r}) transport error")
-        return int(rc)
+        return int(result.value)
 
     def wait(self, keys, timeout=None):
-        tmo = self._timeout_ms if timeout is None else int(timeout * 1000)
+        # protocol: 0 = wait forever, so a zero/rounded-to-zero timeout must
+        # still send >=1ms to keep "timeout=0" meaning an immediate poll
+        tmo = self._timeout_ms if timeout is None else \
+            max(1, int(timeout * 1000))
         if isinstance(keys, str):
             keys = [keys]
         for k in keys:
@@ -105,7 +115,10 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore.wait({k!r}) failed: {rc}")
 
     def delete_key(self, key):
-        return bool(self._lib.pd_store_delete(self._client, key.encode()))
+        rc = self._lib.pd_store_delete(self._client, key.encode())
+        if rc < 0:
+            raise RuntimeError(f"TCPStore.delete_key({key!r}) transport error")
+        return bool(rc)
 
     def barrier(self, tag=""):
         """All world_size participants block until everyone arrives."""
@@ -233,8 +246,20 @@ class BoundedQueue:
 
     def push(self, obj, timeout=None):
         if self._native is None:
-            self._pyq.put(obj, timeout=timeout)
-            return True
+            # mirror the native contract: return False once closed instead of
+            # blocking forever on a full queue nobody will drain
+            deadline = None if timeout is None else \
+                time.monotonic() + timeout
+            while True:
+                if self._closed:
+                    return False
+                try:
+                    self._pyq.put(obj, timeout=0.05)
+                    return True
+                except _pyqueue.Full:
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        raise
         with self._obj_lock:
             token = self._next_token
             self._next_token += 1

@@ -99,7 +99,8 @@ def load_library():
     lib.pd_store_get.argtypes = [c.c_void_p, c.c_char_p,
                                  c.POINTER(c.c_uint8), c.c_uint32]
     lib.pd_store_add.restype = c.c_int64
-    lib.pd_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.pd_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                 c.POINTER(c.c_int64)]
     lib.pd_store_wait.restype = c.c_int64
     lib.pd_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
     lib.pd_store_delete.restype = c.c_int64

@@ -1,0 +1,14 @@
+"""High-level training API. Reference analog: python/paddle/hapi/
+(model.py:1009 `class Model`, fit :1686; callbacks.py; model_summary.py).
+
+TPU-first: one adapter only — the dygraph adapter (reference keeps a
+StaticGraphAdapter at model.py:262 for its legacy graph mode; here "static"
+execution is jit capture, so `Model(..).prepare(jit=True)` fuses the whole
+train step into a single XLA executable via paddle_tpu.jit.TrainStep).
+"""
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .progressbar import ProgressBar  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks", "ProgressBar"]

@@ -1,0 +1,321 @@
+"""Training callbacks. Reference analog: python/paddle/hapi/callbacks.py
+(Callback, CallbackList config_callbacks, ProgBarLogger, ModelCheckpoint,
+LRScheduler, EarlyStopping, VisualDL, WandbCallback)."""
+from __future__ import annotations
+
+import numbers
+import os
+
+from .progressbar import ProgressBar
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL", "ReduceLROnPlateau", "CallbackList",
+           "config_callbacks"]
+
+
+class Callback:
+    """Base class; subclass and override the on_* hooks."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, callback):
+        self.callbacks.append(callback)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Per-step console logging (reference: hapi/callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.train_progbar = None
+        self.eval_progbar = None
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.train_metrics = self.params.get("metrics", [])
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+        self.train_progbar = ProgressBar(num=self.params.get("steps"),
+                                         verbose=self.verbose)
+        self.train_step = 0
+
+    def _updates(self, logs, bar, step):
+        values = {k: v for k, v in (logs or {}).items()
+                  if isinstance(v, numbers.Number)}
+        bar.update(step, values)
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step = step + 1
+        if self.train_step % self.log_freq == 0 and self.verbose:
+            self._updates(logs, self.train_progbar, self.train_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self._updates(logs, self.train_progbar, self.train_step)
+
+    def on_eval_begin(self, logs=None):
+        self.eval_progbar = ProgressBar(num=(logs or {}).get("steps"),
+                                        verbose=self.verbose)
+        self.eval_step = 0
+        if self.verbose:
+            print("Eval begin...")
+
+    def on_eval_batch_end(self, step, logs=None):
+        self.eval_step = step + 1
+        if self.eval_step % self.log_freq == 0 and self.verbose:
+            self._updates(logs, self.eval_progbar, self.eval_step)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            self._updates(logs, self.eval_progbar, self.eval_step)
+            print("Eval samples: ", (logs or {}).get("samples", ""))
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and \
+                (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by epoch by default, matching the
+    reference's by_epoch=True)."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = lambda cur, best: cur < best - self.min_delta
+            self.best_value = float("inf")
+        else:
+            self.monitor_op = lambda cur, best: cur > best + self.min_delta
+            self.best_value = -float("inf")
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.monitor_op(current, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None and \
+                    self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"],
+                                             "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch >= self.patience:
+            if self.model is not None:
+                self.model.stop_training = True
+            if self.verbose:
+                print(f"Epoch {self.stopped_epoch}: Early stopping.")
+
+
+class ReduceLROnPlateau(Callback):
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.monitor_op = lambda a, b: a > b + self.min_delta
+            self.best = -float("inf")
+        else:
+            self.monitor_op = lambda a, b: a < b - self.min_delta
+            self.best = float("inf")
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    old = opt.get_lr()
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old} -> {new}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging to a directory of JSONL files (the VisualDL service is
+    GPU-ecosystem tooling; on TPU pods the same role is played by TensorBoard
+    over the jax profiler — this keeps the API and writes portable logs)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _write(self, tag, logs, step):
+        import json
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                self._fh.write(json.dumps(
+                    {"tag": f"{tag}/{k}", "value": float(v),
+                     "step": step}) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write("train", logs, self._step)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    params = {"batch_size": batch_size, "epochs": epochs, "steps": steps,
+              "verbose": verbose, "metrics": metrics or [],
+              "save_dir": save_dir}
+    cbk_list.set_params(params)
+    return cbk_list

@@ -1,0 +1,275 @@
+"""Keras-like Model facade. Reference analog: python/paddle/hapi/model.py:1009
+(`class Model`; fit :1686; DynamicGraphAdapter :737).
+
+TPU-first: a single dygraph adapter whose train step can optionally be fused
+into one XLA executable (`prepare(..., jit=True)` → paddle_tpu.jit.TrainStep),
+replacing the reference's dual static/dynamic adapters."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import io as _io
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_tensor_list(data):
+    if data is None:
+        return []
+    if isinstance(data, (list, tuple)):
+        return [d if isinstance(d, Tensor) else Tensor(np.asarray(d))
+                for d in data]
+    return [data if isinstance(data, Tensor) else Tensor(np.asarray(data))]
+
+
+def _to_numpy(x):
+    if isinstance(x, Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Model:
+    """Wraps a `nn.Layer` with train/eval/predict loops.
+
+    model = Model(network)
+    model.prepare(optimizer, loss, metrics)
+    model.fit(train_dataset, eval_dataset, epochs=2, batch_size=32)
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._use_jit_step = False
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        if not isinstance(metrics, (list, tuple)):
+            metrics = [metrics]
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+        self._metrics = list(metrics)
+        self._use_jit_step = bool(jit)
+        self._train_step = None
+
+    # ------------------------------------------------------------- batches
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimization step; returns (loss_values, metric_results)."""
+        self.network.train()
+        inputs = _to_tensor_list(inputs)
+        labels = _to_tensor_list(labels)
+        if self._use_jit_step and self._loss is not None and update:
+            from ..jit.train_step import TrainStep
+            if self._train_step is None:
+                self._train_step = TrainStep(self.network, self._loss,
+                                             self._optimizer)
+            loss = self._train_step(*inputs, *labels)
+            return [float(loss)], []
+        outputs = self.network(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if self._loss is not None:
+            loss = self._loss(*outs, *labels)
+        else:
+            loss = outs[0]
+        if update and self._optimizer is not None:
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metric_res = []
+        for m in self._metrics:
+            res = m.compute(outs[0], *labels)
+            if isinstance(res, Tensor):
+                res = res.numpy()
+            m.update(res)
+            metric_res.append(m.accumulate())
+        return [float(loss)], metric_res
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..framework.autograd import no_grad
+        with no_grad():
+            inputs = _to_tensor_list(inputs)
+            labels = _to_tensor_list(labels)
+            outputs = self.network(*inputs)
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            losses = []
+            if self._loss is not None and labels:
+                losses = [float(self._loss(*outs, *labels))]
+            metric_res = []
+            for m in self._metrics:
+                res = m.compute(outs[0], *labels)
+                if isinstance(res, Tensor):
+                    res = res.numpy()
+                m.update(res)
+                metric_res.append(m.accumulate())
+            return losses, metric_res
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..framework.autograd import no_grad
+        with no_grad():
+            outputs = self.network(*_to_tensor_list(inputs))
+            if isinstance(outputs, (list, tuple)):
+                return [_to_numpy(o) for o in outputs]
+            return _to_numpy(outputs)
+
+    # ------------------------------------------------------------- loops
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from ..io import DataLoader
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data  # generator-style iterable
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        """hapi convention: last element of the batch tuple is the label."""
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert train_data is not None, "train_data must be given!"
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers, False)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=self._metric_names())
+        self.stop_training = False
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                losses, metrics = self.train_batch(ins, labs)
+                logs = {"loss": losses[0]}
+                for m, res in zip(self._metrics, metrics):
+                    n = m.name()
+                    names = n if isinstance(n, (list, tuple)) else [n]
+                    vals = res if isinstance(res, (list, tuple)) else [res]
+                    logs.update(zip(names, vals))
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              num_workers=num_workers)
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        own_cbks = not isinstance(callbacks, type(None)) and \
+            hasattr(callbacks, "on_eval_begin")
+        cbks = callbacks if own_cbks else config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=self._metric_names(), mode="eval")
+        for m in self._metrics:
+            m.reset()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks.on_eval_begin({"steps": steps})
+        logs = {}
+        samples = 0
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            losses, metrics = self.eval_batch(ins, labs)
+            if losses:
+                logs["loss"] = losses[0]
+            for m, res in zip(self._metrics, metrics):
+                n = m.name()
+                names = n if isinstance(n, (list, tuple)) else [n]
+                vals = res if isinstance(res, (list, tuple)) else [res]
+                logs.update(zip(names, vals))
+            samples += ins[0].shape[0] if ins and ins[0].shape else 1
+            cbks.on_eval_batch_end(step, logs)
+        logs["samples"] = samples
+        cbks.on_eval_end(logs)
+        return {k: v for k, v in logs.items() if k != "samples"}
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch) if isinstance(batch, (list, tuple)) \
+                else ([batch], [])
+            out = self.predict_batch(ins)
+            outputs.append(out)
+        if stack_outputs and outputs:
+            if isinstance(outputs[0], list):
+                outputs = [np.concatenate([o[i] for o in outputs])
+                           for i in range(len(outputs[0]))]
+            else:
+                outputs = np.concatenate(outputs)
+        return outputs
+
+    # ------------------------------------------------------------- io
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_io.load(opt_path))
+
+    # ------------------------------------------------------------- misc
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtype)

@@ -1,0 +1,51 @@
+"""Terminal progress bar. Reference analog: python/paddle/hapi/progressbar.py."""
+from __future__ import annotations
+
+import sys
+import time
+
+__all__ = ["ProgressBar"]
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, start=True,
+                 file=sys.stdout):
+        self._num = num
+        self._width = width if num is not None else 0
+        self._verbose = verbose
+        self.file = file
+        self._values = {}
+        self._last_update = 0
+        if start:
+            self._start = time.time()
+
+    def start(self):
+        self.file.flush()
+        self._start = time.time()
+
+    def update(self, current_num, values=None):
+        now = time.time()
+        if values:
+            self._values.update(values)
+        if self._verbose == 0:
+            return
+        metrics = " - ".join(
+            f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+            for k, v in self._values.items())
+        if self._num is not None:
+            frac = min(float(current_num) / self._num, 1.0)
+            filled = int(self._width * frac)
+            bar = "=" * filled + ">" + "." * (self._width - filled)
+            line = (f"step {current_num}/{self._num} [{bar}] "
+                    f"- {now - self._start:.0f}s - {metrics}")
+        else:
+            line = f"step {current_num} - {now - self._start:.0f}s - {metrics}"
+        end = "\n" if (self._num is not None and current_num >= self._num) \
+            else "\r"
+        if self._verbose == 1:
+            self.file.write("\r" + line + end if end == "\n" else
+                            "\r" + line)
+        else:
+            self.file.write(line + "\n")
+        self.file.flush()
+        self._last_update = now

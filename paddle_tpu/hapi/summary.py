@@ -1,0 +1,89 @@
+"""Model summary. Reference analog: python/paddle/hapi/model_summary.py
+(`paddle.summary`): per-layer output shapes + parameter counts via forward
+hooks on a dummy run."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import dtype as _dtype_mod
+
+__all__ = ["summary"]
+
+
+def _num_params(layer):
+    return sum(int(np.prod(p.shape)) if p.shape else 1
+               for p in layer.parameters(include_sublayers=False))
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params': n,
+    'trainable_params': n}."""
+    import jax.numpy as jnp
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        sizes = [s if isinstance(s, (list, tuple)) else (s,) for s in sizes]
+        if dtypes is None:
+            dtypes = ["float32"] * len(sizes)
+        elif isinstance(dtypes, str):
+            dtypes = [dtypes] * len(sizes)
+        inputs = []
+        for shape, dt in zip(sizes, dtypes):
+            shape = tuple(1 if (d is None or d == -1) else int(d)
+                          for d in shape)
+            jdt = _dtype_mod.to_jax_dtype(dt)
+            if jnp.issubdtype(jdt, jnp.integer):
+                arr = jnp.zeros(shape, jdt)
+            else:
+                arr = jnp.ones(shape, jdt)
+            inputs.append(Tensor(arr, stop_gradient=True))
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inp, out):
+            shape = out.shape if isinstance(out, Tensor) else (
+                [o.shape for o in out if isinstance(o, Tensor)]
+                if isinstance(out, (list, tuple)) else "?")
+            rows.append((f"{type(layer).__name__}-{len(rows) + 1}",
+                         str(shape), _num_params(layer)))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not list(sub.children()):  # leaves only, like the reference
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) if p.shape else 1
+                for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) if p.shape else 1
+                    for p in net.parameters() if not p.stop_gradient)
+
+    name_w = max([len(r[0]) for r in rows] + [20])
+    shape_w = max([len(r[1]) for r in rows] + [20])
+    line = "-" * (name_w + shape_w + 16)
+    print(line)
+    print(f"{'Layer (type)':<{name_w}}  {'Output Shape':<{shape_w}}  Param #")
+    print("=" * len(line))
+    for r in rows:
+        print(f"{r[0]:<{name_w}}  {r[1]:<{shape_w}}  {r[2]:,}")
+    print("=" * len(line))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,107 @@
+"""hapi Model.fit/evaluate/predict (reference analog:
+python/paddle/tests/test_model.py over hapi/model.py:1009)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class RandomClsDataset(Dataset):
+    def __init__(self, n=64, dim=8, classes=4, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, dim)).astype(np.float32)
+        self.y = rng.integers(0, classes, (n, 1)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def make_net():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_fit_reduces_loss(capsys):
+    paddle.seed(0)
+    model = paddle.Model(make_net())
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    ds = RandomClsDataset()
+    first = model.train_batch([ds.x[:16]], [ds.y[:16]])[0][0]
+    model.fit(ds, epochs=3, batch_size=16, verbose=0)
+    last = model.eval_batch([ds.x[:16]], [ds.y[:16]])[0][0]
+    assert last < first
+
+
+def test_evaluate_and_predict():
+    paddle.seed(0)
+    model = paddle.Model(make_net())
+    model.prepare(None, nn.CrossEntropyLoss(), Accuracy())
+    ds = RandomClsDataset(n=32)
+    res = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "loss" in res and "acc" in res
+    out = model.predict(ds, batch_size=8, stack_outputs=True, verbose=0)
+    assert out.shape == (32, 4)
+
+
+def test_save_load(tmp_path):
+    paddle.seed(0)
+    model = paddle.Model(make_net())
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = RandomClsDataset(n=16)
+    model.fit(ds, epochs=1, batch_size=8, verbose=0)
+    path = os.path.join(tmp_path, "ckpt", "model")
+    model.save(path)
+    w0 = model.network.state_dict()
+    model2 = paddle.Model(make_net())
+    model2.prepare(paddle.optimizer.Adam(parameters=model2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.load(path)
+    w1 = model2.network.state_dict()
+    for k in w0:
+        np.testing.assert_allclose(w0[k].numpy(), w1[k].numpy())
+
+
+def test_callbacks_early_stopping():
+    paddle.seed(0)
+    model = paddle.Model(make_net())
+    opt = paddle.optimizer.Adam(learning_rate=0.0,
+                                parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    ds = RandomClsDataset(n=32)
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=1,
+                                        save_best_model=False, verbose=0)
+    model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+              callbacks=[es])
+    assert model.stop_training
+
+
+def test_summary(capsys):
+    net = make_net()
+    res = paddle.summary(net, (1, 8))
+    n_expected = 8 * 32 + 32 + 32 * 4 + 4
+    assert res["total_params"] == n_expected
+    out = capsys.readouterr().out
+    assert "Total params" in out
+
+
+def test_jit_train_step_path():
+    paddle.seed(0)
+    model = paddle.Model(make_net())
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), jit=True)
+    ds = RandomClsDataset()
+    losses = []
+    for _ in range(5):
+        losses.append(model.train_batch([ds.x[:16]], [ds.y[:16]])[0][0])
+    assert losses[-1] < losses[0]
