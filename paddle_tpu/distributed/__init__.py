@@ -21,6 +21,11 @@ from . import fleet  # noqa: F401
 from .mesh import (  # noqa: F401
     build_mesh, get_global_mesh, set_global_mesh,
 )
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,
+    unshard_dtensor, get_dist_attr,
+)
 
 from ..ops.manipulation import split as _tensor_split  # noqa: F401
 

@@ -1,0 +1,26 @@
+"""Semi-automatic parallelism. Reference analog:
+python/paddle/distributed/auto_parallel/ (~35k LoC: ProcessMesh, shard_tensor
+dist-attrs, completion.py, partitioner.py, reshard.py, engine.py) plus the C++
+data model paddle/fluid/distributed/auto_parallel/ (process_mesh.h,
+dist_attr.h).
+
+TPU-first: the reference implements dist-attr *completion* (propagating
+shardings op-by-op), a program *partitioner*, and explicit *reshard* insertion
+— all of which is exactly what XLA GSPMD does natively. So here:
+  ProcessMesh      -> jax.sharding.Mesh
+  dims_mapping     -> PartitionSpec
+  shard_tensor     -> device_put / with_sharding_constraint (NamedSharding)
+  completion       -> GSPMD sharding propagation inside jit
+  reshard          -> XLA resharding collectives, inserted by the compiler
+  Engine           -> pjit'd train/eval/predict steps
+"""
+from .process_mesh import ProcessMesh, get_current_process_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, shard_op, dtensor_from_fn, reshard, unshard_dtensor,
+    get_dist_attr)
+from .strategy import Strategy  # noqa: F401
+from .engine import Engine  # noqa: F401
+
+__all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor",
+           "shard_op", "dtensor_from_fn", "reshard", "unshard_dtensor",
+           "get_dist_attr", "Strategy", "Engine"]

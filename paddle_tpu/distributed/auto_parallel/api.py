@@ -1,0 +1,154 @@
+"""shard_tensor / shard_op / reshard. Reference analog:
+python/paddle/distributed/auto_parallel/interface.py (shard_tensor attaches a
+DistAttr{process_mesh, dims_mapping}; reshard.py inserts comm ops).
+
+TPU-first: a "dist attr" is (ProcessMesh, shard_spec); applying it outside jit
+is a `jax.device_put` onto a NamedSharding, inside jit a
+`with_sharding_constraint` — GSPMD then completes every unannotated tensor
+(the reference's completion.py) and inserts resharding collectives
+(reshard.py) during compilation."""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework.core import Tensor
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+__all__ = ["shard_tensor", "shard_op", "dtensor_from_fn", "reshard",
+           "unshard_dtensor", "get_dist_attr"]
+
+
+def _to_partition_spec(process_mesh, shard_spec, ndim):
+    if shard_spec is None:
+        shard_spec = [None] * ndim
+    entries = []
+    for s in shard_spec:
+        if s is None:
+            entries.append(None)
+        elif isinstance(s, (list, tuple)):
+            for name in s:
+                if name not in process_mesh.dim_names:
+                    raise ValueError(f"unknown mesh dim {name!r}; mesh has "
+                                     f"{process_mesh.dim_names}")
+            entries.append(tuple(s))
+        else:
+            if s not in process_mesh.dim_names:
+                raise ValueError(f"unknown mesh dim {s!r}; mesh has "
+                                 f"{process_mesh.dim_names}")
+            entries.append(s)
+    return PartitionSpec(*entries)
+
+
+def _named_sharding(process_mesh, shard_spec, ndim):
+    return NamedSharding(process_mesh.jax_mesh(),
+                         _to_partition_spec(process_mesh, shard_spec, ndim))
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None,
+                 stop_gradient=None):
+    """Place `x` on a ProcessMesh with per-dim sharding.
+
+    shard_spec: one entry per tensor dim — a mesh dim name, a list of names,
+    or None (replicated). Works both eagerly (device_put) and under jit
+    (sharding constraint)."""
+    if dist_attr is not None:  # reference v2.4 calling convention
+        process_mesh = dist_attr.get("process_mesh", process_mesh)
+        shard_spec = dist_attr.get("dims_mapping", shard_spec)
+    if process_mesh is None:
+        process_mesh = get_current_process_mesh()
+    if process_mesh is None:
+        raise ValueError("shard_tensor: no process_mesh given and no "
+                         "ProcessMesh context is active")
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    sharding = _named_sharding(process_mesh, shard_spec, len(t.shape))
+    if isinstance(t._value, jax.core.Tracer):
+        val = jax.lax.with_sharding_constraint(t._value, sharding)
+    else:
+        val = jax.device_put(t._value, sharding)
+    out = Tensor(val, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out._dist_attr = (process_mesh, list(shard_spec) if shard_spec else
+                      [None] * len(t.shape))
+    if hasattr(t, "name"):
+        out.name = t.name
+    # parameters keep their identity: re-point the original wrapper so layers
+    # holding it see the sharded value (reference: shard_tensor mutates the
+    # parameter's dist_attr in place)
+    if x is t:
+        t._value = val
+        t._dist_attr = out._dist_attr
+        return t
+    return out
+
+
+def get_dist_attr(x):
+    """(ProcessMesh, shard_spec) if annotated else None."""
+    return getattr(x, "_dist_attr", None)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Wrap a callable so its inputs/outputs get sharding constraints.
+    Reference analog: auto_parallel/interface.py shard_op."""
+    def wrapped(*args, **kwargs):
+        mesh = process_mesh or get_current_process_mesh()
+        if mesh is None:
+            return op_fn(*args, **kwargs)
+        new_args = []
+        for i, a in enumerate(args):
+            spec = in_shard_specs[i] if in_shard_specs and \
+                i < len(in_shard_specs) else None
+            if isinstance(a, Tensor) and spec is not None:
+                a = shard_tensor(Tensor(a._value,
+                                        stop_gradient=a.stop_gradient),
+                                 mesh, spec)
+            new_args.append(a)
+        out = op_fn(*new_args, **kwargs)
+        if out_shard_specs:
+            if isinstance(out, Tensor):
+                out = shard_tensor(Tensor(out._value,
+                                          stop_gradient=out.stop_gradient),
+                                   mesh, out_shard_specs[0])
+            elif isinstance(out, (list, tuple)):
+                specs = list(out_shard_specs) + \
+                    [None] * (len(out) - len(out_shard_specs))
+                out = type(out)(
+                    shard_tensor(Tensor(o._value,
+                                        stop_gradient=o.stop_gradient),
+                                 mesh, s) if isinstance(o, Tensor) and
+                    s is not None else o
+                    for o, s in zip(out, specs))
+        return out
+    return wrapped
+
+
+def dtensor_from_fn(fn, process_mesh, shard_spec, *args, **kwargs):
+    """Build a tensor with `fn` already sharded (reference:
+    paddle.distributed.shard_tensor(creation...))."""
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, process_mesh, shard_spec)
+
+
+def reshard(x, process_mesh, shard_spec=None, placements=None):
+    """Move a tensor to a (new) mesh/sharding; XLA emits the collectives."""
+    if placements is not None and shard_spec is None:
+        shard_spec = placements
+    return shard_tensor(
+        Tensor(x._value if isinstance(x, Tensor) else x,
+               stop_gradient=getattr(x, "stop_gradient", True)),
+        process_mesh, shard_spec)
+
+
+def unshard_dtensor(x):
+    """Gather to a fully-replicated tensor (reference:
+    auto_parallel/api.py unshard_dtensor)."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    attr = getattr(t, "_dist_attr", None)
+    if attr is None:
+        return t
+    mesh = attr[0]
+    sharding = _named_sharding(mesh, None, len(t.shape))
+    out = Tensor(jax.device_put(t._value, sharding),
+                 stop_gradient=t.stop_gradient)
+    return out

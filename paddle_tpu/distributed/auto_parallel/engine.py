@@ -1,0 +1,178 @@
+"""Auto-parallel Engine. Reference analog:
+python/paddle/distributed/auto_parallel/engine.py (`Engine.fit` plans,
+completes dist attrs, partitions the program and runs the distributed
+executor).
+
+TPU-first: planning/completion/partitioning is XLA GSPMD's job, so the Engine
+is thin — it shards the input batch over the mesh's batch axis, runs a fully
+jitted train step (paddle_tpu.jit.TrainStep), and lets the compiler place
+every intermediate and insert resharding collectives."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework.core import Tensor
+from .process_mesh import ProcessMesh, get_current_process_mesh
+from .strategy import Strategy
+
+__all__ = ["Engine"]
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None, process_mesh=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        metrics = metrics or []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else [metrics]
+        self._strategy = strategy or Strategy()
+        self._process_mesh = process_mesh
+        self._train_step = None
+        self._history = None
+
+    # ----------------------------------------------------------------- mesh
+    def _mesh(self):
+        pm = self._process_mesh or get_current_process_mesh()
+        if pm is None:
+            pm = ProcessMesh(np.arange(len(jax.devices())),
+                             dim_names=["data"])
+            self._process_mesh = pm
+        return pm
+
+    def _batch_axis(self, pm):
+        axis = self._strategy.dataset.batch_dim
+        return axis if axis is not None else pm.dim_names[0]
+
+    def _shard_batch(self, arrays):
+        pm = self._mesh()
+        mesh = pm.jax_mesh()
+        axis = self._batch_axis(pm)
+        axis_size = pm.get_dim_size(axis)
+        out = []
+        for a in arrays:
+            val = a._value if isinstance(a, Tensor) else np.asarray(a)
+            ndim = getattr(val, "ndim", 0)
+            # a partial final batch (eval/predict without drop_last) can't be
+            # split over the batch axis — replicate it instead of crashing
+            if ndim and val.shape[0] % axis_size == 0:
+                spec = PartitionSpec(axis, *([None] * (ndim - 1)))
+            else:
+                spec = PartitionSpec()
+            out.append(Tensor(jax.device_put(val, NamedSharding(mesh, spec)),
+                              stop_gradient=True))
+        return out
+
+    # ----------------------------------------------------------------- steps
+    def _get_train_step(self):
+        if self._train_step is None:
+            from ...jit.train_step import TrainStep
+            loss_fn = self._loss
+            if loss_fn is not None and not callable(loss_fn):
+                raise TypeError("loss must be callable")
+            self._train_step = TrainStep(self._model, loss_fn,
+                                         self._optimizer)
+            if self._strategy.sharding.enable:
+                from ..fleet.sharding_opt import shard_optimizer_states
+                params = [p for p in self._model.parameters()
+                          if not p.stop_gradient]
+                self._optimizer._create_accumulators(params)
+                shard_optimizer_states(self._optimizer)
+        return self._train_step
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1, shuffle=True, collate_fn=None):
+        from ...io import DataLoader
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=True, collate_fn=collate_fn)
+        step_fn = self._get_train_step()
+        k_steps = self._strategy.gradient_merge.k_steps \
+            if self._strategy.gradient_merge.enable else 1
+        history = {"loss": []}
+        it = 0
+        for epoch in range(epochs):
+            for batch in loader:
+                if steps_per_epoch is not None and \
+                        it >= (epoch + 1) * steps_per_epoch:
+                    break
+                xs = self._shard_batch(list(batch))
+                if k_steps > 1:
+                    # gradient merge: eager accumulate, update every k steps
+                    out = self._model(*xs[:-1])
+                    loss = self._loss(out, xs[-1]) / k_steps
+                    loss.backward()
+                    if (it + 1) % k_steps == 0:
+                        self._optimizer.step()
+                        self._optimizer.clear_grad()
+                    lval = float(loss) * k_steps
+                else:
+                    lval = float(step_fn(*xs))
+                history["loss"].append(lval)
+                if verbose and it % log_freq == 0:
+                    print(f"[auto_parallel.Engine] epoch {epoch} step {it} "
+                          f"loss {lval:.5f}")
+                it += 1
+        self._history = history
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, steps=None, verbose=0,
+                 collate_fn=None):
+        from ...io import DataLoader
+        from ...framework.autograd import no_grad
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, collate_fn=collate_fn)
+        self._model.eval()
+        losses = []
+        with no_grad():
+            for i, batch in enumerate(loader):
+                if steps is not None and i >= steps:
+                    break
+                xs = self._shard_batch(list(batch))
+                out = self._model(*xs[:-1])
+                if self._loss is not None:
+                    losses.append(float(self._loss(out, xs[-1])))
+        self._model.train()
+        return {"loss": float(np.mean(losses)) if losses else None}
+
+    def predict(self, test_data, batch_size=1, steps=None, collate_fn=None):
+        from ...io import DataLoader
+        from ...framework.autograd import no_grad
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       collate_fn=collate_fn)
+        self._model.eval()
+        outs = []
+        with no_grad():
+            for i, batch in enumerate(loader):
+                if steps is not None and i >= steps:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                xs = self._shard_batch(list(batch))
+                out = self._model(*xs)
+                outs.append(out.numpy() if isinstance(out, Tensor) else out)
+        self._model.train()
+        return outs
+
+    # ------------------------------------------------------------------ io
+    def save(self, path, training=True):
+        from ...framework import io as _io
+        _io.save(self._model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import os
+        from ...framework import io as _io
+        self._model.set_state_dict(_io.load(path + ".pdparams"))
+        if load_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_io.load(path + ".pdopt"))
+
+    @property
+    def main_program(self):  # static-graph parity shim
+        from ...static import default_main_program
+        return default_main_program()

@@ -1,0 +1,104 @@
+"""ProcessMesh: the logical N-D process space. Reference analog:
+python/paddle/distributed/auto_parallel/process_mesh.py and the C++ data model
+paddle/fluid/distributed/auto_parallel/process_mesh.h.
+
+TPU-first: a ProcessMesh is a named view over jax devices; `.jax_mesh()`
+materializes the jax.sharding.Mesh all sharding APIs consume."""
+from __future__ import annotations
+
+import numpy as np
+
+_current_process_mesh = None
+
+__all__ = ["ProcessMesh", "get_current_process_mesh"]
+
+
+class ProcessMesh:
+    """ProcessMesh(mesh=[[0,1],[2,3]], dim_names=["x","y"]).
+
+    `mesh` holds global process/device ids; dim_names name the axes (the
+    reference defaults to d0, d1, ...)."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is None:
+            if shape is None or process_ids is None:
+                raise ValueError("ProcessMesh needs mesh, or shape + "
+                                 "process_ids")
+            mesh = np.asarray(process_ids, dtype=np.int64).reshape(shape)
+        self._mesh = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh.ndim)]
+        if len(dim_names) != self._mesh.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim "
+                f"{self._mesh.ndim}")
+        self._dim_names = [str(d) for d in dim_names]
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def process_ids(self):
+        return [int(p) for p in self._mesh.flatten()]
+
+    def get_dim_size(self, dim_name):
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def jax_mesh(self):
+        """The jax.sharding.Mesh over real devices for this process space."""
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            devices = jax.devices()
+            dev_array = np.empty(self._mesh.shape, dtype=object)
+            for idx in np.ndindex(self._mesh.shape):
+                pid = int(self._mesh[idx])
+                if pid >= len(devices):
+                    raise ValueError(
+                        f"ProcessMesh references process {pid} but only "
+                        f"{len(devices)} devices are visible")
+                dev_array[idx] = devices[pid]
+            self._jax_mesh = Mesh(dev_array, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __enter__(self):
+        global _current_process_mesh
+        self._prev = _current_process_mesh
+        _current_process_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _current_process_mesh
+        _current_process_mesh = self._prev
+        return False
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self._dim_names == other._dim_names and \
+            np.array_equal(self._mesh, other._mesh)
+
+    def __hash__(self):
+        return hash((tuple(self._dim_names), self._mesh.tobytes()))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_current_process_mesh():
+    return _current_process_mesh

@@ -1,0 +1,54 @@
+"""Auto-parallel strategy config. Reference analog:
+python/paddle/distributed/auto_parallel/strategy.py (BaseConfig subclasses:
+RecomputeConfig, AMPConfig, ShardingConfig, GradientMergeConfig...)."""
+from __future__ import annotations
+
+__all__ = ["Strategy"]
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    """Bag of sub-configs steering the Engine.
+
+    amp.enable + amp.dtype: bf16 autocast of the jitted step
+    recompute.enable: jax.checkpoint over each layer forward
+    sharding.enable + stage/degree: optimizer/grad/param sharding axis
+    gradient_merge.enable + k_steps: micro-batch gradient accumulation
+    dataset.batch_dim: which mesh axis shards the batch (default: first)
+    """
+
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.seed = None
+        self.amp = _Config(enable=False, dtype="bfloat16", level="o2",
+                           custom_white_list=[], custom_black_list=[])
+        self.recompute = _Config(enable=False, checkpoints=None,
+                                 no_recompute_segments=[])
+        self.sharding = _Config(enable=False, stage=1, degree=1,
+                                axis="sharding")
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = _Config(enable=True, fused_opt=True)
+        self.dataset = _Config(batch_dim=None)
+        if config:
+            for section, values in config.items():
+                tgt = getattr(self, section, None)
+                if isinstance(tgt, _Config) and isinstance(values, dict):
+                    tgt.__dict__.update(values)
+                else:
+                    setattr(self, section, values)
+
+    def __repr__(self):
+        parts = [f"{k}={v!r}" for k, v in self.__dict__.items()]
+        return "Strategy(" + ", ".join(parts) + ")"

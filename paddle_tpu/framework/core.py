@@ -55,7 +55,7 @@ class Tensor:
     """Eager tensor with paddle semantics over a jax.Array value."""
 
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
-                 "name", "persistable", "_hooks", "__weakref__")
+                 "name", "persistable", "_hooks", "_dist_attr", "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None,
                  persistable=False):

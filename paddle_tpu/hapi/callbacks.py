@@ -197,6 +197,9 @@ class EarlyStopping(Callback):
         if self.baseline is not None:
             self.best_value = self.baseline
 
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
+
     def on_eval_end(self, logs=None):
         if logs is None or self.monitor not in logs:
             return
@@ -213,6 +216,7 @@ class EarlyStopping(Callback):
         else:
             self.wait_epoch += 1
         if self.wait_epoch >= self.patience:
+            self.stopped_epoch = getattr(self, "_epoch", 0)
             if self.model is not None:
                 self.model.stop_training = True
             if self.verbose:

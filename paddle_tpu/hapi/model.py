@@ -69,12 +69,17 @@ class Model:
         self._train_step = None
 
     # ------------------------------------------------------------- batches
-    def train_batch(self, inputs, labels=None, update=True):
-        """One optimization step; returns (loss_values, metric_results)."""
+    def train_batch(self, inputs, labels=None, update=True, loss_scale=1.0):
+        """One optimization step; returns (loss_values, metric_results).
+        update=False accumulates gradients without stepping (loss scaled by
+        loss_scale so k accumulated micro-batches average)."""
         self.network.train()
         inputs = _to_tensor_list(inputs)
         labels = _to_tensor_list(labels)
-        if self._use_jit_step and self._loss is not None and update:
+        # the fused jit step returns only the loss, so metric computation
+        # needs the eager path — metrics win over jit
+        if self._use_jit_step and self._loss is not None and update and \
+                not self._metrics:
             from ..jit.train_step import TrainStep
             if self._train_step is None:
                 self._train_step = TrainStep(self.network, self._loss,
@@ -87,10 +92,11 @@ class Model:
             loss = self._loss(*outs, *labels)
         else:
             loss = outs[0]
-        if update and self._optimizer is not None:
-            loss.backward()
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        if self._optimizer is not None:
+            (loss * loss_scale if loss_scale != 1.0 else loss).backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metric_res = []
         for m in self._metrics:
             res = m.compute(outs[0], *labels)
@@ -160,6 +166,11 @@ class Model:
         assert train_data is not None, "train_data must be given!"
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last)
+        import types
+        if epochs > 1 and isinstance(loader, types.GeneratorType):
+            raise ValueError(
+                "train_data is a one-shot generator but epochs > 1; pass a "
+                "Dataset/DataLoader or a re-iterable so every epoch has data")
         eval_loader = self._make_loader(eval_data, batch_size, False,
                                         num_workers, False)
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -182,7 +193,10 @@ class Model:
                     break
                 cbks.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
-                losses, metrics = self.train_batch(ins, labs)
+                k = max(1, accumulate_grad_batches)
+                losses, metrics = self.train_batch(
+                    ins, labs, update=((step + 1) % k == 0),
+                    loss_scale=1.0 / k)
                 logs = {"loss": losses[0]}
                 for m, res in zip(self._metrics, metrics):
                     n = m.name()
