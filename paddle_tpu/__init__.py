@@ -45,6 +45,7 @@ from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
+from . import inference  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401

@@ -54,7 +54,14 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None,
     (sharding constraint)."""
     if dist_attr is not None:  # reference v2.4 calling convention
         process_mesh = dist_attr.get("process_mesh", process_mesh)
-        shard_spec = dist_attr.get("dims_mapping", shard_spec)
+        dm = dist_attr.get("dims_mapping")
+        if dm is not None:
+            # v2.4 dims_mapping entries are mesh-dim INDICES (-1 = replicated)
+            pm = process_mesh or get_current_process_mesh()
+            if pm is None:
+                raise ValueError("dist_attr needs a process_mesh")
+            shard_spec = [None if d in (-1, None) else pm.dim_names[d]
+                          for d in dm]
     if process_mesh is None:
         process_mesh = get_current_process_mesh()
     if process_mesh is None:
@@ -78,6 +85,8 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None,
     if x is t:
         t._value = val
         t._dist_attr = out._dist_attr
+        if stop_gradient is not None:
+            t.stop_gradient = stop_gradient
         return t
     return out
 

@@ -89,9 +89,11 @@ class Engine:
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=True, collate_fn=collate_fn)
-        step_fn = self._get_train_step()
         k_steps = self._strategy.gradient_merge.k_steps \
             if self._strategy.gradient_merge.enable else 1
+        # gradient merge accumulates eagerly; the fused functional step is
+        # only built (and used) for the plain path
+        step_fn = self._get_train_step() if k_steps <= 1 else None
         history = {"loss": []}
         it = 0
         for epoch in range(epochs):
@@ -116,6 +118,10 @@ class Engine:
                     print(f"[auto_parallel.Engine] epoch {epoch} step {it} "
                           f"loss {lval:.5f}")
                 it += 1
+        if k_steps > 1 and it % k_steps != 0:
+            # flush the trailing partial accumulation window
+            self._optimizer.step()
+            self._optimizer.clear_grad()
         self._history = history
         return history
 

@@ -11,3 +11,4 @@ from .fleet_base import (  # noqa: F401
 )
 from . import meta_parallel  # noqa: F401
 from .utils import recompute  # noqa: F401
+from . import elastic  # noqa: F401

@@ -1,0 +1,104 @@
+"""Elastic training manager. Reference analog: fleet/elastic/manager.py:127
+(ElasticManager: per-node heartbeats in etcd3, dead/added node detection,
+endpoint rewrite + restart; ElasticLevel at manager.py:42).
+
+TPU-first: membership/heartbeats live in the native TCPStore (no etcd
+dependency); restarts are driven by the launch watcher
+(distributed/launch/main.py --max_restarts)."""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["ElasticStatus", "ElasticLevel", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1  # restart same-size job on failure
+    ELASTIC = 2          # allow scale in/out
+
+
+class ElasticManager:
+    """Tracks node liveness via store heartbeats.
+
+    Each node calls start(); a daemon thread writes
+    `heartbeat/<job>/<rank>` every `interval` seconds. `dead_nodes()` reports
+    ranks whose beat is older than 3x interval; `watch()` maps that to an
+    ElasticStatus for the launcher."""
+
+    def __init__(self, store=None, job_id=None, np=None, rank=None,
+                 interval=2.0, level=ElasticLevel.FAULT_TOLERANCE):
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.np = int(np if np is not None else
+                      os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.rank = int(rank if rank is not None else
+                        os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.interval = interval
+        self.level = level
+        self._store = store
+        self._stop = threading.Event()
+        self._thread = None
+        self.enable = self._store is not None and self.np > 1
+
+    def _key(self, rank):
+        return f"heartbeat/{self.job_id}/{rank}"
+
+    def start(self):
+        if not self.enable or self._thread is not None:
+            return
+
+        def beat():
+            while not self._stop.is_set():
+                try:
+                    self._store.set(self._key(self.rank),
+                                    str(time.time()).encode())
+                except Exception:
+                    pass
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=beat, daemon=True,
+                                        name="elastic-heartbeat")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 2)
+            self._thread = None
+
+    def dead_nodes(self):
+        if not self.enable:
+            return []
+        now = time.time()
+        dead = []
+        for r in range(self.np):
+            try:
+                ts = float(self._store.get(self._key(r), wait=False))
+                if now - ts > 3 * self.interval:
+                    dead.append(r)
+            except KeyError:
+                dead.append(r)  # never heartbeated
+            except Exception:
+                pass
+        return dead
+
+    def watch(self):
+        dead = self.dead_nodes()
+        if not dead:
+            return ElasticStatus.COMPLETED
+        if self.level >= ElasticLevel.FAULT_TOLERANCE:
+            return ElasticStatus.RESTART
+        return ElasticStatus.ERROR
+
+    def exit(self, completed=True):
+        self.stop()
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

@@ -1,0 +1,13 @@
+"""Distributed launcher. Reference analog:
+python/paddle/distributed/launch/main.py:18 (`launch()`), controllers/
+{collective.py,master.py,watcher.py}: spawn one process per device/host, wire
+rank env vars + endpoints, capture per-rank logs, watch for failures.
+
+TPU-first: one process per HOST (each process owns all local chips; in-host
+parallelism is the jax Mesh), rendezvous via the native TCPStore (master) and
+`jax.distributed.initialize` inside workers. Elastic restart is in
+fleet.elastic.
+"""
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

@@ -1,0 +1,172 @@
+"""`python -m paddle_tpu.distributed.launch [--nproc_per_node N] script.py
+args...` — reference analog: launch/main.py + controllers/collective.py.
+
+Each worker gets the reference env-var contract (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_MASTER, PADDLE_LOCAL_RANK) plus standard
+RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT aliases. Per-rank stdout/stderr are
+captured under --log_dir (reference: launch log dirs per rank)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed training job")
+    p.add_argument("--nnodes", type=int, default=None,
+                   help="number of hosts (default: from env or 1)")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="this host's rank")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this host (TPU: usually 1 — "
+                        "each process drives all local chips)")
+    p.add_argument("--master", default=None,
+                   help="host:port of the rank-0 rendezvous store")
+    p.add_argument("--log_dir", default="log", help="per-rank log directory")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"])
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: restart failed workers up to N times")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(base, rank, world_size, local_rank, master, log_dir):
+    env = dict(base)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_MASTER": master,
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world_size),
+        "MASTER_ADDR": master.split(":")[0],
+        "MASTER_PORT": master.split(":")[1],
+        "PADDLE_LOG_DIR": log_dir,
+    })
+    return env
+
+
+class _Proc:
+    def __init__(self, rank, popen, out):
+        self.rank = rank
+        self.popen = popen
+        self.out = out
+        self.restarts = 0
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    nnodes = args.nnodes or int(os.environ.get("PADDLE_NNODES", "1"))
+    node_rank = args.node_rank if args.node_rank is not None else \
+        int(os.environ.get("PADDLE_NODE_RANK", "0"))
+    nproc = args.nproc_per_node
+    world_size = nnodes * nproc
+
+    master = args.master or os.environ.get("PADDLE_MASTER")
+    store = None
+    if master is None:
+        from ...core import find_free_port
+        master = f"127.0.0.1:{find_free_port()}"
+    if node_rank == 0:
+        # the launcher owns the rendezvous store so workers can restart
+        # without losing it (reference: controllers/master.py)
+        from ...core import TCPStore
+        host, port = master.rsplit(":", 1)
+        try:
+            store = TCPStore("127.0.0.1", int(port), is_master=True,
+                             world_size=world_size)
+        except RuntimeError:
+            store = None  # port owned by an external master
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+
+    def spawn(rank, local_rank):
+        log_path = os.path.join(args.log_dir,
+                                f"workerlog.{rank}")
+        out = open(log_path, "ab")
+        env = _worker_env(os.environ, rank, world_size, local_rank, master,
+                          args.log_dir)
+        popen = subprocess.Popen(
+            [sys.executable, "-u", args.training_script] +
+            args.training_script_args,
+            env=env, stdout=out, stderr=subprocess.STDOUT)
+        return _Proc(rank, popen, out)
+
+    for lr in range(nproc):
+        procs.append(spawn(node_rank * nproc + lr, lr))
+
+    def terminate_all(sig=signal.SIGTERM):
+        for p in procs:
+            if p.popen.poll() is None:
+                try:
+                    p.popen.send_signal(sig)
+                except OSError:
+                    pass
+
+    def handler(signum, frame):
+        terminate_all()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+    # watcher loop (reference: controllers/watcher.py): on a worker failure
+    # either restart it (elastic budget) or tear the job down
+    exit_code = 0
+    try:
+        while True:
+            alive = 0
+            for i, p in enumerate(procs):
+                rc = p.popen.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    if p.restarts < args.max_restarts:
+                        p.restarts += 1
+                        print(f"[launch] rank {p.rank} exited {rc}; "
+                              f"restart {p.restarts}/{args.max_restarts}",
+                              file=sys.stderr)
+                        newp = spawn(p.rank, p.rank % nproc)
+                        newp.restarts = p.restarts
+                        p.out.close()
+                        procs[i] = newp
+                        alive += 1
+                    else:
+                        print(f"[launch] rank {p.rank} failed with exit code "
+                              f"{rc}; aborting job (log: "
+                              f"{args.log_dir}/workerlog.{p.rank})",
+                              file=sys.stderr)
+                        terminate_all()
+                        exit_code = rc
+                        alive = 0
+                        break
+            if alive == 0:
+                break
+            time.sleep(0.5)
+    finally:
+        terminate_all()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.popen.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.popen.kill()
+            p.out.close()
+        del store
+    return exit_code
+
+
+def main():
+    sys.exit(launch())

@@ -76,10 +76,11 @@ class Model:
         self.network.train()
         inputs = _to_tensor_list(inputs)
         labels = _to_tensor_list(labels)
-        # the fused jit step returns only the loss, so metric computation
-        # needs the eager path — metrics win over jit
+        # the fused jit step returns only the loss and applies grads
+        # functionally, so metrics and gradient accumulation (scaled partial
+        # backward) need the eager path
         if self._use_jit_step and self._loss is not None and update and \
-                not self._metrics:
+                not self._metrics and loss_scale == 1.0:
             from ..jit.train_step import TrainStep
             if self._train_step is None:
                 self._train_step = TrainStep(self.network, self._loss,
@@ -204,6 +205,12 @@ class Model:
                     vals = res if isinstance(res, (list, tuple)) else [res]
                     logs.update(zip(names, vals))
                 cbks.on_train_batch_end(step, logs)
+            k = max(1, accumulate_grad_batches)
+            if k > 1 and (step + 1) % k != 0 and self._optimizer is not None:
+                # flush the trailing partial accumulation window so no scaled
+                # gradients leak into the next epoch
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, batch_size=batch_size,

@@ -1,2 +1,4 @@
-from .api import to_static, not_to_static, ignore_module, TracedLayer, save, load  # noqa: F401
+from .api import (  # noqa: F401
+    to_static, not_to_static, ignore_module, TracedLayer, TranslatedLayer,
+    save, load, InputSpec)
 from .train_step import TrainStep  # noqa: F401

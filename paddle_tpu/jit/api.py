@@ -27,7 +27,7 @@ from ..nn.layer_base import Layer
 from ..ops.dispatch import call_op_multi
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "TracedLayer",
-           "save", "load", "InputSpec"]
+           "TranslatedLayer", "save", "load", "InputSpec"]
 
 _ignored_modules = set()
 
@@ -297,6 +297,14 @@ def save(layer, path, input_spec=None, **configs):
             from jax import export as jexport
             sf = layer if isinstance(layer, StaticFunction) else to_static(layer)
             params, buffers = _collect_state(model)
+            # the exact state values the export closes over, in call order —
+            # state_dict() can't reconstruct this (non-persistable buffers
+            # are part of the signature but not the state dict)
+            payload["export_state"] = [np.asarray(t._value)
+                                       for t in params + buffers]
+            # the exported pure fn returns model outputs + updated buffers;
+            # load needs the split point
+            payload["n_buffer_outputs"] = len(buffers)
             specs = [jax.ShapeDtypeStruct(
                 tuple(s.shape),
                 np.dtype(getattr(s, "dtype", "float32") if not hasattr(
@@ -315,10 +323,67 @@ def save(layer, path, input_spec=None, **configs):
           else path + ".pdmodel")
 
 
+class TranslatedLayer:
+    """Callable artifact returned by jit.load (reference analog:
+    fluid/dygraph/io.py TranslatedLayer): runs the jax.export-serialized
+    forward with the saved weights; falls back to weights-only access when
+    no compiled forward was attached."""
+
+    def __init__(self, payload):
+        self._payload = payload
+        self._state_dict = payload.get("state_dict", {})
+        self._exported = None
+        blob = payload.get("stablehlo")
+        if blob is not None:
+            from jax import export as jexport
+            self._exported = jexport.deserialize(blob)
+        export_state = payload.get("export_state")
+        if export_state is not None:
+            self._param_values = [jnp.asarray(v) for v in export_state]
+        else:  # older artifacts: persistable state only
+            self._param_values = [t._value
+                                  for t in self._state_dict.values()]
+
+    @property
+    def has_forward(self):
+        return self._exported is not None
+
+    def state_dict(self):
+        return dict(self._state_dict)
+
+    def __call__(self, *args):
+        if self._exported is None:
+            err = self._payload.get("stablehlo_error")
+            raise RuntimeError(
+                "this artifact was saved without input_spec so no compiled "
+                "forward is attached" + (f" (export error: {err})" if err
+                                         else ""))
+        vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        key = jax.random.key(0)
+        out = self._exported.call(self._param_values + vals, key)
+        if isinstance(out, (list, tuple)):
+            n_buf = self._payload.get("n_buffer_outputs", 0)
+            model_out = list(out[:len(out) - n_buf]) if n_buf else list(out)
+            outs = [Tensor(o, stop_gradient=True) for o in model_out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out, stop_gradient=True)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only; rebuild the "
+                           "Layer and set_state_dict to fine-tune")
+
+
 def load(path, **configs):
     from ..framework.io import load as fload
     try:
         payload = fload(path)
     except FileNotFoundError:
         payload = fload(path + ".pdmodel")
+    if isinstance(payload, dict) and payload.get("format") == \
+            "paddle_tpu.jit":
+        return TranslatedLayer(payload)
     return payload

@@ -86,12 +86,31 @@ def py_func(func, x, out, backward_func=None):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         **kwargs):
+                         layer=None, input_spec=None, **kwargs):
+    """TPU-native: the inference artifact is jax.export StableHLO. Pass the
+    Layer (and optionally input_spec; defaults to feed_vars when those are
+    InputSpecs) — program+executor arguments exist for API parity."""
     from ..jit.api import save as jit_save
-    program = kwargs.get("program")
-    raise NotImplementedError(
-        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the TPU-native "
-        "inference artifact is serialized StableHLO")
+    if layer is None and hasattr(fetch_vars, "state_dict"):
+        layer = fetch_vars
+    if layer is None:
+        raise ValueError(
+            "save_inference_model needs the Layer: "
+            "save_inference_model(path, feed_vars=[InputSpec...], "
+            "fetch_vars=layer) or layer=...")
+    spec = input_spec
+    if spec is None and feed_vars and all(
+            hasattr(v, "shape") for v in feed_vars):
+        spec = list(feed_vars)
+    jit_save(layer, path_prefix + ".pdmodel", input_spec=spec)
+    # this artifact's sole purpose is the compiled forward — surface export
+    # failure here, not at predictor creation on the deployment host
+    from ..framework.io import load as fload
+    payload = fload(path_prefix + ".pdmodel")
+    if "stablehlo" not in payload:
+        raise RuntimeError(
+            "save_inference_model: StableHLO export failed: "
+            + str(payload.get("stablehlo_error", "no input_spec given")))
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

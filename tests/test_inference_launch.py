@@ -1,0 +1,125 @@
+"""Inference predictor + launch CLI + elastic manager (reference analogs:
+inference/api/analysis_predictor.h, launch/main.py, fleet/elastic)."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+
+
+def make_net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+
+
+def test_jit_save_load_translated_layer(tmp_path):
+    net = make_net()
+    path = str(tmp_path / "m.pdmodel")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 4], "float32")])
+    art = paddle.jit.load(path)
+    assert art.has_forward
+    x = np.ones((1, 4), np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    out = art(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_predictor_run(tmp_path):
+    net = make_net()
+    path = str(tmp_path / "m.pdmodel")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+    cfg = paddle.inference.Config(path)
+    cfg.enable_memory_optim()
+    pred = paddle.inference.create_predictor(cfg)
+    x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    # direct style
+    outs = pred.run([x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+    # handle style
+    names = pred.get_input_names()
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_save_inference_model(tmp_path):
+    net = make_net()
+    prefix = str(tmp_path / "inf")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([1, 4], "float32")], net)
+    art = paddle.static.load_inference_model(prefix + ".pdmodel")
+    assert art.has_forward
+
+
+def test_launch_two_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        ws = os.environ["PADDLE_TRAINERS_NUM"]
+        print(f"rank {rank} of {ws} master={os.environ['PADDLE_MASTER']}")
+    """))
+    log_dir = str(tmp_path / "logs")
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, timeout=120,
+        cwd="/root/repo")
+    assert rc.returncode == 0, rc.stderr
+    for r in range(2):
+        log = open(os.path.join(log_dir, f"workerlog.{r}")).read()
+        assert f"rank {r} of 2" in log
+
+
+def test_launch_elastic_restart(tmp_path):
+    # worker fails once, then succeeds (state kept in a marker file)
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "failed_once"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        m = {str(marker)!r}
+        if not os.path.exists(m):
+            open(m, "w").write("x")
+            sys.exit(3)
+        print("recovered rank", os.environ["PADDLE_TRAINER_ID"])
+    """))
+    log_dir = str(tmp_path / "logs")
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", "2",
+         "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo")
+    assert rc.returncode == 0, rc.stderr
+    log = open(os.path.join(log_dir, "workerlog.0")).read()
+    assert "recovered" in log
+
+
+@pytest.mark.skipif(not paddle.distributed.TCPStore, reason="no native core")
+def test_elastic_manager_heartbeat():
+    from paddle_tpu.core import TCPStore, native_available
+    if not native_available():
+        pytest.skip("native core unavailable")
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    m0 = ElasticManager(store=store, job_id="t", np=2, rank=0, interval=0.2)
+    m1 = ElasticManager(store=store, job_id="t", np=2, rank=1, interval=0.2)
+    m0.start(); m1.start()
+    time.sleep(0.5)
+    assert m0.dead_nodes() == []
+    assert m0.watch() == ElasticStatus.COMPLETED
+    m1.stop()
+    time.sleep(1.0)
+    assert 1 in m0.dead_nodes()
+    assert m0.watch() == ElasticStatus.RESTART
+    m0.stop()
