@@ -3,7 +3,8 @@
 from ..ops.linalg import (  # noqa: F401
     norm, dist, cond, inv, pinv, det, slogdet, svd, qr, eig, eigh, eigvals,
     eigvalsh, matrix_power, matrix_rank, cholesky, cholesky_solve, solve,
-    triangular_solve, lstsq, lu, cross, histogram, bincount, multi_dot,
+    triangular_solve, lstsq, lu, lu_unpack, cross, histogram, bincount,
+    multi_dot,
     corrcoef, cov, householder_product, vander, pca_lowrank,
 )
 from ..ops.math import matmul, t  # noqa: F401

@@ -15,7 +15,7 @@ __all__ = [
     "norm", "dist", "cond", "inv", "pinv", "det", "slogdet", "svd", "qr",
     "eig", "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
     "cholesky", "cholesky_solve", "solve", "triangular_solve", "lstsq", "lu",
-    "cross", "histogram", "bincount", "multi_dot", "corrcoef", "cov",
+    "lu_unpack", "cross", "histogram", "bincount", "multi_dot", "corrcoef", "cov",
     "householder_product", "vander", "pca_lowrank",
 ]
 
@@ -216,6 +216,39 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         outs.append(Tensor(jnp.zeros((), jnp.int32)))
     return tuple(outs)
+
+
+@register_op("lu_unpack", "linalg", differentiable=False)
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack the (LU, pivots) pair from `lu` into P, L, U.
+
+    Reference analog: python/paddle/tensor/linalg.py lu_unpack → phi
+    lu_unpack kernel. Pivots are 1-based LAPACK-style sequential row swaps.
+    """
+    lu_mat = ensure_tensor(x)._value
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    batch = lu_mat.shape[:-2]
+
+    l_t = u_t = p_t = None
+    if unpack_ludata:
+        l_val = jnp.tril(lu_mat[..., :, :k], -1)
+        diag = jnp.arange(k)
+        l_val = l_val.at[..., diag, diag].set(1.0)
+        l_t = Tensor(l_val)
+        u_t = Tensor(jnp.triu(lu_mat[..., :k, :]))
+    if unpack_pivots:
+        # pivot-to-perm composition is inherently sequential; runs on host
+        piv = np.asarray(ensure_tensor(y)._value) - 1
+        p_out = np.zeros(batch + (m, m), lu_mat.dtype)
+        for idx in np.ndindex(*batch) if batch else [()]:
+            perm = np.arange(m)
+            for i, p in enumerate(piv[idx]):
+                perm[i], perm[p] = perm[p], perm[i]
+            # P such that A = P @ L @ U  (row `perm[i]` of P selects row i)
+            p_out[idx][perm, np.arange(m)] = 1.0
+        p_t = Tensor(jnp.asarray(p_out))
+    return p_t, l_t, u_t
 
 
 @register_op("cross", "linalg")

@@ -1,0 +1,133 @@
+"""Tests for paddle.fft, paddle.sparse, and paddle.autograd functional APIs."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, sparse, autograd
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ---------------------------------------------------------------- fft
+
+def test_fft_roundtrip_and_values():
+    a = np.random.randn(8).astype(np.float32)
+    got = fft.fft(_t(a)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft(a), rtol=1e-4, atol=1e-4)
+    back = fft.ifft(_t(got)).numpy()
+    np.testing.assert_allclose(back.real, a, rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_hfft_norms():
+    a = np.random.randn(16).astype(np.float32)
+    np.testing.assert_allclose(fft.rfft(_t(a)).numpy(), np.fft.rfft(a),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        fft.rfft(_t(a), norm="ortho").numpy(),
+        np.fft.rfft(a, norm="ortho"), rtol=1e-4, atol=1e-4)
+    r = np.fft.rfft(a)
+    np.testing.assert_allclose(fft.irfft(_t(r), n=16).numpy(),
+                               np.fft.irfft(r, n=16), rtol=1e-4, atol=1e-4)
+    c = (np.random.randn(9) + 1j * np.random.randn(9)).astype(np.complex64)
+    np.testing.assert_allclose(fft.hfft(_t(c)).numpy(), np.fft.hfft(c),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_fft2_fftn():
+    a = np.random.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(fft.fft2(_t(a)).numpy(), np.fft.fft2(a),
+                               rtol=1e-4, atol=1e-4)
+    b = np.random.randn(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(fft.fftn(_t(b)).numpy(), np.fft.fftn(b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fftfreq_shift():
+    np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5), rtol=1e-6)
+    a = np.arange(8.0, dtype=np.float32)
+    np.testing.assert_allclose(fft.fftshift(_t(a)).numpy(), np.fft.fftshift(a))
+    np.testing.assert_allclose(fft.ifftshift(_t(a)).numpy(),
+                               np.fft.ifftshift(a))
+
+
+# ---------------------------------------------------------------- sparse
+
+def test_sparse_coo_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = sparse.sparse_coo_tensor(_t(np.array(indices, np.int64)),
+                                 _t(np.array(values, np.float32)),
+                                 shape=[3, 3])
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    assert s.nnz() == 3
+
+
+def test_sparse_csr_roundtrip():
+    crows = np.array([0, 1, 3], np.int64)
+    cols = np.array([1, 0, 2], np.int64)
+    vals = np.array([4.0, 5.0, 6.0], np.float32)
+    s = sparse.sparse_csr_tensor(_t(crows), _t(cols), _t(vals), [2, 3])
+    expect = np.zeros((2, 3), np.float32)
+    expect[0, 1], expect[1, 0], expect[1, 2] = 4, 5, 6
+    np.testing.assert_allclose(s.to_dense().numpy(), expect)
+
+
+def test_sparse_ops():
+    idx = _t(np.array([[0, 1], [0, 1]], np.int64))
+    s = sparse.sparse_coo_tensor(idx, _t(np.array([1.0, -2.0], np.float32)),
+                                 shape=[2, 2])
+    d = sparse.add(s, s).numpy()
+    np.testing.assert_allclose(d, np.diag([2.0, -4.0]).astype(np.float32))
+    r = sparse.relu(s)
+    np.testing.assert_allclose(r.to_dense().numpy(),
+                               np.diag([1.0, 0.0]).astype(np.float32))
+    m = sparse.matmul(s, s).numpy()
+    np.testing.assert_allclose(m, np.diag([1.0, 4.0]).astype(np.float32))
+
+
+# ---------------------------------------------------------------- autograd
+
+def test_jacobian():
+    x = _t(np.array([1.0, 2.0, 3.0], np.float32))
+    jac = autograd.jacobian(lambda v: v * v, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0, 6.0]),
+                               rtol=1e-5)
+
+
+def test_hessian():
+    x = _t(np.array([1.0, 2.0], np.float32))
+    hes = autograd.hessian(lambda v: (v * v * v).sum(), x)
+    np.testing.assert_allclose(hes.numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
+
+
+def test_vjp_jvp():
+    x = _t(np.array([1.0, 2.0], np.float32))
+    v = _t(np.array([1.0, 1.0], np.float32))
+    out, g = autograd.vjp(lambda t: t * t, x, v)
+    np.testing.assert_allclose(out.numpy(), [1.0, 4.0], rtol=1e-6)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0], rtol=1e-6)
+    out, tangent = autograd.jvp(lambda t: t * t, x, v)
+    np.testing.assert_allclose(tangent.numpy(), [2.0, 4.0], rtol=1e-6)
+
+
+def test_pylayer():
+    class Square(autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 2.0 * x
+
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = Square.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0], rtol=1e-6)

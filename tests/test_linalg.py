@@ -1,0 +1,152 @@
+"""paddle.linalg numeric tests against NumPy references.
+
+Mirrors the reference's OpTest methodology (unittests/op_test.py:333): values
+checked against numpy.linalg, one gradient spot-checked analytically.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import linalg
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_norm_variants():
+    a = np.random.randn(3, 4).astype(np.float32)
+    x = _t(a)
+    np.testing.assert_allclose(float(linalg.norm(x)), np.linalg.norm(a),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        linalg.norm(x, p=1, axis=1).numpy(),
+        np.linalg.norm(a, ord=1, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        linalg.norm(x, p=np.inf, axis=0).numpy(),
+        np.abs(a).max(axis=0), rtol=1e-5)
+
+
+def test_det_slogdet_inv():
+    a = np.random.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    x = _t(a)
+    np.testing.assert_allclose(float(linalg.det(x)), np.linalg.det(a),
+                               rtol=1e-4)
+    s = linalg.slogdet(x).numpy()
+    sign, logdet = np.linalg.slogdet(a)
+    np.testing.assert_allclose(s, [sign, logdet], rtol=1e-4)
+    np.testing.assert_allclose(linalg.inv(x).numpy(), np.linalg.inv(a),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(linalg.pinv(x).numpy(), np.linalg.pinv(a),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_svd_qr_reconstruct():
+    a = np.random.randn(5, 3).astype(np.float32)
+    u, s, v = linalg.svd(_t(a))
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+    q, r = linalg.qr(_t(a))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-5)
+
+
+def test_eigh_eigvalsh():
+    a = np.random.randn(4, 4).astype(np.float32)
+    a = (a + a.T) / 2
+    w, v = linalg.eigh(_t(a))
+    wr = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(np.sort(w.numpy()), np.sort(wr), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.sort(linalg.eigvalsh(_t(a)).numpy()),
+                               np.sort(wr), rtol=1e-4, atol=1e-5)
+    # eigvectors: A v = w v
+    av = a @ v.numpy()
+    wv = v.numpy() * w.numpy()[None, :]
+    np.testing.assert_allclose(av, wv, rtol=1e-3, atol=1e-4)
+
+
+def test_solve_family():
+    a = np.random.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    b = np.random.randn(4, 2).astype(np.float32)
+    np.testing.assert_allclose(linalg.solve(_t(a), _t(b)).numpy(),
+                               np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+    spd = a @ a.T + np.eye(4, dtype=np.float32)
+    chol = np.linalg.cholesky(spd).astype(np.float32)
+    got = linalg.cholesky_solve(_t(b), _t(chol)).numpy()
+    np.testing.assert_allclose(got, np.linalg.solve(spd, b), rtol=1e-3,
+                               atol=1e-3)
+    tri = np.triu(a)
+    got = linalg.triangular_solve(_t(tri), _t(b), upper=True).numpy()
+    np.testing.assert_allclose(tri @ got, b, rtol=1e-3, atol=1e-3)
+
+
+def test_cholesky():
+    a = np.random.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    l = linalg.cholesky(_t(spd)).numpy()
+    np.testing.assert_allclose(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+    u = linalg.cholesky(_t(spd), upper=True).numpy()
+    np.testing.assert_allclose(u.T @ u, spd, rtol=1e-4, atol=1e-4)
+
+
+def test_lstsq():
+    a = np.random.randn(6, 3).astype(np.float32)
+    b = np.random.randn(6, 2).astype(np.float32)
+    sol, _, rank, _ = linalg.lstsq(_t(a), _t(b))
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(sol.numpy(), ref, rtol=1e-3, atol=1e-3)
+    assert int(rank.numpy()) == 3
+
+
+def test_lu_and_unpack_reconstruct():
+    a = np.random.randn(5, 5).astype(np.float32) + 5 * np.eye(5, dtype=np.float32)
+    lu_mat, piv = linalg.lu(_t(a))
+    p, l, u = linalg.lu_unpack(lu_mat, piv)
+    rec = p.numpy() @ l.numpy() @ u.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+
+def test_matrix_power_rank_multidot():
+    a = np.random.randn(3, 3).astype(np.float32)
+    np.testing.assert_allclose(linalg.matrix_power(_t(a), 3).numpy(),
+                               np.linalg.matrix_power(a, 3), rtol=1e-3,
+                               atol=1e-3)
+    assert int(linalg.matrix_rank(_t(np.eye(4))).numpy()) == 4
+    b = np.random.randn(3, 5).astype(np.float32)
+    c = np.random.randn(5, 2).astype(np.float32)
+    np.testing.assert_allclose(
+        linalg.multi_dot([_t(a), _t(b), _t(c)]).numpy(),
+        a @ b @ c, rtol=1e-4, atol=1e-4)
+
+
+def test_cov_corrcoef_cross():
+    a = np.random.randn(3, 10).astype(np.float32)
+    np.testing.assert_allclose(linalg.cov(_t(a)).numpy(), np.cov(a),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(linalg.corrcoef(_t(a)).numpy(),
+                               np.corrcoef(a), rtol=1e-4, atol=1e-5)
+    x = np.random.randn(4, 3).astype(np.float32)
+    y = np.random.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(linalg.cross(_t(x), _t(y)).numpy(),
+                               np.cross(x, y), rtol=1e-5, atol=1e-6)
+
+
+def test_det_gradient():
+    # d det(A) / dA = det(A) * A^-T
+    a = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    d = linalg.det(x)
+    d.backward()
+    expect = np.linalg.det(a) * np.linalg.inv(a).T
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-3, atol=1e-4)
+
+
+def test_histogram_bincount_vander():
+    x = np.array([0, 1, 1, 3, 2, 1], np.int64)
+    np.testing.assert_array_equal(
+        linalg.bincount(paddle.to_tensor(x)).numpy(), np.bincount(x))
+    h = linalg.histogram(_t([1.0, 2.0, 1.0]), bins=4, min=0, max=3)
+    np.testing.assert_array_equal(h.numpy(), [0, 2, 1, 0])
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(linalg.vander(_t(v), n=3).numpy(),
+                               np.vander(v, 3), rtol=1e-6)
