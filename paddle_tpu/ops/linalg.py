@@ -224,6 +224,9 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 
     Reference analog: python/paddle/tensor/linalg.py lu_unpack → phi
     lu_unpack kernel. Pivots are 1-based LAPACK-style sequential row swaps.
+
+    Always returns a 3-tuple (P, L, U); outputs disabled via
+    unpack_pivots/unpack_ludata are returned as None (and not computed).
     """
     lu_mat = ensure_tensor(x)._value
     m, n = lu_mat.shape[-2], lu_mat.shape[-1]

@@ -1,0 +1,128 @@
+"""DenseNet family. Reference analog: python/paddle/vision/models/densenet.py
+(dense blocks with concatenative feature reuse). jax-backed layers; same
+architecture graph, BN+ReLU pre-activation composite convs."""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn.layer.container import Sequential, LayerList
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AvgPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, Dropout
+from ...ops import manipulation as manip
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = BatchNorm2D(num_channels)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(num_channels, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return manip.concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, num_channels, num_output):
+        super().__init__()
+        self.bn = BatchNorm2D(num_channels)
+        self.relu = ReLU()
+        self.conv = Conv2D(num_channels, num_output, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init_features, growth_rate, block_config = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.features = Sequential(
+            Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                   bias_attr=False),
+            BatchNorm2D(num_init_features), ReLU(),
+            MaxPool2D(kernel_size=3, stride=2, padding=1))
+
+        self.blocks = LayerList()
+        num_channels = num_init_features
+        for i, num_layers in enumerate(block_config):
+            block = Sequential(*[
+                _DenseLayer(num_channels + j * growth_rate, growth_rate,
+                            bn_size, dropout) for j in range(num_layers)])
+            self.blocks.append(block)
+            num_channels += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                self.blocks.append(_Transition(num_channels, num_channels // 2))
+                num_channels //= 2
+
+        self.bn_final = BatchNorm2D(num_channels)
+        self.relu_final = ReLU()
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(num_channels, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.relu_final(self.bn_final(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = manip.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

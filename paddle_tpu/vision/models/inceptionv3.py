@@ -1,0 +1,172 @@
+"""Inception v3. Reference analog:
+python/paddle/vision/models/inceptionv3.py (stem + Inception A/B/C/D/E
+blocks, 299x299 input)."""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AvgPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, Dropout
+from ...ops import manipulation as manip
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class InceptionStem(Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv_1a = ConvBNLayer(3, 32, 3, stride=2)
+        self.conv_2a = ConvBNLayer(32, 32, 3)
+        self.conv_2b = ConvBNLayer(32, 64, 3, padding=1)
+        self.pool1 = MaxPool2D(kernel_size=3, stride=2)
+        self.conv_3b = ConvBNLayer(64, 80, 1)
+        self.conv_4a = ConvBNLayer(80, 192, 3)
+        self.pool2 = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        x = self.pool1(self.conv_2b(self.conv_2a(self.conv_1a(x))))
+        return self.pool2(self.conv_4a(self.conv_3b(x)))
+
+
+class InceptionA(Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1 = ConvBNLayer(in_ch, 64, 1)
+        self.b5 = Sequential(ConvBNLayer(in_ch, 48, 1),
+                             ConvBNLayer(48, 64, 5, padding=2))
+        self.b3 = Sequential(ConvBNLayer(in_ch, 64, 1),
+                             ConvBNLayer(64, 96, 3, padding=1),
+                             ConvBNLayer(96, 96, 3, padding=1))
+        self.pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                               ConvBNLayer(in_ch, pool_features, 1))
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b5(x), self.b3(x),
+                             self.pool(x)], axis=1)
+
+
+class InceptionB(Layer):
+    """Grid-size reduction 35->17."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = ConvBNLayer(in_ch, 384, 3, stride=2)
+        self.b3d = Sequential(ConvBNLayer(in_ch, 64, 1),
+                              ConvBNLayer(64, 96, 3, padding=1),
+                              ConvBNLayer(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return manip.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, in_ch, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.b1 = ConvBNLayer(in_ch, 192, 1)
+        self.b7 = Sequential(
+            ConvBNLayer(in_ch, c7, 1),
+            ConvBNLayer(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNLayer(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            ConvBNLayer(in_ch, c7, 1),
+            ConvBNLayer(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNLayer(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNLayer(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNLayer(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                               ConvBNLayer(in_ch, 192, 1))
+
+    def forward(self, x):
+        return manip.concat([self.b1(x), self.b7(x), self.b7d(x),
+                             self.pool(x)], axis=1)
+
+
+class InceptionD(Layer):
+    """Grid-size reduction 17->8."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = Sequential(ConvBNLayer(in_ch, 192, 1),
+                             ConvBNLayer(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            ConvBNLayer(in_ch, 192, 1),
+            ConvBNLayer(192, 192, (1, 7), padding=(0, 3)),
+            ConvBNLayer(192, 192, (7, 1), padding=(3, 0)),
+            ConvBNLayer(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(kernel_size=3, stride=2)
+
+    def forward(self, x):
+        return manip.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = ConvBNLayer(in_ch, 320, 1)
+        self.b3_1 = ConvBNLayer(in_ch, 384, 1)
+        self.b3_2a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_1 = ConvBNLayer(in_ch, 448, 1)
+        self.b3d_2 = ConvBNLayer(448, 384, 3, padding=1)
+        self.b3d_3a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_3b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                               ConvBNLayer(in_ch, 192, 1))
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3 = manip.concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1)
+        b3d = self.b3d_2(self.b3d_1(x))
+        b3d = manip.concat([self.b3d_3a(b3d), self.b3d_3b(b3d)], axis=1)
+        return manip.concat([self.b1(x), b3, b3d, self.pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = InceptionStem()
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(manip.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return InceptionV3(**kwargs)

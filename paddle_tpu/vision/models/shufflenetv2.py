@@ -1,0 +1,136 @@
+"""ShuffleNet V2. Reference analog:
+python/paddle/vision/models/shufflenetv2.py (channel split + shuffle units)."""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+from ...nn.layer.activation import ReLU, Swish
+from ...nn.layer.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Linear, ChannelShuffle
+from ...ops import manipulation as manip
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def _conv_bn_act(in_ch, out_ch, kernel, stride, groups=1, act="relu"):
+    pad = kernel // 2
+    layers = [Conv2D(in_ch, out_ch, kernel, stride=stride, padding=pad,
+                     groups=groups, bias_attr=False), BatchNorm2D(out_ch)]
+    if act == "relu":
+        layers.append(ReLU())
+    elif act == "swish":
+        layers.append(Swish())
+    return Sequential(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_ch, out_ch, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                _conv_bn_act(branch_ch, branch_ch, 1, 1, act=act),
+                _conv_bn_act(branch_ch, branch_ch, 3, 1, groups=branch_ch,
+                             act="none"),
+                _conv_bn_act(branch_ch, branch_ch, 1, 1, act=act))
+        else:
+            self.branch1 = Sequential(
+                _conv_bn_act(in_ch, in_ch, 3, stride, groups=in_ch,
+                             act="none"),
+                _conv_bn_act(in_ch, branch_ch, 1, 1, act=act))
+            self.branch2 = Sequential(
+                _conv_bn_act(in_ch, branch_ch, 1, 1, act=act),
+                _conv_bn_act(branch_ch, branch_ch, 3, stride,
+                             groups=branch_ch, act="none"),
+                _conv_bn_act(branch_ch, branch_ch, 1, 1, act=act))
+        self.shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = manip.slice(x, [1], [0], [half])
+            x2 = manip.slice(x, [1], [half], [x.shape[1]])
+            out = manip.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = manip.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        ch_map = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                  0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                  1.5: [24, 176, 352, 704, 1024],
+                  2.0: [24, 244, 488, 976, 2048]}
+        stage_out = ch_map[scale]
+
+        self.conv1 = _conv_bn_act(3, stage_out[0], 3, 2, act=act)
+        self.max_pool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+
+        blocks = []
+        in_ch = stage_out[0]
+        for stage_i, repeats in enumerate(stage_repeats):
+            out_ch = stage_out[stage_i + 1]
+            for i in range(repeats):
+                blocks.append(InvertedResidual(in_ch, out_ch,
+                                               stride=2 if i == 0 else 1,
+                                               act=act))
+                in_ch = out_ch
+        self.blocks = Sequential(*blocks)
+        self.conv_last = _conv_bn_act(in_ch, stage_out[-1], 1, 1, act=act)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(manip.flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained=pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kwargs)

@@ -1,0 +1,85 @@
+"""SqueezeNet. Reference analog: python/paddle/vision/models/squeezenet.py
+(fire modules: squeeze 1x1 -> expand 1x1 + 3x3)."""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn.layer.container import Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.activation import ReLU
+from ...nn.layer.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ...nn.layer.common import Dropout
+from ...ops import manipulation as manip
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(Layer):
+    def __init__(self, in_ch, squeeze, expand1, expand3):
+        super().__init__()
+        self.squeeze = Conv2D(in_ch, squeeze, 1)
+        self.expand1 = Conv2D(squeeze, expand1, 1)
+        self.expand3 = Conv2D(squeeze, expand3, 3, padding=1)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return manip.concat([self.relu(self.expand1(x)),
+                             self.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2, padding=1), ReLU(),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(kernel_size=3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256))
+
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return manip.flatten(x, 1)
+
+
+def _squeezenet(version, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return SqueezeNet(version=version, **kwargs)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return _squeezenet("1.0", pretrained, **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return _squeezenet("1.1", pretrained, **kwargs)

@@ -99,11 +99,16 @@ def test_lstsq():
 
 
 def test_lu_and_unpack_reconstruct():
-    a = np.random.randn(5, 5).astype(np.float32) + 5 * np.eye(5, dtype=np.float32)
+    # small diagonal entries force partial pivoting to produce a nontrivial
+    # permutation, exercising the sequential pivot-composition loop
+    a = (np.random.randn(5, 5) + 5 * np.eye(5)[::-1]).astype(np.float32)
     lu_mat, piv = linalg.lu(_t(a))
     p, l, u = linalg.lu_unpack(lu_mat, piv)
+    assert not np.allclose(p.numpy(), np.eye(5)), "want a nontrivial P"
     rec = p.numpy() @ l.numpy() @ u.numpy()
     np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+    p2, l2, u2 = linalg.lu_unpack(lu_mat, piv, unpack_ludata=False)
+    assert l2 is None and u2 is None and p2 is not None
 
 
 def test_matrix_power_rank_multidot():
