@@ -48,6 +48,9 @@ from . import static  # noqa: F401
 from . import inference  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
+from . import audio  # noqa: F401
 from . import sparse  # noqa: F401
 from . import fft  # noqa: F401
 from . import linalg  # noqa: F401

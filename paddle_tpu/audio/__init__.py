@@ -1,0 +1,10 @@
+"""paddle.audio equivalent. Reference analog: python/paddle/audio/
+(features, functional; backends are file-IO and out of scope on TPU hosts)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import (  # noqa: F401
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
+)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,124 @@
+"""Audio feature layers. Reference analog:
+python/paddle/audio/features/layers.py (Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC over the stft/frame ops).
+
+TPU-first: framing is a strided gather and the whole feature pipeline is a
+jit-friendly chain (rfft -> |.|^p -> mel matmul -> log/dct), so XLA fuses it
+into a few kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer_base import Layer
+from ..ops._helpers import ensure_tensor, call_op
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
+    """x: [..., T] -> [..., frame_length, n_frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(frame_length // 2,
+                                          frame_length // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[:, None]
+           + hop_length * jnp.arange(n_frames)[None, :])
+    return x[..., idx]
+
+
+def _stft(x, n_fft, hop_length, win, center, pad_mode):
+    frames = _frame(x, n_fft, hop_length, center, pad_mode)
+    frames = frames * win[None, :, None]
+    return jnp.fft.rfft(frames, axis=-2)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length, dtype=dtype)._value
+        if self.win_length < n_fft:  # center-pad window up to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.window = w
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+
+        def fn(v):
+            spec = _stft(v, self.n_fft, self.hop_length, self.window,
+                         self.center, self.pad_mode)
+            return jnp.abs(spec) ** self.power
+        return call_op("spectrogram", fn, (x,))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.n_mels = n_mels
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm, dtype)._value
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+
+        def fn(v):
+            return jnp.einsum("mf,...ft->...mt", self.fbank, v)
+        return call_op("mel_spectrogram", fn, (spec,))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)._value
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+
+        def fn(v):
+            return jnp.einsum("mk,...mt->...kt", self.dct, v)
+        return call_op("mfcc", fn, (logmel,))

@@ -1,0 +1,144 @@
+"""Audio functional ops. Reference analog: python/paddle/audio/functional/
+(functional.py: hz_to_mel/mel_to_hz/compute_fbank_matrix/power_to_db/
+create_dct; window.py: get_window).
+
+TPU-first: STFT is framing + rfft over the frame axis — one batched matmul
+-shaped FFT instead of per-frame kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor, unary
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list))
+    f = freq._value if isinstance(freq, Tensor) else jnp.asarray(freq)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = jnp.where(f >= min_log_hz,
+                         min_log_mel + jnp.log(f / min_log_hz) / logstep,
+                         mels)
+        out = mels
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list))
+    m = mel._value if isinstance(mel, Tensor) else jnp.asarray(mel)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = jnp.where(m >= min_log_mel,
+                          min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                          freqs)
+        out = freqs
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    low = hz_to_mel(float(f_min), htk=htk)
+    high = hz_to_mel(float(f_max), htk=htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return mel_to_hz(Tensor(mels), htk=htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Mel filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)._value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    x = ensure_tensor(spect)
+
+    def fn(v):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, v))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    return unary("power_to_db", fn, x)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc]."""
+    n = jnp.arange(n_mels, dtype=jnp.float64)
+    k = jnp.arange(n_mfcc, dtype=jnp.float64)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].set(dct[:, 0] * (1.0 / math.sqrt(2)))
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/kaiser/gaussian/... windows."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    n = win_length
+    sym = not fftbins
+    m = n + (0 if sym else 1)
+    t = np.arange(m)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * t / (m - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * t / (m - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * t / (m - 1))
+             + 0.08 * np.cos(4 * np.pi * t / (m - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * t / (m - 1) - 1.0)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        w = np.kaiser(m, beta)
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = np.exp(-0.5 * ((t - (m - 1) / 2) / std) ** 2)
+    elif name in ("boxcar", "rectangular", "ones"):
+        w = np.ones(m)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if not sym:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w.astype(dtype)))

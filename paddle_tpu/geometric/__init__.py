@@ -1,0 +1,185 @@
+"""Graph ops (GNN message passing). Reference analog:
+python/paddle/geometric/ (message_passing/send_recv.py, math.py,
+reindex.py, sampling/neighbors.py) backed by graph_send_recv kernels.
+
+TPU-first: message passing is expressed with jax segment reductions
+(jax.ops.segment_*), which XLA lowers to sorted scatter — no CUDA atomics.
+Reductions require a static out_size under jit; eager calls infer it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor, call_op
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "reindex_graph", "sample_neighbors",
+]
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed from sum / count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = np.asarray(ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(name, data, ids, pool, num):
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=num)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids,
+                                  num_segments=num)
+        cnt = jnp.maximum(cnt, 1)
+        return s / cnt.reshape((-1,) + (1,) * (data.ndim - 1))
+    out = _SEG[pool](data, ids, num_segments=num)
+    if pool in ("max", "min"):
+        # empty segments come back as +-inf; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+def segment_sum(data, segment_ids, name=None):
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids._value, None)
+    return call_op("segment_sum",
+                   lambda d: _segment("segment_sum", d, ids._value, "sum", num),
+                   (data,))
+
+
+def segment_mean(data, segment_ids, name=None):
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids._value, None)
+    return call_op("segment_mean",
+                   lambda d: _segment("segment_mean", d, ids._value, "mean",
+                                      num), (data,))
+
+
+def segment_max(data, segment_ids, name=None):
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids._value, None)
+    return call_op("segment_max",
+                   lambda d: _segment("segment_max", d, ids._value, "max",
+                                      num), (data,))
+
+
+def segment_min(data, segment_ids, name=None):
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids._value, None)
+    return call_op("segment_min",
+                   lambda d: _segment("segment_min", d, ids._value, "min",
+                                      num), (data,))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst. Reference analog:
+    geometric/message_passing/send_recv.py send_u_recv (graph_send_recv op)."""
+    x = ensure_tensor(x)
+    src = ensure_tensor(src_index)._value
+    dst = ensure_tensor(dst_index)._value
+    num = _num_segments(dst, out_size) if out_size is not None else \
+        max(_num_segments(dst, None), x.shape[0])
+
+    def fn(v):
+        return _segment("send_u_recv", v[src], dst, reduce_op, num)
+    return call_op("send_u_recv", fn, (x,))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, then reduce onto
+    dst. Reference analog: send_ue_recv (graph_send_ue_recv op)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    src = ensure_tensor(src_index)._value
+    dst = ensure_tensor(dst_index)._value
+    num = _num_segments(dst, out_size) if out_size is not None else \
+        max(_num_segments(dst, None), x.shape[0])
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+
+    def fn(v, e):
+        msg = ops[message_op](v[src], e)
+        return _segment("send_ue_recv", msg, dst, reduce_op, num)
+    return call_op("send_ue_recv", fn, (x, y))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from src features x and dst features y.
+    Reference analog: send_uv (graph_send_uv op)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    src = ensure_tensor(src_index)._value
+    dst = ensure_tensor(dst_index)._value
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+
+    def fn(v, w):
+        return ops[message_op](v[src], w[dst])
+    return call_op("send_uv", fn, (x, y))
+
+
+def reindex_graph(x, neighbors, count, name=None):
+    """Compact global node ids to local contiguous ids. Reference analog:
+    geometric/reindex.py reindex_graph. Host-side (index bookkeeping, not a
+    compute-path op)."""
+    x_np = np.asarray(ensure_tensor(x)._value)
+    nbr = np.asarray(ensure_tensor(neighbors)._value)
+    cnt = np.asarray(ensure_tensor(count)._value)
+    # paddle semantics: ids keep x first, then new neighbor ids in order of
+    # first appearance
+    order = {}
+    for v in np.concatenate([x_np, nbr]):
+        if v not in order:
+            order[v] = len(order)
+    remap = np.vectorize(order.__getitem__)
+    reindex_nbr = remap(nbr) if nbr.size else nbr
+    out_nodes = np.array(sorted(order, key=order.__getitem__))
+    # edge dst repeated per count
+    dst = np.repeat(remap(x_np), cnt) if cnt.size else np.array([], np.int64)
+    return (Tensor(jnp.asarray(reindex_nbr.astype(np.int64))),
+            Tensor(jnp.asarray(dst.astype(np.int64))),
+            Tensor(jnp.asarray(out_nodes.astype(np.int64))))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniformly sample up to sample_size neighbors per input node from a
+    CSC graph. Reference analog: geometric/sampling/neighbors.py
+    (graph_sample_neighbors kernel). Host-side sampling."""
+    row_np = np.asarray(ensure_tensor(row)._value)
+    colptr_np = np.asarray(ensure_tensor(colptr)._value)
+    nodes = np.asarray(ensure_tensor(input_nodes)._value)
+    eids_np = (np.asarray(ensure_tensor(eids)._value)
+               if eids is not None else np.arange(len(row_np)))
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    rng = np.random.default_rng()
+    out_nbr, out_cnt, out_eids = [], [], []
+    for n in nodes:
+        beg, end = int(colptr_np[n]), int(colptr_np[n + 1])
+        take = np.arange(beg, end)
+        if sample_size > 0 and len(take) > sample_size:
+            take = rng.choice(take, size=sample_size, replace=False)
+        out_nbr.append(row_np[take])
+        out_cnt.append(len(take))
+        out_eids.append(eids_np[take])
+    neighbors = np.concatenate(out_nbr) if out_nbr else np.array([], np.int64)
+    outs = (Tensor(jnp.asarray(neighbors.astype(np.int64))),
+            Tensor(jnp.asarray(np.array(out_cnt, np.int64))))
+    if return_eids:
+        sampled = (np.concatenate(out_eids) if out_eids
+                   else np.array([], np.int64))
+        outs += (Tensor(jnp.asarray(sampled.astype(np.int64))),)
+    return outs

@@ -1,0 +1,242 @@
+"""paddle.text equivalent. Reference analog: python/paddle/text/
+(datasets: Imdb/Imikolov/Movielens/UCIHousing/WMT14/WMT16/Conll05st; plus
+ViterbiDecoder under paddle.text.viterbi_decode in this era).
+
+Network downloads are unavailable, so datasets synthesize deterministic data
+unless given local files — same Dataset contract as the vision datasets.
+ViterbiDecoder is TPU-first: the DP recursion is a lax.scan (static trip
+count over time steps), not a per-step python loop.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..io.dataset import Dataset
+from ..nn.layer_base import Layer
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "viterbi_decode", "ViterbiDecoder"]
+
+
+# ------------------------------------------------------------------ datasets
+
+class _SyntheticTextDataset(Dataset):
+    """Deterministic synthetic fallback shared by the text datasets."""
+
+    N_TRAIN = 512
+    N_TEST = 128
+
+    def __init__(self, mode="train", seed_offset=0):
+        self.mode = mode
+        n = self.N_TRAIN if mode == "train" else self.N_TEST
+        self._rng = np.random.default_rng(
+            (0 if mode == "train" else 1) + seed_offset)
+        self._build(n)
+
+    def _build(self, n):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification: (token_ids, label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        self.cutoff = cutoff
+        super().__init__(mode=mode, seed_offset=10)
+
+    def _build(self, n):
+        self.data = []
+        for _ in range(n):
+            length = int(self._rng.integers(8, 64))
+            label = int(self._rng.integers(0, 2))
+            toks = self._rng.integers(2 + label, 5000, length).astype(np.int64)
+            self.data.append((toks, np.asarray(label, np.int64)))
+
+
+class Imikolov(_SyntheticTextDataset):
+    """n-gram LM dataset: tuples of n token ids."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=False):
+        self.window_size = window_size
+        super().__init__(mode=mode, seed_offset=20)
+
+    def _build(self, n):
+        self.data = [tuple(self._rng.integers(0, 2000, self.window_size)
+                           .astype(np.int64))
+                     for _ in range(n)]
+
+
+class Movielens(_SyntheticTextDataset):
+    """Rating prediction: (user_id, gender, age, job, movie_id, title,
+    categories, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        super().__init__(mode=mode, seed_offset=30)
+
+    def _build(self, n):
+        self.data = []
+        for _ in range(n):
+            self.data.append((
+                np.asarray(self._rng.integers(1, 6041), np.int64),
+                np.asarray(self._rng.integers(0, 2), np.int64),
+                np.asarray(self._rng.integers(0, 7), np.int64),
+                np.asarray(self._rng.integers(0, 21), np.int64),
+                np.asarray(self._rng.integers(1, 3953), np.int64),
+                self._rng.integers(0, 5000, 10).astype(np.int64),
+                self._rng.integers(0, 19, 3).astype(np.int64),
+                np.asarray(self._rng.random() * 4 + 1, np.float32)))
+
+
+class UCIHousing(_SyntheticTextDataset):
+    """Regression: (13 features, price)."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+            self.mode = mode
+            self.data = [(r[:-1], r[-1:]) for r in raw]
+            return
+        super().__init__(mode=mode, seed_offset=40)
+
+    def _build(self, n):
+        feats = self._rng.random((n, 13)).astype(np.float32)
+        w = np.linspace(0.5, 2.0, 13, dtype=np.float32)
+        prices = (feats @ w + 5).astype(np.float32)
+        self.data = [(feats[i], prices[i:i + 1]) for i in range(n)]
+
+
+class WMT14(_SyntheticTextDataset):
+    """Translation: (src_ids, trg_ids, trg_ids_next)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=False):
+        self.dict_size = dict_size
+        super().__init__(mode=mode, seed_offset=50)
+
+    def _build(self, n):
+        self.data = []
+        for _ in range(n):
+            ls, lt = int(self._rng.integers(4, 20)), int(self._rng.integers(4, 20))
+            src = self._rng.integers(3, self.dict_size, ls).astype(np.int64)
+            trg = self._rng.integers(3, self.dict_size, lt).astype(np.int64)
+            trg_next = np.concatenate([trg[1:], [1]]).astype(np.int64)
+            self.data.append((src, trg, trg_next))
+
+
+class WMT16(WMT14):
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=False):
+        super().__init__(mode=mode, dict_size=src_dict_size)
+
+
+class Conll05st(_SyntheticTextDataset):
+    """SRL: (word_ids, predicate_mark, label_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train",
+                 download=False):
+        super().__init__(mode=mode, seed_offset=60)
+
+    def _build(self, n):
+        self.data = []
+        for _ in range(n):
+            length = int(self._rng.integers(5, 30))
+            words = self._rng.integers(0, 5000, length).astype(np.int64)
+            labels = self._rng.integers(0, 67, length).astype(np.int64)
+            mark = np.zeros(length, np.int64)
+            mark[int(self._rng.integers(0, length))] = 1
+            self.data.append((words, mark, labels))
+
+
+# ------------------------------------------------------- viterbi decoding
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decode. Reference analog: the viterbi_decode op
+    (phi viterbi_decode kernel; python/paddle/text/viterbi_decode.py).
+
+    potentials: [B, T, N] unary emissions; transition_params: [N, N];
+    lengths: [B] actual sequence lengths.
+    Returns (scores [B], paths [B, T] int64, zero-padded past length).
+    """
+    pot = ensure_tensor(potentials)._value
+    trans = ensure_tensor(transition_params)._value
+    lens = ensure_tensor(lengths)._value
+    b, t, n = pot.shape
+
+    if include_bos_eos_tag:
+        # reference convention: last tag (n-1) is BOS/start, second-to-last
+        # (n-2) is EOS/stop (python/paddle/text/viterbi_decode.py)
+        bos, eos = n - 1, n - 2
+
+    def step(carry, xs):
+        alpha, step_i = carry
+        emit = xs  # [B, N]
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)             # [B, N]
+        best_score = jnp.max(scores, axis=1) + emit        # [B, N]
+        # only advance where step_i < length
+        active = (step_i < lens)[:, None]
+        alpha_new = jnp.where(active, best_score, alpha)
+        return (alpha_new, step_i + 1), best_prev
+
+    init_alpha = pot[:, 0, :]
+    if include_bos_eos_tag:
+        init_alpha = init_alpha + trans[bos][None, :]
+    (alpha, _), history = jax.lax.scan(
+        step, (init_alpha, jnp.asarray(1)),
+        jnp.transpose(pot[:, 1:, :], (1, 0, 2)))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int64)  # [B]
+
+    # backtrack with a reverse scan; history: [T-1, B, N]
+    def back(carry, hist_t):
+        tag, step_i = carry
+        prev = jnp.take_along_axis(hist_t, tag[:, None], axis=1)[:, 0]
+        # freeze when beyond length: positions t >= len keep tag
+        active = (step_i < lens - 1)
+        tag_new = jnp.where(active, prev.astype(jnp.int64), tag)
+        return (tag_new, step_i - 1), tag_new
+
+    rev_hist = history[::-1]
+    (first_tag, _), rev_tags = jax.lax.scan(
+        back, (last_tag, jnp.asarray(t - 2)), (rev_hist))
+    # path = [first..., last]; rev_tags are tags at positions t-2..0
+    path = jnp.concatenate([rev_tags[::-1].T, last_tag[:, None]], axis=1)
+    # zero out positions >= length (paddle pads with 0)
+    mask = jnp.arange(t)[None, :] < lens[:, None]
+    path = jnp.where(mask, path, 0)
+    return Tensor(scores), Tensor(path.astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper over viterbi_decode. Reference analog:
+    python/paddle/text/viterbi_decode.py ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

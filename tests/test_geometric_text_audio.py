@@ -1,0 +1,171 @@
+"""Tests for paddle.geometric, paddle.text, paddle.audio."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, text, audio
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# ------------------------------------------------------------- geometric
+
+def test_segment_ops():
+    data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    ids = np.array([0, 0, 1, 2], np.int64)
+    np.testing.assert_allclose(
+        geometric.segment_sum(_t(data), _t(ids)).numpy(),
+        [[4., 6.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(
+        geometric.segment_mean(_t(data), _t(ids)).numpy(),
+        [[2., 3.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(
+        geometric.segment_max(_t(data), _t(ids)).numpy(),
+        [[3., 4.], [5., 6.], [7., 8.]])
+    np.testing.assert_allclose(
+        geometric.segment_min(_t(data), _t(ids)).numpy(),
+        [[1., 2.], [5., 6.], [7., 8.]])
+
+
+def test_send_u_recv():
+    x = np.array([[1.], [2.], [4.]], np.float32)
+    src = np.array([0, 1, 2, 0], np.int64)
+    dst = np.array([1, 2, 1, 0], np.int64)
+    out = geometric.send_u_recv(_t(x), _t(src), _t(dst),
+                                reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[1.], [5.], [2.]])
+    out = geometric.send_u_recv(_t(x), _t(src), _t(dst),
+                                reduce_op="max").numpy()
+    np.testing.assert_allclose(out, [[1.], [4.], [2.]])
+
+
+def test_send_ue_recv_send_uv():
+    x = np.array([[1.], [2.]], np.float32)
+    e = np.array([[10.], [20.]], np.float32)
+    src = np.array([0, 1], np.int64)
+    dst = np.array([1, 0], np.int64)
+    out = geometric.send_ue_recv(_t(x), _t(e), _t(src), _t(dst),
+                                 message_op="add", reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[22.], [11.]])
+    out = geometric.send_uv(_t(x), _t(x), _t(src), _t(dst),
+                            message_op="mul").numpy()
+    np.testing.assert_allclose(out, [[2.], [2.]])
+
+
+def test_segment_grad():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32),
+                         stop_gradient=False)
+    out = geometric.segment_sum(x, _t(np.array([0, 0, 1], np.int64)))
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.], [1.], [1.]])
+
+
+def test_sample_neighbors_reindex():
+    # CSC graph: node0 <- {1,2}, node1 <- {2}, node2 <- {}
+    row = _t(np.array([1, 2, 2], np.int64))
+    colptr = _t(np.array([0, 2, 3, 3], np.int64))
+    nodes = _t(np.array([0, 1], np.int64))
+    nbrs, cnt = geometric.sample_neighbors(row, colptr, nodes)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1])
+    np.testing.assert_array_equal(np.sort(nbrs.numpy()[:2]), [1, 2])
+    re_nbr, dst, out_nodes = geometric.reindex_graph(nodes, nbrs, cnt)
+    assert out_nodes.numpy()[0] == 0 and out_nodes.numpy()[1] == 1
+    assert re_nbr.shape[0] == 3
+
+
+# ------------------------------------------------------------------ text
+
+def _brute_viterbi(pot, trans, include_bos_eos):
+    t, n = pot.shape
+    best, path = -np.inf, None
+    # reference convention: last tag is BOS/start, second-to-last is EOS/stop
+    bos, eos = n - 1, n - 2
+    for tags in itertools.product(range(n), repeat=t):
+        s = pot[0, tags[0]] + (trans[bos, tags[0]] if include_bos_eos else 0)
+        for i in range(1, t):
+            s += trans[tags[i - 1], tags[i]] + pot[i, tags[i]]
+        if include_bos_eos:
+            s += trans[tags[-1], eos]
+        if s > best:
+            best, path = s, tags
+    return best, np.array(path)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    t, n = 4, 4
+    pot = rng.random((2, t, n)).astype(np.float32)
+    trans = rng.random((n, n)).astype(np.float32)
+    lens = np.array([t, t], np.int64)
+    scores, paths = text.viterbi_decode(_t(pot), _t(trans), _t(lens),
+                                        include_bos_eos_tag=True)
+    for b in range(2):
+        bs, bp = _brute_viterbi(pot[b], trans, True)
+        np.testing.assert_allclose(scores.numpy()[b], bs, rtol=1e-5)
+        np.testing.assert_array_equal(paths.numpy()[b], bp)
+
+
+def test_viterbi_decoder_layer_and_no_bos():
+    rng = np.random.default_rng(1)
+    pot = rng.random((1, 3, 3)).astype(np.float32)
+    trans = rng.random((3, 3)).astype(np.float32)
+    dec = text.ViterbiDecoder(_t(trans), include_bos_eos_tag=False)
+    scores, paths = dec(_t(pot), _t(np.array([3], np.int64)))
+    bs, bp = _brute_viterbi(pot[0], trans, False)
+    np.testing.assert_allclose(scores.numpy()[0], bs, rtol=1e-5)
+    np.testing.assert_array_equal(paths.numpy()[0], bp)
+
+
+def test_text_datasets():
+    for cls in (text.Imdb, text.Imikolov, text.Movielens, text.UCIHousing,
+                text.WMT14, text.WMT16, text.Conll05st):
+        ds = cls(mode="train")
+        assert len(ds) > 0
+        item = ds[0]
+        assert isinstance(item, tuple)
+    feats, price = text.UCIHousing(mode="test")[0]
+    assert feats.shape == (13,) and price.shape == (1,)
+
+
+# ----------------------------------------------------------------- audio
+
+def test_mel_conversions():
+    assert abs(audio.functional.hz_to_mel(1000.0, htk=True) - 999.99) < 0.1
+    m = audio.functional.hz_to_mel(440.0)
+    back = audio.functional.mel_to_hz(m)
+    assert abs(back - 440.0) < 1e-3
+
+
+def test_fbank_matrix():
+    fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert fb.sum() > 0
+
+
+def test_spectrogram_parseval():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 2048)).astype(np.float32)
+    spec = audio.Spectrogram(n_fft=256, hop_length=128, power=2.0)(_t(x))
+    assert spec.numpy().shape[1] == 129  # 1 + n_fft//2
+    assert np.isfinite(spec.numpy()).all() and spec.numpy().max() > 0
+    # compare one frame against a straight numpy stft (center pad reflect)
+    xp = np.pad(x[0], (128, 128), mode="reflect")
+    frame0 = xp[:256] * np.hanning(257)[:-1]
+    ref = np.abs(np.fft.rfft(frame0)) ** 2
+    np.testing.assert_allclose(spec.numpy()[0, :, 0], ref, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_mel_and_mfcc_shapes():
+    rng = np.random.default_rng(0)
+    x = _t(rng.standard_normal((2, 4000)).astype(np.float32))
+    mel = audio.MelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+    assert mel.numpy().shape[:2] == (2, 64)
+    logmel = audio.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = audio.MFCC(sr=16000, n_mfcc=20, n_fft=512)(x)
+    assert mfcc.numpy().shape[:2] == (2, 20)
