@@ -34,7 +34,7 @@ def _frame(x, frame_length, hop_length, center=True, pad_mode="reflect"):
 
 def _stft(x, n_fft, hop_length, win, center, pad_mode):
     frames = _frame(x, n_fft, hop_length, center, pad_mode)
-    frames = frames * win[None, :, None]
+    frames = frames * win[:, None]
     return jnp.fft.rfft(frames, axis=-2)
 
 

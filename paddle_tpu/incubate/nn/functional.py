@@ -1,0 +1,120 @@
+"""incubate.nn.functional — fused-op functional entry points.
+
+Reference analog: python/paddle/incubate/nn/functional/ (fused_transformer.py
+fused_bias_dropout_residual_layer_norm, fused_matmul_bias, ...) over the
+fused CUDA ops; here they route to Pallas kernels when eligible and XLA
+otherwise.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor, call_op
+from ...kernels import fused_ln as _fused_ln
+from ...kernels import cross_entropy as _fused_ce
+
+__all__ = ["fused_bias_dropout_residual_layer_norm",
+           "fused_softmax_cross_entropy", "fused_linear"]
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+        name=None):
+    """y = LayerNorm(residual + dropout(x + bias)).
+
+    Reference analog: incubate/nn/functional/fused_transformer.py over
+    fused_bias_dropout_residual_layer_norm_op.cu.
+    """
+    x = ensure_tensor(x)
+    residual = ensure_tensor(residual)
+    d = x.shape[-1]
+    bias_t = ensure_tensor(bias) if bias is not None else None
+    scale_t = ensure_tensor(ln_scale) if ln_scale is not None else None
+    shift_t = ensure_tensor(ln_bias) if ln_bias is not None else None
+
+    # dropout is a real op while training, and still rescales at inference
+    # under downscale_in_infer — both cases route through F.dropout (XLA)
+    needs_dropout = dropout_rate > 0.0 and (
+        training or mode == "downscale_in_infer")
+    if needs_dropout or not _fused_ln.is_eligible(x._value, d):
+        from ...nn import functional as F
+        h = x if bias_t is None else x + bias_t
+        if needs_dropout:
+            h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+        return F.layer_norm(h + residual, [d], weight=scale_t, bias=shift_t,
+                            epsilon=ln_epsilon)
+
+    ones = jnp.ones((d,), jnp.float32)
+    zeros = jnp.zeros((d,), jnp.float32)
+    args = [x, residual]
+    b_val = bias_t._value if bias_t is not None else zeros
+    s_val = scale_t._value if scale_t is not None else ones
+    sh_val = shift_t._value if shift_t is not None else zeros
+
+    def fn(xv, rv, *rest):
+        lead = xv.shape[:-1]
+        x2 = xv.reshape(-1, d)
+        r2 = rv.reshape(-1, d)
+        vals = list(rest)
+        bb = vals.pop(0) if bias_t is not None else b_val
+        sc = vals.pop(0) if scale_t is not None else s_val
+        sh = vals.pop(0) if shift_t is not None else sh_val
+        out = _fused_ln.fused_bias_residual_layer_norm(
+            x2, r2, bb, sc, sh, ln_epsilon)
+        return out.reshape(lead + (d,))
+
+    for t in (bias_t, scale_t, shift_t):
+        if t is not None:
+            args.append(t)
+    return call_op("fused_bias_dropout_residual_layer_norm", fn, tuple(args))
+
+
+def fused_softmax_cross_entropy(logits, label, ignore_index=-100,
+                                reduction="mean", name=None):
+    """Vocab-blocked fused CE. Reference analog:
+    c_softmax_with_cross_entropy / softmax_with_cross_entropy.
+
+    Unlike nn.functional.cross_entropy (gated by
+    FLAGS_use_fused_cross_entropy), this explicit entry point always uses the
+    Pallas kernel when the device/shape supports it, falling back to XLA
+    otherwise."""
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+    lab_v = label._value
+
+    if _fused_ce.is_eligible(logits._value, lab_v, force=True):
+        def fn(lg):
+            lab_idx = jnp.clip(lab_v, 0, lg.shape[1] - 1).astype(jnp.int32)
+            nll = _fused_ce.fused_softmax_cross_entropy(lg, lab_idx)
+            valid = lab_v != ignore_index
+            nll = jnp.where(valid, nll, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(jnp.float32))
+                return jnp.sum(nll) / jnp.maximum(denom, 1.0)
+            if reduction == "sum":
+                return jnp.sum(nll)
+            return nll
+        return call_op("fused_softmax_cross_entropy", fn, (logits,))
+
+    from ...nn.functional import cross_entropy
+    return cross_entropy(logits, label, ignore_index=ignore_index,
+                         reduction=reduction)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Matmul + bias epilogue (XLA fuses this natively on the MXU).
+    Reference analog: fused_gemm_epilogue_op.cc (cublasLt epilogue)."""
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    if bias is None:
+        def fn(a, w):
+            wm = w.T if transpose_weight else w
+            return a @ wm
+        return call_op("fused_linear", fn, (x, weight))
+
+    def fn(a, w, b):
+        wm = w.T if transpose_weight else w
+        return a @ wm + b
+    return call_op("fused_linear", fn, (x, weight, ensure_tensor(bias)))

@@ -11,7 +11,42 @@ from ...nn.layer.container import LayerList
 from ...ops import manipulation as manip
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedBiasDropoutResidualLayerNorm"]
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """y = LayerNorm(residual + dropout(x + bias)).
+
+    Reference: incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm over
+    fused_bias_dropout_residual_layer_norm_op.cu. Routes to the Pallas
+    row-blocked kernel (kernels/fused_ln.py) when eligible.
+    """
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = materialize_parameter(
+            [embed_dim], bias_attr, self._dtype, is_bias=True)
+        self.ln_scale = materialize_parameter(
+            [embed_dim], weight_attr, self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = materialize_parameter(
+            [embed_dim], bias_attr, self._dtype, is_bias=True)
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, dropout_rate={self.dropout_rate}"
 
 
 class FusedMultiHeadAttention(Layer):

@@ -5,3 +5,5 @@ fused_feedforward_op.cu, fused_softmax_mask). Here each is a Pallas kernel
 targeting MXU/VMEM directly.
 """
 from . import flash_attention  # noqa: F401
+from . import cross_entropy  # noqa: F401
+from . import fused_ln  # noqa: F401

@@ -1,0 +1,19 @@
+"""Shared helpers for the Pallas TPU kernels."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+# index maps must emit i32 — a python literal 0 traces as i64 under the
+# framework's x64 mode, which Mosaic cannot legalize
+ZERO = np.int32(0)
+
+# platforms that execute Pallas TPU kernels (axon = tunneled v5e chip)
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def on_tpu():
+    try:
+        return jax.devices()[0].platform in TPU_PLATFORMS
+    except Exception:
+        return False

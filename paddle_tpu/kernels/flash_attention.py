@@ -25,16 +25,11 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
+from ._common import ZERO as _SHARED_ZERO, on_tpu as _on_tpu
+
 __all__ = ["flash_attention_bnhd", "is_eligible"]
 
 _NEG_INF = -1e30
-
-
-def _on_tpu():
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
 
 
 # below this sequence length XLA's fused attention wins (measured on v5e:
@@ -136,11 +131,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q=128, block_k=128):
     grid = (b * h, n // block_q)
     kernel = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k, seq_k=m)
-    # index maps must emit i32 — a literal python 0 traces as i64 under the
-    # framework's x64 mode, which Mosaic refuses to legalize. Use a concrete
-    # numpy scalar (a traced jnp constant would be rejected as a capture).
-    import numpy as np
-    zero = np.int32(0)
+    # index maps must emit i32 (see kernels/_common.py)
+    zero = _SHARED_ZERO
     out = pl.pallas_call(
         kernel,
         grid=grid,

@@ -1,0 +1,133 @@
+"""Fused bias + dropout + residual + LayerNorm (Pallas, TPU).
+
+Reference analog: fluid/operators/fused/fused_bias_dropout_residual_layer_norm
+_op.cu (+ fused_dropout_helper.h) — the reference's epilogue fusion after
+attention/FFN projections.
+
+TPU-native design: one row-blocked kernel computes
+    y = LayerNorm((x + bias) + residual) * scale + shift
+entirely in VMEM — a single HBM read of x/residual and a single write of y,
+instead of separate add/reduce/normalize round-trips. Rows are the sublane
+dim; the full hidden dim stays resident per row block.
+
+Dropout (training) falls back to the XLA path: TPU dropout is cheap under
+XLA fusion and keeping RNG out of the kernel keeps it deterministic per
+(seed, position) under pjit. The backward recomputes via XLA (elementwise +
+row reductions fuse into two kernels).
+
+Off-TPU the kernel runs under the Pallas interpreter in tests; the public
+entry point falls back to XLA when ineligible.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+from ._common import ZERO as _ZERO, on_tpu as _on_tpu
+
+__all__ = ["fused_bias_residual_layer_norm", "is_eligible"]
+
+
+def is_eligible(x, d):
+    if not _HAS_PALLAS or not _on_tpu():
+        return False
+    from ..framework.flags import FLAGS
+    if not getattr(FLAGS, "use_fused_layer_norm", True):
+        return False
+    # d must tile the lane dim and leave VMEM room for at least an 8-row block
+    return d % 128 == 0 and _pick_block_r(d) is not None
+
+
+def _pick_block_r(d):
+    # keep x/residual/out blocks around ~6MB of VMEM; None = too large, the
+    # caller must fall back to XLA
+    budget = 6 * 1024 * 1024 // (3 * 4 * d)
+    for br in (256, 128, 64, 32, 16, 8):
+        if br <= budget:
+            return br
+    return None
+
+
+def _kernel(x_ref, res_ref, bias_ref, scale_ref, shift_ref, out_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    z = x + bias_ref[...].astype(jnp.float32) \
+        + res_ref[...].astype(jnp.float32)
+    mean = jnp.mean(z, axis=1, keepdims=True)
+    c = z - mean
+    var = jnp.mean(c * c, axis=1, keepdims=True)
+    y = c * jax.lax.rsqrt(var + eps)
+    y = y * scale_ref[...].astype(jnp.float32) \
+        + shift_ref[...].astype(jnp.float32)
+    out_ref[...] = y.astype(out_ref.dtype)
+
+
+def _reference(x, residual, bias, scale, shift, eps):
+    z = (x.astype(jnp.float32) + bias.astype(jnp.float32)
+         + residual.astype(jnp.float32))
+    mean = jnp.mean(z, axis=-1, keepdims=True)
+    c = z - mean
+    var = jnp.mean(c * c, axis=-1, keepdims=True)
+    y = c * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32) \
+        + shift.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _run(x, residual, bias, scale, shift, eps, interpret):
+    r, d = x.shape
+    block_r = _pick_block_r(d)
+    pad = (-r) % block_r
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    rp = jnp.pad(residual, ((0, pad), (0, 0))) if pad else residual
+    rows = xp.shape[0]
+    kernel = functools.partial(_kernel, eps=eps)
+    vec = lambda a: a.reshape(1, d)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block_r,),
+        in_specs=[
+            pl.BlockSpec((block_r, d), lambda ri: (ri, _ZERO)),
+            pl.BlockSpec((block_r, d), lambda ri: (ri, _ZERO)),
+            pl.BlockSpec((1, d), lambda ri: (_ZERO, _ZERO)),
+            pl.BlockSpec((1, d), lambda ri: (_ZERO, _ZERO)),
+            pl.BlockSpec((1, d), lambda ri: (_ZERO, _ZERO)),
+        ],
+        out_specs=pl.BlockSpec((block_r, d), lambda ri: (ri, _ZERO)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(xp, rp, vec(bias), vec(scale), vec(shift))
+    return out[:r]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_bias_residual_layer_norm(x, residual, bias, scale, shift,
+                                   eps=1e-5, interpret=False):
+    """y = LN(x + bias + residual) * scale + shift.
+
+    x/residual: [rows, d]; bias/scale/shift: [d]. Row blocks stream through
+    VMEM; stats are computed in f32 regardless of input dtype.
+    """
+    return _run(x, residual, bias, scale, shift, eps, interpret)
+
+
+def _vjp_fwd(x, residual, bias, scale, shift, eps, interpret):
+    out = _run(x, residual, bias, scale, shift, eps, interpret)
+    return out, (x, residual, bias, scale, shift)
+
+
+def _vjp_bwd(eps, interpret, res, g):
+    x, residual, bias, scale, shift = res
+    _, vjp = jax.vjp(
+        lambda xx, rr, bb, sc, sh: _reference(xx, rr, bb, sc, sh, eps),
+        x, residual, bias, scale, shift)
+    return vjp(g)
+
+
+fused_bias_residual_layer_norm.defvjp(_vjp_fwd, _vjp_bwd)

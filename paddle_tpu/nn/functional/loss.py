@@ -53,9 +53,24 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         lab_v = jnp.squeeze(lab_v, axis)
 
     def fn(logits, *w):
+        lab_idx = jnp.clip(lab_v, 0, n_classes - 1).astype(jnp.int32)
+        from ...kernels import cross_entropy as fused_ce
+        if (not w and label_smoothing == 0.0 and use_softmax
+                and logits.ndim == 2 and axis in (-1, 1)
+                and lab_idx.ndim == 1
+                and fused_ce.is_eligible(logits, lab_idx)):
+            # vocab-blocked Pallas kernel: no [rows, V] log-softmax in HBM
+            nll = fused_ce.fused_softmax_cross_entropy(logits, lab_idx)
+            valid = (lab_v != ignore_index)
+            nll = jnp.where(valid, nll, 0.0)
+            if reduction == "mean":
+                denom = jnp.sum(valid.astype(jnp.float32))
+                return jnp.sum(nll) / jnp.maximum(denom, 1.0)
+            if reduction == "sum":
+                return jnp.sum(nll)
+            return nll
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
             else jnp.log(jnp.clip(logits, 1e-30, None))
-        lab_idx = jnp.clip(lab_v, 0, n_classes - 1).astype(jnp.int32)
         picked = jnp.take_along_axis(
             logp, jnp.expand_dims(lab_idx, axis), axis=axis)
         picked = jnp.squeeze(picked, axis)
