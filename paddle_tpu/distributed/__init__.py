@@ -22,6 +22,8 @@ from .mesh import (  # noqa: F401
     build_mesh, get_global_mesh, set_global_mesh,
 )
 from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import rpc  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,
     unshard_dtensor, get_dist_attr,

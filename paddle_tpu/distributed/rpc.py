@@ -1,0 +1,187 @@
+"""paddle.distributed.rpc equivalent — user-level RPC between workers.
+
+Reference analog: paddle/fluid/distributed/rpc/ (rpc_agent.cc over brpc,
+python_rpc_handler.cc pickles the callable+args) + python API
+python/paddle/distributed/rpc/rpc.py (init_rpc/rpc_sync/rpc_async/shutdown).
+
+TPU-native design: brpc is replaced by a plain TCP server thread per worker
+(length-prefixed pickle frames); rendezvous of worker endpoints goes through
+the native TCPStore (csrc/tcp_store.cc) instead of a master gflag. Futures
+are concurrent.futures.Future.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_GLOBAL = {}
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_frame(conn, payload):
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(conn):
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+def _serve(server_sock, pool):
+    while True:
+        try:
+            conn, _ = server_sock.accept()
+        except OSError:
+            return  # socket closed -> shutdown
+        pool.submit(_handle, conn)
+
+
+def _handle(conn):
+    try:
+        while True:
+            try:
+                req = pickle.loads(_recv_frame(conn))
+            except (ConnectionError, EOFError):
+                return
+            try:
+                fn, args, kwargs = req
+                result = ("ok", fn(*args, **(kwargs or {})))
+            except Exception as e:  # noqa: BLE001 - forwarded to caller
+                result = ("err", e)
+            try:
+                payload = pickle.dumps(result, protocol=4)
+            except Exception as e:  # unpicklable result/exception
+                payload = pickle.dumps(
+                    ("err", RuntimeError(f"rpc result not picklable: {e}")),
+                    protocol=4)
+            _send_frame(conn, payload)
+    finally:
+        conn.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and exchange endpoints via TCPStore."""
+    from ..core import TCPStore
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29401")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(128)
+    my_port = server.getsockname()[1]
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    store.set(f"rpc/{rank}", f"{name};{my_ip};{my_port}")
+    store.barrier()
+
+    workers = {}
+    for r in range(world_size):
+        wname, ip, wport = store.get(f"rpc/{r}").decode().split(";")
+        workers[wname] = WorkerInfo(wname, r, ip, int(wport))
+
+    pool = ThreadPoolExecutor(max_workers=16)
+    thread = threading.Thread(target=_serve, args=(server, pool), daemon=True)
+    thread.start()
+
+    _GLOBAL.update(dict(name=name, rank=rank, world_size=world_size,
+                        workers=workers, server=server, pool=pool,
+                        store=store, conns={},
+                        send_pool=ThreadPoolExecutor(max_workers=16),
+                        lock=threading.Lock()))
+
+
+def _connect(info):
+    conns = _GLOBAL["conns"]
+    with _GLOBAL["lock"]:
+        if info.name not in conns:
+            s = socket.create_connection((info.ip, info.port), timeout=60)
+            conns[info.name] = (s, threading.Lock())
+    return conns[info.name]
+
+
+def _call(to, fn, args, kwargs):
+    info = _GLOBAL["workers"][to]
+    payload = pickle.dumps((fn, args or (), kwargs or {}), protocol=4)
+    for attempt in (0, 1):
+        conn, lock = _connect(info)
+        try:
+            with lock:  # one in-flight request per connection
+                _send_frame(conn, payload)
+                status, value = pickle.loads(_recv_frame(conn))
+            break
+        except (ConnectionError, OSError, EOFError):
+            # evict the dead cached socket and reconnect once
+            with _GLOBAL["lock"]:
+                if _GLOBAL["conns"].get(info.name, (None,))[0] is conn:
+                    del _GLOBAL["conns"][info.name]
+            conn.close()
+            if attempt == 1:
+                raise
+    if status == "err":
+        raise value
+    return value
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    return _call(to, fn, args, kwargs)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    fut = _GLOBAL["send_pool"].submit(_call, to, fn, args, kwargs)
+    # paddle returns an object with .wait(); Future.result is aliased
+    fut.wait = fut.result
+    return fut
+
+
+def get_worker_info(name):
+    return _GLOBAL["workers"][name]
+
+
+def get_all_worker_infos():
+    return sorted(_GLOBAL["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    return _GLOBAL["workers"][_GLOBAL["name"]]
+
+
+def shutdown():
+    if not _GLOBAL:
+        return
+    _GLOBAL["store"].barrier()  # drain: everyone stops sending first
+    for s, _ in _GLOBAL["conns"].values():
+        s.close()
+    _GLOBAL["server"].close()
+    _GLOBAL["pool"].shutdown(wait=False)
+    _GLOBAL["send_pool"].shutdown(wait=False)
+    _GLOBAL.clear()

@@ -2,3 +2,5 @@
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import models  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import distributed  # noqa: F401

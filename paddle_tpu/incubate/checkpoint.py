@@ -1,0 +1,75 @@
+"""Automatic epoch-level checkpoint/resume.
+
+Reference analog: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py
+(train_epoch_range :642, checkpoint checker :72) — epoch bookkeeping with a
+run id so a restarted job resumes at the first unfinished epoch.
+
+TPU-native simplification: state lives in a local/NFS directory (the
+reference used HDFS); model/optimizer snapshots go through paddle.save or
+distributed.checkpoint.save_state_dict.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["train_epoch_range", "EpochRange"]
+
+
+class EpochRange:
+    """Iterate epochs [0, max_epoch) resuming after the last completed one.
+
+    Usage:
+        for epoch in train_epoch_range(10, save_dir=".auto_ckpt"):
+            train_one_epoch(...)
+            # mark extra artifacts via range.save(...) if desired
+    """
+
+    def __init__(self, max_epoch_num, save_dir=None, run_id=None):
+        self.max_epoch_num = max_epoch_num
+        self.save_dir = save_dir or os.environ.get(
+            "PADDLE_TPU_AUTO_CKPT_DIR", ".auto_checkpoint")
+        self.run_id = run_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self._meta_path = os.path.join(self.save_dir,
+                                       f"range_{self.run_id}.json")
+        self._completed = -1
+        if os.path.exists(self._meta_path):
+            try:
+                with open(self._meta_path) as f:
+                    meta = json.load(f)
+                if meta.get("max_epoch_num") == max_epoch_num:
+                    self._completed = int(meta.get("completed_epoch", -1))
+            except (json.JSONDecodeError, OSError):
+                pass
+
+    @property
+    def restored_from(self):
+        """Index of the last completed epoch (-1 = fresh run)."""
+        return self._completed
+
+    def _mark(self, epoch):
+        os.makedirs(self.save_dir, exist_ok=True)
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"run_id": self.run_id,
+                       "max_epoch_num": self.max_epoch_num,
+                       "completed_epoch": epoch,
+                       "timestamp": time.time()}, f)
+        os.replace(tmp, self._meta_path)
+
+    def __iter__(self):
+        for epoch in range(self._completed + 1, self.max_epoch_num):
+            yield epoch
+            self._completed = epoch
+            self._mark(epoch)
+
+    def checkpoint_path(self, epoch=None):
+        """Directory for this run's (epoch) artifacts."""
+        e = self._completed + 1 if epoch is None else epoch
+        return os.path.join(self.save_dir, self.run_id, f"epoch_{e}")
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
+                      save_dir=None, run_id=None):
+    return EpochRange(max_epoch_num, save_dir=save_dir, run_id=run_id)

@@ -88,14 +88,8 @@ def fused_softmax_cross_entropy(logits, label, ignore_index=-100,
         def fn(lg):
             lab_idx = jnp.clip(lab_v, 0, lg.shape[1] - 1).astype(jnp.int32)
             nll = _fused_ce.fused_softmax_cross_entropy(lg, lab_idx)
-            valid = lab_v != ignore_index
-            nll = jnp.where(valid, nll, 0.0)
-            if reduction == "mean":
-                denom = jnp.sum(valid.astype(jnp.float32))
-                return jnp.sum(nll) / jnp.maximum(denom, 1.0)
-            if reduction == "sum":
-                return jnp.sum(nll)
-            return nll
+            return _fused_ce.masked_reduce(nll, lab_v, ignore_index,
+                                           reduction)
         return call_op("fused_softmax_cross_entropy", fn, (logits,))
 
     from ...nn.functional import cross_entropy

@@ -35,7 +35,21 @@ except Exception:  # pragma: no cover
 
 from ._common import ZERO as _ZERO, on_tpu as _on_tpu
 
-__all__ = ["fused_softmax_cross_entropy", "is_eligible"]
+__all__ = ["fused_softmax_cross_entropy", "is_eligible", "masked_reduce"]
+
+
+def masked_reduce(nll, lab_v, ignore_index, reduction):
+    """Shared ignore_index masking + reduction used by every fused-CE entry
+    point (nn.functional.cross_entropy, incubate fused_softmax_cross_entropy)
+    so their semantics cannot drift apart."""
+    valid = lab_v != ignore_index
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(valid.astype(jnp.float32))
+        return jnp.sum(nll) / jnp.maximum(denom, 1.0)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
 
 _NEG_INF = -1e30
 _BLOCK_R = 128

@@ -61,14 +61,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 and fused_ce.is_eligible(logits, lab_idx)):
             # vocab-blocked Pallas kernel: no [rows, V] log-softmax in HBM
             nll = fused_ce.fused_softmax_cross_entropy(logits, lab_idx)
-            valid = (lab_v != ignore_index)
-            nll = jnp.where(valid, nll, 0.0)
-            if reduction == "mean":
-                denom = jnp.sum(valid.astype(jnp.float32))
-                return jnp.sum(nll) / jnp.maximum(denom, 1.0)
-            if reduction == "sum":
-                return jnp.sum(nll)
-            return nll
+            return fused_ce.masked_reduce(nll, lab_v, ignore_index, reduction)
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
             else jnp.log(jnp.clip(logits, 1e-30, None))
         picked = jnp.take_along_axis(

@@ -1,0 +1,59 @@
+"""distributed.rpc tests: multi-process workers in the TestDistBase style
+(subprocess ranks on one host, SURVEY.md §4)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_WORKER = r"""
+import sys, numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed import rpc
+
+rank = int(sys.argv[1]); port = sys.argv[2]
+
+def add(a, b):
+    return a + b
+
+def matsum(arr):
+    return float(np.asarray(arr).sum())
+
+rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2,
+             master_endpoint=f"127.0.0.1:{port}")
+
+if rank == 0:
+    out = rpc.rpc_sync("worker1", add, args=(2, 40))
+    assert out == 42, out
+    fut = rpc.rpc_async("worker1", matsum, args=(np.ones((4, 4)),))
+    assert fut.wait() == 16.0
+    # self-call roundtrip
+    assert rpc.rpc_sync("worker0", add, args=(1, 1)) == 2
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+    print("RPC_OK")
+
+rpc.shutdown()
+"""
+
+
+def test_rpc_two_workers(tmp_path):
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        for r in range(2)]
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert "RPC_OK" in outs[0]
