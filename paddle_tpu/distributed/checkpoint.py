@@ -87,9 +87,13 @@ def save_state_dict(state_dict, path, process_index=None):
         entries = []
         seen = set()
         for shard in arr.addressable_shards:
+            # replica 0 of each region has exactly one owner globally, so
+            # multi-host replicated params are written once, not per process
+            if getattr(shard, "replica_id", 0) != 0:
+                continue
             bounds = tuple(map(tuple, _shard_index_to_spec(shard.index,
                                                            arr.shape)))
-            if bounds in seen:        # replicated across local devices
+            if bounds in seen:        # belt-and-braces local dedup
                 continue
             seen.add(bounds)
             entries.append((list(map(list, bounds)), np.asarray(shard.data)))

@@ -96,8 +96,18 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     server.bind(("0.0.0.0", 0))
     server.listen(128)
     my_port = server.getsockname()[1]
-    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
-        socket.gethostbyname(socket.gethostname())
+    if host in ("127.0.0.1", "localhost"):
+        my_ip = "127.0.0.1"
+    else:
+        # the IP of the interface that actually reaches the master —
+        # gethostbyname(gethostname()) returns 127.0.1.1 on stock
+        # Debian/Ubuntu /etc/hosts and would break cross-host RPC
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((host, int(port)))
+            my_ip = probe.getsockname()[0]
+        finally:
+            probe.close()
 
     store = TCPStore(host, int(port), is_master=(rank == 0),
                      world_size=world_size)
@@ -123,41 +133,57 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 def _connect(info):
     conns = _GLOBAL["conns"]
     with _GLOBAL["lock"]:
-        if info.name not in conns:
+        entry = conns.get(info.name)
+        if entry is None:
             s = socket.create_connection((info.ip, info.port), timeout=60)
-            conns[info.name] = (s, threading.Lock())
-    return conns[info.name]
+            s.settimeout(None)  # connect timeout only; RPCs may run long
+            entry = (s, threading.Lock())
+            conns[info.name] = entry
+    return entry
 
 
-def _call(to, fn, args, kwargs):
+def _evict(info, conn):
+    with _GLOBAL["lock"]:
+        if _GLOBAL["conns"].get(info.name, (None,))[0] is conn:
+            del _GLOBAL["conns"][info.name]
+    conn.close()
+
+
+def _call(to, fn, args, kwargs, timeout=None):
     info = _GLOBAL["workers"][to]
     payload = pickle.dumps((fn, args or (), kwargs or {}), protocol=4)
     for attempt in (0, 1):
         conn, lock = _connect(info)
-        try:
-            with lock:  # one in-flight request per connection
+        with lock:  # one in-flight request per connection
+            conn.settimeout(timeout)
+            try:
                 _send_frame(conn, payload)
+            except (ConnectionError, OSError):
+                # stale cached socket found dead on send: the request was
+                # never delivered, so reconnect-and-retry is safe
+                _evict(info, conn)
+                if attempt == 1:
+                    raise
+                continue
+            try:
                 status, value = pickle.loads(_recv_frame(conn))
-            break
-        except (ConnectionError, OSError, EOFError):
-            # evict the dead cached socket and reconnect once
-            with _GLOBAL["lock"]:
-                if _GLOBAL["conns"].get(info.name, (None,))[0] is conn:
-                    del _GLOBAL["conns"][info.name]
-            conn.close()
-            if attempt == 1:
+            except (ConnectionError, OSError, EOFError):
+                # request may have executed remotely — never blind-retry a
+                # possibly-delivered call (double side effects)
+                _evict(info, conn)
                 raise
+        break
     if status == "err":
         raise value
     return value
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
-    return _call(to, fn, args, kwargs)
+    return _call(to, fn, args, kwargs, timeout=timeout)
 
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
-    fut = _GLOBAL["send_pool"].submit(_call, to, fn, args, kwargs)
+    fut = _GLOBAL["send_pool"].submit(_call, to, fn, args, kwargs, timeout)
     # paddle returns an object with .wait(); Future.result is aliased
     fut.wait = fut.result
     return fut

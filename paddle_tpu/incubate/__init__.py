@@ -4,3 +4,4 @@ from . import autograd  # noqa: F401
 from . import models  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401

@@ -1,0 +1,117 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference analog: python/paddle/incubate/asp/ (utils.py mask calculators
+create_mask/check_sparsity, asp.py prune_model/decorate — the reference
+targets Ampere 2:4 sparse tensor cores).
+
+TPU note: the MXU has no sparse mode, so n:m sparsity here is a model
+compression / regularization feature (masked weights stay dense in compute),
+with identical mask semantics + the optimizer decoration that re-applies
+masks after each step so pruned weights stay zero through training.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["calculate_density", "create_mask", "check_sparsity",
+           "prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers"]
+
+_EXCLUDED = set()
+# masks are stored on the pruned model itself (model._asp_masks) so two
+# models with identical parameter names cannot cross-contaminate
+
+
+def calculate_density(x):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float((v != 0).sum()) / max(v.size, 1)
+
+
+def _mask_1d(vec, n, m):
+    """Keep the n largest-|.| of every m consecutive values."""
+    pad = (-len(vec)) % m
+    vp = np.pad(vec, (0, pad))
+    groups = np.abs(vp.reshape(-1, m))
+    keep = np.argsort(-groups, axis=1)[:, :n]
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, keep, True, axis=1)
+    return mask.reshape(-1)[:len(vec)]
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m mask with the reference's group-along-rows convention
+    (asp/utils.py create_mask)."""
+    v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    shape = v.shape
+    flat = v.reshape(shape[0], -1) if v.ndim > 1 else v.reshape(1, -1)
+    mask = np.stack([_mask_1d(row, n, m) for row in flat])
+    return mask.reshape(shape)
+
+
+def check_sparsity(tensor, func_name="check_mask_1d", n=2, m=4):
+    v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    flat = v.reshape(-1)
+    pad = (-len(flat)) % m
+    vp = np.pad(flat, (0, pad)).reshape(-1, m)
+    return bool((np.count_nonzero(vp, axis=1) <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name, param):
+    if name in _EXCLUDED or param.stop_gradient:
+        return False
+    v = param._value
+    return v.ndim >= 2 and min(v.shape) >= 4 and "bias" not in name
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable weight in place; remember masks so
+    `decorate`d optimizers keep them enforced."""
+    import jax.numpy as jnp
+    pruned = {}
+    for name, param in model.named_parameters():
+        if not _prunable(name, param):
+            continue
+        mask = create_mask(param, func_name=mask_algo, n=n, m=m)
+        param._value = param._value * jnp.asarray(mask, param._value.dtype)
+        pruned[name] = mask
+    if with_mask:
+        model._asp_masks = pruned
+    return pruned
+
+
+class _ASPOptimizerWrapper:
+    """Reference analog: asp.decorate -> OptimizerWithSparsityGuarantee.
+    After every step, re-zero the pruned weights."""
+
+    def __init__(self, optimizer, model):
+        self._opt = optimizer
+        self._model = model
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def step(self):
+        import jax.numpy as jnp
+        self._opt.step()
+        if self._model is None:
+            return
+        masks = getattr(self._model, "_asp_masks", None) or {}
+        for name, param in self._model.named_parameters():
+            mask = masks.get(name)
+            if mask is not None:
+                param._value = param._value * jnp.asarray(
+                    mask, param._value.dtype)
+
+
+def decorate(optimizer, model=None):
+    return _ASPOptimizerWrapper(optimizer, model)

@@ -23,11 +23,14 @@ class EpochRange:
     Usage:
         for epoch in train_epoch_range(10, save_dir=".auto_ckpt"):
             train_one_epoch(...)
-            # mark extra artifacts via range.save(...) if desired
+    Snapshot model/optimizer state into `checkpoint_path(epoch)` inside the
+    loop (paddle.save or distributed.checkpoint.save_state_dict).
     """
 
-    def __init__(self, max_epoch_num, save_dir=None, run_id=None):
+    def __init__(self, max_epoch_num, save_dir=None, run_id=None,
+                 save_checkpoint_inter=1):
         self.max_epoch_num = max_epoch_num
+        self.save_checkpoint_inter = max(1, int(save_checkpoint_inter or 1))
         self.save_dir = save_dir or os.environ.get(
             "PADDLE_TPU_AUTO_CKPT_DIR", ".auto_checkpoint")
         self.run_id = run_id or os.environ.get("PADDLE_JOB_ID", "default")
@@ -62,7 +65,10 @@ class EpochRange:
         for epoch in range(self._completed + 1, self.max_epoch_num):
             yield epoch
             self._completed = epoch
-            self._mark(epoch)
+            # persist progress every save_checkpoint_inter epochs (+ final)
+            if ((epoch + 1) % self.save_checkpoint_inter == 0
+                    or epoch == self.max_epoch_num - 1):
+                self._mark(epoch)
 
     def checkpoint_path(self, epoch=None):
         """Directory for this run's (epoch) artifacts."""
@@ -72,4 +78,5 @@ class EpochRange:
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
                       save_dir=None, run_id=None):
-    return EpochRange(max_epoch_num, save_dir=save_dir, run_id=run_id)
+    return EpochRange(max_epoch_num, save_dir=save_dir, run_id=run_id,
+                      save_checkpoint_inter=save_checkpoint_inter)

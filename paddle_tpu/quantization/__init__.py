@@ -1,0 +1,202 @@
+"""Quantization-aware training and post-training quantization.
+
+Reference analog: python/paddle/fluid/contrib/slim/quantization/
+(imperative/qat.py ImperativeQuantAware, fake quant ops
+fake_quantize_abs_max / fake_quantize_moving_average_abs_max /
+fake_channel_wise_quantize_abs_max in fluid/operators).
+
+TPU-native design: fake-quant is a pure function with a straight-through
+estimator (q = x + stop_grad(quant(x) - x)), so QAT graphs stay fully
+jit-able — no custom gradient ops. Scales live as non-trainable layer state.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer_base import Layer
+from ..ops._helpers import ensure_tensor, call_op
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_quantize_channel_wise_abs_max",
+    "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
+    "MovingAverageAbsMaxObserver", "quant_post_dynamic",
+]
+
+
+def _ste(x, quantized):
+    """Straight-through estimator: forward = quantized, grad = identity."""
+    return x + jax.lax.stop_gradient(quantized - x)
+
+
+def _quant_dequant(v, scale, bits):
+    bnt = (1 << (bits - 1)) - 1
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(v / s * bnt), -bnt, bnt)
+    return q * s / bnt
+
+
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """Per-tensor abs-max fake quantization (with STE gradient).
+    Returns (quantized_dequantized, scale)."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        scale = jnp.max(jnp.abs(v))
+        return _ste(v, _quant_dequant(v, scale, bit_length))
+    out = call_op("fake_quantize_abs_max", fn, (x,))
+    scale = Tensor(jnp.max(jnp.abs(x._value)))
+    return out, scale
+
+
+def fake_quantize_channel_wise_abs_max(x, bit_length=8, quant_axis=0,
+                                       name=None):
+    """Per-channel abs-max fake quantization along quant_axis."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        axes = tuple(i for i in range(v.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(v), axis=axes, keepdims=True)
+        return _ste(v, _quant_dequant(v, scale, bit_length))
+    out = call_op("fake_quantize_channel_wise_abs_max", fn, (x,))
+    axes = tuple(i for i in range(x._value.ndim) if i != quant_axis)
+    scale = Tensor(jnp.max(jnp.abs(x._value), axis=axes))
+    return out, scale
+
+
+class MovingAverageAbsMaxObserver:
+    """Activation scale observer (reference:
+    fake_quantize_moving_average_abs_max op, default rate 0.9)."""
+
+    def __init__(self, rate=0.9):
+        self.rate = rate
+        self.scale = None
+
+    def update(self, value):
+        cur = float(jnp.max(jnp.abs(value)))
+        if self.scale is None:
+            self.scale = cur
+        else:
+            self.scale = self.rate * self.scale + (1 - self.rate) * cur
+        return self.scale
+
+
+class _QuantHelper:
+    def __init__(self, weight_bits, activation_bits, weight_quantize_type,
+                 activation_quantize_type):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.act_observer = MovingAverageAbsMaxObserver()
+
+    def quant_weight(self, w, quant_axis):
+        if self.weight_quantize_type == "channel_wise_abs_max":
+            out, _ = fake_quantize_channel_wise_abs_max(
+                w, self.weight_bits, quant_axis)
+        else:
+            out, _ = fake_quantize_abs_max(w, self.weight_bits)
+        return out
+
+    def quant_act(self, x, training):
+        if training:
+            self.act_observer.update(x._value)
+        scale = self.act_observer.scale
+        if scale is None:
+            return x
+
+        def fn(v):
+            return _ste(v, _quant_dequant(v, jnp.float32(scale),
+                                          self.activation_bits))
+        return call_op("fake_quantize_act", fn, (x,))
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight + activation.
+    Reference: slim/quantization/imperative/quant_layers.py QuantizedLinear."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self._inner = layer
+        self._q = _QuantHelper(weight_bits, activation_bits,
+                               weight_quantize_type, activation_quantize_type)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self._q.quant_act(ensure_tensor(x), self.training)
+        # paddle Linear weight is [in, out]; out-channel axis = 1
+        w = self._q.quant_weight(self._inner.weight, quant_axis=1)
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self._inner = layer
+        self._q = _QuantHelper(weight_bits, activation_bits,
+                               weight_quantize_type, activation_quantize_type)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        inner = self._inner
+        x = self._q.quant_act(ensure_tensor(x), self.training)
+        w = self._q.quant_weight(inner.weight, quant_axis=0)
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups)
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT driver. Reference:
+    slim/quantization/imperative/qat.py ImperativeQuantAware — walks the
+    model, swapping Linear/Conv2D for quantized twins in place."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 quantizable_layer_type=("Conv2D", "Linear")):
+        self._kw = dict(weight_bits=weight_bits,
+                        activation_bits=activation_bits,
+                        weight_quantize_type=weight_quantize_type,
+                        activation_quantize_type=activation_quantize_type)
+        self._types = set(quantizable_layer_type)
+
+    def quantize(self, model):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        for parent in model.sublayers(include_self=True):
+            for name, child in list(parent._sub_layers.items()):
+                if isinstance(child, Linear) and "Linear" in self._types:
+                    parent._sub_layers[name] = QuantizedLinear(child,
+                                                               **self._kw)
+                elif isinstance(child, Conv2D) and "Conv2D" in self._types:
+                    parent._sub_layers[name] = QuantizedConv2D(child,
+                                                               **self._kw)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from ..jit.api import save as jit_save
+        jit_save(model, path, input_spec=input_spec)
+
+
+def quant_post_dynamic(state_dict, weight_bits=8):
+    """Post-training dynamic quantization of a state dict: weights ->
+    (int8 values, scales). Reference analog: slim post_training_quantization
+    (weight-only path)."""
+    bnt = (1 << (weight_bits - 1)) - 1
+    out = {}
+    for name, t in state_dict.items():
+        v = np.asarray(t._value if isinstance(t, Tensor) else t)
+        if v.ndim < 2 or not np.issubdtype(v.dtype, np.floating):
+            out[name] = v
+            continue
+        scale = np.maximum(np.abs(v).max(), 1e-8)
+        q = np.clip(np.round(v / scale * bnt), -bnt, bnt).astype(np.int8)
+        out[name] = {"int8": q, "scale": float(scale), "bits": weight_bits}
+    return out

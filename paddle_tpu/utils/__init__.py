@@ -37,18 +37,6 @@ def deprecated(since=None, update_to=None, reason=None):
     return deco
 
 
-def run_check():
-    """Post-install smoke test. Reference analog:
-    python/paddle/fluid/install_check.py (tiny train incl. DP)."""
-    import numpy as np
-    import paddle_tpu as paddle
-    x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
-    linear = paddle.nn.Linear(8, 2)
-    opt = paddle.optimizer.SGD(0.1, parameters=linear.parameters())
-    loss = paddle.nn.functional.mse_loss(
-        linear(x), paddle.zeros([4, 2]))
-    loss.backward()
-    opt.step()
-    print("paddle_tpu is installed successfully!")
-    import jax
-    print(f"devices: {jax.devices()}")
+from .install_check import run_check  # noqa: F401,E402
+from . import dlpack  # noqa: F401,E402
+from . import cpp_extension  # noqa: F401,E402

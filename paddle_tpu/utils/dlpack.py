@@ -1,0 +1,35 @@
+"""DLPack interop. Reference analog: paddle.utils.dlpack
+(framework/dlpack_tensor.cc) — zero-copy tensor exchange with other
+frameworks.
+
+Modern convention: exchange objects implementing the __dlpack__ protocol
+(torch tensors, numpy arrays, jax arrays all do) rather than raw capsules —
+jax removed legacy capsule ingestion, so to_dlpack returns the protocol
+object and from_dlpack accepts any protocol object.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack-protocol object (implements __dlpack__).
+
+    Pass the result to torch.from_dlpack / np.from_dlpack / etc."""
+    return ensure_tensor(x)._value
+
+
+def from_dlpack(ext_tensor):
+    """Import any __dlpack__-protocol object (torch/numpy/jax) as a Tensor."""
+    if not hasattr(ext_tensor, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing __dlpack__ (raw "
+            "PyCapsule ingestion was removed from jax); pass the tensor "
+            "object itself, e.g. from_dlpack(torch_tensor)")
+    arr = jax.dlpack.from_dlpack(ext_tensor)
+    return Tensor(arr, stop_gradient=True)

@@ -1,0 +1,42 @@
+"""Post-install smoke check. Reference analog:
+python/paddle/fluid/install_check.py run_check() — a tiny train (plus 2-GPU
+DP when available) proving the install works end to end."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    print("Running verify PaddleTPU program ...")
+    paddle.seed(0)
+    model = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 2, (8,)).astype(np.int64))
+    losses = []
+    for _ in range(3):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all(), losses
+
+    n_dev = jax.device_count()
+    if n_dev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(n_dev), ("data",))
+        arr = jax.device_put(np.ones((n_dev * 2, 4), np.float32),
+                             NamedSharding(mesh, P("data")))
+        out = model(paddle.Tensor(arr, stop_gradient=True))
+        assert np.isfinite(np.asarray(out._value)).all()
+        print(f"PaddleTPU works well on {n_dev} devices.")
+    print(f"PaddleTPU works well on 1 {jax.devices()[0].platform} device.")
+    print("PaddleTPU is installed successfully!")

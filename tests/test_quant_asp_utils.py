@@ -1,0 +1,142 @@
+"""Tests for quantization (QAT/PTQ), ASP sparsity, and utils
+(cpp_extension, dlpack, run_check)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import quantization as Q
+from paddle_tpu.incubate import asp
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+# ----------------------------------------------------------- quantization
+
+def test_fake_quant_abs_max_values():
+    x = _t([-1.0, -0.5, 0.0, 0.5, 1.0])
+    out, scale = Q.fake_quantize_abs_max(x, bit_length=8)
+    assert abs(float(scale) - 1.0) < 1e-6
+    # 8-bit grid: values land within one step (1/127) of the original
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1.0 / 127 + 1e-6)
+
+
+def test_fake_quant_channel_wise():
+    w = np.array([[1.0, -2.0], [0.1, 0.2]], np.float32)  # quant_axis=0 rows
+    out, scale = Q.fake_quantize_channel_wise_abs_max(_t(w), quant_axis=0)
+    np.testing.assert_allclose(scale.numpy(), [2.0, 0.2], rtol=1e-6)
+    np.testing.assert_allclose(out.numpy(), w, atol=2.0 / 127 + 1e-6)
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                         stop_gradient=False)
+    out, _ = Q.fake_quantize_abs_max(x)
+    out.sum().backward()
+    # STE: gradient is identity
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 3))
+    qat = Q.ImperativeQuantAware()
+    qat.quantize(model)
+    assert isinstance(model[0], Q.QuantizedLinear)
+    assert isinstance(model[2], Q.QuantizedLinear)
+
+    opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+    x = _t(np.random.randn(16, 8))
+    y = paddle.to_tensor(np.random.randint(0, 3, 16).astype(np.int64))
+    import paddle_tpu.nn.functional as F
+    l0 = None
+    for _ in range(15):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward(); opt.step(); opt.clear_grad()
+        l0 = l0 or float(loss)
+    assert float(loss) < l0  # trains through the fake-quant STE
+
+
+def test_quant_post_dynamic():
+    model = paddle.nn.Linear(8, 4)
+    qsd = Q.quant_post_dynamic(model.state_dict())
+    w = qsd["weight"]
+    assert w["int8"].dtype == np.int8
+    deq = w["int8"].astype(np.float32) * w["scale"] / 127
+    np.testing.assert_allclose(deq, model.weight.numpy(), atol=w["scale"] / 100)
+
+
+# ------------------------------------------------------------------- asp
+
+def test_asp_mask_and_check():
+    v = _t(np.random.randn(8, 16))
+    mask = asp.create_mask(v, n=2, m=4)
+    masked = v.numpy() * mask
+    assert asp.check_sparsity(_t(masked), n=2, m=4)
+    assert abs(asp.calculate_density(_t(masked)) - 0.5) < 1e-6
+
+
+def test_asp_prune_and_decorate():
+    paddle.seed(0)
+    model = paddle.nn.Linear(16, 8)
+    asp.prune_model(model, n=2, m=4)
+    assert asp.check_sparsity(model.weight, n=2, m=4)
+
+    opt = asp.decorate(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()), model)
+    x = _t(np.random.randn(4, 16))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    # masks survive the update
+    assert asp.check_sparsity(model.weight, n=2, m=4)
+    asp.reset_excluded_layers()
+
+
+# ----------------------------------------------------------------- utils
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "my_relu.cc"
+    src.write_text(r"""
+#include <cstdint>
+extern "C" void my_relu(const float* x, float* y, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0 ? x[i] : 0.0f;
+}
+extern "C" void my_square(const float* x, float* y, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i];
+}
+""")
+    from paddle_tpu.utils import cpp_extension
+    ext = cpp_extension.load("my_relu", [str(src)],
+                             functions=["my_relu", "my_square"])
+    x = _t(np.array([-1.0, 2.0, -3.0, 4.0]))
+    np.testing.assert_allclose(ext.my_relu(x).numpy(), [0, 2, 0, 4])
+    np.testing.assert_allclose(ext.my_square(x).numpy(), [1, 4, 9, 16])
+
+
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils import dlpack
+    x = _t(np.array([1.0, 2.0, 3.0]))
+    obj = dlpack.to_dlpack(x)
+    y = dlpack.from_dlpack(obj)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+    # interop: torch tensor -> paddle Tensor (both directions via protocol)
+    import torch
+    t = torch.tensor([4.0, 5.0])
+    z = dlpack.from_dlpack(t)
+    np.testing.assert_allclose(z.numpy(), [4.0, 5.0])
+    back = torch.from_dlpack(dlpack.to_dlpack(z))
+    np.testing.assert_allclose(back.numpy(), [4.0, 5.0])
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_sysconfig():
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_include())
