@@ -16,7 +16,8 @@ import tempfile
 _LIB = None
 _TRIED = False
 
-_SRC_FILES = ("tcp_store.cc", "workqueue.cc", "host_tracer.cc")
+_SRC_FILES = ("tcp_store.cc", "workqueue.cc", "host_tracer.cc",
+              "ckpt_writer.cc")
 
 
 def _csrc_dir():

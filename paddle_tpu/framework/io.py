@@ -11,7 +11,7 @@ import numpy as np
 
 from .core import Tensor, Parameter
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "async_save", "AsyncSaveHandle"]
 
 _PROTOCOL_KEY = "__paddle_tpu_tensor__"
 
@@ -65,11 +65,100 @@ def save(obj, path, protocol=4, **configs):
         pickle.dump(_pack(obj), f, protocol=protocol)
 
 
+class AsyncSaveHandle:
+    """In-flight async save. wait() joins the native writer; done() polls."""
+
+    _ERR = {1: "cannot open file", 2: "short write", 3: "trailer write failed",
+            4: "rename failed"}
+
+    def __init__(self, lib, native_handle, path):
+        self._lib = lib
+        self._handle = native_handle
+        self.path = path
+        self._finished = False
+
+    def done(self):
+        if self._finished:
+            return True
+        return self._lib.pd_ckpt_poll(self._handle) >= 0
+
+    def wait(self):
+        if self._finished:
+            return
+        status = self._lib.pd_ckpt_wait(self._handle)
+        self._finished = True
+        if status != 0:
+            raise IOError(
+                f"async_save to {self.path} failed: "
+                f"{self._ERR.get(status, status)}")
+
+    def __del__(self):
+        # poll-only callers would otherwise leak the native job
+        if not self._finished and self._lib is not None:
+            try:
+                self._lib.pd_ckpt_wait(self._handle)
+            except Exception:
+                pass
+            self._finished = True
+
+
+def async_save(obj, path, protocol=4):
+    """Serialize on the calling thread, write + fsync + CRC on a native C++
+    writer thread (csrc/ckpt_writer.cc) so training overlaps checkpoint IO.
+
+    Reference analog: save ops + auto_checkpoint's background persistence.
+    Returns an AsyncSaveHandle; call .wait() before relying on the file.
+    Falls back to a synchronous save when the native runtime is unavailable.
+    """
+    import ctypes
+    from ..core._build import load_library
+
+    path = os.fspath(path)
+    payload = pickle.dumps(_pack(obj), protocol=protocol)
+    dirname = os.path.dirname(path)
+    if dirname and not os.path.isdir(dirname):
+        os.makedirs(dirname, exist_ok=True)
+
+    lib = load_library()
+    if lib is None:
+        with open(path, "wb") as f:
+            f.write(payload)
+        sync = AsyncSaveHandle(None, None, path)
+        sync._finished = True
+        return sync
+
+    lib.pd_ckpt_async_write.restype = ctypes.c_void_p
+    lib.pd_ckpt_async_write.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_uint64]
+    lib.pd_ckpt_poll.argtypes = [ctypes.c_void_p]
+    lib.pd_ckpt_wait.argtypes = [ctypes.c_void_p]
+    handle = lib.pd_ckpt_async_write(path.encode(), payload, len(payload))
+    return AsyncSaveHandle(lib, handle, path)
+
+
+def _verify_trailer(path):
+    """CRC-check files written by async_save; no-op for legacy files."""
+    import ctypes
+    from ..core._build import load_library
+    lib = load_library()
+    if lib is None:
+        return
+    lib.pd_ckpt_verify.restype = ctypes.c_int64
+    lib.pd_ckpt_verify.argtypes = [ctypes.c_char_p]
+    status = lib.pd_ckpt_verify(os.fspath(path).encode())
+    if status == -2:
+        raise IOError(f"checkpoint {path} is corrupt (CRC mismatch — torn "
+                      "write?)")
+
+
 def load(path, **configs):
     return_numpy = configs.get("return_numpy", False)
     if hasattr(path, "read"):
         data = pickle.load(path)
     else:
+        _verify_trailer(path)
         with open(path, "rb") as f:
+            # pickle.load stops at the end of the pickle stream, so the
+            # 24-byte CRC trailer from async_save is transparently ignored
             data = pickle.load(f)
     return _unpack(data, return_numpy)

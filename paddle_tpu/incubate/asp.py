@@ -51,11 +51,16 @@ def create_mask(tensor, func_name="mask_1d", n=2, m=4):
 
 
 def check_sparsity(tensor, func_name="check_mask_1d", n=2, m=4):
+    """Row-wise n:m check matching create_mask's per-row grouping (groups
+    never straddle row boundaries)."""
     v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
-    flat = v.reshape(-1)
-    pad = (-len(flat)) % m
-    vp = np.pad(flat, (0, pad)).reshape(-1, m)
-    return bool((np.count_nonzero(vp, axis=1) <= n).all())
+    rows = v.reshape(v.shape[0], -1) if v.ndim > 1 else v.reshape(1, -1)
+    for row in rows:
+        pad = (-len(row)) % m
+        vp = np.pad(row, (0, pad)).reshape(-1, m)
+        if (np.count_nonzero(vp, axis=1) > n).any():
+            return False
+    return True
 
 
 def set_excluded_layers(param_names, main_program=None):
@@ -100,9 +105,8 @@ class _ASPOptimizerWrapper:
     def __getattr__(self, item):
         return getattr(self._opt, item)
 
-    def step(self):
+    def _reapply_masks(self):
         import jax.numpy as jnp
-        self._opt.step()
         if self._model is None:
             return
         masks = getattr(self._model, "_asp_masks", None) or {}
@@ -111,6 +115,18 @@ class _ASPOptimizerWrapper:
             if mask is not None:
                 param._value = param._value * jnp.asarray(
                     mask, param._value.dtype)
+
+    def step(self):
+        self._opt.step()
+        self._reapply_masks()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self._opt.minimize(loss, startup_program=startup_program,
+                                 parameters=parameters,
+                                 no_grad_set=no_grad_set)
+        self._reapply_masks()
+        return out
 
 
 def decorate(optimizer, model=None):

@@ -1,7 +1,7 @@
 """paddle.optimizer equivalent."""
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Momentum, Adam, AdamW, Lamb, RMSProp, Adagrad, Adadelta, Adamax,
+    SGD, Momentum, Adam, AdamW, Lamb, Lars, RMSProp, Adagrad, Adadelta, Adamax,
 )
 from . import lr  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401

@@ -9,7 +9,7 @@ import jax.numpy as jnp
 
 from .optimizer import Optimizer
 
-__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "RMSProp", "Adagrad",
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "Lars", "RMSProp", "Adagrad",
            "Adadelta", "Adamax"]
 
 
@@ -298,3 +298,56 @@ class Adamax(Optimizer):
         lr_t = lr / (1 - jnp.power(b1, t))
         new_p = p.astype(jnp.float32) - lr_t * m / (u + eps)
         return new_p.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class Lars(Optimizer):
+    """LARS (layer-wise adaptive rate scaling) momentum.
+
+    Reference analog: fluid/operators/optimizers/lars_momentum_op.cc +
+    fleet meta_optimizers/lars_optimizer.py. local_lr =
+    lr * coeff * ||w|| / (||g|| + wd * ||w|| + eps).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9,
+                 exclude_from_weight_decay=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+        self._decay_flags = {}
+        for p in self._parameter_list:
+            self._decay_flags[p.name] = not any(
+                token in p.name for token in self._exclude)
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("velocity", p, dtype=jnp.float32)
+
+    def _apply_optimize(self, params_grads):
+        self._current_decay_flags = [self._decay_flags.get(p.name, True)
+                                     for p, _ in params_grads]
+        super()._apply_optimize(params_grads)
+
+    def _extra_cache_key(self):
+        return tuple(getattr(self, "_current_decay_flags", ()) or ())
+
+    def _single_update(self, p, g, accs, lr, step):
+        flag = self._current_decay_flags.pop(0) \
+            if getattr(self, "_current_decay_flags", None) else True
+        wd = self._wd if flag else 0.0
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(pf * pf))
+        g_norm = jnp.sqrt(jnp.sum(gf * gf))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._coeff * p_norm / (g_norm + wd * p_norm + self._epsilon),
+            1.0)
+        upd = gf + wd * pf
+        v_new = self._momentum * accs["velocity"] \
+            + lr.astype(jnp.float32) * local_lr * upd
+        return (pf - v_new).astype(p.dtype), {"velocity": v_new}

@@ -68,14 +68,19 @@ def fake_quantize_channel_wise_abs_max(x, bit_length=8, quant_axis=0,
 
 class MovingAverageAbsMaxObserver:
     """Activation scale observer (reference:
-    fake_quantize_moving_average_abs_max op, default rate 0.9)."""
+    fake_quantize_moving_average_abs_max op, default rate 0.9).
+
+    The scale stays a device scalar — no host sync in the QAT hot path.
+    Observation is eager-mode state; under jit capture the last observed
+    scale is baked in as a constant (freeze observers before export).
+    """
 
     def __init__(self, rate=0.9):
         self.rate = rate
         self.scale = None
 
     def update(self, value):
-        cur = float(jnp.max(jnp.abs(value)))
+        cur = jnp.max(jnp.abs(value)).astype(jnp.float32)
         if self.scale is None:
             self.scale = cur
         else:
@@ -108,8 +113,7 @@ class _QuantHelper:
             return x
 
         def fn(v):
-            return _ste(v, _quant_dequant(v, jnp.float32(scale),
-                                          self.activation_bits))
+            return _ste(v, _quant_dequant(v, scale, self.activation_bits))
         return call_op("fake_quantize_act", fn, (x,))
 
 

@@ -12,8 +12,10 @@ CPU kernels play). Contract: each exported function has the C signature
 
 computing y[i] from x[i] (elementwise, same shape). `load()` compiles with
 g++ -O2 -fPIC -shared, binds via ctypes, and returns a module-like object
-whose attributes are differentiable-via-callback ops usable from any
-paddle_tpu code (eager or jit).
+whose attributes are ops usable from eager or jit code. Host callbacks have
+no autodiff rule, so the ops are NON-differentiable: inputs requiring grad
+are rejected with a clear error (detach() first, as with the reference's
+backward-less custom ops).
 """
 from __future__ import annotations
 
@@ -78,7 +80,13 @@ class _HostOp:
         return y
 
     def __call__(self, x):
+        from ..framework.autograd import is_grad_enabled
         x = ensure_tensor(x)
+        if is_grad_enabled() and not x.stop_gradient:
+            raise RuntimeError(
+                f"custom op {self._name!r} is a host callback with no "
+                "backward; call it on a detached tensor (x.detach()) or "
+                "under paddle.no_grad()")
 
         def fn(v):
             return jax.pure_callback(

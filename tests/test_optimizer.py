@@ -151,3 +151,23 @@ def test_train_step_fused():
     y = paddle.to_tensor(np.random.rand(8, 1).astype(np.float32))
     losses = [float(step(x, y)) for _ in range(30)]
     assert losses[-1] < losses[0] * 0.5
+
+
+def test_lars_trains_and_excludes_bias_decay():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    paddle.seed(0)
+    model = paddle.nn.Linear(8, 4)
+    opt = paddle.optimizer.Lars(0.5, lars_coeff=0.01,
+                                parameters=model.parameters(),
+                                exclude_from_weight_decay=["bias"])
+    x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 4, 16).astype(np.int64))
+    losses = []
+    for _ in range(30):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
