@@ -87,9 +87,13 @@ extern "C" {
 // Start an async write of `size` bytes to `path` (atomic via tmp+rename,
 // CRC32 trailer appended). Copies the buffer; caller may free immediately.
 void* pd_ckpt_async_write(const char* path, const void* data, uint64_t size) {
+  static std::atomic<uint64_t> counter{0};
   auto* job = new WriteJob();
   job->path = path;
-  job->tmp_path = std::string(path) + ".tmp";
+  // unique tmp per job: concurrent saves to one path must not share it
+  job->tmp_path = std::string(path) + ".tmp." +
+                  std::to_string(::getpid()) + "." +
+                  std::to_string(counter.fetch_add(1));
   job->data = new uint8_t[size];
   job->size = size;
   std::memcpy(job->data, data, size);
@@ -133,13 +137,11 @@ int64_t pd_ckpt_verify(const char* path) {
   std::fseek(f, 0, SEEK_SET);
   uint8_t chunk[1 << 16];
   uint64_t left = size;
-  uint32_t crc = 0;
-  bool first = true;
+  uint32_t crc = 0;  // Crc32::run chains: crc_0 = 0 seeds the first chunk
   while (left > 0) {
     uint64_t n = left < sizeof(chunk) ? left : sizeof(chunk);
     if (std::fread(chunk, 1, n, f) != n) { std::fclose(f); return -3; }
-    crc = first ? kCrc.run(chunk, n) : kCrc.run(chunk, n, crc);
-    first = false;
+    crc = kCrc.run(chunk, n, crc);
     left -= n;
   }
   std::fclose(f);

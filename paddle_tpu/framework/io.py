@@ -121,8 +121,18 @@ def async_save(obj, path, protocol=4):
 
     lib = load_library()
     if lib is None:
-        with open(path, "wb") as f:
+        # synchronous fallback keeps the same guarantees: atomic tmp+rename
+        # and the CRC trailer (pure-python zlib.crc32 == IEEE CRC-32)
+        import struct
+        import zlib
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
             f.write(payload)
+            f.write(struct.pack("<QQQ", _TRAILER_MAGIC, len(payload),
+                                zlib.crc32(payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         sync = AsyncSaveHandle(None, None, path)
         sync._finished = True
         return sync
@@ -136,17 +146,35 @@ def async_save(obj, path, protocol=4):
     return AsyncSaveHandle(lib, handle, path)
 
 
+_TRAILER_MAGIC = 0x50445450434b5054  # "PDTPCKPT" (csrc/ckpt_writer.cc)
+
+
 def _verify_trailer(path):
-    """CRC-check files written by async_save; no-op for legacy files."""
-    import ctypes
-    from ..core._build import load_library
-    lib = load_library()
-    if lib is None:
+    """CRC-check files written by async_save; no-op for legacy files.
+
+    Pure python (zlib.crc32 is the same IEEE CRC-32 the native writer uses),
+    so verification never depends on a g++ toolchain at load time."""
+    import struct
+    import zlib
+    path = os.fspath(path)
+    size = os.path.getsize(path)
+    if size < 24:
         return
-    lib.pd_ckpt_verify.restype = ctypes.c_int64
-    lib.pd_ckpt_verify.argtypes = [ctypes.c_char_p]
-    status = lib.pd_ckpt_verify(os.fspath(path).encode())
-    if status == -2:
+    with open(path, "rb") as f:
+        f.seek(size - 24)
+        magic, payload_len, crc_stored = struct.unpack("<QQQ", f.read(24))
+        if magic != _TRAILER_MAGIC or payload_len != size - 24:
+            return  # legacy file without a trailer
+        f.seek(0)
+        crc = 0
+        left = payload_len
+        while left > 0:
+            chunk = f.read(min(left, 1 << 20))
+            if not chunk:
+                raise IOError(f"checkpoint {path} is corrupt (truncated)")
+            crc = zlib.crc32(chunk, crc)
+            left -= len(chunk)
+    if crc != crc_stored:
         raise IOError(f"checkpoint {path} is corrupt (CRC mismatch — torn "
                       "write?)")
 

@@ -55,12 +55,9 @@ def check_sparsity(tensor, func_name="check_mask_1d", n=2, m=4):
     never straddle row boundaries)."""
     v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
     rows = v.reshape(v.shape[0], -1) if v.ndim > 1 else v.reshape(1, -1)
-    for row in rows:
-        pad = (-len(row)) % m
-        vp = np.pad(row, (0, pad)).reshape(-1, m)
-        if (np.count_nonzero(vp, axis=1) > n).any():
-            return False
-    return True
+    pad = (-rows.shape[1]) % m
+    vp = np.pad(rows, ((0, 0), (0, pad))).reshape(rows.shape[0], -1, m)
+    return bool((np.count_nonzero(vp, axis=2) <= n).all())
 
 
 def set_excluded_layers(param_names, main_program=None):

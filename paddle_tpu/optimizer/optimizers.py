@@ -320,8 +320,14 @@ class Lars(Optimizer):
         self._exclude = tuple(exclude_from_weight_decay or ())
         self._decay_flags = {}
         for p in self._parameter_list:
-            self._decay_flags[p.name] = not any(
-                token in p.name for token in self._exclude)
+            excluded = any(token in p.name for token in self._exclude)
+            # auto-named params ("param_N") carry no structural name, so the
+            # conventional ["bias"] exclusion also matches by shape: biases
+            # and norm scales are the 0/1-D parameters
+            if not excluded and ("bias" in self._exclude
+                                 and len(p.shape) <= 1):
+                excluded = True
+            self._decay_flags[p.name] = not excluded
 
     def _create_accumulators(self, params):
         for p in params:

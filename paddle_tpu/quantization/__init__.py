@@ -81,6 +81,11 @@ class MovingAverageAbsMaxObserver:
 
     def update(self, value):
         cur = jnp.max(jnp.abs(value)).astype(jnp.float32)
+        if isinstance(cur, jax.core.Tracer):
+            raise RuntimeError(
+                "observer update under jit would leak a tracer into python "
+                "state; run QAT calibration eagerly (observers freeze their "
+                "last scale for jitted/exported graphs)")
         if self.scale is None:
             self.scale = cur
         else:

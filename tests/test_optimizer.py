@@ -164,6 +164,10 @@ def test_lars_trains_and_excludes_bias_decay():
                                 exclude_from_weight_decay=["bias"])
     x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
     y = paddle.to_tensor(np.random.randint(0, 4, 16).astype(np.int64))
+    # the 1-D bias must actually be excluded from decay (auto-named params
+    # match by shape, not name)
+    flags = [opt._decay_flags[p.name] for p in model.parameters()]
+    assert False in flags and True in flags
     losses = []
     for _ in range(30):
         loss = F.cross_entropy(model(x), y)
