@@ -1,0 +1,180 @@
+"""PS-lite: a minimal parameter-server runtime.
+
+Reference analog: paddle/fluid/distributed/ps/ (brpc_ps_server/client,
+table/ memory sparse + dense tables, ~50k LoC C++) driven by
+python/paddle/distributed/ps/the_one_ps.py. The reference serves CTR-scale
+embedding tables too big for trainer memory.
+
+TPU-native scope: dense compute belongs on chips; the PS niche that remains
+is the huge-sparse-embedding path, so this module provides exactly that —
+dense tables (pull/push with server-side SGD) and lazily-materialized sparse
+tables (embedding pull/push by id) served over paddle_tpu.distributed.rpc.
+Handlers are top-level functions (picklable by reference) operating on the
+server process's table registry.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["PSServer", "PSClient", "DenseTable", "SparseTable"]
+
+# ---------------------------------------------------------------- tables
+
+_TABLES = {}
+_LOCK = threading.Lock()
+
+
+class DenseTable:
+    def __init__(self, name, shape, initializer="zeros", seed=0):
+        self.name = name
+        rng = np.random.default_rng(seed)
+        if initializer == "zeros":
+            self.value = np.zeros(shape, np.float32)
+        elif initializer == "uniform":
+            bound = 1.0 / np.sqrt(shape[-1] if len(shape) else 1)
+            self.value = rng.uniform(-bound, bound, shape).astype(np.float32)
+        else:
+            raise ValueError(initializer)
+
+    def pull(self):
+        return self.value
+
+    def push(self, grad, lr):
+        self.value -= lr * grad
+
+
+class SparseTable:
+    """id -> embedding row, materialized on first touch (the reference's
+    memory_sparse_table lazy init)."""
+
+    def __init__(self, name, dim, initializer="uniform", seed=0):
+        self.name = name
+        self.dim = dim
+        self.rows = {}
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer
+
+    def _materialize(self, key):
+        if self._init == "zeros":
+            return np.zeros(self.dim, np.float32)
+        bound = 1.0 / np.sqrt(self.dim)
+        return self._rng.uniform(-bound, bound, self.dim).astype(np.float32)
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, key in enumerate(ids):
+            k = int(key)
+            if k not in self.rows:
+                self.rows[k] = self._materialize(k)
+            out[i] = self.rows[k]
+        return out
+
+    def push(self, ids, grads, lr):
+        # duplicate ids accumulate, matching dense embedding-grad semantics
+        for key, g in zip(ids, grads):
+            k = int(key)
+            if k not in self.rows:
+                self.rows[k] = self._materialize(k)
+            self.rows[k] = self.rows[k] - lr * g
+
+
+# ------------------------------------------- server-side rpc handlers
+# top-level so the rpc layer pickles them by reference
+
+def _ps_create_dense(name, shape, initializer, seed):
+    with _LOCK:
+        if name not in _TABLES:
+            _TABLES[name] = DenseTable(name, shape, initializer, seed)
+    return True
+
+
+def _ps_create_sparse(name, dim, initializer, seed):
+    with _LOCK:
+        if name not in _TABLES:
+            _TABLES[name] = SparseTable(name, dim, initializer, seed)
+    return True
+
+
+def _ps_pull_dense(name):
+    with _LOCK:
+        return _TABLES[name].pull()
+
+
+def _ps_push_dense(name, grad, lr):
+    with _LOCK:
+        _TABLES[name].push(grad, lr)
+    return True
+
+
+def _ps_pull_sparse(name, ids):
+    with _LOCK:
+        return _TABLES[name].pull(ids)
+
+
+def _ps_push_sparse(name, ids, grads, lr):
+    with _LOCK:
+        _TABLES[name].push(ids, grads, lr)
+    return True
+
+
+def _ps_table_size(name):
+    with _LOCK:
+        t = _TABLES[name]
+        return len(t.rows) if isinstance(t, SparseTable) else t.value.size
+
+
+class PSServer:
+    """Run on the server rank after rpc.init_rpc: tables live in-process;
+    clients reach them through the handlers above."""
+
+    def __init__(self):
+        self.tables = _TABLES
+
+
+class PSClient:
+    """Trainer-side facade. Reference analog: ps_client.h pull/push API."""
+
+    def __init__(self, server_name="ps0"):
+        self.server = server_name
+
+    def _rpc(self):
+        from .. import rpc
+        return rpc
+
+    def create_dense_table(self, name, shape, initializer="zeros", seed=0):
+        self._rpc().rpc_sync(self.server, _ps_create_dense,
+                             args=(name, list(shape), initializer, seed))
+
+    def create_sparse_table(self, name, dim, initializer="uniform", seed=0):
+        self._rpc().rpc_sync(self.server, _ps_create_sparse,
+                             args=(name, dim, initializer, seed))
+
+    def pull_dense(self, name):
+        return Tensor(np.asarray(
+            self._rpc().rpc_sync(self.server, _ps_pull_dense, args=(name,))))
+
+    def push_dense(self, name, grad, lr=0.1):
+        g = np.asarray(grad._value if isinstance(grad, Tensor) else grad,
+                       np.float32)
+        self._rpc().rpc_sync(self.server, _ps_push_dense, args=(name, g, lr))
+
+    def pull_sparse(self, name, ids):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        return Tensor(np.asarray(self._rpc().rpc_sync(
+            self.server, _ps_pull_sparse, args=(name, ids_np))))
+
+    def push_sparse(self, name, ids, grads, lr=0.1):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        g = np.asarray(grads._value if isinstance(grads, Tensor) else grads,
+                       np.float32).reshape(len(ids_np), -1)
+        self._rpc().rpc_sync(self.server, _ps_push_sparse,
+                             args=(name, ids_np, g, lr))
+
+    def table_size(self, name):
+        return self._rpc().rpc_sync(self.server, _ps_table_size, args=(name,))

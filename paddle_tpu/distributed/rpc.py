@@ -204,7 +204,25 @@ def get_current_worker_info():
 def shutdown():
     if not _GLOBAL:
         return
-    _GLOBAL["store"].barrier()  # drain: everyone stops sending first
+    store = _GLOBAL["store"]
+    rank = _GLOBAL["rank"]
+    world = _GLOBAL["world_size"]
+    store.barrier()  # drain: everyone stops sending first
+    # the master must outlive every peer's barrier round-trip: the last
+    # arriver's done-set response races with master teardown (its handler
+    # thread can be descheduled between notify and send), so peers ack
+    # AFTER their barrier returns and only then does the master stop
+    if rank == 0:
+        if world > 1:
+            store.wait([f"rpc/shutdown_ack/{r}" for r in range(1, world)])
+    else:
+        try:
+            store.set(f"rpc/shutdown_ack/{rank}", b"1")
+        except RuntimeError:
+            # two-generals tail: the set REQUEST reaching the master is what
+            # releases its wait; the master may tear down before our response
+            # leg completes. A lost response here is benign.
+            pass
     for s, _ in _GLOBAL["conns"].values():
         s.close()
     _GLOBAL["server"].close()

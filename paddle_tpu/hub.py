@@ -1,0 +1,60 @@
+"""paddle.hub — load models from a hubconf.py. Reference analog:
+python/paddle/hapi/hub.py (list/help/load with github/gitee/local sources).
+
+This environment has no network egress, so only source='local' is supported;
+a hub repo is any directory with a hubconf.py exposing entrypoint callables
+(functions not prefixed with '_').
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise ValueError(
+            f"source={source!r} needs network access, which this environment "
+            "does not have; use source='local' with a checked-out repo dir")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [name for name in dir(mod)
+            if callable(getattr(mod, name)) and not name.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate entrypoint `model` from the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(
+            f"{model!r} not in {repo_dir}/hubconf.py; available: "
+            f"{list(repo_dir)}")
+    return getattr(mod, model)(**kwargs)

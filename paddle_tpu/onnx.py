@@ -1,0 +1,27 @@
+"""paddle.onnx — model export. Reference analog: python/paddle/onnx/export.py
+(delegates to the external paddle2onnx package).
+
+TPU-native position: the deployment artifact of this framework is StableHLO
+via jit.save / static.save_inference_model (portable across XLA runtimes,
+including ONNX-Runtime's XLA EP). ONNX protobuf emission would need an
+onnx-package dependency that is not bundled, so export() raises with the
+supported alternative unless `onnx` is importable.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export needs the 'onnx' package (not bundled in this "
+            "environment). Use paddle_tpu.jit.save(layer, path, input_spec) "
+            "— the StableHLO artifact it produces is this framework's "
+            "deployment format (loadable via jit.load / "
+            "static.load_inference_model)") from None
+    raise NotImplementedError(
+        "ONNX emission from jaxpr is not implemented yet; use "
+        "paddle_tpu.jit.save for the StableHLO deployment artifact")

@@ -1,0 +1,129 @@
+"""paddle.signal — STFT / ISTFT. Reference analog: python/paddle/signal.py
+(frame/overlap_add ops + fft).
+
+TPU-native: framing is a strided gather, the FFT batch runs over frames, and
+ISTFT's overlap-add is a scatter-add — all jit-friendly XLA ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .ops._helpers import ensure_tensor, call_op
+from .audio.functional import get_window
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Split the last (or first) axis into overlapping frames.
+    Output: [..., frame_length, num_frames] for axis=-1."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis in (0,):
+            v = jnp.moveaxis(v, 0, -1)
+        t = v.shape[-1]
+        n_frames = 1 + (t - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])
+        out = v[..., idx]
+        if axis in (0,):
+            out = jnp.moveaxis(out, (-2, -1), (0, 1))
+        return out
+    return call_op("frame", fn, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, num_frames] -> [..., T]."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if axis in (0,):
+            v = jnp.moveaxis(v, (0, 1), (-2, -1))
+        frame_length, n_frames = v.shape[-2], v.shape[-1]
+        t = frame_length + hop_length * (n_frames - 1)
+        out = jnp.zeros(v.shape[:-2] + (t,), v.dtype)
+        idx = (jnp.arange(frame_length)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])
+        out = out.at[..., idx].add(v)
+        if axis in (0,):
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return call_op("overlap_add", fn, (x,))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform of [B, T] or [T] signals.
+    Returns [B, n_fft//2+1 (or n_fft), num_frames] complex."""
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length, jnp.float32)
+    else:
+        win = window._value if isinstance(window, Tensor) \
+            else get_window(window, win_length)._value
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def fn(v):
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode=pad_mode)
+        t = v.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])
+        frames = v[..., idx] * win[:, None]
+        spec = jnp.fft.rfft(frames, axis=-2) if onesided \
+            else jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+    return call_op("stft", fn, (x,))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (NOLA)."""
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length, jnp.float32)
+    else:
+        win = window._value if isinstance(window, Tensor) \
+            else get_window(window, win_length)._value
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def fn(spec):
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-2) if onesided \
+            else jnp.fft.ifft(spec, axis=-2).real
+        frames = frames * win[:, None]
+        n_frames = frames.shape[-1]
+        t = n_fft + hop_length * (n_frames - 1)
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(n_frames)[None, :])
+        out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
+        out = out.at[..., idx].add(frames)
+        # NOLA normalization: divide by the summed squared window envelope
+        env = jnp.zeros((t,), frames.dtype)
+        env = env.at[idx.reshape(-1)].add(
+            jnp.broadcast_to((win * win)[:, None],
+                             (n_fft, n_frames)).reshape(-1))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:t - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return call_op("istft", fn, (x,))

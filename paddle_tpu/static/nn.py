@@ -1,0 +1,191 @@
+"""paddle.static.nn — static-graph layer functions.
+
+Reference analog: python/paddle/static/nn/ (fc, conv2d, batch_norm,
+embedding, cond, while_loop, switch_case over the fluid layers/controlflow
+ops).
+
+TPU-first: "static" building here means trace-compatible functions — layer
+params are created once per call-site name in a process-wide registry (the
+Program's parameter scope analog) and the control-flow ops map onto
+lax.cond/lax.while_loop, which keeps them compilable under jit instead of
+becoming Python-side branches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor
+from ..utils import unique_name
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm", "cond", "while_loop",
+           "switch_case", "case"]
+
+# parameter scope: call-site name -> Layer (the startup-program analog)
+_LAYERS = {}
+
+
+def _get_layer(name, factory):
+    if name is None:
+        raise ValueError("static.nn layers need name= (the parameter scope "
+                         "key; the reference derives it from unique_name)")
+    if name not in _LAYERS:
+        _LAYERS[name] = factory()
+    return _LAYERS[name]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ..nn.layer.common import Linear
+    from ..ops import manipulation as manip
+    x = ensure_tensor(x)
+    name = name or unique_name.generate("fc")
+    lead = x.shape[:num_flatten_dims]
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= s
+    layer = _get_layer(name, lambda: Linear(
+        in_features, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    flat = manip.reshape(x, list(lead) + [in_features])
+    out = layer(flat)
+    if activation is not None:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    from ..nn.layer.common import Embedding
+    name = name or unique_name.generate("embedding")
+    layer = _get_layer(name, lambda: Embedding(
+        size[0], size[1], padding_idx=padding_idx, weight_attr=param_attr))
+    return layer(ensure_tensor(input))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    from ..nn.layer.conv import Conv2D
+    x = ensure_tensor(input)
+    name = name or unique_name.generate("conv2d")
+    in_channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    layer = _get_layer(name, lambda: Conv2D(
+        in_channels, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format))
+    out = layer(x)
+    if act is not None:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from ..nn.layer.norm import BatchNorm2D, BatchNorm1D
+    x = ensure_tensor(input)
+    name = name or unique_name.generate("batch_norm")
+    ch = x.shape[1] if data_layout == "NCHW" else x.shape[-1]
+    cls = BatchNorm2D if len(x.shape) == 4 else BatchNorm1D
+    layer = _get_layer(name, lambda: cls(ch, momentum=momentum,
+                                         epsilon=epsilon))
+    if is_test:
+        layer.eval()
+    out = layer(x)
+    if act is not None:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+# ------------------------------------------------------------ control flow
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_out(v):
+    if isinstance(v, (list, tuple)):
+        return type(v)(_wrap_out(e) for e in v)
+    return Tensor(v) if not isinstance(v, Tensor) else v
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Reference: fluid/layers/control_flow cond (conditional_block ops).
+    Lowers to lax.cond so both branches stay inside one compiled graph."""
+    p = _unwrap(ensure_tensor(pred))
+    p = jnp.reshape(p, ()).astype(bool)
+
+    def t_branch(_):
+        out = true_fn()
+        return jax.tree_util.tree_map(_unwrap, out)
+
+    def f_branch(_):
+        out = false_fn()
+        return jax.tree_util.tree_map(_unwrap, out)
+
+    out = jax.lax.cond(p, t_branch, f_branch, operand=None)
+    return _wrap_out(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Reference: fluid while op. Lowers to lax.while_loop (compilable
+    data-dependent trip count)."""
+    init = [_unwrap(ensure_tensor(v)) for v in loop_vars]
+
+    def c(vals):
+        out = cond_fn(*[Tensor(v, stop_gradient=True) for v in vals])
+        return jnp.reshape(_unwrap(out), ()).astype(bool)
+
+    def b(vals):
+        out = body_fn(*[Tensor(v, stop_gradient=True) for v in vals])
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [_unwrap(ensure_tensor(o)) for o in out]
+
+    final = jax.lax.while_loop(c, b, init)
+    return [_wrap_out(v) for v in final]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference: fluid switch_case. Lowers to lax.switch."""
+    idx = jnp.reshape(_unwrap(ensure_tensor(branch_index)), ()).astype(
+        jnp.int32)
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map arbitrary branch keys onto dense switch indices
+        lut = jnp.full((max(keys) + 2,), len(fns), jnp.int32)
+        for pos, k in enumerate(keys):
+            lut = lut.at[k].set(pos)
+        idx = lut[jnp.clip(idx, 0, max(keys) + 1)]
+    else:
+        fns = list(branch_fns)
+        idx = jnp.clip(idx, 0, len(fns))
+    if default is not None:
+        fns = fns + [default]
+    else:
+        fns = fns + [fns[-1]]
+
+    wrapped = [lambda _, f=f: jax.tree_util.tree_map(_unwrap, f())
+               for f in fns]
+    out = jax.lax.switch(jnp.minimum(idx, len(fns) - 1), wrapped, None)
+    return _wrap_out(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference: fluid case. First true predicate wins."""
+    preds = [jnp.reshape(_unwrap(ensure_tensor(p)), ()).astype(jnp.int32)
+             for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    stacked = jnp.stack(preds)
+    first = jnp.argmax(stacked)
+    any_true = jnp.any(stacked > 0)
+    idx = jnp.where(any_true, first, len(fns))
+    if default is None:
+        default = fns[-1]
+    wrapped = [lambda _, f=f: jax.tree_util.tree_map(_unwrap, f())
+               for f in fns + [default]]
+    out = jax.lax.switch(idx.astype(jnp.int32), wrapped, None)
+    return _wrap_out(out)

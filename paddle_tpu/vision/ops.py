@@ -1,0 +1,440 @@
+"""Detection ops. Reference analog: python/paddle/vision/ops.py over the
+fluid detection kernels (nms, roi_align, roi_pool, box_coder, yolo_box,
+prior_box, psroi_pool, distribute_fpn_proposals).
+
+TPU-native split: dense, differentiable ops (roi_align/roi_pool/psroi_pool,
+box decode) are jnp math that lowers to XLA gathers; sequential
+post-processing (nms, fpn routing) runs on host numpy — it is O(#boxes)
+bookkeeping after the network, exactly where the reference runs its CPU
+fallbacks.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor, call_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "psroi_pool", "box_coder",
+           "yolo_box", "prior_box", "distribute_fpn_proposals", "box_iou",
+           "RoIAlign", "RoIPool"]
+
+
+def _np(x):
+    return np.asarray(ensure_tensor(x)._value)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU of [N,4] and [M,4] xyxy boxes -> [N, M]."""
+    b1, b2 = ensure_tensor(boxes1), ensure_tensor(boxes2)
+
+    def fn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+    return call_op("box_iou", fn, (b1, b2))
+
+
+def _nms_single(boxes, scores, iou_threshold, top_k=None):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        if top_k is not None and len(keep) >= top_k:
+            break
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(boxes[i, 0], boxes[rest, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[rest, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[rest, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[rest, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a_r = (boxes[rest, 2] - boxes[rest, 0]) * \
+            (boxes[rest, 3] - boxes[rest, 1])
+        iou = inter / (a_i + a_r - inter + 1e-10)
+        order = rest[iou <= iou_threshold]
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy (optionally category-aware) hard NMS; returns kept indices.
+    Reference: vision/ops.py nms (phi nms kernel). Host-side: sequential
+    suppression is post-processing, not accelerator work."""
+    b = _np(boxes)
+    s = _np(scores) if scores is not None else \
+        np.arange(len(b), 0, -1, dtype=np.float32)
+    if category_idxs is None:
+        keep = _nms_single(b, s, iou_threshold, top_k)
+    else:
+        cats = _np(category_idxs)
+        kept = []
+        for c in (categories if categories is not None
+                  else np.unique(cats)):
+            c_val = getattr(c, "item", lambda: c)()
+            idx = np.nonzero(cats == c_val)[0]
+            if idx.size == 0:
+                continue
+            k = _nms_single(b[idx], s[idx], iou_threshold)
+            kept.append(idx[k])
+        keep = np.concatenate(kept) if kept else np.array([], np.int64)
+        keep = keep[np.argsort(-s[keep], kind="stable")]
+        if top_k is not None:
+            keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def _bilinear_sample(feat, y, x):
+    """feat: [C, H, W]; y/x: sample grids (any shape) -> [C, *grid]."""
+    h, w = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def get(yy, xx):
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return feat[:, yc, xc]
+
+    out = (get(y0, x0) * (wy0 * wx0) + get(y0, x1) * (wy0 * wx1)
+           + get(y1, x0) * (wy1 * wx0) + get(y1, x1) * (wy1 * wx1))
+    # zero outside the feature map (paddle semantics: sample in-range only)
+    valid = (y > -1) & (y < h) & (x > -1) & (x < w)
+    return out * valid
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Differentiable RoIAlign. Reference: vision/ops.py roi_align (phi
+    roi_align kernel). x: [N,C,H,W]; boxes: [R,4] xyxy; boxes_num: [N]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x_t = ensure_tensor(x)
+    boxes_t = ensure_tensor(boxes)
+    num = _np(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(num)), num)
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    def fn(feat, bx):
+        offset = 0.5 if aligned else 0.0
+        b = bx * spatial_scale - offset
+        xs0, ys0, xs1, ys1 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        rw = xs1 - xs0
+        rh = ys1 - ys0
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid: [ph*ratio, pw*ratio] points per roi
+        gy = (jnp.arange(ph * ratio) + 0.5) / ratio   # in bin units
+        gx = (jnp.arange(pw * ratio) + 0.5) / ratio
+
+        def per_roi(i):
+            yy = ys0[i] + gy * bin_h[i]               # [ph*ratio]
+            xx = xs0[i] + gx * bin_w[i]               # [pw*ratio]
+            grid_y = jnp.broadcast_to(yy[:, None], (ph * ratio, pw * ratio))
+            grid_x = jnp.broadcast_to(xx[None, :], (ph * ratio, pw * ratio))
+            samples = _bilinear_sample(feat[batch_idx[i]], grid_y, grid_x)
+            c = samples.shape[0]
+            return samples.reshape(c, ph, ratio, pw, ratio).mean((2, 4))
+
+        return jnp.stack([per_roi(i) for i in range(len(batch_idx))]) \
+            if len(batch_idx) else jnp.zeros((0, feat.shape[1], ph, pw),
+                                             feat.dtype)
+    return call_op("roi_align", fn, (x_t, boxes_t))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (max over integer bins). Reference: vision/ops.py roi_pool."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x_t = ensure_tensor(x)
+    boxes_t = ensure_tensor(boxes)
+    num = _np(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(num)), num)
+
+    def fn(feat, bx):
+        h, w = feat.shape[-2], feat.shape[-1]
+        b = jnp.round(bx * spatial_scale)
+        ys = jnp.arange(h)[:, None]
+        xs = jnp.arange(w)[None, :]
+
+        def per_roi(i):
+            x0, y0, x1, y1 = b[i, 0], b[i, 1], b[i, 2], b[i, 3]
+            rh = jnp.maximum(y1 - y0 + 1, 1.0)
+            rw = jnp.maximum(x1 - x0 + 1, 1.0)
+            outs = []
+            for py in range(ph):
+                for px in range(pw):
+                    by0 = jnp.floor(y0 + rh * py / ph)
+                    by1 = jnp.ceil(y0 + rh * (py + 1) / ph)
+                    bx0 = jnp.floor(x0 + rw * px / pw)
+                    bx1 = jnp.ceil(x0 + rw * (px + 1) / pw)
+                    mask = ((ys >= by0) & (ys < by1) & (xs >= bx0)
+                            & (xs < bx1) & (ys >= 0) & (ys < h)
+                            & (xs >= 0) & (xs < w))
+                    masked = jnp.where(mask[None], feat[batch_idx[i]],
+                                       -jnp.inf)
+                    m = jnp.max(masked, axis=(1, 2))
+                    outs.append(jnp.where(jnp.isfinite(m), m, 0.0))
+            c = feat.shape[1]
+            return jnp.stack(outs, axis=1).reshape(c, ph, pw)
+
+        return jnp.stack([per_roi(i) for i in range(len(batch_idx))]) \
+            if len(batch_idx) else jnp.zeros((0, feat.shape[1], ph, pw),
+                                             feat.dtype)
+    return call_op("roi_pool", fn, (x_t, boxes_t))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN). Channels are split into
+    ph*pw groups; bin (i,j) averages its own channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x_t = ensure_tensor(x)
+    c_total = x_t.shape[1]
+    assert c_total % (ph * pw) == 0, "channels must divide output_size^2"
+    c_out = c_total // (ph * pw)
+    boxes_t = ensure_tensor(boxes)
+    num = _np(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(num)), num)
+
+    def fn(feat, bx):
+        h, w = feat.shape[-2], feat.shape[-1]
+        b = bx * spatial_scale
+        ys = jnp.arange(h)[:, None]
+        xs = jnp.arange(w)[None, :]
+
+        def per_roi(i):
+            x0, y0, x1, y1 = b[i, 0], b[i, 1], b[i, 2], b[i, 3]
+            rh = jnp.maximum(y1 - y0, 0.1)
+            rw = jnp.maximum(x1 - x0, 0.1)
+            out = jnp.zeros((c_out, ph, pw), feat.dtype)
+            for py in range(ph):
+                for px in range(pw):
+                    by0 = jnp.floor(y0 + rh * py / ph)
+                    by1 = jnp.ceil(y0 + rh * (py + 1) / ph)
+                    bx0 = jnp.floor(x0 + rw * px / pw)
+                    bx1 = jnp.ceil(x0 + rw * (px + 1) / pw)
+                    mask = ((ys >= by0) & (ys < by1) & (xs >= bx0)
+                            & (xs < bx1) & (ys >= 0) & (ys < h)
+                            & (xs >= 0) & (xs < w))
+                    grp = feat[batch_idx[i],
+                               (py * pw + px) * c_out:(py * pw + px + 1)
+                               * c_out]
+                    cnt = jnp.maximum(jnp.sum(mask), 1)
+                    avg = jnp.sum(grp * mask[None], axis=(1, 2)) / cnt
+                    out = out.at[:, py, px].set(avg)
+            return out
+
+        return jnp.stack([per_roi(i) for i in range(len(batch_idx))]) \
+            if len(batch_idx) else jnp.zeros((0, c_out, ph, pw), feat.dtype)
+    return call_op("psroi_pool", fn, (x_t, boxes_t))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (SSD/R-CNN deltas).
+    Reference: fluid box_coder op."""
+    pb = ensure_tensor(prior_box)
+    tb = ensure_tensor(target_box)
+    if isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    elif prior_box_var is None:
+        var = jnp.ones(4, jnp.float32)
+    else:
+        var = ensure_tensor(prior_box_var)._value
+
+    def fn(p, t):
+        norm = 0.0 if box_normalized else 1.0
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw * 0.5
+            tcy = t[:, 1] + th * 0.5
+            dx = (tcx - pcx) / pw
+            dy = (tcy - pcy) / ph
+            dw = jnp.log(tw / pw)
+            dh = jnp.log(th / ph)
+            out = jnp.stack([dx, dy, dw, dh], axis=1)
+            return out / var.reshape(1, 4) if var.ndim == 1 else out / var
+        # decode: t is [N, 4] deltas (single-class form)
+        v = var.reshape(1, 4) if var.ndim == 1 else var
+        d = t * v
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=1)
+    return call_op("box_coder", fn, (pb, tb))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output [N, A*(5+C), H, W] into (boxes, scores).
+    Reference: fluid yolo_box op."""
+    x_t = ensure_tensor(x)
+    img = ensure_tensor(img_size)
+    a = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = len(a)
+
+    def fn(pred, imsz):
+        n, _, h, w = pred.shape
+        p = pred.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx[None, None, None, :]) / w
+        by = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy[None, None, :, None]) / h
+        input_w = downsample_ratio * w
+        input_h = downsample_ratio * h
+        bw = jnp.exp(p[:, :, 2]) * a[None, :, 0, None, None] / input_w
+        bh = jnp.exp(p[:, :, 3]) * a[None, :, 1, None, None] / input_h
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        probs = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        probs = jnp.where(conf[:, :, None] >= conf_thresh, probs, 0.0)
+        imh = imsz[:, 0].astype(jnp.float32)
+        imw = imsz[:, 1].astype(jnp.float32)
+        x0 = (bx - bw / 2) * imw[:, None, None, None]
+        y0 = (by - bh / 2) * imh[:, None, None, None]
+        x1 = (bx + bw / 2) * imw[:, None, None, None]
+        y1 = (by + bh / 2) * imh[:, None, None, None]
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0)
+            y0 = jnp.clip(y0, 0)
+            x1 = jnp.minimum(x1, imw[:, None, None, None] - 1)
+            y1 = jnp.minimum(y1, imh[:, None, None, None] - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+        return boxes, scores
+    from ..ops.dispatch import call_op_multi
+    return call_op_multi("yolo_box", fn, (x_t, img), num_outputs=2)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes for one feature map. Reference: fluid prior_box op.
+    Host-side generation (static per shape)."""
+    feat = ensure_tensor(input)
+    im = ensure_tensor(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = im.shape[2], im.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    boxes = []
+    vars_out = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            for k, ms in enumerate(np.atleast_1d(min_sizes)):
+                # min-size square
+                boxes.append([cx - ms / 2, cy - ms / 2,
+                              cx + ms / 2, cy + ms / 2])
+                # extra aspect ratios
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    bw = ms * math.sqrt(ar)
+                    bh = ms / math.sqrt(ar)
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+                if max_sizes is not None:
+                    bs = math.sqrt(ms * np.atleast_1d(max_sizes)[k])
+                    boxes.append([cx - bs / 2, cy - bs / 2,
+                                  cx + bs / 2, cy + bs / 2])
+    out = np.asarray(boxes, np.float32)
+    out[:, 0::2] /= iw
+    out[:, 1::2] /= ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    out = out.reshape(fh, fw, -1, 4)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale. Reference: fluid
+    distribute_fpn_proposals op. Host-side bookkeeping."""
+    rois = _np(fpn_rois)
+    offset = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + offset
+    h = rois[:, 3] - rois[:, 1] + offset
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    level = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+
+    multi_rois = []
+    rois_num_per = []
+    order = []
+    for lv in range(min_level, max_level + 1):
+        idx = np.nonzero(level == lv)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        rois_num_per.append(Tensor(jnp.asarray(
+            np.asarray([len(idx)], np.int32))))
+        order.append(idx)
+    restore = np.argsort(np.concatenate(order)) if order else \
+        np.array([], np.int64)
+    restore_ind = Tensor(jnp.asarray(restore.astype(np.int64)[:, None]))
+    if rois_num is not None:
+        return multi_rois, restore_ind, rois_num_per
+    return multi_rois, restore_ind
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

@@ -1,0 +1,71 @@
+"""PS-lite tests: one server + one trainer process over rpc; the trainer
+learns a sparse embedding + dense weight living on the server (the reference's
+TestDistBase PS pattern, SURVEY.md §4)."""
+import os
+import socket
+import subprocess
+import sys
+
+_WORKER = r"""
+import sys
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.ps import PSClient, PSServer
+
+rank = int(sys.argv[1]); port = sys.argv[2]
+name = "ps0" if rank == 0 else f"trainer{rank}"
+rpc.init_rpc(name, rank=rank, world_size=2,
+             master_endpoint=f"127.0.0.1:{port}")
+
+if rank == 0:
+    PSServer()           # tables live here; handlers run in rpc threads
+else:
+    client = PSClient("ps0")
+    client.create_sparse_table("emb", dim=4, initializer="zeros")
+    client.create_dense_table("w", shape=[4], initializer="zeros")
+
+    # dense push/pull arithmetic: w = 0 - 0.1 * (-1) = 0.1 per dim
+    client.push_dense("w", -np.ones(4, np.float32), lr=0.1)
+    w = client.pull_dense("w").numpy()
+    assert np.allclose(w, 0.1), w
+
+    # learn emb rows (fixed w): linear regression, converges geometrically
+    ids = np.array([3, 7, 3], np.int64)          # duplicate id: grads sum
+    emb = client.pull_sparse("emb", ids).numpy()
+    assert emb.shape == (3, 4) and (emb == 0).all()
+    label = np.array([1.0, -1.0, 1.0], np.float32)
+    for step in range(80):
+        e = client.pull_sparse("emb", ids).numpy()    # [3, 4]
+        err = e @ w - label
+        ge = np.outer(err, w)
+        client.push_sparse("emb", ids, ge, lr=5.0)
+
+    e = client.pull_sparse("emb", ids).numpy()
+    loss = ((e @ w - label) ** 2).mean()
+    assert loss < 1e-3, loss
+    assert client.table_size("emb") == 2   # only ids 3 and 7 materialized
+    print("PS_OK", loss)
+
+rpc.shutdown()
+"""
+
+
+def test_ps_server_trainer(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    script = tmp_path / "ps_worker.py"
+    script.write_text(_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for r in range(2)]
+    outs = [p.communicate(timeout=180)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert "PS_OK" in outs[1]

@@ -1,0 +1,134 @@
+"""Tests for vision.ops (detection) and paddle.signal (stft/istft)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+from paddle_tpu import signal
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+# ------------------------------------------------------------ vision.ops
+
+def test_box_iou():
+    a = _t([[0, 0, 2, 2]])
+    b = _t([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]])
+    iou = vops.box_iou(a, b).numpy()[0]
+    np.testing.assert_allclose(iou, [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_nms_basic_and_categories():
+    boxes = _t([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]])
+    scores = _t([0.9, 0.8, 0.7])
+    keep = vops.nms(boxes, 0.5, scores).numpy()
+    np.testing.assert_array_equal(keep, [0, 2])
+    cats = paddle.to_tensor(np.array([0, 1, 0], np.int64))
+    keep = vops.nms(boxes, 0.5, scores, category_idxs=cats).numpy()
+    # different categories -> overlapping boxes both kept
+    assert set(keep.tolist()) == {0, 1, 2}
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every aligned value equals the constant
+    feat = np.full((1, 3, 16, 16), 5.0, np.float32)
+    boxes = _t([[2.0, 2.0, 10.0, 10.0]])
+    out = vops.roi_align(_t(feat), boxes, paddle.to_tensor(
+        np.array([1], np.int32)), output_size=4)
+    assert list(out.shape) == [1, 3, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 5.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    feat = paddle.to_tensor(
+        np.random.randn(1, 2, 8, 8).astype(np.float32), stop_gradient=False)
+    boxes = _t([[1.0, 1.0, 6.0, 6.0]])
+    out = vops.roi_align(feat, boxes, paddle.to_tensor(
+        np.array([1], np.int32)), output_size=2)
+    out.sum().backward()
+    g = feat.grad.numpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_roi_pool_exact_bins():
+    feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    boxes = _t([[0.0, 0.0, 3.0, 3.0]])
+    out = vops.roi_pool(_t(feat), boxes, paddle.to_tensor(
+        np.array([1], np.int32)), output_size=2)
+    # max over quadrants of the full 4x4 map
+    np.testing.assert_allclose(out.numpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_box_coder_roundtrip():
+    priors = _t([[0, 0, 10, 10], [5, 5, 20, 25]])
+    targets = _t([[1, 1, 9, 11], [4, 6, 22, 24]])
+    enc = vops.box_coder(priors, [1.0, 1.0, 1.0, 1.0], targets,
+                         code_type="encode_center_size")
+    dec = vops.box_coder(priors, [1.0, 1.0, 1.0, 1.0], enc,
+                         code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), targets.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_yolo_box_shapes():
+    n, na, c, h, w = 1, 3, 4, 5, 5
+    x = _t(np.random.randn(n, na * (5 + c), h, w))
+    img = paddle.to_tensor(np.array([[320, 320]], np.int32))
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=c, downsample_ratio=32)
+    assert list(boxes.shape) == [1, na * h * w, 4]
+    assert list(scores.shape) == [1, na * h * w, c]
+    assert np.isfinite(boxes.numpy()).all()
+
+
+def test_prior_box():
+    feat = _t(np.zeros((1, 8, 4, 4)))
+    img = _t(np.zeros((1, 3, 32, 32)))
+    boxes, variances = vops.prior_box(feat, img, min_sizes=[8.0],
+                                      aspect_ratios=[2.0], flip=True,
+                                      clip=True)
+    # 1 min-size square + 2 flipped ratios = 3 priors per cell
+    assert list(boxes.shape) == [4, 4, 3, 4]
+    assert boxes.numpy().min() >= 0 and boxes.numpy().max() <= 1
+    assert list(variances.shape) == [4, 4, 3, 4]
+
+
+def test_distribute_fpn_proposals():
+    rois = _t([[0, 0, 10, 10], [0, 0, 120, 120], [0, 0, 500, 500]])
+    multi, restore = vops.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    total = sum(r.shape[0] for r in multi)
+    assert total == 3
+    # restore index maps concatenated-by-level order back to input order
+    cat = np.concatenate([r.numpy() for r in multi if r.shape[0]])
+    np.testing.assert_allclose(cat[restore.numpy()[:, 0]], rois.numpy())
+
+
+# ---------------------------------------------------------------- signal
+
+def test_stft_matches_manual():
+    x = np.random.randn(2, 512).astype(np.float32)
+    spec = signal.stft(_t(x), n_fft=128, hop_length=64,
+                       window="hann").numpy()
+    assert spec.shape == (2, 65, 9)
+    # frame 0 vs manual
+    xp = np.pad(x[0], (64, 64), mode="reflect")
+    w = np.hanning(129)[:-1]
+    ref = np.fft.rfft(xp[:128] * w)
+    np.testing.assert_allclose(spec[0, :, 0], ref, rtol=1e-3, atol=1e-3)
+
+
+def test_stft_istft_roundtrip():
+    x = np.random.randn(1, 1024).astype(np.float32)
+    spec = signal.stft(_t(x), n_fft=256, hop_length=64, window="hann")
+    rec = signal.istft(spec, n_fft=256, hop_length=64, window="hann",
+                       length=1024).numpy()
+    np.testing.assert_allclose(rec, x, rtol=1e-3, atol=1e-4)
+
+
+def test_frame_overlap_add_inverse():
+    x = np.arange(32, dtype=np.float32)
+    f = signal.frame(_t(x), frame_length=8, hop_length=8)
+    assert list(f.shape) == [8, 4]
+    back = signal.overlap_add(f, hop_length=8).numpy()
+    np.testing.assert_allclose(back, x)
