@@ -8,6 +8,10 @@ from .mp_layers import (  # noqa: F401
 from .mp_ops import _c_identity, _c_concat, _c_split, _mp_allreduce, split  # noqa: F401
 from .pp_layers import LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .spmd_pipeline import (  # noqa: F401
+    spmd_pipeline, pipeline_schedule, PipelineTrainStep, stack_stage_params,
+    find_block_run,
+)
 from .parallel_wrappers import TensorParallel, ShardingParallel  # noqa: F401
 from .sep_parallel import (  # noqa: F401
     ring_attention, ulysses_attention, sep_attention, SEP_AXIS,

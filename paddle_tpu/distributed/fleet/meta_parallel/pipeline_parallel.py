@@ -1,15 +1,18 @@
-"""Pipeline-parallel execution with the 1F1B schedule.
+"""Pipeline-parallel execution.
 
 Reference analog: fleet/meta_parallel/pipeline_parallel.py —
 forward_backward_pipeline (:117), train_batch (:228), interleaved variant
 (:461); p2p meta handshake (pp_utils/p2p_communication.py:53).
 
-TPU-first: one controller owns every stage, so "p2p" is an activation handoff
-and the 1F1B order is preserved as a schedule (warmup F, steady 1F1B, drain B)
-— micro-batch b's backward runs before micro-batch b+k's forward, bounding
-live activations exactly like the reference. Cross-device stage placement
-comes from sharding stage parameters over the mesh "pipe" axis; XLA then
-overlaps stages across micro-batches (the FleetExecutor role, SURVEY.md §7).
+Two execution paths:
+
+  - mesh "pipe" axis > 1: the REAL pipeline — stage parameters sharded over
+    the pipe axis, micro-batches rotated between stages with ppermute inside
+    one jitted program (spmd_pipeline.PipelineTrainStep). This is the
+    cross-device path; stages live on different devices and overlap.
+  - pipe == 1 (or no mesh): single-device fallback — sequential gradient
+    accumulation over micro-batches. Same losses, no parallelism; useful for
+    debugging a PipelineLayer model without a mesh.
 """
 from __future__ import annotations
 
@@ -38,6 +41,15 @@ class PipelineParallel(Layer):
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self.num_stages = layers.get_num_stages()
         self.total_loss = None
+        self._spmd_step = None
+
+    def _mesh_pipe_degree(self):
+        from ...mesh import get_global_mesh
+        try:
+            mesh = get_global_mesh()
+        except Exception:
+            return 1
+        return mesh.shape.get("pipe", 1)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -50,7 +62,9 @@ class PipelineParallel(Layer):
         return manip.split(data, n, axis=0)
 
     def forward_backward_pipeline(self, data, scaler=None):
-        """1F1B: warmup forwards (num_stages-1), steady alternation, drain."""
+        """Single-controller fallback: forward+backward per micro-batch
+        (sequential gradient accumulation — no cross-device overlap; the
+        overlapped path is train_batch over a pipe>1 mesh)."""
         micro_batches = self._split_micro_batches(data)
         num_micro = len(micro_batches)
         losses = []
@@ -82,7 +96,32 @@ class PipelineParallel(Layer):
         return out
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Reference analog: pipeline_parallel.py:228 train_batch."""
+        """Reference analog: pipeline_parallel.py:228 train_batch.
+
+        Over a mesh with pipe > 1 this runs the SPMD pipeline (stage params
+        sharded over "pipe", ppermute handoff, fused fwd+bwd+update); the
+        scaler is unsupported there (bf16-first, no loss scaling on TPU).
+        """
+        spmd_eligible = (self._mesh_pipe_degree() > 1 and scaler is None
+                         and self._layers._loss_fn is not None
+                         and isinstance(data, (tuple, list))
+                         and len(data) == 2)
+        if spmd_eligible:
+            self._layers.train()     # trace in train mode (dropout on)
+            if self._spmd_step is None:
+                from .spmd_pipeline import PipelineTrainStep
+                self._spmd_step = PipelineTrainStep(
+                    self._layers, self._layers._loss_fn, optimizer,
+                    num_microbatches=max(self.accumulate_steps,
+                                         self._mesh_pipe_degree()))
+            x, y = data
+            loss = self._spmd_step(x, y)
+            # keep the eager model/optimizer observable (eval_batch,
+            # state_dict, checkpointing) in sync with the fused step
+            self._spmd_step.sync_to_model()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss.detach()
         self._layers.train()
         loss = self.forward_backward_pipeline(data, scaler)
         if scaler is not None:

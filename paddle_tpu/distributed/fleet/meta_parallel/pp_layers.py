@@ -4,9 +4,12 @@ Reference analog: fleet/meta_parallel/parallel_layers/pp_layers.py —
 LayerDesc (:56), SharedLayerDesc (:76), SegmentLayers (:92),
 PipelineLayerChunk (:182), PipelineLayer (:208).
 
-The descriptor/segmentation API is identical; execution differs: stages run on
-one controller with parameters shardable over the mesh "pipe" axis, and the
-1F1B schedule lives in pipeline_parallel.py.
+The descriptor/segmentation API is identical; execution differs: over a mesh
+with pipe > 1, PipelineTrainStep (spmd_pipeline.py) stacks the homogeneous
+block run's parameters on a leading dim sharded over the "pipe" axis and
+rotates micro-batch activations between stages with ppermute — that module is
+where cross-device placement actually happens. Without a pipe axis, stages
+run sequentially on one device.
 """
 from __future__ import annotations
 

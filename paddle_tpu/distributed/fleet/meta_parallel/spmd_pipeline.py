@@ -1,0 +1,493 @@
+"""SPMD pipeline parallelism over the mesh "pipe" axis.
+
+Reference analog: the 1F1B runtime (fleet/meta_parallel/pipeline_parallel.py:117
+forward_backward_pipeline, pp_utils/p2p_communication.py:53 SendRecvMeta) and
+the FleetExecutor actor runtime (fluid/distributed/fleet_executor/carrier.h:49).
+
+TPU-first design — no actor runtime, no p2p handshake. The pipeline is ONE
+XLA program:
+
+  - stage parameters are stacked on a leading dim and sharded over the mesh
+    "pipe" axis, so each device group holds exactly its stage's weights;
+  - the schedule is a `lax.scan` over timesteps inside `shard_map`: at step t
+    device (stage) i computes micro-batch t-i, then hands its activation to
+    stage i+1 with a single `lax.ppermute` hop over ICI;
+  - `jax.grad` through the scan+ppermute yields the reverse pipeline
+    automatically (ppermute transposes to the reversed ring), so the backward
+    schedule mirrors the forward one with no hand-written p2p;
+  - activation memory is bounded with `jax.checkpoint` on the per-stage body
+    (the 1F1B memory discipline, achieved by remat instead of schedule order).
+
+Schedule shape: GPipe-style fill/steady/drain — M+S-1 steps, steady-state
+concurrency S (all stages busy on different micro-batches). The bubble
+fraction is (S-1)/(M+S-1); choose num_microbatches >= num_stages.
+`pipeline_schedule` exposes the (timestep -> {(stage, microbatch)}) map for
+inspection and testing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import tree_map
+
+from ....framework.core import Tensor
+from ....framework import random as _random
+from ....framework.autograd import set_grad_enabled
+
+__all__ = ["pipeline_schedule", "spmd_pipeline", "PipelineTrainStep",
+           "stack_stage_params", "find_block_run"]
+
+
+def pipeline_schedule(num_micro, num_stages):
+    """Forward schedule: list over timesteps of {(stage, microbatch)} active
+    simultaneously. Steady state has all `num_stages` stages busy — this is
+    the micro-batch overlap the schedule guarantees."""
+    sched = []
+    for t in range(num_micro + num_stages - 1):
+        active = {(s, t - s) for s in range(num_stages)
+                  if 0 <= t - s < num_micro}
+        sched.append(active)
+    return sched
+
+
+def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
+    """Run `x` through a pipeline of S stages laid out over `axis`.
+
+    stage_fn(params_one_stage, mb) -> mb   (same shape/dtype out as in);
+    when `key` is given, called as stage_fn(params, mb, subkey) with a key
+    folded over (timestep, stage) so dropout masks differ per micro-batch
+    and per stage.
+    stage_params: pytree whose leaves have leading dim S, sharded over `axis`
+    x: [M, *mb_shape] micro-batched activations, replicated over `axis`
+    returns [M, *mb_shape]: last stage's outputs, replicated over `axis`.
+
+    Everything happens inside one shard_map over only the pipe axis; other
+    mesh axes (data/model/sharding) stay in auto mode so existing Megatron
+    shardings on the stage parameters keep working inside each stage.
+    """
+    S = mesh.shape[axis]
+    M = x.shape[0]
+    if S == 1:
+        # degenerate pipeline: just apply the single stage to each microbatch
+        params0 = tree_map(lambda l: l[0], stage_params)
+        if key is None:
+            return lax.map(lambda mb: stage_fn(params0, mb), x)
+        return lax.map(
+            lambda tm: stage_fn(params0, tm[1],
+                                jax.random.fold_in(key, tm[0])),
+            (jnp.arange(M), x))
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_device(params_local, x_full):
+        my = tree_map(lambda l: jnp.squeeze(l, 0), params_local)
+        idx = lax.axis_index(axis)
+
+        def body(carry, t):
+            state, outs = carry
+            # feed: stage 0 picks up micro-batch t (clipped garbage in drain)
+            inp = lax.dynamic_index_in_dim(x_full, jnp.clip(t, 0, M - 1), 0,
+                                           keepdims=False)
+            state = jnp.where(idx == 0, inp, state)
+            if key is None:
+                out = stage_fn(my, state)
+            else:
+                out = stage_fn(my, state,
+                               jax.random.fold_in(
+                                   jax.random.fold_in(key, t), idx))
+            # collect: stage S-1 emits micro-batch t-(S-1) once it exists
+            t_out = jnp.clip(t - (S - 1), 0, M - 1)
+            collect = jnp.logical_and(idx == S - 1, t >= S - 1)
+            prev = lax.dynamic_index_in_dim(outs, t_out, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(collect, out, prev), t_out, 0)
+            # rotate: one ICI hop to the next stage
+            state = lax.ppermute(out, axis, perm)
+            return (state, outs), None
+
+        # the carry varies across the pipe axis from step 1 on; mark the
+        # zero-init as varying so scan's carry types line up
+        init = (lax.pcast(jnp.zeros_like(x_full[0]), axis, to="varying"),
+                lax.pcast(jnp.zeros_like(x_full), axis, to="varying"))
+        (_, outs), _ = lax.scan(body, init, jnp.arange(M + S - 1))
+        # only the last stage's buffer is real; replicate it over the axis
+        outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
+                        axis)
+        return outs
+
+    mapped = jax.shard_map(per_device, mesh=mesh, axis_names={axis},
+                           in_specs=(P(axis), P()), out_specs=P())
+    return mapped(stage_params, x)
+
+
+def find_block_run(layers, num_stages):
+    """Locate the longest contiguous run of structurally identical layers
+    (the pipeline-able transformer blocks) in `layers`.
+
+    Returns (start, count) with count a positive multiple of num_stages, or
+    raises if no such run exists. Layers outside the run become the prologue
+    (before) and epilogue (after), executed un-pipelined.
+    """
+    def sig(layer):
+        return (type(layer).__name__,
+                tuple((tuple(p.shape), str(p.dtype), p.stop_gradient)
+                      for p in layer.parameters()))
+
+    sigs = [sig(l) for l in layers]
+    best = (0, 0)
+    i = 0
+    while i < len(layers):
+        j = i
+        while j < len(layers) and sigs[j] == sigs[i]:
+            j += 1
+        if sigs[i][1] and j - i > best[1]:   # has params and longer
+            best = (i, j - i)
+        i = j
+    start, count = best
+    count = (count // num_stages) * num_stages
+    if count == 0:
+        raise ValueError(
+            f"no contiguous run of >= {num_stages} structurally identical "
+            f"layers found; cannot partition into {num_stages} pipeline "
+            f"stages")
+    return start, count
+
+
+def stack_stage_params(blocks, num_stages, mesh, axis="pipe"):
+    """Stack the parameters of `blocks` (len = S * per) into leaves of shape
+    [S, per, *param_shape], sharded over `axis` on dim 0 and preserving each
+    parameter's existing named sharding on the trailing dims (so Megatron
+    "model"-axis placements survive stacking)."""
+    per = len(blocks) // num_stages
+    proto_params = blocks[0].parameters()
+    stacked = []
+    for k, pp in enumerate(proto_params):
+        rows = []
+        for s in range(num_stages):
+            vals = [blocks[s * per + j].parameters()[k]._value
+                    for j in range(per)]
+            rows.append(jnp.stack(vals))
+        leaf = jnp.stack(rows)                       # [S, per, *shape]
+        spec = P()
+        shd = getattr(pp._value, "sharding", None)
+        if isinstance(shd, NamedSharding):
+            spec = shd.spec
+        full_spec = P(axis, None, *tuple(spec))
+        stacked.append(jax.device_put(leaf, NamedSharding(mesh, full_spec)))
+    return stacked
+
+
+def _acc_sharding(mesh, base_spec, shape, axis="sharding"):
+    """Sharding for an optimizer-state leaf: keep the parameter's placement
+    and additionally shard the largest free dim over the ZeRO `axis` (stage-1
+    optimizer-state sharding, sharding_opt.py's policy lifted to stacked
+    pipeline leaves)."""
+    dims = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    n = mesh.shape.get(axis, 1)
+    if n > 1:
+        used = set()
+        for d in dims:
+            if isinstance(d, tuple):
+                used.update(d)
+            elif d is not None:
+                used.add(d)
+        if axis not in used:
+            for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+                if dims[i] is None and shape[i] % n == 0 and shape[i] >= n:
+                    dims[i] = axis
+                    break
+    return NamedSharding(mesh, P(*dims))
+
+
+class PipelineTrainStep:
+    """Fully-fused pipeline-parallel training step (fwd+bwd+optimizer in one
+    jitted program), the pipe-axis sibling of paddle_tpu.jit.TrainStep.
+
+    layers: a PipelineLayer or a flat list of nn.Layer executed sequentially.
+    The longest run of identical layers is pipelined over the mesh "pipe"
+    axis; everything before/after runs replicated (prologue/epilogue) under
+    normal auto sharding. Weight tying between prologue and epilogue (e.g.
+    GPT's tied wte/lm_head) is handled by parameter identity: a shared
+    Parameter is a single leaf and its gradients accumulate through jax AD.
+    """
+
+    def __init__(self, layers, loss_fn, optimizer, *, mesh=None,
+                 num_microbatches=1, axis="pipe", remat=True):
+        from .pp_layers import PipelineLayer
+        if isinstance(layers, PipelineLayer):
+            flat = [l for stage in layers._stage_layers for l in stage]
+            if loss_fn is None:
+                loss_fn = layers._loss_fn
+        else:
+            flat = list(layers)
+        if mesh is None:
+            from ...mesh import get_global_mesh
+            mesh = get_global_mesh()
+        self.mesh = mesh
+        self.axis = axis
+        self.num_stages = mesh.shape[axis]
+        self.num_microbatches = num_microbatches
+        if num_microbatches < self.num_stages:
+            raise ValueError(
+                f"num_microbatches ({num_microbatches}) must be >= pipeline "
+                f"stages ({self.num_stages}) for a useful schedule")
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._remat = remat
+        self._flat = flat
+        self._jitted = None
+
+    # -- construction -----------------------------------------------------
+    def _build(self):
+        S = self.num_stages
+        flat = self._flat
+        start, count = find_block_run(flat, S)
+        self._blocks = flat[start:start + count]
+        pre_layers = flat[:start]
+        post_layers = flat[start + count:]
+        per = count // S
+        self._per_stage = per
+
+        # outer (non-pipelined) params, deduped by identity so tied weights
+        # are a single leaf
+        outer, seen = [], set()
+        for l in pre_layers + post_layers:
+            for p in l.parameters():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    outer.append(p)
+        self._outer_params = outer
+        proto = self._blocks[0]
+        self._proto_params = proto.parameters()
+
+        opt = self.optimizer
+        if getattr(opt, "_multi_precision", False):
+            raise NotImplementedError(
+                "multi_precision optimizers not supported in "
+                "PipelineTrainStep yet")
+
+        # stacked block params [S, per, ...] over the pipe axis
+        self._stacked = stack_stage_params(self._blocks, S, self.mesh,
+                                           self.axis)
+
+        # accumulators: probe shapes/dtypes with the real (un-stacked) params
+        probe = [p for p in outer + self._proto_params if not p.stop_gradient]
+        opt._create_accumulators(probe)
+        acc_names = sorted(opt._accumulators.keys())
+        acc_names = [n for n in acc_names if opt._accumulators[n]]
+        self._acc_names = acc_names
+
+        def acc_like(p, leaf_val):
+            out = []
+            for n in acc_names:
+                a = opt._accumulators[n][p.name]
+                out.append(jnp.zeros(leaf_val.shape[:len(leaf_val.shape) -
+                                                    len(a.shape)] + a.shape,
+                                     a.dtype))
+            return out
+
+        def spec_of(val):
+            shd = getattr(val, "sharding", None)
+            return tuple(shd.spec) if isinstance(shd, NamedSharding) else ()
+
+        # accumulators inherit the param placement plus ZeRO-1 sharding of
+        # the largest free dim over the "sharding" axis
+        self._outer_accs = [
+            [jax.device_put(a, _acc_sharding(self.mesh, spec_of(p._value),
+                                             a.shape))
+             for a in acc_like(p, p._value)]
+            for p in outer if not p.stop_gradient]
+        self._stacked_accs = [
+            [jax.device_put(a, _acc_sharding(self.mesh, spec_of(leaf),
+                                             a.shape))
+             for a in acc_like(pp, leaf)]
+            for pp, leaf in zip(self._proto_params, self._stacked)
+            if not pp.stop_gradient]
+
+        loss_fn = self.loss_fn
+        mesh, axis, M = self.mesh, self.axis, self.num_microbatches
+
+        def swap_apply(layers, params, pvals, x):
+            saved = [p._value for p in params]
+            try:
+                for p, v in zip(params, pvals):
+                    p._value = v
+                out = x if isinstance(x, Tensor) else Tensor(
+                    x, stop_gradient=True)
+                with set_grad_enabled(False):
+                    for l in layers:
+                        out = l(out)
+                return out._value
+            finally:
+                for p, v in zip(params, saved):
+                    p._value = v
+
+        def block_apply(pvals, x, k=None):
+            # the key is an explicit argument so jax.checkpoint's recompute
+            # trace sees the same randomness as the forward trace
+            if k is None:
+                return swap_apply([proto], self._proto_params, pvals, x)
+            with _random.tracing_key_scope(k):
+                return swap_apply([proto], self._proto_params, pvals, x)
+
+        if self._remat:
+            block_apply = jax.checkpoint(block_apply)
+
+        def stage_fn(stage_leaves, x, k=None):
+            for j in range(per):
+                kj = None if k is None else jax.random.fold_in(k, j)
+                x = block_apply([leaf[j] for leaf in stage_leaves], x, kj)
+            return x
+
+        outer_trainable = [p for p in outer if not p.stop_gradient]
+        proto_trainable_ix = [k for k, p in enumerate(self._proto_params)
+                              if not p.stop_gradient]
+
+        def loss_of(outer_vals, stacked_vals, x, y, key):
+            with _random.tracing_key_scope(key):
+                h = swap_apply(pre_layers, outer, outer_vals, x)
+                mb_shape = (M, h.shape[0] // M) + h.shape[1:]
+                hm = jnp.reshape(h, mb_shape)
+                ym = spmd_pipeline(stage_fn, stacked_vals, hm,
+                                   mesh=mesh, axis=axis,
+                                   key=jax.random.fold_in(key, 0x5049))
+                h2 = jnp.reshape(ym, h.shape[:1] + ym.shape[2:])
+                out = swap_apply(post_layers, outer, outer_vals, h2)
+                loss = loss_fn(Tensor(out, stop_gradient=True),
+                               Tensor(y, stop_gradient=True))
+                return loss._value
+
+        acc_names_l = acc_names
+
+        def apply_updates(pvals, grads, accs, lr, step_count, names,
+                          stacked=False):
+            new_p, new_a = [], []
+            # bake AdamW decay flags in call order
+            if hasattr(opt, "_decay_skip"):
+                opt._current_decay_flags = [n not in opt._decay_skip
+                                            for n in names]
+            elif hasattr(opt, "_decay_flags"):
+                opt._current_decay_flags = [opt._decay_flags.get(n, True)
+                                            for n in names]
+            for pv, gv, ac in zip(pvals, grads, accs):
+                acc_dict = dict(zip(acc_names_l, ac))
+                if stacked:
+                    # per-block update: vmap over the (S, per) leading dims
+                    # so norm-based optimizers (Lamb/Lars) see one block's
+                    # parameter at a time, exactly as un-stacked training
+                    def upd(pv_, gv_, ad_):
+                        return opt._single_update(pv_, gv_, ad_, lr,
+                                                  step_count)
+                    np_, na_ = jax.vmap(jax.vmap(upd))(pv, gv, acc_dict)
+                else:
+                    np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
+                                                  step_count)
+                new_p.append(np_)
+                new_a.append([na_[n] for n in acc_names_l])
+            return new_p, new_a
+
+        outer_names = [p.name for p in outer_trainable]
+        block_names = [self._proto_params[k].name for k in proto_trainable_ix]
+
+        def step(outer_vals, stacked_vals, outer_accs, stacked_accs,
+                 x, y, lr, step_count, key):
+            def closure(train_outer, train_stacked):
+                full_outer, ti = [], 0
+                for p, v in zip(outer, outer_vals):
+                    if p.stop_gradient:
+                        full_outer.append(v)
+                    else:
+                        full_outer.append(train_outer[ti])
+                        ti += 1
+                full_stacked, ti = [], 0
+                for k, v in enumerate(stacked_vals):
+                    if k in proto_trainable_ix:
+                        full_stacked.append(train_stacked[ti])
+                        ti += 1
+                    else:
+                        full_stacked.append(v)
+                return loss_of(full_outer, full_stacked, x, y, key)
+
+            t_outer = [v for p, v in zip(outer, outer_vals)
+                       if not p.stop_gradient]
+            t_stacked = [stacked_vals[k] for k in proto_trainable_ix]
+            loss, (g_outer, g_stacked) = jax.value_and_grad(
+                closure, argnums=(0, 1))(t_outer, t_stacked)
+            new_outer, new_oaccs = apply_updates(
+                t_outer, g_outer, outer_accs, lr, step_count, outer_names)
+            new_stacked, new_saccs = apply_updates(
+                t_stacked, g_stacked, stacked_accs, lr, step_count,
+                block_names, stacked=True)
+            # reassemble full lists with frozen params untouched
+            out_outer, ti = [], 0
+            for p, v in zip(outer, outer_vals):
+                if p.stop_gradient:
+                    out_outer.append(v)
+                else:
+                    out_outer.append(new_outer[ti])
+                    ti += 1
+            out_stacked, ti = [], 0
+            for k, v in enumerate(stacked_vals):
+                if k in proto_trainable_ix:
+                    out_stacked.append(new_stacked[ti])
+                    ti += 1
+                else:
+                    out_stacked.append(v)
+            return loss, out_outer, out_stacked, new_oaccs, new_saccs
+
+        # donate accumulators only: params are aliased by live eager
+        # Parameter wrappers on the first step (same policy as TrainStep)
+        self._jitted = jax.jit(step, donate_argnums=(2, 3))
+        self._outer_vals = [p._value for p in outer]
+
+    # -- execution --------------------------------------------------------
+    def __call__(self, x, y):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        if self._jitted is None:
+            self._build()
+        if xv.shape[0] % self.num_microbatches != 0:
+            raise ValueError(
+                f"batch {xv.shape[0]} not divisible by num_microbatches "
+                f"{self.num_microbatches}")
+        opt = self.optimizer
+        if not hasattr(opt, "_step_count"):
+            opt._step_count = 0
+        opt._step_count += 1
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        sc = jnp.asarray(opt._step_count, jnp.int32)
+        key = _random.get_rng_key()
+        loss, self._outer_vals, self._stacked, self._outer_accs, \
+            self._stacked_accs = self._jitted(
+                self._outer_vals, self._stacked, self._outer_accs,
+                self._stacked_accs, xv, yv, lr, sc, key)
+        return Tensor(loss, stop_gradient=True)
+
+    def sync_to_model(self):
+        """Write the step's state back into the wrapper Parameters AND the
+        optimizer's accumulator dict, so eager inspection (state_dict,
+        p.numpy(), optimizer.state_dict for checkpointing) sees current
+        values."""
+        for p, v in zip(self._outer_params, self._outer_vals):
+            p._value = v
+        per = self._per_stage
+        for k, leaf in enumerate(self._stacked):
+            for s in range(self.num_stages):
+                for j in range(per):
+                    blk = self._blocks[s * per + j]
+                    blk.parameters()[k]._value = leaf[s, j]
+        opt = self.optimizer
+        names = self._acc_names
+        t_outer = [p for p in self._outer_params if not p.stop_gradient]
+        for p, accs in zip(t_outer, self._outer_accs):
+            for n, a in zip(names, accs):
+                opt._accumulators[n][p.name] = a
+        trainable_ix = [k for k, pp in enumerate(self._proto_params)
+                        if not pp.stop_gradient]
+        for k, accs in zip(trainable_ix, self._stacked_accs):
+            for n, a in zip(names, accs):
+                for s in range(self.num_stages):
+                    for j in range(per):
+                        blk_p = self._blocks[s * per + j].parameters()[k]
+                        opt._accumulators[n][blk_p.name] = a[s, j]

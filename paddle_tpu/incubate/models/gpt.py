@@ -34,7 +34,8 @@ from ...ops import manipulation as manip
 from ...framework.core import Tensor
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
-           "gpt2_124m", "gpt3_1p3b", "gpt3_6p7b", "shard_gpt"]
+           "gpt2_124m", "gpt3_1p3b", "gpt3_6p7b", "shard_gpt",
+           "GPTEmbeddingPipe", "GPTHeadPipe", "gpt_pipeline_layers"]
 
 
 @dataclass
@@ -301,3 +302,52 @@ def shard_gpt(model: GPTForCausalLM, mesh, dtype=None):
                 break
         put(p, spec if spec is not None else P())
     return model
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel decomposition
+# ---------------------------------------------------------------------------
+
+class GPTEmbeddingPipe(Layer):
+    """Prologue stage: token + position embedding (shares the model's
+    wte/wpe/drop sublayers). Reference analog: the embedding LayerDesc in the
+    reference GPT pipeline models (fleet pp_layers SharedLayerDesc for tied
+    embeddings)."""
+
+    def __init__(self, model: "GPTForCausalLM"):
+        super().__init__()
+        self.wte = model.gpt.wte
+        self.wpe = model.gpt.wpe
+        self.drop = model.gpt.drop
+
+    def forward(self, input_ids):
+        n = input_ids.shape[1]
+        pos = Tensor(jnp.arange(0, n, dtype=jnp.int32)[None, :],
+                     stop_gradient=True)
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTHeadPipe(Layer):
+    """Epilogue stage: final LayerNorm + (tied) LM head. The tied wte weight
+    is the SAME Parameter object as the embedding's — PipelineTrainStep
+    dedupes by identity so its gradient accumulates from both uses."""
+
+    def __init__(self, model: "GPTForCausalLM"):
+        super().__init__()
+        self.ln_f = model.gpt.ln_f
+        self.lm_head = model.lm_head
+        self._wte = model.gpt.wte
+
+    def forward(self, x):
+        h = self.ln_f(x)
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return F.linear(h, manip.transpose(self._wte.weight, [1, 0]))
+
+
+def gpt_pipeline_layers(model: "GPTForCausalLM"):
+    """Flatten a GPTForCausalLM into the sequential layer list consumed by
+    PipelineTrainStep: [embedding, block*L, ln_f+head]. The transformer
+    blocks form the homogeneous run that gets sharded over the "pipe" axis."""
+    return ([GPTEmbeddingPipe(model)] + list(model.gpt.h)
+            + [GPTHeadPipe(model)])
