@@ -41,8 +41,8 @@ def main():
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     seq = 1024
-    # batch sweep on v5e (2026-07): 8 -> 85.6k, 16 -> 87.9k, 24 -> 80.9k
-    # tok/s; 16 is the HBM/arithmetic-intensity sweet spot
+    # batch sweep on v5e with the Pallas flash fwd+bwd path (2026-07):
+    # 8 -> 108.7k, 16 -> 111.5k, 24 -> 110.8k, 32 -> 103.8k tok/s
     batch = 16 if on_tpu else 2
     steps = 10 if on_tpu else 2
 
@@ -57,7 +57,8 @@ def main():
                                  parameters=model.parameters(),
                                  multi_precision=on_tpu)
     criterion = GPTPretrainingCriterion()
-    step = TrainStep(model, lambda logits, y: criterion(logits, y), opt)
+    step = TrainStep(model, lambda logits, y: criterion(logits, y), opt,
+                     donate="all")
 
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)

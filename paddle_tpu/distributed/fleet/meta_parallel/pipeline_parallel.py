@@ -42,6 +42,17 @@ class PipelineParallel(Layer):
         self.num_stages = layers.get_num_stages()
         self.total_loss = None
         self._spmd_step = None
+        self._spmd_key = None
+        self._needs_sync = False
+
+    def _sync_if_needed(self):
+        if self._needs_sync and self._spmd_step is not None:
+            self._spmd_step.sync_to_model()
+            self._needs_sync = False
+
+    def state_dict(self, *args, **kwargs):
+        self._sync_if_needed()
+        return super().state_dict(*args, **kwargs)
 
     def _mesh_pipe_degree(self):
         from ...mesh import get_global_mesh
@@ -108,17 +119,22 @@ class PipelineParallel(Layer):
                          and len(data) == 2)
         if spmd_eligible:
             self._layers.train()     # trace in train mode (dropout on)
-            if self._spmd_step is None:
+            num_micro = max(self.accumulate_steps, self._mesh_pipe_degree())
+            step_key = (id(optimizer), num_micro)
+            if self._spmd_step is None or self._spmd_key != step_key:
+                if self._spmd_step is not None:
+                    self._spmd_step.sync_to_model()   # hand off prior state
                 from .spmd_pipeline import PipelineTrainStep
                 self._spmd_step = PipelineTrainStep(
                     self._layers, self._layers._loss_fn, optimizer,
-                    num_microbatches=max(self.accumulate_steps,
-                                         self._mesh_pipe_degree()))
+                    num_microbatches=num_micro)
+                self._spmd_key = step_key
             x, y = data
             loss = self._spmd_step(x, y)
-            # keep the eager model/optimizer observable (eval_batch,
-            # state_dict, checkpointing) in sync with the fused step
-            self._spmd_step.sync_to_model()
+            # sync back lazily: eval_batch/state_dict re-materialize the
+            # eager view; doing it every step would serialize thousands of
+            # small cross-device slices after the fused program
+            self._needs_sync = True
             if lr_scheduler is not None:
                 lr_scheduler.step()
             return loss.detach()
@@ -135,6 +151,7 @@ class PipelineParallel(Layer):
         return loss.detach()
 
     def eval_batch(self, data, compute_loss=True):
+        self._sync_if_needed()
         self._layers.eval()
         micro_batches = self._split_micro_batches(data)
         losses = []

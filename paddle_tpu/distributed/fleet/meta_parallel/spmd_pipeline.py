@@ -482,7 +482,9 @@ class PipelineTrainStep:
         t_outer = [p for p in self._outer_params if not p.stop_gradient]
         for p, accs in zip(t_outer, self._outer_accs):
             for n, a in zip(names, accs):
-                opt._accumulators[n][p.name] = a
+                # copy: the next jitted step donates self._outer_accs, which
+                # would leave the optimizer dict pointing at deleted buffers
+                opt._accumulators[n][p.name] = jnp.array(a, copy=True)
         trainable_ix = [k for k, pp in enumerate(self._proto_params)
                         if not pp.stop_gradient]
         for k, accs in zip(trainable_ix, self._stacked_accs):
