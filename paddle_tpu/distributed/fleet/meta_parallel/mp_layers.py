@@ -44,6 +44,15 @@ def _try_shard(param, spec):
 
 
 class VocabParallelEmbedding(Layer):
+    """Vocab-sharded embedding. Reference analog: mp_layers.py:37 over
+    operators/collective/c_embedding_op.cc — each rank holds a contiguous
+    vocab slice, looks up in-range ids locally (out-of-range rows produce
+    zeros), and the partial results are summed over the mp group.
+
+    Under pjit the P("model", None) weight placement lets the partitioner
+    derive that pattern; inside shard_map the explicit masked-lookup + psum
+    (exact c_embedding semantics) is emitted."""
+
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
                  mp_group=None, name=None):
         super().__init__()
@@ -55,7 +64,24 @@ class VocabParallelEmbedding(Layer):
         _try_shard(self.weight, P("model", None))
 
     def forward(self, x):
-        return F.embedding(x, self.weight)
+        if not in_spmd_axis():
+            return F.embedding(x, self.weight)
+        x = ensure_tensor(x)
+        ids = x._value.astype(jnp.int32)
+
+        def fn(w_local):
+            # inside shard_map the weight is this rank's vocab slice
+            # [V/n, D] (same contract as Column/RowParallelLinear): rank i
+            # owns rows [i*vshard, (i+1)*vshard)
+            idx = jax.lax.axis_index("model")
+            vshard = w_local.shape[0]
+            local = ids - idx * vshard
+            in_range = (local >= 0) & (local < vshard)
+            safe = jnp.clip(local, 0, vshard - 1)
+            out = jnp.take(w_local, safe, axis=0)
+            out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
+            return jax.lax.psum(out, "model")
+        return call_op("c_embedding", fn, (ensure_tensor(self.weight),))
 
 
 class ColumnParallelLinear(Layer):
@@ -159,7 +185,9 @@ class ParallelCrossEntropy(Layer):
             idx = jax.lax.axis_index("model")
             vshard = logits.shape[-1]
             local_max = jnp.max(logits, axis=-1, keepdims=True)
-            gmax = jax.lax.pmax(local_max, "model")
+            # the max-shift cancels in d(softmax-CE)/d(logits); pmax has no
+            # VJP rule, and none is needed — cut the tape before it
+            gmax = jax.lax.pmax(jax.lax.stop_gradient(local_max), "model")
             ex = jnp.exp(logits - gmax)
             denom = jax.lax.psum(jnp.sum(ex, axis=-1, keepdims=True), "model")
             # pick the target logit if it lives in this shard
@@ -174,7 +202,10 @@ class ParallelCrossEntropy(Layer):
             picked = jnp.where(in_range, picked, 0.0)
             picked = jax.lax.psum(picked, "model")
             loss = jnp.log(denom[..., 0]) - picked
-            return loss
+            # parity with the dense path: ignored labels contribute 0 loss
+            # (and therefore 0 gradient — loss is constant in logits there)
+            return jnp.where(lab == self.ignore_index,
+                             jnp.zeros_like(loss), loss)
         return call_op("parallel_cross_entropy", fn, (input,))
 
 

@@ -66,6 +66,14 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
     Everything happens inside one shard_map over only the pipe axis; other
     mesh axes (data/model/sharding) stay in auto mode so existing Megatron
     shardings on the stage parameters keep working inside each stage.
+
+    All shard_map inputs/outputs ride the pipe axis as `varying` values (x is
+    tiled over the axis, the output is the stacked per-stage buffer with the
+    last stage's slice selected OUTSIDE the shard_map): the program contains
+    no psum, so collecting the result is a copy off the last stage rather
+    than an all-reduce, and no AD transpose introduces one either (bf16
+    psum inside shard_map over a sub-axis of a multi-axis mesh also breaks
+    XLA:CPU float normalization, which the virtual-mesh tests would hit).
     """
     S = mesh.shape[axis]
     M = x.shape[0]
@@ -80,8 +88,9 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
             (jnp.arange(M), x))
     perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def per_device(params_local, x_full):
+    def per_device(params_local, x_local):
         my = tree_map(lambda l: jnp.squeeze(l, 0), params_local)
+        x_full = jnp.squeeze(x_local, 0)
         idx = lax.axis_index(axis)
 
         def body(carry, t):
@@ -106,19 +115,18 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
             state = lax.ppermute(out, axis, perm)
             return (state, outs), None
 
-        # the carry varies across the pipe axis from step 1 on; mark the
-        # zero-init as varying so scan's carry types line up
-        init = (lax.pcast(jnp.zeros_like(x_full[0]), axis, to="varying"),
-                lax.pcast(jnp.zeros_like(x_full), axis, to="varying"))
+        # the carry varies across the pipe axis from step 1 on; x_full is
+        # already varying (in_specs P(axis)), so zeros_like inherits it
+        init = (jnp.zeros_like(x_full[0]), jnp.zeros_like(x_full))
         (_, outs), _ = lax.scan(body, init, jnp.arange(M + S - 1))
-        # only the last stage's buffer is real; replicate it over the axis
-        outs = lax.psum(jnp.where(idx == S - 1, outs, jnp.zeros_like(outs)),
-                        axis)
-        return outs
+        return outs[None]
 
     mapped = jax.shard_map(per_device, mesh=mesh, axis_names={axis},
-                           in_specs=(P(axis), P()), out_specs=P())
-    return mapped(stage_params, x)
+                           in_specs=(P(axis), P(axis)), out_specs=P(axis))
+    x_tiled = jnp.broadcast_to(x[None], (S,) + x.shape)
+    stacked = mapped(stage_params, x_tiled)
+    # only the last stage's buffer is real: select it outside the shard_map
+    return lax.index_in_dim(stacked, S - 1, 0, keepdims=False)
 
 
 def find_block_run(layers, num_stages):
@@ -262,10 +270,6 @@ class PipelineTrainStep:
         self._proto_params = proto.parameters()
 
         opt = self.optimizer
-        if getattr(opt, "_multi_precision", False):
-            raise NotImplementedError(
-                "multi_precision optimizers not supported in "
-                "PipelineTrainStep yet")
 
         # stacked block params [S, per, ...] over the pipe axis
         self._stacked = stack_stage_params(self._blocks, S, self.mesh,
@@ -279,12 +283,22 @@ class PipelineTrainStep:
         self._acc_names = acc_names
 
         def acc_like(p, leaf_val):
+            # master_weight (multi_precision bf16 + f32 master, reference
+            # analog: master-weight handling in fluid/operators/optimizers/
+            # adamw_op + hybrid_parallel_optimizer.py:186) starts as the f32
+            # copy of the (possibly stacked) parameter, not zeros; params
+            # without a master entry (already f32) carry None.
             out = []
             for n in acc_names:
-                a = opt._accumulators[n][p.name]
-                out.append(jnp.zeros(leaf_val.shape[:len(leaf_val.shape) -
-                                                    len(a.shape)] + a.shape,
-                                     a.dtype))
+                a = opt._accumulators[n].get(p.name)
+                if a is None:
+                    out.append(None)
+                elif n == "master_weight":
+                    out.append(leaf_val.astype(jnp.float32))
+                else:
+                    out.append(jnp.zeros(leaf_val.shape[:len(leaf_val.shape) -
+                                                        len(a.shape)]
+                                         + a.shape, a.dtype))
             return out
 
         def spec_of(val):
@@ -293,15 +307,17 @@ class PipelineTrainStep:
 
         # accumulators inherit the param placement plus ZeRO-1 sharding of
         # the largest free dim over the "sharding" axis
+        def place_accs(alist, base_spec):
+            return [a if a is None else
+                    jax.device_put(a, _acc_sharding(self.mesh, base_spec,
+                                                    a.shape))
+                    for a in alist]
+
         self._outer_accs = [
-            [jax.device_put(a, _acc_sharding(self.mesh, spec_of(p._value),
-                                             a.shape))
-             for a in acc_like(p, p._value)]
+            place_accs(acc_like(p, p._value), spec_of(p._value))
             for p in outer if not p.stop_gradient]
         self._stacked_accs = [
-            [jax.device_put(a, _acc_sharding(self.mesh, spec_of(leaf),
-                                             a.shape))
-             for a in acc_like(pp, leaf)]
+            place_accs(acc_like(pp, leaf), spec_of(leaf))
             for pp, leaf in zip(self._proto_params, self._stacked)
             if not pp.stop_gradient]
 
@@ -384,7 +400,7 @@ class PipelineTrainStep:
                     np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
                                                   step_count)
                 new_p.append(np_)
-                new_a.append([na_[n] for n in acc_names_l])
+                new_a.append([na_.get(n) for n in acc_names_l])
             return new_p, new_a
 
         outer_names = [p.name for p in outer_trainable]
@@ -482,6 +498,8 @@ class PipelineTrainStep:
         t_outer = [p for p in self._outer_params if not p.stop_gradient]
         for p, accs in zip(t_outer, self._outer_accs):
             for n, a in zip(names, accs):
+                if a is None:
+                    continue
                 # copy: the next jitted step donates self._outer_accs, which
                 # would leave the optimizer dict pointing at deleted buffers
                 opt._accumulators[n][p.name] = jnp.array(a, copy=True)
@@ -489,6 +507,8 @@ class PipelineTrainStep:
                         if not pp.stop_gradient]
         for k, accs in zip(trainable_ix, self._stacked_accs):
             for n, a in zip(names, accs):
+                if a is None:
+                    continue
                 for s in range(self.num_stages):
                     for j in range(per):
                         blk_p = self._blocks[s * per + j].parameters()[k]

@@ -1,4 +1,6 @@
-"""Optimizer-state sharding (ZeRO stage 1/2) over the mesh "sharding" axis.
+"""Optimizer-state sharding (ZeRO stage 1) over the mesh "sharding" axis.
+Stages 2/3 (grad + parameter sharding) layer on top of this in
+paddle_tpu.distributed.sharding.group_sharded_parallel.
 
 Reference analog: fleet/meta_optimizers/dygraph_optimizer/
 dygraph_sharding_optimizer.py:28 (DygraphShardingOptimizer: each rank owns a

@@ -87,7 +87,9 @@ class TrainStep:
                 acc_dict = dict(zip(acc_names, ac))
                 np_, na_ = opt._single_update(pv, gv, acc_dict, lr, step_count)
                 new_p.append(np_)
-                new_accs.append([na_[n] for n in acc_names])
+                # .get: f32 params have no master_weight entry under
+                # multi_precision
+                new_accs.append([na_.get(n) for n in acc_names])
             return loss, new_p, new_accs, new_b
 
         # donate accumulators by default; donating params would invalidate
@@ -112,7 +114,7 @@ class TrainStep:
         opt._step_count += 1
 
         pvals = [p._value for p in params]
-        accs = [[opt._accumulators[n][p.name] for n in acc_names]
+        accs = [[opt._accumulators[n].get(p.name) for n in acc_names]
                 for p in params]
         bvals = [b._value for b in self._buffers]
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -125,7 +127,8 @@ class TrainStep:
             p._value = v
         for p, ac in zip(params, new_accs):
             for n, v in zip(acc_names, ac):
-                opt._accumulators[n][p.name] = v
+                if v is not None:
+                    opt._accumulators[n][p.name] = v
         for b, v in zip(self._buffers, new_b):
             b._value = v
         return Tensor(loss)

@@ -111,6 +111,8 @@ class Optimizer:
 
         pvals = [p._value for p, _ in params_grads]
         gvals = [g._value for _, g in params_grads]
+        # .get: a param can lack an entry for some accumulator (e.g. no
+        # master_weight for params already f32 under multi_precision)
         accs = [[self._accumulators[n].get(p.name) for n in acc_names]
                 for p, _ in params_grads]
 
@@ -128,7 +130,7 @@ class Optimizer:
                     acc_dict = dict(zip(acc_names, ac))
                     np_, na_ = single(pv, gv, acc_dict, lr, step_count)
                     new_p.append(np_)
-                    new_a.append([na_[n] for n in acc_names])
+                    new_a.append([na_.get(n) for n in acc_names])
                 return new_p, new_a
 
             # only accumulator buffers are donated: param buffers may be
@@ -141,7 +143,8 @@ class Optimizer:
         for (p, _), npv, nac in zip(params_grads, new_pvals, new_accs):
             p._value = npv
             for n, v in zip(acc_names, nac):
-                self._accumulators[n][p.name] = v
+                if v is not None:
+                    self._accumulators[n][p.name] = v
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

@@ -1,0 +1,154 @@
+"""Per-primitive collective matrix on the 8-device mesh.
+
+Reference analog: the unittests/collective/ per-op scripts
+(collective_allreduce_api.py, collective_allgather_api.py,
+collective_reduce_scatter_api.py, collective_alltoall_api.py,
+collective_sendrecv_api.py ...) — one focused correctness check per
+communication primitive, here against the XLA collectives that implement
+them on the ICI mesh (SURVEY §2.5 "c_* ops ≙ lax collectives").
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+N = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("g",))
+
+
+def _vals():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.normal(size=(N, 4)), jnp.float32)
+
+
+def _run(body, x, out_specs=P("g")):
+    return jax.shard_map(body, mesh=_mesh(), in_specs=P("g"),
+                         out_specs=out_specs)(x)
+
+
+class TestSPMDPrimitives:
+    def test_all_reduce_sum(self):
+        x = _vals()
+        out = _run(lambda v: lax.psum(v, "g"), x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(x.sum(0), (N, 1)), rtol=1e-6)
+
+    def test_all_reduce_max(self):
+        x = _vals()
+        out = _run(lambda v: lax.pmax(v, "g"), x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(x.max(0), (N, 1)), rtol=1e-6)
+
+    def test_all_reduce_mean(self):
+        x = _vals()
+        out = _run(lambda v: lax.pmean(v, "g"), x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.tile(x.mean(0), (N, 1)), rtol=1e-6)
+
+    def test_all_gather(self):
+        x = _vals()
+        out = _run(lambda v: lax.all_gather(v, "g", tiled=True)[None], x,
+                   out_specs=P("g"))
+        # every rank sees the full concatenation
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(out[r]), np.asarray(x),
+                                       rtol=1e-6)
+
+    def test_reduce_scatter(self):
+        """psum_scatter: rank i owns the i-th chunk of the sum."""
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(N, N)),
+                        jnp.float32)
+        out = _run(lambda v: lax.psum_scatter(v, "g", scatter_dimension=1,
+                                              tiled=True), x)
+        ref = x.sum(0)  # [N]; rank i gets element i
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   np.asarray(ref), rtol=1e-5)
+
+    def test_alltoall(self):
+        """all_to_all transposes the (rank, chunk) layout."""
+        x = jnp.arange(N * N, dtype=jnp.float32).reshape(N, N)
+        out = _run(lambda v: lax.all_to_all(v, "g", split_axis=1,
+                                            concat_axis=1, tiled=True), x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x).T)
+
+    def test_ppermute_ring(self):
+        """ppermute one hop around the ring — the pipeline handoff / p2p
+        send-recv primitive."""
+        x = _vals()
+        perm = [(i, (i + 1) % N) for i in range(N)]
+        out = _run(lambda v: lax.ppermute(v, "g", perm), x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.roll(np.asarray(x), 1, axis=0),
+                                   rtol=1e-6)
+
+    def test_ppermute_send_recv_pair(self):
+        """A single (src->dst) edge: dst receives src's value, everyone else
+        receives zeros — point-to-point send/recv semantics."""
+        x = _vals()
+        out = _run(lambda v: lax.ppermute(v, "g", [(2, 5)]), x)
+        got = np.asarray(out)
+        np.testing.assert_allclose(got[5], np.asarray(x)[2], rtol=1e-6)
+        for r in range(N):
+            if r != 5:
+                np.testing.assert_allclose(got[r], 0.0)
+
+    def test_broadcast_from_src(self):
+        x = _vals()
+        out = _run(lambda v: lax.all_gather(v, "g")[3], x)
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(out[r]),
+                                       np.asarray(x)[3], rtol=1e-6)
+
+    def test_axis_index(self):
+        out = _run(lambda v: v * 0 + lax.axis_index("g"), _vals())
+        for r in range(N):
+            assert np.all(np.asarray(out[r]) == r)
+
+
+class TestEagerCollectiveAPI:
+    """paddle.distributed.* eager entry points (single-controller mode)."""
+
+    def test_all_reduce(self):
+        t = paddle.Tensor(jnp.ones((4,), jnp.float32))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(np.asarray(t._value), 1.0)
+
+    def test_all_gather(self):
+        out = []
+        t = paddle.Tensor(jnp.arange(4, dtype=jnp.float32))
+        dist.all_gather(out, t)
+        assert len(out) == 1
+        np.testing.assert_allclose(np.asarray(out[0]._value),
+                                   np.arange(4, dtype=np.float32))
+
+    def test_reduce_scatter(self):
+        dst = paddle.Tensor(jnp.zeros((4,), jnp.float32))
+        parts = [paddle.Tensor(jnp.full((4,), float(i)))
+                 for i in range(2)]
+        dist.reduce_scatter(dst, parts)
+        np.testing.assert_allclose(np.asarray(dst._value), 1.0)
+
+    def test_broadcast(self):
+        t = paddle.Tensor(jnp.full((3,), 7.0))
+        dist.broadcast(t, src=0)
+        np.testing.assert_allclose(np.asarray(t._value), 7.0)
+
+    def test_send_recv_roundtrip(self):
+        src = paddle.Tensor(jnp.asarray([1.0, 2.0, 3.0]))
+        dst = paddle.Tensor(jnp.zeros((3,)))
+        dist.send(src, dst=0)
+        dist.recv(dst, src=0)
+        np.testing.assert_allclose(np.asarray(dst._value),
+                                   np.asarray(src._value))
+
+    def test_barrier_and_group(self):
+        dist.barrier()
+        g = dist.get_group(0)
+        assert g is not None and g.nranks >= 1

@@ -175,3 +175,56 @@ def test_lars_trains_and_excludes_bias_decay():
         losses.append(float(loss))
     assert losses[-1] < losses[0]
     assert np.isfinite(losses).all()
+
+
+def test_multi_precision_mixed_dtype_params():
+    """multi_precision with a model mixing bf16 and f32 params: only bf16
+    params carry a master_weight; the eager step must not require one for
+    every param (regression: KeyError 'master_weight')."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 4))
+    # half the params to bf16, the rest stay f32 (the keep-norms-in-f32
+    # recipe)
+    for p in model[0].parameters():
+        p._value = p._value.astype(jnp.bfloat16)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 4, 16).astype(np.int64))
+    import paddle_tpu.nn.functional as F
+    losses = []
+    for _ in range(5):
+        loss = F.cross_entropy(model(x.astype("bfloat16")), y)
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # masters exist exactly for the bf16 params
+    masters = opt._accumulators["master_weight"]
+    bf16_names = {p.name for p in model[0].parameters()}
+    assert set(masters.keys()) == bf16_names
+
+
+def test_multi_precision_mixed_dtype_train_step():
+    """Same regression through the fused TrainStep."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 4))
+    for p in model[0].parameters():
+        p._value = p._value.astype(jnp.bfloat16)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    step = TrainStep(model, lambda o, t: F.cross_entropy(o, t), opt)
+    x = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32)) \
+        .astype("bfloat16")
+    y = paddle.to_tensor(np.random.randint(0, 4, 16).astype(np.int64))
+    losses = [float(step(x, y)) for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

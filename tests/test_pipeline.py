@@ -251,6 +251,67 @@ class TestPipelineTraining:
                 num_microbatches=2)
 
 
+def _train_losses_bf16_mp(pp, steps=5, num_micro=4, lr=1e-2):
+    """bf16 weights + f32 master AdamW (multi_precision) — the BASELINE
+    config-4 recipe — either single-device (pp=1) or pipelined."""
+    if pp == 1:
+        set_global_mesh(build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1,
+                                   devices=jax.devices()[:1]))
+    else:
+        set_global_mesh(build_mesh(dp=8 // pp, pp=pp, sharding=1, sep=1,
+                                   mp=1, devices=jax.devices()[:8]))
+    paddle.seed(0)
+    model = GPTForCausalLM(tiny_cfg())
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    crit = GPTPretrainingCriterion()
+    if pp == 1:
+        step = TrainStep(model, lambda o, y: crit(o, y), opt)
+    else:
+        step = PipelineTrainStep(gpt_pipeline_layers(model), crit, opt,
+                                 num_microbatches=num_micro)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+    losses = [float(step(ids, labels)) for _ in range(steps)]
+    return losses, step, model
+
+
+class TestPipelineMultiPrecision:
+    def test_pp2_bf16_master_matches_single_device(self):
+        """multi_precision (bf16 weights + f32 master) through the pipeline
+        matches single-device multi_precision training. Reference analog:
+        hybrid_parallel_optimizer.py:186 master-weight path."""
+        ref, _, _ = _train_losses_bf16_mp(pp=1)
+        got, _, _ = _train_losses_bf16_mp(pp=2)
+        np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+        assert got[-1] < got[0]
+
+    def test_master_weights_stay_f32_params_stay_bf16(self):
+        _, step, model = _train_losses_bf16_mp(pp=2, steps=2)
+        assert "master_weight" in step._acc_names
+        mw_ix = step._acc_names.index("master_weight")
+        n_master = 0
+        for accs in step._stacked_accs:
+            a = accs[mw_ix]
+            if a is not None:
+                assert a.dtype == jnp.float32
+                n_master += 1
+        assert n_master > 0
+        step.sync_to_model()
+        for p in model.parameters():
+            assert p._value.dtype == jnp.bfloat16
+
+    def test_master_weight_drives_update_precision(self):
+        """With lr small enough that bf16 rounding would swallow updates,
+        the f32 master still accumulates them (the whole point of
+        multi_precision)."""
+        losses, step, _ = _train_losses_bf16_mp(pp=2, steps=8, lr=2e-3)
+        assert losses[-1] < losses[0]
+
+
 class TestPipelineParallelAPI:
     def test_train_batch_uses_spmd_pipeline(self):
         """The reference-parity PipelineParallel.train_batch rides the SPMD
