@@ -21,8 +21,19 @@ _SRC_FILES = ("tcp_store.cc", "workqueue.cc", "host_tracer.cc",
 
 
 def _csrc_dir():
-    return os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))), "csrc")
+    """csrc/ in the source tree (repo root) or bundled in the wheel
+    (paddle_tpu/csrc, packaged by setup.py)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_csrc = os.path.join(os.path.dirname(pkg), "csrc")
+    if os.path.isdir(repo_csrc):
+        return repo_csrc
+    return os.path.join(pkg, "csrc")
+
+
+def _prebuilt_path():
+    """Wheel builds ship the compiled library next to this module."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libpaddle_tpu_core.so")
 
 
 def _cache_dir():
@@ -41,7 +52,11 @@ def _needs_rebuild(lib_path, sources):
 
 
 def build_library(verbose=False):
-    """Compile csrc/*.cc into a shared library; returns path or None."""
+    """Compile csrc/*.cc into a shared library; returns path or None.
+    A library prebuilt by the wheel (setup.py BuildNative) wins outright."""
+    pre = _prebuilt_path()
+    if os.path.exists(pre):
+        return pre
     csrc = _csrc_dir()
     sources = [os.path.join(csrc, f) for f in _SRC_FILES]
     if not all(os.path.exists(s) for s in sources):

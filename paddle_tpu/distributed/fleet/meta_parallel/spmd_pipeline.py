@@ -478,6 +478,13 @@ class PipelineTrainStep:
             self._stacked_accs = self._jitted(
                 self._outer_vals, self._stacked, self._outer_accs,
                 self._stacked_accs, xv, yv, lr, sc, key)
+        from ....framework.flags import _FLAGS
+        if _FLAGS.get("FLAGS_check_nan_inf") and \
+                not bool(jnp.isfinite(loss)):
+            raise FloatingPointError(
+                "PipelineTrainStep produced a non-finite loss "
+                "(FLAGS_check_nan_inf); the step's updates were already "
+                "applied to the stacked stage state")
         return Tensor(loss, stop_gradient=True)
 
     def sync_to_model(self):

@@ -90,7 +90,7 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "edges", "out_avals", "pending",
-                 "out_hooks", "retain_count")
+                 "out_hooks", "retain_count", "fwd_fn", "in_vals")
 
     def __init__(self, name, vjp_fn, edges, out_avals):
         self.name = name
@@ -102,6 +102,12 @@ class GradNode:
         self.pending = {}       # out_index -> accumulated incoming grad
         self.out_hooks = {}     # out_index -> [callable]
         self.retain_count = 0
+        # recorded forward (pure fn over full input values) + the input
+        # values themselves: lets grad(create_graph=True) re-derive the
+        # whole subgraph functionally (higher-order AD by replay, the
+        # TPU-first analog of eager/general_grad.h double-grad nodes)
+        self.fwd_fn = None
+        self.in_vals = None
 
     # -- engine interface ---------------------------------------------------
     def add_grad(self, out_index: int, g):
@@ -132,6 +138,15 @@ class GradNode:
     def release(self):
         self.vjp_fn = None
         self.pending = {}
+        # free the recorded forward too — after a non-retained backward the
+        # graph is spent (same contract as the vjp residuals). The sentinel
+        # distinguishes "spent" from "never recorded" (PyLayer/to_static)
+        # so replay errors point at the real cause.
+        self.fwd_fn = _RELEASED
+        self.in_vals = None
+
+
+_RELEASED = object()
 
 
 class AccumulationNode(GradNode):
@@ -225,24 +240,192 @@ def run_backward(root_node: GradNode, root_index: int, seed_grad,
                 ready.append(nxt)
 
 
+def _reachable_nodes(outputs):
+    """(ids, nodes) of all GradNodes reachable from the outputs' nodes."""
+    seen, nodes, q = set(), [], deque()
+    for out in outputs:
+        node = out._grad_node
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            nodes.append(node)
+            q.append(node)
+    while q:
+        node = q.popleft()
+        for edge in node.edges:
+            if edge is not None and id(edge[0]) not in seen:
+                seen.add(id(edge[0]))
+                nodes.append(edge[0])
+                q.append(edge[0])
+    return seen, nodes
+
+
+def replay_pure(outputs, inputs):
+    """Build a PURE function F(*input_values) -> tuple(output_values) by
+    replaying the recorded op graph between `inputs` and `outputs`.
+
+    This is the TPU-first route to higher-order autograd: instead of taping
+    backward ops as the reference's double-grad nodes do
+    (eager/general_grad.h), the captured graph is re-derived as one jax
+    function, so any jax transform (vjp for double grad, jvp for
+    forward-over-reverse) applies to it — and everything XLA-compiles.
+    """
+    import sys
+
+    in_keys = [(id(t._ensure_grad_node()
+                   if t._grad_node is None else t._grad_node), t._out_index)
+               for t in inputs]
+
+    def F(*in_vals):
+        env = dict(zip(in_keys, in_vals))
+        memo = {}
+
+        def value_of(node, out_idx):
+            key = (id(node), out_idx)
+            if key in env:
+                return env[key]
+            if isinstance(node, AccumulationNode):
+                t = node.tensor_ref()
+                if t is None:
+                    raise RuntimeError(
+                        "a leaf tensor of the recorded graph was freed; "
+                        "cannot replay for create_graph")
+                return t._value
+            return compute(node)[out_idx]
+
+        def compute(node):
+            outs = memo.get(id(node))
+            if outs is not None:
+                return outs
+            if node.fwd_fn is _RELEASED:
+                raise RuntimeError(
+                    f"op '{node.name}' was released (backward already ran "
+                    "without retain_graph); cannot replay for create_graph")
+            if node.fwd_fn is None:
+                raise RuntimeError(
+                    f"op '{node.name}' did not record a replayable forward "
+                    "(PyLayer / to_static subgraphs are not supported in "
+                    "create_graph=True double grad yet)")
+            args = []
+            for i, edge in enumerate(node.edges):
+                if edge is None:
+                    args.append(node.in_vals[i])
+                else:
+                    args.append(value_of(*edge))
+            outs = node.fwd_fn(*args)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            memo[id(node)] = outs
+            return outs
+
+        old = sys.getrecursionlimit()
+        sys.setrecursionlimit(max(old, 20000))
+        try:
+            return tuple(
+                value_of(out._grad_node, out._out_index)
+                if out._grad_node is not None else out._value
+                for out in outputs)
+        finally:
+            sys.setrecursionlimit(old)
+
+    return F
+
+
+def _leaves_of(rnodes, exclude_ids):
+    """Live leaf tensors (AccumulationNodes) among `rnodes`, minus
+    `exclude_ids`."""
+    leaves = []
+    for node in rnodes:
+        if isinstance(node, AccumulationNode):
+            t = node.tensor_ref()
+            if t is not None and id(t) not in exclude_ids:
+                leaves.append(t)
+    return leaves
+
+
+def reachable_leaves(outputs, exclude_ids=()):
+    """Leaf tensors of the recorded subgraph under `outputs`, for callers
+    (incubate forward_grad) that must thread them through dispatched replay
+    ops to keep results differentiable w.r.t. them."""
+    _, rnodes = _reachable_nodes(outputs)
+    return _leaves_of(rnodes, set(exclude_ids))
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """grad(create_graph=True): differentiable gradients by replay + jax.vjp,
+    dispatched through the op funnel so results carry their own GradNodes
+    (and so third and higher orders recurse for free)."""
+    from .core import Tensor
+    from ..ops.dispatch import call_op_multi
+
+    reachable, rnodes = _reachable_nodes(outputs)
+    connected = []
+    for t in inputs:
+        node = t._ensure_grad_node() if t._grad_node is None \
+            else t._grad_node
+        connected.append(id(node) in reachable)
+    if not all(connected) and not allow_unused:
+        bad = [t.name for t, c in zip(inputs, connected) if not c]
+        raise RuntimeError(
+            f"differentiated tensors {bad} appear unused in the graph; "
+            "set allow_unused=True to return None for them")
+    conn = [t for t, c in zip(inputs, connected) if c]
+    if not conn:
+        return [None] * len(inputs)
+
+    # every OTHER differentiable leaf in the subgraph (e.g. the model's
+    # parameters when differentiating w.r.t. the input for a gradient
+    # penalty) must be an argument of the dispatched op, not a baked
+    # constant — otherwise the second backward cannot reach it
+    leaves = _leaves_of(rnodes, {id(t) for t in conn})
+
+    F = replay_pure(outputs, conn + leaves)
+    seeds = []
+    for out, gout in zip(outputs, grad_outputs):
+        if gout is None:
+            seeds.append(Tensor(jnp.ones(out.shape, out._value.dtype),
+                                stop_gradient=True))
+        elif isinstance(gout, Tensor):
+            seeds.append(gout)
+        else:
+            seeds.append(Tensor(jnp.asarray(gout), stop_gradient=True))
+    n_in, n_leaf = len(conn), len(leaves)
+
+    def G(*vals):
+        in_vals = vals[:n_in]
+        leaf_vals = vals[n_in:n_in + n_leaf]
+        seed_vals = vals[n_in + n_leaf:]
+        _, vjp_fn = jax.vjp(lambda *iv: F(*iv, *leaf_vals), *in_vals)
+        return tuple(vjp_fn(tuple(seed_vals)))
+
+    grads = call_op_multi("double_grad_replay", G,
+                          list(conn) + leaves + seeds, num_outputs=n_in)
+    results, it = [], iter(grads)
+    for c in connected:
+        results.append(next(it) if c else None)
+    return results
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """`paddle.grad` equivalent: grads of outputs w.r.t. inputs without touching
     .grad. Reference analog: eager/general_grad.h (GeneralGrad).
 
     Implementation: temporarily swap AccumulationNode capture — we hook input
-    tensors' nodes by running a normal backward into fresh buffers.
-    """
+    tensors' nodes by running a normal backward into fresh buffers. With
+    create_graph=True the recorded graph is replayed as a pure jax function
+    and differentiated with jax.vjp, so the returned grads are themselves
+    differentiable (see replay_pure)."""
     from .core import Tensor
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported yet")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
+
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
 
     # stash and clear existing grads on inputs; run backward; read; restore.
     # A grad filter keeps accumulation away from leaves outside `inputs`.

@@ -1,21 +1,69 @@
-"""Primitive-op AD (forward mode). Reference analog:
+"""Primitive-op AD. Reference analog:
 python/paddle/incubate/autograd/primapi.py (:22 forward_grad, :105 grad).
-TPU-first: jax.jvp/jax.grad are the primitive transforms."""
+
+TPU-first: instead of lowering to a primitive-op program and transforming it
+(the reference's prim2orig pipeline), the recorded eager graph is replayed as
+a pure jax function (framework.autograd.replay_pure) and jax.jvp / jax.vjp
+are the primitive transforms. Everything XLA-compiles.
+"""
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor
-from ...autograd import grad, jvp as _jvp  # noqa: F401
+from ...framework.autograd import replay_pure, reachable_leaves
+from ...framework.autograd import grad as _eager_grad
+from ...autograd import jvp, vjp, jacobian, hessian  # noqa: F401
 
-__all__ = ["forward_grad", "grad", "jvp"]
+__all__ = ["forward_grad", "grad", "jvp", "vjp", "jacobian", "hessian"]
 
-jvp = _jvp
+
+def _listify(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):
-    """Forward-mode gradients (JVP) of outputs w.r.t. inputs."""
-    raise NotImplementedError(
-        "forward_grad over recorded eager graphs is not supported; use "
-        "paddle_tpu.autograd.jvp(func, xs, v) with an explicit function")
+    """Forward-mode gradients (JVP) of outputs w.r.t. inputs over the
+    recorded eager graph. Reference analog: primapi.py:22 forward_grad.
+
+    grad_inputs: tangent seeds aligned with `inputs` (ones by default).
+    Returns tangents aligned with `outputs`, dispatched through the op
+    funnel so they are themselves differentiable.
+    """
+    from ...ops.dispatch import call_op_multi
+    outputs = _listify(outputs)
+    inputs = _listify(inputs)
+    if grad_inputs is None:
+        tangents = [Tensor(jnp.ones(t.shape, t._value.dtype),
+                           stop_gradient=True) for t in inputs]
+    else:
+        tangents = [g if isinstance(g, Tensor)
+                    else Tensor(jnp.asarray(g), stop_gradient=True)
+                    for g in _listify(grad_inputs)]
+    # other leaves (model params) ride along as op arguments so the tangent
+    # stays differentiable w.r.t. them (mixed forward-over-reverse d2y/dxdW)
+    leaves = reachable_leaves(outputs, {id(t) for t in inputs})
+    F = replay_pure(outputs, inputs + leaves)
+    n, nl = len(inputs), len(leaves)
+
+    def J(*vals):
+        primals = vals[:n]
+        leaf_vals = vals[n:n + nl]
+        tans = vals[n + nl:]
+        _, out_tangents = jax.jvp(lambda *iv: F(*iv, *leaf_vals),
+                                  primals, tans)
+        return tuple(out_tangents)
+
+    outs = call_op_multi("forward_grad_replay", J,
+                         inputs + leaves + tangents,
+                         num_outputs=len(outputs))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode gradients over the recorded graph, differentiable
+    (primapi.py:105 semantics — always create_graph)."""
+    res = _eager_grad(outputs, inputs, grad_outputs=grad_outputs,
+                      create_graph=True, allow_unused=True)
+    return res if len(res) > 1 else res[0]

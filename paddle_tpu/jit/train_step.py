@@ -123,6 +123,21 @@ class TrainStep:
 
         loss, new_p, new_accs, new_b = self._jitted(
             pvals, accs, bvals, arg_vals, lr, step_count, key)
+        from ..framework.flags import _FLAGS
+        if _FLAGS.get("FLAGS_check_nan_inf") and \
+                not bool(jnp.isfinite(loss)):
+            # keep the (non-donated) pre-step parameters so an eager re-run
+            # can locate the bad op; the donated accumulator buffers are
+            # gone, so their new values must land regardless
+            for p, ac in zip(params, new_accs):
+                for n, v in zip(acc_names, ac):
+                    if v is not None:
+                        opt._accumulators[n][p.name] = v
+            raise FloatingPointError(
+                "TrainStep produced a non-finite loss "
+                "(FLAGS_check_nan_inf); parameters were NOT updated "
+                "(optimizer accumulators were) — re-run the step eagerly "
+                "to locate the offending op")
         for p, v in zip(params, new_p):
             p._value = v
         for p, ac in zip(params, new_accs):

@@ -1,27 +1,341 @@
-"""paddle.onnx — model export. Reference analog: python/paddle/onnx/export.py
-(delegates to the external paddle2onnx package).
+"""paddle.onnx — ONNX model export. Reference analog:
+python/paddle/onnx/export.py (delegates to the external paddle2onnx package).
 
-TPU-native position: the deployment artifact of this framework is StableHLO
-via jit.save / static.save_inference_model (portable across XLA runtimes,
-including ONNX-Runtime's XLA EP). ONNX protobuf emission would need an
-onnx-package dependency that is not bundled, so export() raises with the
-supported alternative unless `onnx` is importable.
+TPU-first: the model's forward is traced to a jaxpr (the same capture
+jit.to_static uses) and the jaxpr equations are lowered to ONNX nodes. The
+ModelProto is serialized with a self-contained protobuf wire-format emitter
+(onnx.proto field numbers), so export needs no external onnx dependency —
+mirroring how the framework's own deployment artifact (StableHLO via
+jit.save) is dependency-free.
+
+Covered op set: the MLP/attention-adjacent core (MatMul, elementwise
+arithmetic, activations, reductions, reshape/transpose/cast, broadcast via
+Expand). Convs and control flow raise with the supported alternative
+(jit.save / StableHLO).
 """
 from __future__ import annotations
+
+import struct
+
+import numpy as np
+import jax
+from jax.extend.core import Literal as _Literal
+import jax.numpy as jnp
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise NotImplementedError(
-            "ONNX export needs the 'onnx' package (not bundled in this "
-            "environment). Use paddle_tpu.jit.save(layer, path, input_spec) "
-            "— the StableHLO artifact it produces is this framework's "
-            "deployment format (loadable via jit.load / "
-            "static.load_inference_model)") from None
-    raise NotImplementedError(
-        "ONNX emission from jaxpr is not implemented yet; use "
-        "paddle_tpu.jit.save for the StableHLO deployment artifact")
+# ---------------------------------------------------------------------------
+# protobuf wire-format primitives (proto3, onnx.proto field numbers)
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_int(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(value)
+
+
+def _f_bytes(field: int, value: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(value)) + value
+
+
+def _f_str(field: int, value: str) -> bytes:
+    return _f_bytes(field, value.encode())
+
+
+def _f_msg(field: int, payload: bytes) -> bytes:
+    return _f_bytes(field, payload)
+
+
+# ONNX TensorProto.DataType
+_DTYPE = {
+    np.dtype(np.float32): 1, np.dtype(np.uint8): 2, np.dtype(np.int8): 3,
+    np.dtype(np.int16): 5, np.dtype(np.int32): 6, np.dtype(np.int64): 7,
+    np.dtype(np.bool_): 9, np.dtype(np.float16): 10,
+    np.dtype(np.float64): 11, np.dtype(np.uint32): 12,
+    np.dtype(np.uint64): 13,
+}
+
+
+def _onnx_dtype(dt) -> int:
+    dt = np.dtype(dt)
+    if dt == jnp.bfloat16:
+        return 16
+    if dt not in _DTYPE:
+        raise ValueError(f"dtype {dt} has no ONNX mapping")
+    return _DTYPE[dt]
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = b"".join(_f_int(1, int(d)) for d in arr.shape)
+    out += _f_int(2, _onnx_dtype(arr.dtype))
+    out += _f_str(8, name)
+    out += _f_bytes(9, arr.tobytes())          # raw_data
+    return out
+
+
+def _value_info(name: str, shape, dtype) -> bytes:
+    dims = b"".join(_f_msg(1, _f_int(1, int(d))) for d in shape)
+    tensor_type = _f_int(1, _onnx_dtype(dtype)) + _f_msg(2, dims)
+    return _f_str(1, name) + _f_msg(2, _f_msg(1, tensor_type))
+
+
+def _attr_ints(name: str, ints) -> bytes:
+    return _f_str(1, name) + b"".join(_f_int(8, int(i)) for i in ints) \
+        + _f_int(20, 7)                        # AttributeProto.Type.INTS
+
+
+def _attr_int(name: str, i: int) -> bytes:
+    return _f_str(1, name) + _f_int(3, int(i)) + _f_int(20, 2)  # INT
+
+
+def _node(op_type: str, inputs, outputs, attrs=()) -> bytes:
+    out = b"".join(_f_str(1, i) for i in inputs)
+    out += b"".join(_f_str(2, o) for o in outputs)
+    out += _f_str(4, op_type)
+    out += b"".join(_f_msg(5, a) for a in attrs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# jaxpr -> ONNX graph
+# ---------------------------------------------------------------------------
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "tanh": "Tanh", "logistic": "Sigmoid", "exp": "Exp", "log": "Log",
+    "neg": "Neg", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
+    "max": "Max", "min": "Min", "pow": "Pow", "floor": "Floor",
+    "ceil": "Ceil", "sin": "Sin", "cos": "Cos", "erf": "Erf",
+}
+
+
+class _GraphBuilder:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.names = {}
+        self._ctr = 0
+
+    def fresh(self, prefix="t"):
+        self._ctr += 1
+        return f"{prefix}_{self._ctr}"
+
+    def name_of(self, var, jaxpr_consts):
+        if isinstance(var, _Literal):
+            return self.add_const(np.asarray(var.val))
+        if var not in self.names:
+            raise ValueError(f"unbound jaxpr var {var}")
+        return self.names[var]
+
+    def add_const(self, arr, prefix="const"):
+        name = self.fresh(prefix)
+        self.initializers.append(_tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op_type, in_names, n_out=1, attrs=()):
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(_node(op_type, in_names, outs, attrs))
+        return outs
+
+    # -- per-equation lowering ---------------------------------------------
+    def lower_eqn(self, eqn):
+        prim = eqn.primitive.name
+        # recurse through call-like primitives (nested jit, custom vjp/jvp,
+        # remat): inline their inner jaxpr
+        inner = None
+        if prim == "pjit":
+            inner = eqn.params["jaxpr"]
+        elif prim in ("custom_jvp_call", "custom_vjp_call",
+                      "custom_vjp_call_jaxpr", "remat", "checkpoint",
+                      "closed_call", "core_call"):
+            inner = eqn.params.get("call_jaxpr") or eqn.params.get("jaxpr") \
+                or eqn.params.get("fun_jaxpr")
+        if inner is not None:
+            closed = inner if hasattr(inner, "jaxpr") else None
+            j = closed.jaxpr if closed is not None else inner
+            consts = closed.consts if closed is not None else []
+            for cv, cval in zip(j.constvars, consts):
+                self.names[cv] = self.add_const(np.asarray(cval))
+            for iv, outer in zip(j.invars, eqn.invars):
+                self.names[iv] = self.name_of(outer, None)
+            for ie in j.eqns:
+                self.lower_eqn(ie)
+            for ov, outer in zip(j.outvars, eqn.outvars):
+                self.names[outer] = self.names[ov] \
+                    if not isinstance(ov, _Literal) \
+                    else self.add_const(np.asarray(ov.val))
+            return
+
+        ins = [self.name_of(v, None) for v in eqn.invars]
+
+        if prim in _SIMPLE:
+            (out,) = self.emit(_SIMPLE[prim], ins)
+        elif prim == "integer_pow":
+            y = eqn.params["y"]
+            p = self.add_const(np.asarray(
+                float(y), dtype=eqn.invars[0].aval.dtype))
+            (out,) = self.emit("Pow", [ins[0], p])
+        elif prim == "dot_general":
+            ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+            lhs_ndim = len(eqn.invars[0].aval.shape)
+            rhs_ndim = len(eqn.invars[1].aval.shape)
+            std = (tuple(lc) == (lhs_ndim - 1,) and tuple(rc)
+                   == (len(lb),) and tuple(lb) == tuple(range(len(lb)))
+                   and tuple(rb) == tuple(range(len(rb))))
+            if not std:
+                raise ValueError(
+                    f"dot_general with dimension_numbers "
+                    f"{eqn.params['dimension_numbers']} does not map to "
+                    "ONNX MatMul; use paddle_tpu.jit.save (StableHLO) for "
+                    "this model")
+            (out,) = self.emit("MatMul", ins)
+        elif prim == "reshape":
+            shape = self.add_const(
+                np.asarray(eqn.params["new_sizes"], np.int64), "shape")
+            (out,) = self.emit("Reshape", [ins[0], shape])
+        elif prim == "transpose":
+            (out,) = self.emit(
+                "Transpose", ins,
+                attrs=[_attr_ints("perm", eqn.params["permutation"])])
+        elif prim == "broadcast_in_dim":
+            # insert singleton dims, then Expand to the target shape
+            tgt = eqn.params["shape"]
+            bdims = eqn.params["broadcast_dimensions"]
+            inter = [1] * len(tgt)
+            for i, d in enumerate(bdims):
+                inter[d] = eqn.invars[0].aval.shape[i]
+            rs = self.add_const(np.asarray(inter, np.int64), "shape")
+            (mid,) = self.emit("Reshape", [ins[0], rs])
+            ts = self.add_const(np.asarray(tgt, np.int64), "shape")
+            (out,) = self.emit("Expand", [mid, ts])
+        elif prim == "convert_element_type":
+            (out,) = self.emit(
+                "Cast", ins,
+                attrs=[_attr_int("to",
+                                 _onnx_dtype(eqn.params["new_dtype"]))])
+        elif prim == "reduce_sum":
+            # ReduceSum takes axes as an input from opset 13
+            axes = self.add_const(
+                np.asarray(eqn.params["axes"], np.int64), "axes")
+            (out,) = self.emit("ReduceSum", [ins[0], axes],
+                               attrs=[_attr_int("keepdims", 0)])
+        elif prim in ("reduce_max", "reduce_min"):
+            # ReduceMax/Min only accept axes as an input from opset 18;
+            # the attribute form is valid across 13-17 too
+            op = "ReduceMax" if prim == "reduce_max" else "ReduceMin"
+            (out,) = self.emit(
+                op, [ins[0]],
+                attrs=[_attr_ints("axes", eqn.params["axes"]),
+                       _attr_int("keepdims", 0)])
+        elif prim == "stop_gradient":
+            (out,) = self.emit("Identity", ins)
+        elif prim == "squeeze":
+            axes = self.add_const(
+                np.asarray(eqn.params["dimensions"], np.int64), "axes")
+            (out,) = self.emit("Squeeze", [ins[0], axes])
+        elif prim == "expand_dims":
+            axes = self.add_const(
+                np.asarray(eqn.params["dimensions"], np.int64), "axes")
+            (out,) = self.emit("Unsqueeze", [ins[0], axes])
+        elif prim == "select_n" and len(ins) == 3:
+            # select_n(pred, on_false, on_true) -> Where(pred, true, false)
+            (out,) = self.emit("Where", [ins[0], ins[2], ins[1]])
+        else:
+            raise ValueError(
+                f"jaxpr primitive '{prim}' is not in the ONNX-exportable "
+                "op set; use paddle_tpu.jit.save (StableHLO) for this "
+                "model")
+        self.names[eqn.outvars[0]] = out
+        for extra in eqn.outvars[1:]:
+            self.names[extra] = out
+
+
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Trace `layer` and write an ONNX ModelProto to `path` ('.onnx' is
+    appended when missing). Reference analog: python/paddle/onnx/export.py.
+    """
+    from .framework.core import Tensor
+    from .framework.autograd import set_grad_enabled
+    from .jit.api import InputSpec
+    from .framework.dtype import to_jax_dtype
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    if opset_version < 13:
+        raise ValueError(
+            "onnx.export emits axes-as-input ReduceSum/Squeeze/Unsqueeze, "
+            f"which need opset >= 13 (got {opset_version})")
+    specs = list(input_spec)
+    example = []
+    for s in specs:
+        if isinstance(s, InputSpec):
+            shape = tuple(1 if d is None or d < 0 else d for d in s.shape)
+            example.append(jnp.zeros(shape, to_jax_dtype(s.dtype)))
+        elif isinstance(s, Tensor):
+            example.append(s._value)
+        else:
+            example.append(jnp.asarray(s))
+
+    fwd = layer.forward if hasattr(layer, "forward") else layer
+
+    def pure(*vals):
+        with set_grad_enabled(False):
+            out = fwd(*[Tensor(v, stop_gradient=True) for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    closed = jax.make_jaxpr(pure)(*example)
+    j = closed.jaxpr
+
+    g = _GraphBuilder()
+    in_names = []
+    for i, (iv, ex) in enumerate(zip(j.invars, example)):
+        name = f"input_{i}"
+        g.names[iv] = name
+        in_names.append(_value_info(name, ex.shape, ex.dtype))
+    for cv, cval in zip(j.constvars, closed.consts):
+        g.names[cv] = g.add_const(np.asarray(cval), "param")
+    for eqn in j.eqns:
+        g.lower_eqn(eqn)
+    out_infos, out_renames = [], []
+    for i, ov in enumerate(j.outvars):
+        name = g.name_of(ov, None)
+        out_infos.append(_value_info(f"output_{i}", ov.aval.shape,
+                                     ov.aval.dtype))
+        out_renames.append(_node("Identity", [name], [f"output_{i}"]))
+
+    graph = b"".join(_f_msg(1, n) for n in g.nodes + out_renames)
+    graph += _f_str(2, type(layer).__name__)
+    graph += b"".join(_f_msg(5, t) for t in g.initializers)
+    graph += b"".join(_f_msg(11, vi) for vi in in_names)
+    graph += b"".join(_f_msg(12, vi) for vi in out_infos)
+
+    model = _f_int(1, 8)                               # ir_version
+    model += _f_str(2, "paddle-tpu")                   # producer_name
+    model += _f_msg(7, graph)
+    model += _f_msg(8, _f_str(1, "") + _f_int(2, opset_version))
+
+    if not path.endswith(".onnx"):
+        path = path + ".onnx"
+    with open(path, "wb") as f:
+        f.write(model)
+    return path

@@ -15,12 +15,39 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework.autograd import GradNode, is_grad_enabled
+from ..framework.flags import _FLAGS
 
 __all__ = ["call_op", "call_op_multi"]
 
 
 def _values(tensors):
     return tuple(t._value for t in tensors)
+
+
+def _debug_checks(name, out_vals):
+    """FLAGS_check_nan_inf: scan op outputs for non-finite values, raising
+    (level 0) or warning (level >= 1) with the op name — the eager analog of
+    framework/details/nan_inf_utils.h:29 CheckOpHasNanOrInf.
+    FLAGS_benchmark: block until the op's result is ready so per-op wall
+    times are honest (platform/flags.cc FLAGS_benchmark sync semantics)."""
+    if _FLAGS.get("FLAGS_check_nan_inf"):
+        from jax.errors import TracerBoolConversionError
+        for v in out_vals:
+            if not jnp.issubdtype(v.dtype, jnp.inexact):
+                continue
+            try:
+                finite = bool(jnp.all(jnp.isfinite(v)))
+            except TracerBoolConversionError:
+                continue   # inside a jit trace: the fused TrainStep checks
+            if not finite:
+                msg = f"Operator '{name}' output contains NaN/Inf"
+                if int(_FLAGS.get("FLAGS_check_nan_inf_level", 0)) == 0:
+                    raise FloatingPointError(msg)
+                import warnings
+                warnings.warn(msg)
+    elif _FLAGS.get("FLAGS_benchmark"):
+        for v in out_vals:
+            jax.block_until_ready(v)
 
 
 def _differentiable(t):
@@ -53,8 +80,12 @@ def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Te
     non-tensor arguments must already be closed over in `fn`."""
     inputs = _amp_transform(name, inputs)
     vals = _values(inputs)
+    debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
     if not _requires_grad(inputs):
-        return Tensor(fn(*vals), stop_gradient=True)
+        out_val = fn(*vals)
+        if debug:
+            _debug_checks(name, (out_val,))
+        return Tensor(out_val, stop_gradient=True)
 
     diff_mask = [_differentiable(t) for t in inputs]
     if all(diff_mask):
@@ -79,8 +110,12 @@ def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Te
                 full[i] = pg
             return tuple(full)
 
+    if debug:
+        _debug_checks(name, (out_val,))
     node = GradNode(name, wrapped_vjp, _make_edges(inputs),
                     ((out_val.shape, out_val.dtype),))
+    node.fwd_fn = fn
+    node.in_vals = vals
     out = Tensor(out_val, stop_gradient=False)
     out._grad_node = node
     out._out_index = 0
@@ -92,8 +127,11 @@ def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
     """Dispatch an op whose fn returns a tuple of `num_outputs` jax values."""
     inputs = _amp_transform(name, inputs)
     vals = _values(inputs)
+    debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
     if not _requires_grad(inputs):
         out_vals = fn(*vals)
+        if debug:
+            _debug_checks(name, out_vals)
         return [Tensor(v, stop_gradient=True) for v in out_vals]
 
     diff_mask = [_differentiable(t) for t in inputs]
@@ -106,8 +144,14 @@ def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
         return fn(*full)
 
     out_vals, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
+    if debug:
+        _debug_checks(name, out_vals)
 
     def wrapped_vjp(gs, _vjp=vjp_fn, _idx=diff_idx, _n=len(inputs)):
+        if not isinstance(gs, tuple):
+            # the engine passes a bare cotangent when the op has exactly one
+            # output; jax.vjp of a tuple-returning fn wants a tuple
+            gs = (gs,)
         partial = _vjp(gs)
         full = [None] * _n
         for i, pg in zip(_idx, partial):
@@ -116,6 +160,8 @@ def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
 
     node = GradNode(name, wrapped_vjp, _make_edges(inputs),
                     tuple((v.shape, v.dtype) for v in out_vals))
+    node.fwd_fn = fn
+    node.in_vals = vals
     outs = []
     for j, v in enumerate(out_vals):
         t = Tensor(v, stop_gradient=False)

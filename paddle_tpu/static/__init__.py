@@ -76,13 +76,32 @@ class Executor:
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
-        raise NotImplementedError(
-            "graph-mode Program execution: build models in dygraph and use "
-            "paddle_tpu.jit.to_static for compiled execution")
+        # eager-backed shell: ops already executed when built, so a run()
+        # fetches current values (callables are invoked with the feed)
+        results = []
+        for f in (fetch_list or []):
+            if callable(f):
+                results.append(f(**(feed or {})))
+            elif hasattr(f, "numpy"):
+                results.append(f.numpy())
+            else:
+                results.append(f)
+        return results
 
 
 def py_func(func, x, out, backward_func=None):
-    raise NotImplementedError
+    """Run a python callable as an op (reference: fluid/layers/py_func_op).
+    Eager-first: call `func` on the input tensors now; `out` (a Tensor or
+    list prototype, per the reference API) receives the result values."""
+    from ..framework.core import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    res = res if isinstance(res, (list, tuple)) else [res]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    import jax.numpy as jnp
+    for o, r in zip(outs, res):
+        o._value = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+    return out
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

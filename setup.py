@@ -1,0 +1,49 @@
+"""paddlepaddle-tpu wheel build.
+
+Reference analog: python/setup.py.in — the reference bundles the CMake-built
+libpaddle into its wheel; here BuildNative compiles the csrc/ runtime
+services (TCP store, work queue, host tracer, checkpoint writer) with g++
+into paddle_tpu/core/libpaddle_tpu_core.so and bundles the sources as a
+rebuild fallback for platforms the prebuilt .so doesn't match.
+
+Build:   pip wheel . -w dist --no-deps
+Verify:  pip install dist/*.whl && python -c "import paddle_tpu; paddle_tpu.utils.run_check()"
+"""
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+CSRC_FILES = ("tcp_store.cc", "workqueue.cc", "host_tracer.cc",
+              "ckpt_writer.cc")
+
+
+class BuildNative(build_py):
+    def run(self):
+        super().run()
+        root = os.path.dirname(os.path.abspath(__file__))
+        csrc = os.path.join(root, "csrc")
+        sources = [os.path.join(csrc, f) for f in CSRC_FILES]
+        pkg_dir = os.path.join(self.build_lib, "paddle_tpu")
+        # bundle the sources (rebuild fallback on foreign platforms)
+        bundled = os.path.join(pkg_dir, "csrc")
+        os.makedirs(bundled, exist_ok=True)
+        for s in sources:
+            shutil.copy2(s, bundled)
+        # compile the native runtime into the package
+        out = os.path.join(pkg_dir, "core", "libpaddle_tpu_core.so")
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out] \
+            + sources + ["-lpthread"]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300)
+            if res.returncode != 0:
+                print("WARNING: native build failed (pure-python fallbacks "
+                      "will be used):\n" + res.stderr)
+        except OSError as e:
+            print(f"WARNING: no C++ toolchain ({e}); skipping native build")
+
+
+setup(cmdclass={"build_py": BuildNative})

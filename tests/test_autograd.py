@@ -150,3 +150,117 @@ def test_jacobian_vjp_jvp():
     np.testing.assert_allclose(g.numpy(), [2.0, 4.0], atol=1e-5)
     out, tangent = jvp(lambda t: (t * t).sum(), x)
     np.testing.assert_allclose(float(tangent), 6.0, atol=1e-5)
+
+
+# ---- double grad (create_graph=True) ---------------------------------------
+# Reference analog: eager/general_grad.h + test_imperative_double_grad.py;
+# implementation here is functional replay (framework/autograd.py replay_pure).
+
+def test_double_grad_tanh():
+    """d2/dx2 tanh(x).sum() == -2 tanh(x) (1 - tanh(x)^2)."""
+    xv = np.array([0.3, -0.7, 1.2], np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    y = paddle.tanh(x).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    assert not g1.stop_gradient
+    (g2,) = paddle.grad(g1.sum(), x)
+    t = np.tanh(xv)
+    np.testing.assert_allclose(g2.numpy(), -2 * t * (1 - t * t),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_double_grad_matmul_matches_finite_diff():
+    """d2/dW2 of sum((x@W)^3) via grad-of-grad vs central finite differences."""
+    rng = np.random.default_rng(0)
+    xv = rng.normal(size=(3, 4)).astype(np.float32)
+    wv = rng.normal(size=(4, 2)).astype(np.float32)
+    x = paddle.to_tensor(xv)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    y = (x.matmul(w) ** 3).sum()
+    (g1,) = paddle.grad(y, w, create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), w)
+
+    def first_grad(wnp):
+        import jax.numpy as jnp
+        import jax
+        return np.asarray(jax.grad(
+            lambda W: jnp.sum((xv @ W) ** 3))(jnp.asarray(wnp)))
+
+    eps = 1e-3
+    fd = np.zeros_like(wv)
+    for i in range(wv.shape[0]):
+        for j in range(wv.shape[1]):
+            dp = wv.copy(); dp[i, j] += eps
+            dm = wv.copy(); dm[i, j] -= eps
+            fd[i, j] = (first_grad(dp).sum() - first_grad(dm).sum()) \
+                / (2 * eps)
+    np.testing.assert_allclose(g2.numpy(), fd, rtol=2e-2, atol=2e-2)
+
+
+def test_double_grad_softmax():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(1)
+    xv = rng.normal(size=(5,)).astype(np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    y = (paddle.nn.functional.softmax(x) ** 2).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad((g1 ** 2).sum(), x)
+
+    def f(v):
+        return jnp.sum(jax.nn.softmax(v) ** 2)
+
+    ref = jax.grad(lambda v: jnp.sum(jax.grad(f)(v) ** 2))(jnp.asarray(xv))
+    np.testing.assert_allclose(g2.numpy(), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_penalty_pattern():
+    """WGAN-GP style: ||d out/d x||^2 as a loss term, backward to params."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 1)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32),
+                         stop_gradient=False)
+    out = lin(x).sum()
+    (gx,) = paddle.grad(out, x, create_graph=True)
+    gp = (gx ** 2).sum()
+    gp.backward()
+    wgrad = lin.weight.grad
+    assert wgrad is not None
+    # d gp / d W = 2 * N * W (gx = W broadcast over batch of 8 rows)
+    np.testing.assert_allclose(wgrad.numpy(),
+                               16 * lin.weight.numpy(), rtol=1e-4)
+
+
+def test_triple_grad():
+    """Third order: d3/dx3 of x^4 = 24 x."""
+    x = paddle.to_tensor(np.array([1.5], np.float32), stop_gradient=False)
+    y = (x ** 4).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), x, create_graph=True)
+    (g3,) = paddle.grad(g2.sum(), x)
+    np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-5)
+
+
+def test_double_grad_unused_input():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    z = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z], create_graph=True)
+    g = paddle.grad(y, [x, z], create_graph=True, allow_unused=True)
+    assert g[1] is None
+    np.testing.assert_allclose(g[0].numpy(), 2 * np.ones(3), rtol=1e-6)
+
+
+def test_forward_grad_incubate():
+    """incubate.autograd.forward_grad: JVP over the recorded graph."""
+    from paddle_tpu.incubate.autograd import forward_grad
+    xv = np.array([0.5, 1.0], np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    y = (x * x).sum()
+    t = forward_grad(y, x)
+    np.testing.assert_allclose(float(t), float((2 * xv).sum()), rtol=1e-6)
+    # and the tangent is differentiable further
+    (g,) = paddle.grad(t, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 2.0], rtol=1e-6)
