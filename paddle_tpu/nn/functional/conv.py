@@ -73,13 +73,13 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n,
             perm = tuple(range(2, 2 + n)) + (1, 0)
             w = jnp.transpose(w, perm)
         dn = lax.conv_dimension_numbers(v.shape, w.shape, dn_spec)
+        # no preferred_element_type override: the TPU MXU already
+        # accumulates bf16 convs in f32 internally, and the f32 hint breaks
+        # jax's conv transpose rule (f32 cotangent vs bf16 operands)
         out = lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad,
             rhs_dilation=dilations, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16.dtype
-            else None)
-        out = out.astype(v.dtype)
+            feature_group_count=groups)
         if maybe_bias:
             b = maybe_bias[0]
             shape = [1] * out.ndim

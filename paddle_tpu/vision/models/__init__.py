@@ -24,3 +24,6 @@ from .shufflenetv2 import (  # noqa: F401
     shufflenet_v2_x2_0, shufflenet_v2_swish,
 )
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .vit import (  # noqa: F401
+    VisionTransformer, vit_b_16, vit_l_16, vit_l_32,
+)

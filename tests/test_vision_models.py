@@ -68,3 +68,86 @@ def test_small_model_trains():
     grads = [p.grad for p in model.parameters() if not p.stop_gradient]
     assert any(g is not None and float(np.abs(g.numpy()).sum()) > 0
                for g in grads)
+
+
+# ---- ViT (BASELINE config 5) ------------------------------------------------
+
+class TestVisionTransformer:
+    def _tiny(self, fused):
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        from paddle_tpu.vision.models import VisionTransformer
+        return VisionTransformer(img_size=16, patch_size=8, embed_dim=32,
+                                 depth=2, num_heads=4, num_classes=5,
+                                 dropout=0.0, attention_dropout=0.0,
+                                 use_fused_attn=fused)
+
+    def test_fused_matches_unfused_with_mapped_weights(self):
+        """The fused encoder computes the same function as the plain one
+        when weights are mapped (qkv stacking per fused_attention_op.cu
+        layout)."""
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        fused = self._tiny(True)
+        plain = self._tiny(False)
+        # shared trunk params
+        for dst, src in [(fused.patch_embed.proj, plain.patch_embed.proj),
+                         (fused.norm, plain.norm), (fused.head, plain.head)]:
+            dst.weight._value = src.weight._value
+            dst.bias._value = src.bias._value
+        fused.cls_token._value = plain.cls_token._value
+        fused.pos_embed._value = plain.pos_embed._value
+        H, D = 4, 8
+        for fb, pb in zip(fused.blocks, plain.blocks):
+            at, ff = fb.fused_attn, fb.ffn
+            sa = pb.self_attn
+            qkv = np.stack([
+                np.asarray(l.weight._value).T.reshape(H, D, 32)
+                for l in (sa.q_proj, sa.k_proj, sa.v_proj)])
+            at.qkv_weight._value = jnp.asarray(qkv)
+            at.qkv_bias._value = jnp.asarray(np.stack(
+                [np.asarray(l.bias._value).reshape(H, D)
+                 for l in (sa.q_proj, sa.k_proj, sa.v_proj)]))
+            at.linear_weight._value = sa.out_proj.weight._value
+            at.linear_bias._value = sa.out_proj.bias._value
+            at.pre_ln_scale._value = pb.norm1.weight._value
+            at.pre_ln_bias._value = pb.norm1.bias._value
+            ff.ln1_scale._value = pb.norm2.weight._value
+            ff.ln1_bias._value = pb.norm2.bias._value
+            ff.linear1_weight._value = pb.linear1.weight._value
+            ff.linear1_bias._value = pb.linear1.bias._value
+            ff.linear2_weight._value = pb.linear2.weight._value
+            ff.linear2_bias._value = pb.linear2.bias._value
+        fused.eval(); plain.eval()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(2, 3, 16, 16))
+            .astype(np.float32))
+        np.testing.assert_allclose(np.asarray(fused(x)._value),
+                                   np.asarray(plain(x)._value),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_vit_trains(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.jit import TrainStep
+        model = self._tiny(True)
+        model.train()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = TrainStep(model, lambda o, y: F.cross_entropy(o, y), opt)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(4, 3, 16, 16))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 5, 4).astype(np.int64))
+        losses = [float(step(x, y)) for _ in range(10)]
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_constructors(self):
+        from paddle_tpu.vision.models import vit_b_16, vit_l_16, vit_l_32
+        m = vit_b_16(num_classes=10, img_size=32)
+        assert len(m.blocks) == 12 and m.embed_dim == 768
+        m = vit_l_32(num_classes=0, img_size=64)
+        assert len(m.blocks) == 24 and m.head is None
