@@ -9,8 +9,8 @@ from .mp_ops import _c_identity, _c_concat, _c_split, _mp_allreduce, split  # no
 from .pp_layers import LayerDesc, SharedLayerDesc, SegmentLayers, PipelineLayer  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .spmd_pipeline import (  # noqa: F401
-    spmd_pipeline, pipeline_schedule, PipelineTrainStep, stack_stage_params,
-    find_block_run,
+    spmd_pipeline, pipeline_schedule, interleaved_schedule,
+    PipelineTrainStep, stack_stage_params, find_block_run,
 )
 from .parallel_wrappers import TensorParallel, ShardingParallel  # noqa: F401
 from .sep_parallel import (  # noqa: F401

@@ -23,6 +23,16 @@ concurrency S (all stages busy on different micro-batches). The bubble
 fraction is (S-1)/(M+S-1); choose num_microbatches >= num_stages.
 `pipeline_schedule` exposes the (timestep -> {(stage, microbatch)}) map for
 inspection and testing.
+
+Interleaved virtual stages (num_virtual=V > 1, reference analog
+PipelineParallelWithInterleave): device s holds model chunks s, s+S, ...,
+s+(V-1)S; the grouped schedule (see interleaved_schedule) stays
+ring-compatible — one hop, one chunk-application per device per step —
+and cuts the fill/drain bubble to (S-1)/(V*M + S-1). Chunk selection inside
+the scan is a dynamic-index over the lap dim — branchless on purpose: the
+lap predicate diverges across pipe stages, and divergent lax.switch branches
+deadlock once the partitioner plants resharding collectives for the auto
+(data/sharding/model) axes inside them.
 """
 from __future__ import annotations
 
@@ -36,8 +46,8 @@ from ....framework.core import Tensor
 from ....framework import random as _random
 from ....framework.autograd import set_grad_enabled
 
-__all__ = ["pipeline_schedule", "spmd_pipeline", "PipelineTrainStep",
-           "stack_stage_params", "find_block_run"]
+__all__ = ["pipeline_schedule", "interleaved_schedule", "spmd_pipeline",
+           "PipelineTrainStep", "stack_stage_params", "find_block_run"]
 
 
 def pipeline_schedule(num_micro, num_stages):
@@ -52,7 +62,46 @@ def pipeline_schedule(num_micro, num_stages):
     return sched
 
 
-def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
+def interleaved_schedule(num_micro, num_stages, num_virtual):
+    """Grouped interleaved schedule (reference analog:
+    PipelineParallelWithInterleave, fleet/meta_parallel/
+    pipeline_parallel.py:461 — virtual pipeline stages, device s owns model
+    chunks s, s+S, ..., s+(V-1)S).
+
+    Device idx's work item at global chunk-step t is derived from its local
+    step u = t - idx: group g = u // (S*V) (S micro-batches complete all V
+    laps per group), lap l = (u % (S*V)) // S, member j = u % S, micro-batch
+    m = g*S + j, chunk = l. This is exactly ring-compatible: the producer of
+    (m, lap, stage-1) finishes at global step t-1, so one ppermute hop per
+    step suffices and each device holds a single in-flight activation.
+
+    Returns (timesteps list of {(stage, lap, micro)}, total_steps,
+    bubble_fraction). Total steps = V*M + S - 1; bubble (S-1)/(V*M + S - 1),
+    a V-fold reduction of the GPipe fill/drain cost.
+    """
+    S, V, M = num_stages, num_virtual, num_micro
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) divisible "
+            f"by num_stages ({S})")
+    total = V * M + S - 1
+    sched = []
+    for t in range(total):
+        active = set()
+        for s in range(S):
+            u = t - s
+            if not 0 <= u < V * M:
+                continue
+            g, r = divmod(u, S * V)
+            l, j = divmod(r, S)
+            active.add((s, l, g * S + j))
+        sched.append(active)
+    bubble = (S - 1) / total
+    return sched, total, bubble
+
+
+def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None,
+                  num_virtual=1):
     """Run `x` through a pipeline of S stages laid out over `axis`.
 
     stage_fn(params_one_stage, mb) -> mb   (same shape/dtype out as in);
@@ -60,6 +109,9 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
     folded over (timestep, stage) so dropout masks differ per micro-batch
     and per stage.
     stage_params: pytree whose leaves have leading dim S, sharded over `axis`
+    (with num_virtual=V > 1: leading dims [V, S], dim 1 sharded — device s
+    holds model chunks s, s+S, ..., s+(V-1)S and the schedule follows
+    interleaved_schedule, cutting the fill/drain bubble V-fold)
     x: [M, *mb_shape] micro-batched activations, replicated over `axis`
     returns [M, *mb_shape]: last stage's outputs, replicated over `axis`.
 
@@ -77,40 +129,77 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
     """
     S = mesh.shape[axis]
     M = x.shape[0]
+    V = num_virtual
     if S == 1:
-        # degenerate pipeline: just apply the single stage to each microbatch
-        params0 = tree_map(lambda l: l[0], stage_params)
-        if key is None:
-            return lax.map(lambda mb: stage_fn(params0, mb), x)
-        return lax.map(
-            lambda tm: stage_fn(params0, tm[1],
-                                jax.random.fold_in(key, tm[0])),
-            (jnp.arange(M), x))
+        # degenerate pipeline: just apply the stage(s) to each microbatch
+        params0 = tree_map(lambda l: l[0], stage_params) if V == 1 else None
+
+        def all_chunks(mb, t):
+            if V == 1:
+                if key is None:
+                    return stage_fn(params0, mb)
+                return stage_fn(params0, mb, jax.random.fold_in(key, t))
+            for l in range(V):
+                chunk = tree_map(lambda p: p[l, 0], stage_params)
+                k = None if key is None else jax.random.fold_in(
+                    jax.random.fold_in(key, t), l)
+                mb = stage_fn(chunk, mb) if k is None \
+                    else stage_fn(chunk, mb, k)
+            return mb
+        return lax.map(lambda tm: all_chunks(tm[1], tm[0]),
+                       (jnp.arange(M), x))
+    if V > 1 and M % S != 0:
+        raise ValueError(
+            f"interleaved pipeline needs num_microbatches ({M}) divisible "
+            f"by num_stages ({S})")
     perm = [(i, (i + 1) % S) for i in range(S)]
+    total = V * M + S - 1
 
     def per_device(params_local, x_local):
-        my = tree_map(lambda l: jnp.squeeze(l, 0), params_local)
+        # V=1 leaves are [1, ...] (pipe dim); V>1 leaves are [V, 1, ...]
+        my = tree_map(lambda l: jnp.squeeze(l, 0 if V == 1 else 1),
+                      params_local)
         x_full = jnp.squeeze(x_local, 0)
         idx = lax.axis_index(axis)
 
         def body(carry, t):
             state, outs = carry
-            # feed: stage 0 picks up micro-batch t (clipped garbage in drain)
-            inp = lax.dynamic_index_in_dim(x_full, jnp.clip(t, 0, M - 1), 0,
+            # interleaved work item at local step u = t - idx (see
+            # interleaved_schedule): lap l, member j, micro g*S + j
+            u = t - idx
+            g, r = jnp.divmod(u, S * V)
+            l, j = jnp.divmod(r, S)
+            micro = g * S + j
+            # feed: stage 0 picks up a fresh micro-batch on its lap-0 steps
+            inp = lax.dynamic_index_in_dim(x_full,
+                                           jnp.clip(micro, 0, M - 1), 0,
                                            keepdims=False)
-            state = jnp.where(idx == 0, inp, state)
-            if key is None:
-                out = stage_fn(my, state)
+            feed = (idx == 0) & (l == 0)
+            state = jnp.where(feed, inp, state)
+            if V == 1:
+                chunk = my
             else:
-                out = stage_fn(my, state,
+                # dynamic-index (NOT lax.switch): the lap predicate diverges
+                # across pipe stages, and divergent branches deadlock when
+                # the partitioner plants resharding collectives for the
+                # auto (data/sharding/model) axes inside them. l is already
+                # in [0, V-1] by floor-mod, even during fill (u < 0).
+                chunk = tree_map(
+                    lambda p: lax.dynamic_index_in_dim(p, l, 0,
+                                                       keepdims=False), my)
+            if key is None:
+                out = stage_fn(chunk, state)
+            else:
+                out = stage_fn(chunk, state,
                                jax.random.fold_in(
                                    jax.random.fold_in(key, t), idx))
-            # collect: stage S-1 emits micro-batch t-(S-1) once it exists
-            t_out = jnp.clip(t - (S - 1), 0, M - 1)
-            collect = jnp.logical_and(idx == S - 1, t >= S - 1)
-            prev = lax.dynamic_index_in_dim(outs, t_out, 0, keepdims=False)
+            # collect: stage S-1 emits micro `micro` on its last-lap steps
+            # (micro <= M-1 holds whenever u >= 0 at the last stage)
+            m_out = jnp.clip(micro, 0, M - 1)
+            collect = (idx == S - 1) & (l == V - 1) & (u >= 0)
+            prev = lax.dynamic_index_in_dim(outs, m_out, 0, keepdims=False)
             outs = lax.dynamic_update_index_in_dim(
-                outs, jnp.where(collect, out, prev), t_out, 0)
+                outs, jnp.where(collect, out, prev), m_out, 0)
             # rotate: one ICI hop to the next stage
             state = lax.ppermute(out, axis, perm)
             return (state, outs), None
@@ -118,11 +207,12 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None):
         # the carry varies across the pipe axis from step 1 on; x_full is
         # already varying (in_specs P(axis)), so zeros_like inherits it
         init = (jnp.zeros_like(x_full[0]), jnp.zeros_like(x_full))
-        (_, outs), _ = lax.scan(body, init, jnp.arange(M + S - 1))
+        (_, outs), _ = lax.scan(body, init, jnp.arange(total))
         return outs[None]
 
+    pspec = P(axis) if V == 1 else P(None, axis)
     mapped = jax.shard_map(per_device, mesh=mesh, axis_names={axis},
-                           in_specs=(P(axis), P(axis)), out_specs=P(axis))
+                           in_specs=(pspec, P(axis)), out_specs=P(axis))
     x_tiled = jnp.broadcast_to(x[None], (S,) + x.shape)
     stacked = mapped(stage_params, x_tiled)
     # only the last stage's buffer is real: select it outside the shard_map
@@ -162,26 +252,35 @@ def find_block_run(layers, num_stages):
     return start, count
 
 
-def stack_stage_params(blocks, num_stages, mesh, axis="pipe"):
-    """Stack the parameters of `blocks` (len = S * per) into leaves of shape
-    [S, per, *param_shape], sharded over `axis` on dim 0 and preserving each
+def stack_stage_params(blocks, num_stages, mesh, axis="pipe",
+                       num_virtual=1):
+    """Stack the parameters of `blocks` (len = V * S * per) into leaves of
+    shape [S, per, *param_shape] (V=1) or [V, S, per, *param_shape] (V>1,
+    interleaved: chunk l*S+s — blocks [(l*S+s)*per, ...) — lands at
+    leaf[l, s]), sharded over `axis` on the stage dim and preserving each
     parameter's existing named sharding on the trailing dims (so Megatron
     "model"-axis placements survive stacking)."""
-    per = len(blocks) // num_stages
+    S, V = num_stages, num_virtual
+    per = len(blocks) // (S * V)
     proto_params = blocks[0].parameters()
     stacked = []
     for k, pp in enumerate(proto_params):
-        rows = []
-        for s in range(num_stages):
-            vals = [blocks[s * per + j].parameters()[k]._value
-                    for j in range(per)]
-            rows.append(jnp.stack(vals))
-        leaf = jnp.stack(rows)                       # [S, per, *shape]
+        laps = []
+        for l in range(V):
+            rows = []
+            for s in range(S):
+                c = l * S + s
+                vals = [blocks[c * per + j].parameters()[k]._value
+                        for j in range(per)]
+                rows.append(jnp.stack(vals))
+            laps.append(jnp.stack(rows))             # [S, per, *shape]
+        leaf = laps[0] if V == 1 else jnp.stack(laps)
         spec = P()
         shd = getattr(pp._value, "sharding", None)
         if isinstance(shd, NamedSharding):
             spec = shd.spec
-        full_spec = P(axis, None, *tuple(spec))
+        lead = (axis, None) if V == 1 else (None, axis, None)
+        full_spec = P(*lead, *tuple(spec))
         stacked.append(jax.device_put(leaf, NamedSharding(mesh, full_spec)))
     return stacked
 
@@ -221,7 +320,8 @@ class PipelineTrainStep:
     """
 
     def __init__(self, layers, loss_fn, optimizer, *, mesh=None,
-                 num_microbatches=1, axis="pipe", remat=True):
+                 num_microbatches=1, axis="pipe", remat=True,
+                 num_virtual=1):
         from .pp_layers import PipelineLayer
         if isinstance(layers, PipelineLayer):
             flat = [l for stage in layers._stage_layers for l in stage]
@@ -235,11 +335,17 @@ class PipelineTrainStep:
         self.mesh = mesh
         self.axis = axis
         self.num_stages = mesh.shape[axis]
+        self.num_virtual = num_virtual
         self.num_microbatches = num_microbatches
         if num_microbatches < self.num_stages:
             raise ValueError(
                 f"num_microbatches ({num_microbatches}) must be >= pipeline "
                 f"stages ({self.num_stages}) for a useful schedule")
+        if num_virtual > 1 and num_microbatches % self.num_stages != 0:
+            raise ValueError(
+                f"interleaved pipeline (num_virtual={num_virtual}) needs "
+                f"num_microbatches ({num_microbatches}) divisible by "
+                f"stages ({self.num_stages})")
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._remat = remat
@@ -249,12 +355,13 @@ class PipelineTrainStep:
     # -- construction -----------------------------------------------------
     def _build(self):
         S = self.num_stages
+        V = self.num_virtual
         flat = self._flat
-        start, count = find_block_run(flat, S)
+        start, count = find_block_run(flat, S * V)
         self._blocks = flat[start:start + count]
         pre_layers = flat[:start]
         post_layers = flat[start + count:]
-        per = count // S
+        per = count // (S * V)
         self._per_stage = per
 
         # outer (non-pipelined) params, deduped by identity so tied weights
@@ -271,9 +378,10 @@ class PipelineTrainStep:
 
         opt = self.optimizer
 
-        # stacked block params [S, per, ...] over the pipe axis
+        # stacked block params [S, per, ...] (or [V, S, per, ...]) over the
+        # pipe axis
         self._stacked = stack_stage_params(self._blocks, S, self.mesh,
-                                           self.axis)
+                                           self.axis, num_virtual=V)
 
         # accumulators: probe shapes/dtypes with the real (un-stacked) params
         probe = [p for p in outer + self._proto_params if not p.stop_gradient]
@@ -367,7 +475,8 @@ class PipelineTrainStep:
                 hm = jnp.reshape(h, mb_shape)
                 ym = spmd_pipeline(stage_fn, stacked_vals, hm,
                                    mesh=mesh, axis=axis,
-                                   key=jax.random.fold_in(key, 0x5049))
+                                   key=jax.random.fold_in(key, 0x5049),
+                                   num_virtual=V)
                 h2 = jnp.reshape(ym, h.shape[:1] + ym.shape[2:])
                 out = swap_apply(post_layers, outer, outer_vals, h2)
                 loss = loss_fn(Tensor(out, stop_gradient=True),
@@ -389,13 +498,17 @@ class PipelineTrainStep:
             for pv, gv, ac in zip(pvals, grads, accs):
                 acc_dict = dict(zip(acc_names_l, ac))
                 if stacked:
-                    # per-block update: vmap over the (S, per) leading dims
-                    # so norm-based optimizers (Lamb/Lars) see one block's
+                    # per-block update: vmap over the (S, per) — or
+                    # (V, S, per) when interleaved — leading dims so
+                    # norm-based optimizers (Lamb/Lars) see one block's
                     # parameter at a time, exactly as un-stacked training
                     def upd(pv_, gv_, ad_):
                         return opt._single_update(pv_, gv_, ad_, lr,
                                                   step_count)
-                    np_, na_ = jax.vmap(jax.vmap(upd))(pv, gv, acc_dict)
+                    vm = upd
+                    for _ in range(2 if V == 1 else 3):
+                        vm = jax.vmap(vm)
+                    np_, na_ = vm(pv, gv, acc_dict)
                 else:
                     np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
                                                   step_count)
@@ -495,11 +608,19 @@ class PipelineTrainStep:
         for p, v in zip(self._outer_params, self._outer_vals):
             p._value = v
         per = self._per_stage
+        S, V = self.num_stages, self.num_virtual
+
+        def chunk_entry(arr, c, j):
+            # chunk c = l*S + s lives at arr[s, ...] (V=1) or arr[l, s, ...]
+            if V == 1:
+                return arr[c, j]
+            return arr[c // S, c % S, j]
+
         for k, leaf in enumerate(self._stacked):
-            for s in range(self.num_stages):
+            for c in range(S * V):
                 for j in range(per):
-                    blk = self._blocks[s * per + j]
-                    blk.parameters()[k]._value = leaf[s, j]
+                    blk = self._blocks[c * per + j]
+                    blk.parameters()[k]._value = chunk_entry(leaf, c, j)
         opt = self.optimizer
         names = self._acc_names
         t_outer = [p for p in self._outer_params if not p.stop_gradient]
@@ -516,7 +637,8 @@ class PipelineTrainStep:
             for n, a in zip(names, accs):
                 if a is None:
                     continue
-                for s in range(self.num_stages):
+                for c in range(S * V):
                     for j in range(per):
-                        blk_p = self._blocks[s * per + j].parameters()[k]
-                        opt._accumulators[n][blk_p.name] = a[s, j]
+                        blk_p = self._blocks[c * per + j].parameters()[k]
+                        opt._accumulators[n][blk_p.name] = \
+                            chunk_entry(a, c, j)
