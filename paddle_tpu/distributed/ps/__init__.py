@@ -178,3 +178,41 @@ class PSClient:
 
     def table_size(self, name):
         return self._rpc().rpc_sync(self.server, _ps_table_size, args=(name,))
+
+
+class LocalPSClient(PSClient):
+    """In-process client: tables live in this process (no rpc) — the
+    single-node analog of the reference's local PS mode, used by the
+    Trainer/DeviceWorker loop in tests and notebooks."""
+
+    def __init__(self):
+        super().__init__(server_name="<local>")
+
+    def create_dense_table(self, name, shape, initializer="zeros", seed=0):
+        _ps_create_dense(name, list(shape), initializer, seed)
+
+    def create_sparse_table(self, name, dim, initializer="uniform", seed=0):
+        _ps_create_sparse(name, dim, initializer, seed)
+
+    def pull_dense(self, name):
+        return Tensor(np.asarray(_ps_pull_dense(name)))
+
+    def push_dense(self, name, grad, lr=0.1):
+        g = np.asarray(grad._value if isinstance(grad, Tensor) else grad,
+                       np.float32)
+        _ps_push_dense(name, g, lr)
+
+    def pull_sparse(self, name, ids):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        return Tensor(np.asarray(_ps_pull_sparse(name, ids_np)))
+
+    def push_sparse(self, name, ids, grads, lr=0.1):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        g = np.asarray(grads._value if isinstance(grads, Tensor) else grads,
+                       np.float32).reshape(len(ids_np), -1)
+        _ps_push_sparse(name, ids_np, g, lr)
+
+    def table_size(self, name):
+        return _ps_table_size(name)

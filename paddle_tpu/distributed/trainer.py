@@ -1,0 +1,181 @@
+"""Trainer / DeviceWorker stack (PS-style training loops).
+
+Reference analog: python/paddle/fluid/trainer_factory.py,
+trainer_desc.py (TrainerDesc/MultiTrainer/DistMultiTrainer) and
+device_worker.py (DeviceWorker/Hogwild/DownpourSGD) over the C++
+fluid/framework/{multi_trainer,downpour_worker,hogwild_worker}.cc.
+
+TPU-first: the reference's device worker pulls a per-thread Program through
+an op-by-op executor against a parameter server; here a worker thread pulls
+dense/sparse slices from the PS, runs ONE jitted local step (fwd+bwd in a
+single XLA executable), and pushes gradients back — hogwild-style lock-free
+across threads. Dense math stays on device; only the PS exchange is host
+numpy.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["TrainerDesc", "DeviceWorker", "Hogwild", "DownpourSGD",
+           "MultiTrainer", "DistMultiTrainer", "TrainerFactory"]
+
+
+class TrainerDesc:
+    """Config shell (reference: trainer_desc.py TrainerDesc proto wrapper)."""
+
+    def __init__(self):
+        self.thread_num = 1
+        self.device_worker_name = "Hogwild"
+        self.fetch_vars = []
+        self.fetch_period = 100
+        self.use_ps = False
+
+    def _set_thread(self, n):
+        self.thread_num = int(n)
+
+    def _set_device_worker(self, name):
+        self.device_worker_name = name
+
+    def _set_fetch_var_and_period(self, fetch_vars, period):
+        self.fetch_vars = list(fetch_vars)
+        self.fetch_period = int(period)
+
+
+class DeviceWorker:
+    """One worker = one thread's training loop body."""
+
+    def __init__(self):
+        self._desc = None
+
+    def _set_trainer_desc(self, desc):
+        self._desc = desc
+
+    def train_one_batch(self, batch):
+        raise NotImplementedError
+
+
+class Hogwild(DeviceWorker):
+    """Lock-free local training (reference: hogwild_worker.cc): every thread
+    updates the SHARED local model through the optimizer without
+    synchronization; jax arrays being immutable makes each update atomic at
+    the parameter-pointer level."""
+
+    def __init__(self, model, loss_fn, optimizer):
+        super().__init__()
+        self._model = model
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+
+    def train_one_batch(self, batch):
+        x, y = batch
+        loss = self._loss_fn(self._model(x), y)
+        loss.backward()
+        self._opt.step()
+        self._opt.clear_grad()
+        return float(loss)
+
+
+class DownpourSGD(DeviceWorker):
+    """PS worker (reference: downpour_worker.cc + DownpourSGD in
+    device_worker.py): pull dense table + the batch's sparse rows, compute
+    grads with ONE jitted fwd+bwd, push grads back to the server.
+
+    loss_of(dense_w, emb_rows, batch) -> scalar loss must be a pure jax
+    function; its grads w.r.t. the pulled slices are what gets pushed.
+    """
+
+    def __init__(self, client, dense_table, sparse_table, loss_of, lr=0.1):
+        super().__init__()
+        import jax
+        self._client = client
+        self._dense = dense_table
+        self._sparse = sparse_table
+        self._lr = lr
+        self._grad = jax.jit(jax.value_and_grad(loss_of, argnums=(0, 1)))
+
+    def train_one_batch(self, batch):
+        import jax.numpy as jnp
+        ids, data = batch
+        w = self._client.pull_dense(self._dense)
+        rows = self._client.pull_sparse(self._sparse, ids)
+        loss, (gw, ge) = self._grad(jnp.asarray(w._value),
+                                    jnp.asarray(rows._value), data)
+        self._client.push_dense(self._dense, np.asarray(gw), lr=self._lr)
+        self._client.push_sparse(self._sparse, ids, np.asarray(ge),
+                                 lr=self._lr)
+        return float(loss)
+
+
+class MultiTrainer:
+    """Thread fan-out over a shared batch stream (reference:
+    multi_trainer.cc). Batches are claimed lock-step from one iterator; each
+    thread runs its own DeviceWorker instance."""
+
+    def __init__(self, desc: TrainerDesc, worker_factory):
+        self._desc = desc
+        self._worker_factory = worker_factory
+        self.losses = []
+
+    def run(self, batches):
+        it = iter(batches)
+        lock = threading.Lock()
+        losses = []
+        errors = []
+
+        def loop(tid):
+            worker = self._worker_factory(tid)
+            worker._set_trainer_desc(self._desc)
+            step = 0
+            while True:
+                with lock:
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                try:
+                    loss = worker.train_one_batch(batch)
+                except BaseException as e:
+                    errors.append(e)
+                    return
+                losses.append(loss)
+                step += 1
+                if self._desc.fetch_vars and \
+                        step % self._desc.fetch_period == 0:
+                    print(f"[trainer thread {tid}] step {step} "
+                          f"loss {loss:.4f}")
+
+        threads = [threading.Thread(target=loop, args=(t,), daemon=True)
+                   for t in range(self._desc.thread_num)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        self.losses = losses
+        return losses
+
+
+class DistMultiTrainer(MultiTrainer):
+    """PS-distributed variant (reference: DistMultiTrainer): same fan-out,
+    workers talk to the parameter server (DownpourSGD)."""
+
+
+class TrainerFactory:
+    """Reference analog: trainer_factory.py — builds (trainer, worker) from
+    a desc."""
+
+    _trainers = {"MultiTrainer": MultiTrainer,
+                 "DistMultiTrainer": DistMultiTrainer}
+
+    def create_trainer(self, trainer_name, desc, worker_factory):
+        cls = self._trainers.get(trainer_name)
+        if cls is None:
+            raise ValueError(
+                f"unknown trainer {trainer_name!r}; have "
+                f"{sorted(self._trainers)}")
+        return cls(desc, worker_factory)

@@ -184,12 +184,15 @@ class AccumulationNode(GradNode):
             res = hook(Tensor(g, stop_gradient=True))
             if res is not None:
                 g = res._value if hasattr(res, "_value") else jnp.asarray(res)
-        if t.grad is None:
+        cur = t.grad    # snapshot: a concurrent clear_grad (hogwild
+        # threads, multi_trainer.cc semantics) must not crash accumulation
+        if cur is None:
             from .core import Tensor
-            t.grad = Tensor(g, stop_gradient=True)
-            t.grad.name = t.name + "@GRAD" if t.name else "grad"
+            cur = Tensor(g, stop_gradient=True)
+            cur.name = t.name + "@GRAD" if t.name else "grad"
+            t.grad = cur
         else:
-            t.grad._value = t.grad._value + g
+            cur._value = cur._value + g
 
 
 def _count_dependencies(root: GradNode):

@@ -17,11 +17,15 @@ __all__ = ["seed", "get_rng_key", "split_key", "default_generator",
 
 
 class _GlobalGenerator:
-    """Stateful generator: holds a jax PRNG key, splits off a fresh subkey per use."""
+    """Stateful generator: holds a jax PRNG key, splits off a fresh subkey
+    per use. The key materializes LAZILY on first use — creating it at
+    import would initialize the jax backend as a side effect of
+    `import paddle_tpu` (launch helpers and shell tools must be able to
+    import the package without touching an accelerator)."""
 
     def __init__(self, seed_val: int = 0):
         self._lock = threading.Lock()
-        self._key = jax.random.key(seed_val)
+        self._key = None
         self.initial_seed = seed_val
 
     def manual_seed(self, seed_val: int):
@@ -32,6 +36,8 @@ class _GlobalGenerator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self.initial_seed)
             self._key, sub = jax.random.split(self._key)
         return sub
 

@@ -2,5 +2,5 @@ from . import gpt  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
     gpt2_124m, gpt3_1p3b, gpt3_6p7b, shard_gpt,
-    GPTEmbeddingPipe, GPTHeadPipe, gpt_pipeline_layers,
+    GPTEmbeddingPipe, GPTHeadPipe, gpt_pipeline_layers, GPTDecodeStep,
 )

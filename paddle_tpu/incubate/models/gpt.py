@@ -21,6 +21,7 @@ import math
 from dataclasses import dataclass, field
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ...nn.layer_base import Layer
@@ -35,7 +36,8 @@ from ...framework.core import Tensor
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
            "gpt2_124m", "gpt3_1p3b", "gpt3_6p7b", "shard_gpt",
-           "GPTEmbeddingPipe", "GPTHeadPipe", "gpt_pipeline_layers"]
+           "GPTEmbeddingPipe", "GPTHeadPipe", "gpt_pipeline_layers",
+           "GPTDecodeStep"]
 
 
 @dataclass
@@ -96,6 +98,12 @@ class GPTAttention(Layer):
         q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), 2)
         k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), 2)
         v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), 2)
+        if cache is not None and len(cache) == 3:
+            # static serving cache: preallocated [B, T, H, D] buffers + a
+            # write position — one compiled decode step serves every token
+            # (reference analog: the fused_multi_transformer serving cache,
+            # inference/api/analysis_predictor.h:95 clientele)
+            return self._decode_step(q, k, v, cache, b, n)
         if cache is not None:
             pk, pv = cache
             k = manip.concat([pk, k], axis=1)
@@ -109,6 +117,47 @@ class GPTAttention(Layer):
         out = manip.reshape(out, [b, n, self.hidden_size])
         out = self.resid_dropout(self.out_proj(out))
         return (out, cache) if cache is not None else out
+
+    def _decode_step(self, q, k, v, cache, b, n):
+        """Single-token attention against a static KV buffer: write the new
+        K/V at `pos`, attend over positions <= pos. All shapes static, so
+        XLA compiles ONE program for the whole decode loop."""
+        k_buf, v_buf, pos = cache
+        posv = pos._value
+
+        def fn(qv, kv, vv, kbv, vbv):
+            z = jnp.asarray(0, jnp.int32)   # match index dtypes under x64
+            start = (z, posv.astype(jnp.int32), z, z)
+            kbv = jax.lax.dynamic_update_slice(kbv, kv.astype(kbv.dtype),
+                                               start)
+            vbv = jax.lax.dynamic_update_slice(vbv, vv.astype(vbv.dtype),
+                                               start)
+            t = kbv.shape[1]
+            # [B,H,n,D] x [B,H,D,T] -> scores [B,H,n,T]
+            qh = jnp.transpose(qv, (0, 2, 1, 3))
+            kh = jnp.transpose(kbv, (0, 2, 3, 1))
+            scores = jnp.einsum("bhnd,bhdt->bhnt", qh, kh) \
+                / jnp.sqrt(jnp.asarray(self.head_dim, qv.dtype))
+            # row r of this chunk sits at absolute position pos+r and may
+            # attend to every position <= pos+r (causal within the chunk)
+            n_in = qv.shape[1]
+            row_pos = posv + jnp.arange(n_in)[None, None, :, None]
+            valid = jnp.arange(t)[None, None, None, :] <= row_pos
+            scores = jnp.where(valid, scores, jnp.asarray(-1e9, qv.dtype))
+            probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                   axis=-1).astype(qv.dtype)
+            vh = jnp.transpose(vbv, (0, 2, 1, 3))
+            out = jnp.einsum("bhnt,bhtd->bhnd", probs, vh)
+            return jnp.transpose(out, (0, 2, 1, 3)), kbv, vbv
+
+        from ...ops._helpers import call_op_multi, ensure_tensor
+        out, new_k, new_v = call_op_multi(
+            "gpt_decode_attention", fn,
+            (ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
+             k_buf, v_buf), num_outputs=3)
+        out = manip.reshape(out, [b, n, self.hidden_size])
+        out = self.out_proj(out)
+        return out, (new_k, new_v, pos)
 
 
 class GPTMLP(Layer):
@@ -164,8 +213,16 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None):
         b, n = input_ids.shape[0], input_ids.shape[1]
-        past_len = caches[0][0].shape[1] if caches is not None else 0
-        if position_ids is None:
+        static_cache = caches is not None and len(caches[0]) == 3
+        if static_cache:
+            past = caches[0][2]._value           # current write position
+            past_len = None
+        else:
+            past_len = caches[0][0].shape[1] if caches is not None else 0
+        if position_ids is None and static_cache:
+            pos = Tensor(past.astype(jnp.int32)
+                         + jnp.arange(n, dtype=jnp.int32)[None, :])
+        elif position_ids is None:
             pos = Tensor(jnp.arange(past_len, past_len + n,
                                     dtype=jnp.int32)[None, :])
         else:
@@ -239,6 +296,153 @@ class GPTForCausalLM(Layer):
         if training:
             return 6 * n + 3 * attn_fwd
         return 2 * n + attn_fwd
+
+    def gen_static_caches(self, batch_size, max_len, dtype=None):
+        """Preallocated serving caches: per layer (k_buf, v_buf) of shape
+        [B, max_len, H, D] plus a shared position scalar — the static-shape
+        counterpart of gen_caches for the compiled decode loop."""
+        cfg = self.config
+        if dtype is None:
+            params = self.parameters()
+            dtype = params[0]._value.dtype if params else jnp.float32
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        shape = (batch_size, max_len, cfg.num_attention_heads, head_dim)
+        return [(Tensor(jnp.zeros(shape, dtype)),
+                 Tensor(jnp.zeros(shape, dtype)))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=1, temperature=1.0, seed=0):
+        """Batched autoregressive decoding, compiled as ONE XLA program:
+        prefill on the full prompt, then a lax.scan over decode steps
+        against static KV buffers (shapes fixed at [B, P + N]).
+
+        Reference analog: the serving decode the reference drives through
+        AnalysisPredictor + fused_multi_transformer
+        (inference/api/analysis_predictor.h:95, incubate FusedMultiTransformer);
+        greedy (do_sample=False) or top-k temperature sampling.
+        Returns the generated ids, [B, max_new_tokens].
+        """
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, p = ids.shape
+        n_new = int(max_new_tokens)
+        total = p + n_new
+        params = self.parameters()
+        was_training = self.training
+        self.eval()
+
+        def swap_call(pvals, *args, **kw):
+            saved = [pp._value for pp in params]
+            try:
+                for pp, vv in zip(params, pvals):
+                    pp._value = vv
+                from ...framework.autograd import set_grad_enabled
+                with set_grad_enabled(False):
+                    return self.forward(*args, **kw)
+            finally:
+                for pp, vv in zip(params, saved):
+                    pp._value = vv
+
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dt = params[0]._value.dtype
+
+        def decode(pvals, prompt, key):
+            # prefill: dynamic-cache forward over the prompt (static shapes
+            # because the prompt length is static)
+            empty = [(Tensor(jnp.zeros((b, 0, cfg.num_attention_heads,
+                                        head_dim), dt)),) * 2
+                     for _ in range(cfg.num_hidden_layers)]
+            logits, caches = swap_call(pvals,
+                                       Tensor(prompt, stop_gradient=True),
+                                       caches=[tuple(c) for c in empty])
+            # pack prompt KV into the static buffers
+            bufs = []
+            for (ck, cv) in caches:
+                kb = jnp.zeros((b, total, cfg.num_attention_heads, head_dim),
+                               dt).at[:, :p].set(ck._value)
+                vb = jnp.zeros((b, total, cfg.num_attention_heads, head_dim),
+                               dt).at[:, :p].set(cv._value)
+                bufs.append((kb, vb))
+            last = logits._value[:, -1, :]
+
+            def pick(lg, k2):
+                if not do_sample:
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                lg = lg.astype(jnp.float32) / max(temperature, 1e-6)
+                if top_k and top_k > 0:
+                    kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+                    lg = jnp.where(lg < kth, -jnp.inf, lg)
+                return jax.random.categorical(k2, lg, axis=-1) \
+                    .astype(jnp.int32)
+
+            tok0 = pick(last, jax.random.fold_in(key, 0))
+
+            def step(carry, i):
+                tok, bufs, key = carry
+                pos = p + i
+                static = [(Tensor(kb), Tensor(vb),
+                           Tensor(jnp.asarray(pos, jnp.int32)))
+                          for kb, vb in bufs]
+                lg, new_caches = swap_call(
+                    pvals, Tensor(tok[:, None], stop_gradient=True),
+                    caches=static)
+                bufs = [(nk._value, nv._value)
+                        for nk, nv, _pos in new_caches]
+                nxt = pick(lg._value[:, -1, :],
+                           jax.random.fold_in(key, i + 1))
+                return (nxt, bufs, key), tok
+
+            (last_tok, _, _), toks = jax.lax.scan(
+                step, (tok0, bufs, key), jnp.arange(n_new - 1))
+            out = jnp.concatenate([jnp.transpose(toks, (1, 0)),
+                                   last_tok[:, None]], axis=1)
+            return out
+
+        try:
+            # cache the compiled decode per shape/flag signature — a fresh
+            # jax.jit wrapper every call would retrace AND recompile
+            if not hasattr(self, "_gen_cache"):
+                self._gen_cache = {}
+            sig = (b, p, n_new, bool(do_sample), int(top_k),
+                   float(temperature))
+            jitted = self._gen_cache.get(sig)
+            if jitted is None:
+                jitted = jax.jit(decode)
+                self._gen_cache[sig] = jitted
+            out = jitted([pp._value for pp in params], ids,
+                         jax.random.PRNGKey(seed))
+        finally:
+            if was_training:
+                self.train()
+        return Tensor(out, stop_gradient=True)
+
+
+class GPTDecodeStep(Layer):
+    """One serving decode step as a saveable artifact: (tokens [B,1],
+    k_bufs [L,B,T,H,D], v_bufs, pos scalar) -> (logits [B,1,V], new_k,
+    new_v). jit.save(...) of this layer yields the StableHLO program the
+    inference Predictor replays per generated token — the TPU-native analog
+    of running the reference's fused_multi_transformer decode through
+    AnalysisPredictor (inference/api/analysis_predictor.h:95)."""
+
+    def __init__(self, model: "GPTForCausalLM"):
+        super().__init__()
+        self.model = model
+
+    def forward(self, tokens, k_bufs, v_bufs, pos):
+        cfg = self.model.config
+        caches = []
+        for l in range(cfg.num_hidden_layers):
+            kb = manip.squeeze(manip.slice(k_bufs, [0], [l], [l + 1]), 0)
+            vb = manip.squeeze(manip.slice(v_bufs, [0], [l], [l + 1]), 0)
+            caches.append((kb, vb, pos))
+        logits, new_caches = self.model(tokens, caches=caches)
+        new_k = manip.stack([c[0] for c in new_caches])
+        new_v = manip.stack([c[1] for c in new_caches])
+        return logits, new_k, new_v
 
 
 class GPTPretrainingCriterion(Layer):

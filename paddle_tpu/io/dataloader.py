@@ -1,16 +1,20 @@
-"""DataLoader with threaded prefetch.
+"""DataLoader with threaded prefetch and multiprocess workers.
 
 Reference analog: python/paddle/fluid/reader.py:312 (DataLoader),
-fluid/dataloader/dataloader_iter.py (worker iterators), and the C++
+fluid/dataloader/dataloader_iter.py (_DataLoaderIterMultiProcess: index
+queue -> worker subprocesses -> reorder-by-batch-index), and the C++
 double-buffering reader (operators/reader/buffered_reader.cc).
 
-TPU-first: batches are assembled by a thread pool (numpy is GIL-releasing for
-the copy-heavy parts) and staged through a bounded prefetch queue so host input
-processing overlaps device compute. Device transfer happens lazily on first
-use (jnp.asarray), which XLA pipelines.
+TPU-first: with num_workers > 0 batches are assembled in forked worker
+PROCESSES (numpy-only in the children — a forked child must never touch the
+parent's initialized XLA runtime), reordered by batch index in the parent,
+and staged through a bounded prefetch queue so host input processing
+overlaps device compute. Device transfer happens lazily on first use
+(jnp.asarray), which XLA pipelines.
 """
 from __future__ import annotations
 
+import multiprocessing
 import queue
 import threading
 
@@ -21,6 +25,136 @@ from .dataset import IterableDataset
 from .sampler import BatchSampler
 
 __all__ = ["DataLoader", "default_collate_fn"]
+
+
+def _np_collate(batch):
+    """Numpy-only collation for worker processes (no jax in forked
+    children)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        # unwrap to host numpy — a forked child must not run jax ops, but
+        # np.asarray on an existing device buffer is a read
+        batch = [np.asarray(b._value) for b in batch]
+        sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(_np_collate(list(items)) for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _to_tensors(data):
+    if isinstance(data, np.ndarray):
+        return Tensor(data)
+    if isinstance(data, tuple):
+        return tuple(_to_tensors(d) for d in data)
+    if isinstance(data, dict):
+        return {k: _to_tensors(v) for k, v in data.items()}
+    return data
+
+
+def _worker_loop(dataset, task_q, result_q, worker_id, worker_init_fn,
+                 raw_samples):
+    """Body of one worker subprocess (reference:
+    dataloader_iter.py _worker_loop). Pulls (batch_idx, indices), pushes
+    (batch_idx, payload) — numpy only."""
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        bidx, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            payload = samples if raw_samples else _np_collate(samples)
+            result_q.put((bidx, payload, None))
+        except BaseException as e:       # ship the error to the parent
+            result_q.put((bidx, None, f"{type(e).__name__}: {e}"))
+
+
+class _MultiprocessProducer:
+    """Fan out index batches to forked workers; yield results IN ORDER.
+
+    In-flight work is windowed to num_workers * prefetch_factor batches
+    (like the reference _DataLoaderIterMultiProcess outstanding-batch
+    cap), so a slow consumer doesn't let workers race through the epoch
+    and pile every collated batch into host memory."""
+
+    def __init__(self, dataset, batches, num_workers, worker_init_fn,
+                 timeout, raw_samples, prefetch_factor=2):
+        ctx = multiprocessing.get_context("fork")
+        self._task_q = ctx.SimpleQueue()
+        self._result_q = ctx.Queue()
+        self._timeout = timeout
+        self._depth = max(1, num_workers * max(prefetch_factor, 1))
+        self._workers = []
+        for w in range(num_workers):
+            p = ctx.Process(target=_worker_loop,
+                            args=(dataset, self._task_q, self._result_q, w,
+                                  worker_init_fn, raw_samples),
+                            daemon=True)
+            p.start()
+            self._workers.append(p)
+        self._batches = list(batches)
+
+    def _get_result(self):
+        """Wait for one result, polling worker liveness (a SIGKILLed or
+        fork-deadlocked worker must surface as an error, not a hang)."""
+        import time as _time
+        deadline = (_time.monotonic() + self._timeout) if self._timeout \
+            else None
+        while True:
+            try:
+                return self._result_q.get(timeout=1.0)
+            except queue.Empty:
+                if any(not p.is_alive() for p in self._workers):
+                    raise RuntimeError(
+                        "a DataLoader worker process died unexpectedly "
+                        "(killed or crashed before reporting)") from None
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after "
+                        f"{self._timeout}s") from None
+
+    def __iter__(self):
+        try:
+            n = len(self._batches)
+            submitted = 0
+            while submitted < min(self._depth, n):
+                self._task_q.put((submitted,
+                                  list(self._batches[submitted])))
+                submitted += 1
+            pending = {}
+            for want in range(n):
+                while want not in pending:
+                    bidx, payload, err = self._get_result()
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed on batch {bidx}: "
+                            f"{err}")
+                    pending[bidx] = payload
+                    if submitted < n:
+                        self._task_q.put(
+                            (submitted, list(self._batches[submitted])))
+                        submitted += 1
+                yield pending.pop(want)
+        finally:
+            self.close()
+
+    def close(self):
+        for p in self._workers:
+            if p.is_alive():
+                p.terminate()
+        for p in self._workers:
+            p.join(timeout=1.0)
+        self._workers = []
 
 
 def default_collate_fn(batch):
@@ -99,9 +233,12 @@ class DataLoader:
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
+        self._custom_collate = collate_fn is not None
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
@@ -131,28 +268,19 @@ class DataLoader:
             if batch and not self.drop_last:
                 yield self.collate_fn(batch)
             return
-        if self.num_workers > 0:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                def fetch(indices):
-                    return self.collate_fn(
-                        [self.dataset[i] for i in indices])
-                # windowed map keeps at most num_workers*prefetch futures alive
-                futures = []
-                it = iter(self.batch_sampler)
-                depth = self.num_workers * max(self.prefetch_factor, 1)
-                try:
-                    for _ in range(depth):
-                        futures.append(pool.submit(fetch, next(it)))
-                except StopIteration:
-                    it = None
-                while futures:
-                    yield futures.pop(0).result()
-                    if it is not None:
-                        try:
-                            futures.append(pool.submit(fetch, next(it)))
-                        except StopIteration:
-                            it = None
+        if self.num_workers > 0 and hasattr(multiprocessing, "get_context"):
+            # subprocess workers (reference _DataLoaderIterMultiProcess).
+            # Default collate: workers collate numpy, the parent wraps
+            # Tensors. Custom collate_fn runs in the PARENT on the raw
+            # samples (jax must never run in a forked child).
+            raw = self._custom_collate
+            producer = _MultiprocessProducer(
+                self.dataset, iter(self.batch_sampler), self.num_workers,
+                self.worker_init_fn, self.timeout, raw,
+                prefetch_factor=self.prefetch_factor)
+            for payload in producer:
+                yield self.collate_fn(payload) if raw \
+                    else _to_tensors(payload)
         else:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])

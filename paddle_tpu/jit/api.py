@@ -71,7 +71,29 @@ class StaticFunction:
 
     @property
     def forward_callable(self):
+        if getattr(self, "_transformed_fwd", None) is not None:
+            return self._transformed_fwd
         return self._layer.forward if self._layer is not None else self._function
+
+    def _apply_dy2static(self):
+        """Retry hook: rewrite data-dependent if/while via the dy2static AST
+        transformer (reference analog: program_translator.py falling back to
+        dygraph_to_static conversion). Returns True when a transform was
+        installed."""
+        if getattr(self, "_transformed_fwd", None) is not None:
+            return False
+        from .dy2static import ast_transform
+        import types as _types
+        base = self._layer.forward if self._layer is not None \
+            else self._function
+        new_fn = ast_transform(base)
+        if new_fn is None:
+            return False
+        if self._layer is not None:
+            new_fn = _types.MethodType(new_fn, self._layer)
+        self._transformed_fwd = new_fn
+        self._jitted.clear()
+        return True
 
     def _make_pure(self, params, buffers, tensor_args_spec, static_args):
         fwd = self.forward_callable
@@ -145,41 +167,66 @@ class StaticFunction:
                 for v in kwargs.values()) else None,
             training,
         )
-        with self._lock:
-            entry = self._jitted.get(cache_key)
-            if entry is None:
-                pure = self._make_pure(params, buffers, spec, kwargs)
-                jitted = jax.jit(pure)
-                entry = (pure, jitted)
-                self._jitted[cache_key] = entry
-        pure, jitted = entry
-
         all_inputs = params + buffers + tensor_args
         values = [t._value for t in all_inputs]
         key = _random.get_rng_key()
 
+        def build():
+            with self._lock:
+                entry = self._jitted.get(cache_key)
+                if entry is None:
+                    pure = self._make_pure(params, buffers, spec, kwargs)
+                    entry = (pure, jax.jit(pure))
+                    self._jitted[cache_key] = entry
+            return entry
+
+        pure, jitted = build()
+
         requires_grad = is_grad_enabled() and any(
             not t.stop_gradient for t in all_inputs)
         n_out_extra = len(buffers)
+        # data-dependent python control flow fails the FIRST trace of a new
+        # signature; rewrite via the dy2static AST pass and retry once (no
+        # extra tracing on the happy path)
+        from jax.errors import JAXTypeError
         if not requires_grad:
-            out_vals = jitted(values, key)
+            try:
+                out_vals = jitted(values, key)
+            except JAXTypeError:
+                if not self._apply_dy2static():
+                    raise
+                pure, jitted = build()
+                out_vals = jitted(values, key)
         else:
             # one GradNode for the whole compiled function
             diff_idx = [i for i, t in enumerate(all_inputs)
                         if not t.stop_gradient and
                         jnp.issubdtype(t._value.dtype, jnp.inexact)]
 
-            def fn(*diff_vals):
-                full = list(values)
-                for i, v in zip(diff_idx, diff_vals):
-                    full[i] = v
-                return jitted(full, key)
+            def make_fn(jitted_):
+                def fn(*diff_vals):
+                    full = list(values)
+                    for i, v in zip(diff_idx, diff_vals):
+                        full[i] = v
+                    return jitted_(full, key)
+                return fn
 
-            out_vals, vjp_fn = jax.vjp(
-                fn, *(values[i] for i in diff_idx))
+            try:
+                out_vals, vjp_fn = jax.vjp(
+                    make_fn(jitted), *(values[i] for i in diff_idx))
+            except JAXTypeError:
+                if not self._apply_dy2static():
+                    raise
+                pure, jitted = build()
+                out_vals, vjp_fn = jax.vjp(
+                    make_fn(jitted), *(values[i] for i in diff_idx))
 
             def wrapped_vjp(gs, _vjp=vjp_fn, _idx=diff_idx,
                             _n=len(all_inputs)):
+                if not isinstance(gs, tuple):
+                    # engine passes a bare cotangent for single-output fns;
+                    # jax.vjp of a tuple-returning fn wants a tuple
+                    gs = (gs,)
                 partial = _vjp(gs)
                 full = [None] * _n
                 for i, pg in zip(_idx, partial):

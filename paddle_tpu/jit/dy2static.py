@@ -1,0 +1,328 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Reference analog: python/paddle/fluid/dygraph/dygraph_to_static/
+(program_translator.py + ifelse_transformer.py / loop_transformer.py) — the
+reference rewrites `if`/`while` over tensors into cond/while ops in the
+ProgramDesc. TPU-first: the same AST rewrite targets `lax.cond` /
+`lax.while_loop`, so a data-dependent branch or loop compiles into the ONE
+jitted program instead of failing the trace.
+
+Scope (the pragmatic subset the transformer guarantees):
+  - `if`/`while` whose condition may be a traced Tensor;
+  - branch/loop bodies that communicate through assigned local variables
+    (the transformer computes the carried-name set);
+  - bodies containing `return`/`break`/`continue` are left untransformed
+    (python semantics; they only work with concrete conditions);
+  - python-valued conditions keep exact python semantics (the runtime
+    helpers fall back to ordinary branching when the predicate is concrete).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["convert_ifelse", "convert_while", "ast_transform",
+           "Dy2StaticError"]
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+def _raw(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _pack(carry):
+    """Split a carry tuple into (traced values, rebuild) — Tensor/array
+    leaves flow through lax; everything else is static and passes through
+    unchanged (branches must not rewrite statics divergently)."""
+    vals, slots = [], []
+    for c in carry:
+        r = _raw(c)
+        if isinstance(r, (jax.Array, jnp.ndarray)) or _is_tracer(r) or \
+                isinstance(r, (int, float, bool)):
+            slots.append(len(vals))
+            vals.append(jnp.asarray(r))
+        else:
+            slots.append(None)
+
+    def rebuild(new_vals, statics=carry):
+        out = []
+        for slot, orig in zip(slots, statics):
+            if slot is None:
+                out.append(orig)
+            else:
+                out.append(Tensor(new_vals[slot], stop_gradient=True))
+        return tuple(out)
+    return tuple(vals), rebuild, slots
+
+
+def convert_ifelse(pred, true_fn, false_fn, carry):
+    """Runtime of a transformed `if`: python branch for concrete predicates,
+    lax.cond for traced ones. The OUTPUT structure is read off the branch
+    traces (lax.cond traces both branches at bind time), so locals first
+    bound inside the branches work."""
+    p = _raw(pred)
+    if not _is_tracer(p):
+        return true_fn(*carry) if bool(p) else false_fn(*carry)
+    vals, rebuild, _ = _pack(carry)
+    meta = {}
+
+    def wrap(fn, tag):
+        def g(vs):
+            out = fn(*rebuild(vs))
+            ovals, _, oslots = _pack(out)
+            meta[tag] = (oslots, out)
+            return ovals
+        return g
+
+    try:
+        out_vals = jax.lax.cond(jnp.asarray(p, bool).reshape(()),
+                                wrap(true_fn, "t"), wrap(false_fn, "f"),
+                                vals)
+    except TypeError as e:
+        raise Dy2StaticError(
+            "branches of a traced `if` must produce matching tensor "
+            f"structures: {e}") from None
+    if meta["t"][0] != meta["f"][0]:
+        raise Dy2StaticError(
+            "branches of a traced `if` must bind the same set of "
+            "tensor-valued locals")
+    oslots, sample = meta["t"]
+    return tuple(sample[i] if slot is None
+                 else Tensor(out_vals[slot], stop_gradient=True)
+                 for i, slot in enumerate(oslots))
+
+
+def convert_while(cond_fn, body_fn, carry):
+    """Runtime of a transformed `while`: python loop for concrete
+    predicates, lax.while_loop once the condition traces."""
+    first = _raw(cond_fn(*carry))
+    if not _is_tracer(first):
+        # concrete: plain python loop (re-evaluating the condition eagerly)
+        while bool(_raw(cond_fn(*carry))):
+            carry = body_fn(*carry)
+        return carry
+    vals, rebuild, slots = _pack(carry)
+
+    def cond(vs):
+        return jnp.asarray(_raw(cond_fn(*rebuild(vs))), bool).reshape(())
+
+    def body(vs):
+        out = body_fn(*rebuild(vs))
+        ovals, _, oslots = _pack(out)
+        if oslots != slots:
+            raise Dy2StaticError(
+                "a traced `while` body must keep the same set of "
+                "tensor-valued locals as the loop entry (bind loop "
+                "variables before the loop)")
+        return ovals
+
+    out_vals = jax.lax.while_loop(cond, body, vals)
+    return rebuild(out_vals)
+
+
+# ---------------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------------
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names assigned (Store context) in a statement list, not descending
+    into nested function/class scopes."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _has_flow_escape(stmts):
+    """True if the statement list contains top-scope return/break/continue
+    (not inside a nested function or a nested loop for break/continue)."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        # break/continue inside a NESTED loop don't escape our region, but a
+        # nested loop's body may still contain `return`; keep scanning loops.
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+class _Undefined:
+    """Sentinel for locals not yet bound when a transformed region starts
+    (reference analog: dygraph_to_static UndefinedVar)."""
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def _undef_guard(name):
+    """`try: name\nexcept NameError|UnboundLocalError: name = UNDEFINED`"""
+    return ast.Try(
+        body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                                 ast.Name(id="UnboundLocalError",
+                                          ctx=ast.Load())],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Name(id="_d2s_UNDEFINED", ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
+
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._k = 0
+
+    def _fresh(self, kind):
+        self._k += 1
+        return f"_d2s_{kind}_{self._k}"
+
+    def _make_fn(self, name, arg_names, body, ret_names):
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in arg_names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        ret = ast.Return(value=_names_tuple(ret_names, ast.Load))
+        return ast.FunctionDef(name=name, args=args, body=body + [ret],
+                               decorator_list=[], returns=None,
+                               type_params=[])
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node
+        names = sorted(_assigned(node.body) | _assigned(node.orelse))
+        if not names:
+            return node
+        tname = self._fresh("true")
+        fname = self._fresh("false")
+        tfn = self._make_fn(tname, names, node.body, names)
+        ffn = self._make_fn(fname, names,
+                            node.orelse if node.orelse else [ast.Pass()],
+                            names)
+        call = ast.Call(
+            func=ast.Name(id="_d2s_convert_ifelse", ctx=ast.Load()),
+            args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  _names_tuple(names, ast.Load)], keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(names, ast.Store)],
+                            value=call)
+        return [_undef_guard(n) for n in names] + [tfn, ffn, assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_flow_escape(node.body):
+            return node
+        names = sorted(_assigned(node.body))
+        if not names:
+            return node
+        cname = self._fresh("cond")
+        bname = self._fresh("body")
+        cargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cfn = ast.FunctionDef(
+            name=cname, args=cargs, body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        bfn = self._make_fn(bname, names, node.body, names)
+        call = ast.Call(
+            func=ast.Name(id="_d2s_convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  _names_tuple(names, ast.Load)], keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(names, ast.Store)],
+                            value=call)
+        return [_undef_guard(n) for n in names] + [cfn, bfn, assign]
+
+
+def ast_transform(func):
+    """Rewrite `func`'s data-dependent if/while into convert_ifelse /
+    convert_while calls; returns the transformed function, or None when the
+    function can't be transformed (no source, closures)."""
+    raw = getattr(func, "__func__", func)
+    if getattr(raw, "__closure__", None):
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return None
+    fndef = tree.body[0]
+    if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fndef.decorator_list = []
+    new_tree = _CtrlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    ns = dict(raw.__globals__)
+    ns["_d2s_convert_ifelse"] = convert_ifelse
+    ns["_d2s_convert_while"] = convert_while
+    ns["_d2s_UNDEFINED"] = UNDEFINED
+    code = compile(new_tree, filename=f"<dy2static:{raw.__name__}>",
+                   mode="exec")
+    exec(code, ns)
+    new_fn = ns[fndef.name]
+    new_fn.__dy2static__ = True
+    return new_fn

@@ -18,7 +18,10 @@ from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
 from .einsum_op import einsum  # noqa: F401
-from .registry import all_ops, get_op, register_op, override_kernel  # noqa: F401
+from .registry import (  # noqa: F401
+    all_ops, get_op, register_op, override_kernel, use_kernel, infer_meta,
+    describe,
+)
 from ._helpers import ensure_tensor
 
 

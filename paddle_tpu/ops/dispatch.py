@@ -78,6 +78,10 @@ def _make_edges(tensors):
 def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Tensor:
     """Dispatch a single-output op. `fn` maps jax values -> jax value; all
     non-tensor arguments must already be closed over in `fn`."""
+    from .registry import _active_override
+    override = _active_override(name)
+    if override is not None:
+        fn = override
     inputs = _amp_transform(name, inputs)
     vals = _values(inputs)
     debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
@@ -125,6 +129,10 @@ def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Te
 def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
                   num_outputs: int) -> list:
     """Dispatch an op whose fn returns a tuple of `num_outputs` jax values."""
+    from .registry import _active_override
+    override = _active_override(name)
+    if override is not None:
+        fn = override
     inputs = _amp_transform(name, inputs)
     vals = _values(inputs)
     debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")

@@ -1,18 +1,29 @@
 """Op schema registry — single source of truth for the op corpus.
 
 Reference analog: paddle/phi/api/yaml/{ops,legacy_ops}.yaml + KernelFactory
-(phi/core/kernel_factory.h:268). TPU-first: instead of per-backend kernel
+(phi/core/kernel_factory.h:268) + custom kernel plug-in
+(phi/core/custom_kernel.cc). TPU-first: instead of per-backend kernel
 variants keyed by (Backend, Layout, DataType), every op has one jax
-implementation that XLA lowers for the active platform; the registry exists for
-introspection, parity auditing, and pluggable overrides (e.g. swapping a Pallas
-kernel in for a hot op).
+implementation that XLA lowers for the active platform. The registry holds
+the schema the reference keeps in YAML — generated from the code instead of
+codegen'd into it:
+
+  - args:       the op's python signature (the yaml `args:` row)
+  - infer_meta: shape/dtype inference = jax abstract eval (`infer_meta()`
+                runs the op under jax.eval_shape — no separate rule table)
+  - backward:   `differentiable` (VJPs are captured at dispatch, so every
+                differentiable op has its backward by construction)
+  - kernel:     the jax entry point, plus named overrides (e.g. a Pallas
+                kernel) that dispatch consults when activated
 """
 from __future__ import annotations
 
+import inspect
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-__all__ = ["OpDef", "register_op", "get_op", "all_ops", "override_kernel"]
+__all__ = ["OpDef", "register_op", "get_op", "all_ops", "override_kernel",
+           "use_kernel", "infer_meta", "describe"]
 
 
 @dataclass
@@ -22,7 +33,9 @@ class OpDef:
     fn: Optional[Callable] = None       # the python-level op entry point
     differentiable: bool = True
     ref: str = ""                       # reference citation (file:line)
-    overrides: dict = field(default_factory=dict)  # e.g. {"pallas": fn}
+    args: tuple = ()                    # entry-point signature (arg names)
+    overrides: dict = field(default_factory=dict)  # impl_name -> callable
+    active: Optional[str] = None        # activated override, if any
 
 
 _REGISTRY: dict[str, OpDef] = {}
@@ -32,8 +45,13 @@ def register_op(name: str, category: str, differentiable: bool = True,
                 ref: str = ""):
     """Decorator registering a python op entry point into the corpus table."""
     def deco(fn):
+        try:
+            args = tuple(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            args = ()
         _REGISTRY[name] = OpDef(name=name, category=category, fn=fn,
-                                differentiable=differentiable, ref=ref)
+                                differentiable=differentiable, ref=ref,
+                                args=args)
         return fn
     return deco
 
@@ -46,7 +64,84 @@ def all_ops() -> dict[str, OpDef]:
     return dict(_REGISTRY)
 
 
-def override_kernel(name: str, impl_name: str, fn: Callable):
-    """Install an alternative implementation (e.g. a Pallas kernel) for an op.
-    Reference analog: custom kernel plug-in (phi/core/custom_kernel.cc)."""
-    _REGISTRY[name].overrides[impl_name] = fn
+def describe(name: str) -> dict:
+    """The op's schema row (yaml-table analog): args / kernel / backward /
+    overrides."""
+    od = _REGISTRY[name]
+    return {"op": od.name, "category": od.category, "args": list(od.args),
+            "backward": f"{od.name}_grad (vjp)" if od.differentiable
+            else None, "kernel": "jax/XLA" if od.fn is not None else None,
+            "overrides": list(od.overrides), "active_override": od.active,
+            "ref": od.ref}
+
+
+def infer_meta(name: str, *specs):
+    """Shape/dtype inference via jax abstract eval (the InferMeta analog —
+    SURVEY §2.2 row: InferMeta ≙ jax.eval_shape). `specs` are
+    jax.ShapeDtypeStruct-likes (or arrays); returns the output
+    ShapeDtypeStruct(s) without computing anything."""
+    import jax
+    from ..framework.core import Tensor
+    od = _REGISTRY[name]
+    if od.fn is None:
+        raise ValueError(f"op {name!r} has no registered entry point")
+
+    def run(*vals):
+        out = od.fn(*[Tensor(v, stop_gradient=True) for v in vals])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return jax.eval_shape(run, *specs)
+
+
+def override_kernel(name: str, impl_name: str, fn: Callable,
+                    activate: bool = False):
+    """Install an alternative kernel (e.g. Pallas) for an op; activation
+    (routing dispatch through `fn` instead of the built-in jax
+    implementation) is explicit — pass activate=True or use the use_kernel
+    switch — so registering a kernel for benchmarking/introspection never
+    reroutes global dispatch as a side effect. The override receives the
+    same positional jax values the built-in kernel closure receives (the
+    op's tensor operands; non-tensor attrs stay with the built-in closure
+    contract). Reference analog: phi/core/custom_kernel.cc
+    RegisterKernelWithMetaInfo.
+    """
+    od = _REGISTRY.get(name)
+    if od is None:
+        od = _REGISTRY.setdefault(name, OpDef(name=name, category="custom"))
+    od.overrides[impl_name] = fn
+    if activate:
+        od.active = impl_name
+    return fn
+
+
+class use_kernel:
+    """Context manager / switch selecting which implementation an op
+    dispatches to: use_kernel("softmax", "pallas") activates the named
+    override; use_kernel("softmax", None) restores the built-in kernel."""
+
+    def __init__(self, name: str, impl_name: Optional[str]):
+        od = _REGISTRY[name]
+        if impl_name is not None and impl_name not in od.overrides:
+            raise KeyError(
+                f"op {name!r} has no override {impl_name!r}; installed: "
+                f"{list(od.overrides)}")
+        self._od = od
+        self._prev = od.active
+        od.active = impl_name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._od.active = self._prev
+        return False
+
+
+def _active_override(name: str):
+    """Dispatch hook: the activated override callable for `name`, or None."""
+    od = _REGISTRY.get(name)
+    if od is not None and od.active is not None:
+        return od.overrides.get(od.active)
+    return None

@@ -1,0 +1,132 @@
+"""dy2static: AST conversion of data-dependent control flow to
+lax.cond/while_loop (reference analog: dygraph_to_static
+ifelse_transformer.py / loop_transformer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (ast_transform, convert_ifelse,
+                                      convert_while, Dy2StaticError)
+
+
+def test_tensor_if_compiles_both_branches():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    pos = paddle.to_tensor(np.ones(3, np.float32))
+    neg = paddle.to_tensor(-np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(f(pos)._value), 2.0)
+    np.testing.assert_allclose(np.asarray(f(neg)._value), -2.0)
+    # one cache entry serves both predicate values (it's a lax.cond, not a
+    # retrace per branch)
+    assert len(f._jitted) == 1
+
+
+def test_tensor_while_compiles():
+    @paddle.jit.to_static
+    def g(x):
+        s = x
+        while s.sum() < 100.0:
+            s = s * 2
+        return s
+
+    out = np.asarray(g(paddle.to_tensor(np.ones(3, np.float32)))._value)
+    assert out.sum() >= 100 and out.sum() / 2 < 100
+
+
+def test_python_condition_untouched():
+    @paddle.jit.to_static
+    def h(x, flag=True):
+        if flag:
+            return x + 1
+        return x - 1
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose(np.asarray(h(x)._value), 2.0)
+
+
+def test_if_updating_multiple_locals():
+    @paddle.jit.to_static
+    def f(x):
+        a = x
+        b = x * 0
+        if x.mean() > 0:
+            a = a + 10
+            b = b + 1
+        else:
+            a = a - 10
+            b = b - 1
+        return a + b
+
+    pos = paddle.to_tensor(np.ones(2, np.float32))
+    neg = paddle.to_tensor(-np.ones(2, np.float32))
+    np.testing.assert_allclose(np.asarray(f(pos)._value), 12.0)
+    np.testing.assert_allclose(np.asarray(f(neg)._value), -12.0)
+
+
+def test_loop_accumulator_with_counter():
+    @paddle.jit.to_static
+    def f(x, n):
+        i = paddle.to_tensor(np.int32(0))
+        acc = x * 0
+        while i < n:
+            acc = acc + x
+            i = i + 1
+        return acc
+
+    x = paddle.to_tensor(np.full(3, 2.0, np.float32))
+    n = paddle.to_tensor(np.int32(5))
+    np.testing.assert_allclose(np.asarray(f(x, n)._value), 10.0)
+
+
+def test_grad_through_transformed_if():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * x
+        else:
+            y = x * 3
+        return y.sum()
+
+    x = paddle.to_tensor(np.array([2.0, 1.0], np.float32),
+                         stop_gradient=False)
+    f(x).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), [4.0, 2.0])
+
+
+def test_ast_transform_returns_none_for_closures():
+    z = 3
+
+    def f(x):
+        return x + z        # closure over z
+
+    assert ast_transform(f) is None
+
+
+def test_convert_helpers_concrete_fallback():
+    out = convert_ifelse(True, lambda a: (a + 1,), lambda a: (a - 1,), (5,))
+    assert out == (6,)
+    out = convert_while(lambda i: i < 3, lambda i: (i + 1,), (0,))
+    assert out == (3,)
+
+
+def test_mismatched_branches_raise():
+    import jax
+    import jax.numpy as jnp
+
+    def run(xv):
+        t = paddle.Tensor(xv, stop_gradient=True)
+        out = convert_ifelse(
+            (t.sum() > 0),
+            lambda a: (a * 2,),            # tensor
+            lambda a: ("static-string",),  # static
+            (t,))
+        return out[0]._value
+
+    with pytest.raises(Dy2StaticError):
+        jax.jit(run)(jnp.ones(2))

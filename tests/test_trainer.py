@@ -1,0 +1,110 @@
+"""Trainer/DeviceWorker stack (reference analog: trainer_factory.py,
+device_worker.py Hogwild/DownpourSGD, multi_trainer.cc)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.trainer import (TrainerDesc, Hogwild,
+                                            DownpourSGD, MultiTrainer,
+                                            DistMultiTrainer,
+                                            TrainerFactory)
+from paddle_tpu.distributed.ps import LocalPSClient
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(4, 1)).astype(np.float32)
+    for _ in range(n):
+        x = rng.normal(size=(batch, 4)).astype(np.float32)
+        y = x @ w_true
+        yield (paddle.to_tensor(x), paddle.to_tensor(y))
+
+
+def test_hogwild_multitrainer_learns():
+    paddle.seed(0)
+    model = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    desc = TrainerDesc()
+    desc._set_thread(2)
+    trainer = MultiTrainer(desc, lambda tid: Hogwild(
+        model, lambda o, y: F.mse_loss(o, y), opt))
+    losses = trainer.run(_batches(60))
+    assert len(losses) == 60
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) / 5
+
+
+def test_downpour_ps_worker_learns():
+    """DownpourSGD against the (local) parameter server: dense weight and
+    sparse embedding rows both live on the PS and both get trained."""
+    client = LocalPSClient()
+    client.create_dense_table("w", shape=[4], initializer="zeros")
+    client.create_sparse_table("emb", dim=4, initializer="zeros")
+
+    rng = np.random.default_rng(0)
+    target = {3: 1.0, 7: -1.0, 11: 0.5}
+
+    def loss_of(w, rows, labels):
+        pred = rows @ w
+        return ((pred - labels) ** 2).mean() + 1e-4 * (w ** 2).sum()
+
+    worker = DownpourSGD(client, "w", "emb", loss_of, lr=0.5)
+    # seed w away from zero so emb rows receive gradient
+    client.push_dense("w", -np.ones(4, np.float32), lr=0.25)
+
+    losses = []
+    for step in range(150):
+        ids = np.array(list(target), np.int64)
+        labels = jnp.asarray([target[i] for i in ids], jnp.float32)
+        losses.append(worker.train_one_batch((ids, labels)))
+    assert losses[-1] < 1e-2, losses[-1]
+    assert client.table_size("emb") == 3
+
+
+def test_dist_multitrainer_with_ps():
+    client = LocalPSClient()
+    client.create_dense_table("w2", shape=[4], initializer="zeros")
+    client.create_sparse_table("emb2", dim=4, initializer="zeros")
+    client.push_dense("w2", -np.ones(4, np.float32), lr=0.25)
+
+    def loss_of(w, rows, labels):
+        return (((rows @ w) - labels) ** 2).mean()
+
+    desc = TrainerDesc()
+    desc._set_thread(2)
+    desc._set_device_worker("DownpourSGD")
+    trainer = TrainerFactory().create_trainer(
+        "DistMultiTrainer", desc,
+        lambda tid: DownpourSGD(client, "w2", "emb2", loss_of, lr=0.3))
+
+    rng = np.random.default_rng(1)
+
+    def batches():
+        for _ in range(80):
+            ids = rng.choice([1, 2, 5, 9], size=3, replace=False) \
+                .astype(np.int64)
+            labels = jnp.asarray((ids % 3 - 1).astype(np.float32))
+            yield ids, labels
+
+    losses = trainer.run(batches())
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+def test_trainer_factory_unknown_raises():
+    with pytest.raises(ValueError):
+        TrainerFactory().create_trainer("Nope", TrainerDesc(), lambda t: None)
+
+
+def test_worker_error_propagates():
+    desc = TrainerDesc()
+    desc._set_thread(2)
+
+    class Bad(Hogwild):
+        def train_one_batch(self, batch):
+            raise RuntimeError("worker exploded")
+
+    trainer = MultiTrainer(desc, lambda tid: Bad(None, None, None))
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        trainer.run(_batches(4))
