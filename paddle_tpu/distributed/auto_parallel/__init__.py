@@ -24,3 +24,6 @@ from .engine import Engine  # noqa: F401
 __all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor",
            "shard_op", "dtensor_from_fn", "reshard", "unshard_dtensor",
            "get_dist_attr", "Strategy", "Engine"]
+from .planner import (  # noqa: F401
+    ModelStats, PlanChoice, plan_mesh, gpt_stats,
+)

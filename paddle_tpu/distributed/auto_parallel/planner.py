@@ -1,0 +1,134 @@
+"""Mesh planner: pick hybrid-parallel degrees from an analytic cost model.
+
+Reference analog: python/paddle/distributed/auto_parallel/
+{planner_v2.py, cost_model.py, tuner/} — the reference searches dist-attr
+assignments per op with a simulated cost model. TPU-first the search space
+collapses to the MESH FACTORIZATION (dp x mp x pp x sharding): inside a
+factorization XLA's partitioner already places every intermediate, so the
+planner only has to weigh the collective traffic and memory of each
+factorization and hand the winner to pjit.
+
+Cost model (per training step, relative units):
+  - dp:   ring all-reduce of grads        2 * (dp-1)/dp * P_bytes
+  - mp:   2 all-reduces of activations per block
+          2 * 2 * L * (mp-1)/mp * B*S*H_bytes
+  - pp:   bubble overhead multiplies compute: (S-1)/(M+S-1)
+  - sharding (ZeRO): all-gather params + reduce-scatter grads ~ dp cost
+          but divides optimizer-state memory by the degree
+Feasibility: params + grads + optimizer states + activations per device
+must fit in `hbm_bytes`.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+__all__ = ["ModelStats", "PlanChoice", "plan_mesh", "gpt_stats"]
+
+
+@dataclass
+class ModelStats:
+    """Coarse per-model numbers the cost model needs."""
+    n_params: int                  # total parameter count
+    n_layers: int                  # pipeline-able blocks
+    hidden: int                    # activation feature size
+    seq_len: int = 1024
+    bytes_per_param: int = 2       # bf16
+    bytes_per_opt_state: int = 12  # f32 master + 2 moments (mixed AdamW)
+    act_factor: float = 18.0       # bytes/act-element incl. remat tradeoff
+
+
+@dataclass
+class PlanChoice:
+    dp: int
+    mp: int
+    pp: int
+    sharding: int
+    cost: float
+    mem_bytes: float
+    feasible: bool
+    rationale: str = ""
+
+
+def _factorizations(n):
+    """All (dp, mp, pp, sharding) with dp*mp*pp*sharding == n."""
+    out = []
+    for mp in _divisors(n):
+        for pp in _divisors(n // mp):
+            rest = n // (mp * pp)
+            for sh in _divisors(rest):
+                out.append((rest // sh, mp, pp, sh))
+    return sorted(set(out))
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+PEAK_FLOPS = 200e12      # ~v5e bf16 chip
+ICI_BW = 100e9           # bytes/s per link, order-of-magnitude
+
+
+def _evaluate(st: ModelStats, dp, mp, pp, sh, batch, micro_batches,
+              hbm_bytes, peak=PEAK_FLOPS, ici_bw=ICI_BW):
+    P = st.n_params * st.bytes_per_param
+    # per-device memory
+    params_dev = P / (mp * pp)
+    if sh > 1:
+        params_dev /= sh                     # ZeRO-3 style param sharding
+    opt_dev = st.n_params * st.bytes_per_opt_state / (mp * pp * max(sh, 1))
+    act_dev = (batch / max(dp * sh, 1)) * st.seq_len * st.hidden \
+        * st.act_factor * (st.n_layers / pp)
+    mem = params_dev + opt_dev + act_dev
+
+    # step-time estimate in SECONDS so compute and comm are commensurable
+    grad_bytes = P / (mp * pp)
+    c_dp = 2 * (dp * sh - 1) / max(dp * sh, 1) * grad_bytes / ici_bw
+    act_bytes = (batch / max(dp * sh, 1)) * st.seq_len * st.hidden \
+        * st.bytes_per_param
+    c_mp = 4 * st.n_layers / pp * (mp - 1) / max(mp, 1) * act_bytes / ici_bw
+    compute = 6 * st.n_params * (batch / max(dp * sh, 1)) * st.seq_len \
+        / (mp * pp) / peak
+    bubble = (pp - 1) / (micro_batches + pp - 1) if pp > 1 else 0.0
+    cost = compute * (1 + bubble) + c_dp + c_mp
+    # near-tie regularizer: hybrid axes carry real overheads the coarse
+    # model can't see (p2p latency, resharding, schedule complexity) —
+    # prefer the simpler topology unless it genuinely wins
+    cost *= (1 + 0.05 * (mp > 1) + 0.05 * (pp > 1) + 0.02 * (sh > 1))
+    return cost, mem
+
+
+def plan_mesh(stats: ModelStats, n_devices, batch, hbm_bytes=16e9,
+              micro_batches=8, max_mp=8):
+    """Pick (dp, mp, pp, sharding) for `n_devices`. Returns the ranked
+    feasible PlanChoice list, best first (reference analog:
+    planner_v2.py Planner.plan -> the chosen dist context)."""
+    choices = []
+    for dp, mp, pp, sh in _factorizations(n_devices):
+        if mp > max_mp or mp > stats.hidden:
+            continue
+        if pp > 1 and stats.n_layers % pp != 0:
+            continue
+        if batch % max(dp * sh, 1) != 0:
+            continue
+        cost, mem = _evaluate(stats, dp, mp, pp, sh, batch,
+                              micro_batches, hbm_bytes)
+        feasible = mem <= hbm_bytes
+        why = (f"mem {mem/1e9:.2f} GB/dev "
+               f"({'fits' if feasible else 'EXCEEDS'} "
+               f"{hbm_bytes/1e9:.0f} GB), cost {cost:.3g}")
+        choices.append(PlanChoice(dp, mp, pp, sh, cost, mem, feasible, why))
+    feasible = [c for c in choices if c.feasible]
+    ranked = sorted(feasible or choices, key=lambda c: c.cost)
+    return ranked
+
+
+def gpt_stats(config, seq_len=None, bytes_per_param=2):
+    """ModelStats from a GPTConfig (incubate.models.GPTConfig)."""
+    h = config.hidden_size
+    L = config.num_hidden_layers
+    v = config.vocab_size
+    n_params = 12 * L * h * h + v * h + config.max_position_embeddings * h
+    return ModelStats(n_params=n_params, n_layers=L, hidden=h,
+                      seq_len=seq_len or config.max_position_embeddings,
+                      bytes_per_param=bytes_per_param)

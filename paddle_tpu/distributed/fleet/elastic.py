@@ -61,6 +61,13 @@ class ElasticManager:
                 try:
                     self._store.set(self._key(self.rank),
                                     str(time.time()).encode())
+                    ep = getattr(self, "_endpoint", None)
+                    if ep is not None:
+                        # refresh the timestamped registration with each
+                        # beat so alive_nodes never reads a stale address
+                        self._store.set(
+                            f"nodes/{self.job_id}/{self.rank}",
+                            f"{time.time()}|{ep}".encode())
                 except Exception:
                     pass
                 self._stop.wait(self.interval)
@@ -98,6 +105,92 @@ class ElasticManager:
         if self.level >= ElasticLevel.FAULT_TOLERANCE:
             return ElasticStatus.RESTART
         return ElasticStatus.ERROR
+
+    # -- scale in/out (reference: manager.py:127 _match + endpoint rewrite) --
+    def register(self, endpoint):
+        """Announce this node's endpoint. The value is timestamped and
+        refreshed by the heartbeat thread, so a replacement node reusing a
+        dead node's rank is never attributed the predecessor's stale
+        address."""
+        self._endpoint = str(endpoint)
+        if self._store is None:
+            return
+        self._store.set(f"nodes/{self.job_id}/{self.rank}",
+                        f"{time.time()}|{self._endpoint}".encode())
+
+    def alive_nodes(self):
+        """rank -> endpoint (or None if unregistered) for every node with a
+        fresh heartbeat; scans past self.np to discover joiners. Endpoint
+        registrations older than the liveness window are treated as stale
+        and ignored."""
+        alive = {}
+        if self._store is None:
+            return alive
+        now = time.time()
+        for r in range(max(self.np * 4, self.np + 8)):
+            try:
+                ts = float(self._store.get(self._key(r), wait=False))
+            except Exception:
+                continue
+            if now - ts <= 3 * self.interval:
+                ep = None
+                try:
+                    raw = self._store.get(f"nodes/{self.job_id}/{r}",
+                                          wait=False)
+                    raw = raw.decode() if isinstance(raw, bytes) \
+                        else str(raw)
+                    ep_ts, _, addr = raw.partition("|")
+                    if addr and now - float(ep_ts) <= 3 * self.interval:
+                        ep = addr
+                except Exception:
+                    ep = None
+                alive[r] = ep
+        return alive
+
+    def scale_plan(self, np_min=1, np_max=None):
+        """Decide the next world layout from liveness (ElasticLevel.ELASTIC).
+
+        Returns (status, plan): plan maps OLD rank -> (new_rank, endpoint)
+        for survivors/joiners, with ranks renumbered densely — the endpoint
+        rewrite of manager.py. status is COMPLETED when the world is
+        unchanged, RESTART when it must relaunch at the new size, ERROR
+        when liveness fell below np_min. plan is None when status is ERROR
+        or when the manager is below ElasticLevel.ELASTIC (the
+        FAULT_TOLERANCE path restarts at the same size, no rewrite)."""
+        if self.level < ElasticLevel.ELASTIC:
+            return self.watch(), None
+        alive = self.alive_nodes()
+        if len(alive) < np_min:
+            return ElasticStatus.ERROR, None
+        if np_max is not None and len(alive) > np_max:
+            alive = dict(sorted(alive.items())[:np_max])
+        plan = {old: (new, alive[old])
+                for new, old in enumerate(sorted(alive))}
+        unchanged = (len(alive) == self.np
+                     and all(o == n for o, (n, _) in plan.items()))
+        return (ElasticStatus.COMPLETED if unchanged
+                else ElasticStatus.RESTART), plan
+
+    @staticmethod
+    def rewrite_endpoints(plan, env=None):
+        """Produce the PADDLE_* env for a relaunch under `plan` (the
+        endpoint rewrite the reference applies before restarting). The
+        endpoint list is emitted only when EVERY surviving node registered
+        one — a partial list would disagree with PADDLE_TRAINERS_NUM and
+        could crown the wrong master."""
+        if plan is None:
+            raise ValueError(
+                "rewrite_endpoints needs a plan from an ELASTIC-level "
+                "scale_plan (got None — FAULT_TOLERANCE restarts keep the "
+                "old endpoints)")
+        env = dict(env or {})
+        ordered = sorted(plan.items(), key=lambda kv: kv[1][0])
+        eps = [ep for _, (_, ep) in ordered]
+        env["PADDLE_TRAINERS_NUM"] = str(len(plan))
+        if all(ep for ep in eps):
+            env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(eps)
+            env["PADDLE_MASTER"] = eps[0]
+        return env
 
     def exit(self, completed=True):
         self.stop()

@@ -36,6 +36,7 @@ deadlock once the partitioner plants resharding collectives for the auto
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -617,10 +618,15 @@ class PipelineTrainStep:
             return arr[c // S, c % S, j]
 
         for k, leaf in enumerate(self._stacked):
+            # ONE host transfer per stacked leaf, then numpy slicing —
+            # per-(stage, block) device indexing would issue thousands of
+            # small cross-device slices for a large model
+            host = np.asarray(jax.device_get(leaf))
             for c in range(S * V):
                 for j in range(per):
                     blk = self._blocks[c * per + j]
-                    blk.parameters()[k]._value = chunk_entry(leaf, c, j)
+                    blk.parameters()[k]._value = jnp.asarray(
+                        chunk_entry(host, c, j))
         opt = self.optimizer
         names = self._acc_names
         t_outer = [p for p in self._outer_params if not p.stop_gradient]
@@ -637,8 +643,10 @@ class PipelineTrainStep:
             for n, a in zip(names, accs):
                 if a is None:
                     continue
+                # batched like the param loop: one host transfer per leaf
+                host = np.asarray(jax.device_get(a))
                 for c in range(S * V):
                     for j in range(per):
                         blk_p = self._blocks[c * per + j].parameters()[k]
-                        opt._accumulators[n][blk_p.name] = \
-                            chunk_entry(a, c, j)
+                        opt._accumulators[n][blk_p.name] = jnp.asarray(
+                            chunk_entry(host, c, j))

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from . import creation, math, logic, manipulation, linalg, search, random_ops
+from . import tail
 from . import einsum_op
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -17,6 +18,7 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
+from .tail import *  # noqa: F401,F403
 from .einsum_op import einsum  # noqa: F401
 from .registry import (  # noqa: F401
     all_ops, get_op, register_op, override_kernel, use_kernel, infer_meta,
@@ -97,11 +99,13 @@ def _patch_operators():
 
     # -- method attachment (tensor_method_func analog) ----------------------
     method_sources = [creation, math, logic, manipulation, linalg, search,
-                      random_ops]
+                      random_ops, tail]
     skip = {"to_tensor", "meshgrid", "zeros", "ones", "full", "arange",
             "linspace", "logspace", "eye", "empty", "rand", "randn", "randint",
             "uniform", "normal", "randperm", "tril_indices", "triu_indices",
-            "complex", "vander", "scatter_nd", "einsum"}
+            "complex", "vander", "scatter_nd", "einsum",
+            "shape", "broadcast_shape", "set_printoptions", "create_array",
+            "array_read", "array_write", "array_length"}
     for mod in method_sources:
         for fname in getattr(mod, "__all__", []):
             if fname in skip or hasattr(T, fname):
@@ -128,3 +132,14 @@ def add_n(inputs, name=None):
     import operator
     return nary("add_n", lambda *vs: functools.reduce(operator.add, vs),
                 list(inputs))
+
+
+def _resolve_op(name):
+    """Look up an op entry point by public name (used by the generated
+    inplace variants in tail.py)."""
+    import sys
+    mod = sys.modules[__name__]
+    fn = getattr(mod, name, None)
+    if fn is None:
+        raise AttributeError(f"no op named {name!r}")
+    return fn

@@ -1,0 +1,244 @@
+"""Long-tail tensor API: inplace variants, array ops, and misc utilities.
+
+Reference analog: the `_`-suffixed inplace entries of
+python/paddle/tensor/__init__.py (inplace_apis_in_dygraph generated from
+ops.yaml `inplace:` rows), fluid LoDTensorArray ops
+(create_array/array_read/array_write/array_length), and the scattered
+utility ops (frexp, quantile, shard_index, broadcast_shape ...).
+
+TPU-first note on inplace: jax arrays are immutable, so `x.add_(y)` is
+value-rebinding — the wrapper Tensor keeps its identity while `_value` (and
+the autograd edge) move to the result. That preserves the reference's
+aliasing contract at the python level without mutable device buffers.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ._helpers import ensure_tensor, call_op, call_op_multi
+from .registry import register_op
+
+__all__ = [
+    "add_", "subtract_", "ceil_", "clip_", "erfinv_", "exp_", "flatten_",
+    "floor_", "index_add_", "lerp_", "put_along_axis_", "reciprocal_",
+    "remainder_", "round_", "rsqrt_", "scale_", "sqrt_", "tanh_",
+    "frexp", "inverse", "quantile", "nanquantile", "numel", "rank",
+    "broadcast_shape", "reverse", "vsplit", "is_complex",
+    "is_floating_point", "is_integer", "set_printoptions", "shard_index",
+    "create_array", "array_read", "array_write", "array_length",
+    "shape",
+]
+
+
+def _inplace(base_name):
+    """Build the `op_` variant: run the out-of-place op, rebind the input
+    Tensor's value AND autograd edge to the result."""
+    def op_(x, *args, **kwargs):
+        from . import _resolve_op
+        from ..framework.autograd import is_grad_enabled, AccumulationNode
+        if is_grad_enabled() and not x.stop_gradient and \
+                (x._grad_node is None
+                 or isinstance(x._grad_node, AccumulationNode)):
+            # same contract as the reference dygraph check (eager inplace
+            # version check): a leaf that requires grad cannot be mutated
+            # in place — wrap parameter-style updates in paddle.no_grad()
+            raise RuntimeError(
+                f"a leaf Tensor that requires grad is used in an in-place "
+                f"operation ({base_name}_); wrap the update in "
+                "paddle.no_grad()")
+        out = _resolve_op(base_name)(x, *args, **kwargs)
+        x._value = out._value
+        if not out.stop_gradient:
+            x._grad_node = out._grad_node
+            x._out_index = out._out_index
+            x.stop_gradient = False
+        return x
+    op_.__name__ = base_name + "_"
+    op_.__doc__ = f"Inplace variant of `{base_name}` (reference: " \
+                  f"ops.yaml inplace row {base_name}_)."
+    return op_
+
+
+add_ = _inplace("add")
+subtract_ = _inplace("subtract")
+ceil_ = _inplace("ceil")
+clip_ = _inplace("clip")
+erfinv_ = _inplace("erfinv")
+exp_ = _inplace("exp")
+flatten_ = _inplace("flatten")
+floor_ = _inplace("floor")
+index_add_ = _inplace("index_add")
+lerp_ = _inplace("lerp")
+put_along_axis_ = _inplace("put_along_axis")
+reciprocal_ = _inplace("reciprocal")
+remainder_ = _inplace("remainder")
+round_ = _inplace("round")
+rsqrt_ = _inplace("rsqrt")
+scale_ = _inplace("scale")
+sqrt_ = _inplace("sqrt")
+tanh_ = _inplace("tanh")
+
+
+@register_op("frexp", "math", ref="python/paddle/tensor/math.py frexp")
+def frexp(x, name=None):
+    x = ensure_tensor(x)
+    return call_op_multi("frexp", lambda v: jnp.frexp(v), (x,),
+                         num_outputs=2)
+
+
+@register_op("inverse", "linalg", ref="phi/kernels/inverse_kernel.h")
+def inverse(x, name=None):
+    return call_op("inverse", jnp.linalg.inv, (ensure_tensor(x),))
+
+
+@register_op("quantile", "stat", ref="python/paddle/tensor/stat.py quantile")
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    x = ensure_tensor(x)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+
+    def fn(v):
+        return jnp.quantile(v, qv, axis=axis, keepdims=keepdim,
+                            method=interpolation)
+    return call_op("quantile", fn, (x,))
+
+
+@register_op("nanquantile", "stat",
+             ref="python/paddle/tensor/stat.py nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    x = ensure_tensor(x)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+
+    def fn(v):
+        return jnp.nanquantile(v, qv, axis=axis, keepdims=keepdim,
+                               method=interpolation)
+    return call_op("nanquantile", fn, (x,))
+
+
+@register_op("numel", "attribute", differentiable=False,
+             ref="phi/kernels/numel_kernel.h")
+def numel(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x)._value.size, jnp.int64),
+                  stop_gradient=True)
+
+
+@register_op("rank", "attribute", differentiable=False,
+             ref="python/paddle/tensor/attribute.py rank")
+def rank(x, name=None):
+    return Tensor(jnp.asarray(ensure_tensor(x)._value.ndim, jnp.int32),
+                  stop_gradient=True)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static shape arithmetic (reference: broadcast_shape API)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@register_op("reverse", "manipulation", ref="phi/kernels/flip_kernel.h")
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    """Split along dim 0 (reference: python/paddle/tensor/manipulation.py
+    vsplit)."""
+    x = ensure_tensor(x)
+    if x._value.ndim < 2:
+        raise ValueError(
+            f"vsplit expects at least a 2-D tensor, got {x._value.ndim}-D")
+    from .manipulation import split
+    if isinstance(num_or_indices, int):
+        return split(x, num_or_indices, axis=0)
+    sizes, prev = [], 0
+    for ix in list(num_or_indices) + [x.shape[0]]:
+        sizes.append(ix - prev)
+        prev = ix
+    return split(x, sizes, axis=0)
+
+
+def is_complex(x):
+    return bool(jnp.issubdtype(ensure_tensor(x)._value.dtype,
+                               jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.integer))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Printing config (reference: python/paddle/tensor/to_string.py);
+    tensors print through numpy, so numpy's options are the knobs."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+@register_op("shard_index", "manipulation", differentiable=False,
+             ref="fluid/operators/shard_index_op.cc")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Relabel ids for one shard of a row-parallel table: ids owned by
+    `shard_id` map to their local row, others to `ignore_value`."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    x = ensure_tensor(input)
+    per = (index_num + nshards - 1) // nshards
+
+    def fn(ids):
+        owner = ids // per
+        local = ids % per
+        return jnp.where(owner == shard_id, local,
+                         jnp.asarray(ignore_value, ids.dtype))
+    return call_op("shard_index", fn, (x,))
+
+
+# -- LoDTensorArray shims (reference: fluid control_flow array ops) ----------
+
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list) if initialized_list else []
+
+
+def array_write(x, i, array=None):
+    i = int(i.item()) if isinstance(i, Tensor) else int(i)
+    if array is None:
+        array = []
+    while len(array) <= i:
+        array.append(None)
+    array[i] = ensure_tensor(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(i.item()) if isinstance(i, Tensor) else int(i)
+    return array[i]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64), stop_gradient=True)
+
+
+@register_op("shape", "attribute", differentiable=False,
+             ref="phi/kernels/shape_kernel.h")
+def shape(input, name=None):
+    """The runtime shape as an int32 tensor (reference: paddle.shape op)."""
+    return Tensor(jnp.asarray(ensure_tensor(input)._value.shape, jnp.int32),
+                  stop_gradient=True)

@@ -134,3 +134,52 @@ def test_engine_tp_annotation():
     assert hist["loss"][-1] < hist["loss"][0]
     # the parameter kept its annotation through training
     assert tuple(model[0].weight._value.sharding.spec)[-1] == "model"
+
+
+class TestMeshPlanner:
+    """Cost-model mesh planner (reference analog: auto_parallel
+    planner_v2.py + cost_model.py)."""
+
+    def _stats(self):
+        from paddle_tpu.distributed.auto_parallel import gpt_stats
+        from paddle_tpu.incubate.models import gpt3_6p7b
+        return gpt_stats(gpt3_6p7b())
+
+    def test_small_model_prefers_pure_dp(self):
+        from paddle_tpu.distributed.auto_parallel import (plan_mesh,
+                                                          ModelStats)
+        st = ModelStats(n_params=10_000_000, n_layers=12, hidden=768,
+                        seq_len=512)
+        best = plan_mesh(st, n_devices=8, batch=64, hbm_bytes=16e9)[0]
+        assert best.feasible
+        assert best.mp == 1 and best.pp == 1   # no model parallel needed
+
+    def test_big_model_needs_model_parallelism(self):
+        from paddle_tpu.distributed.auto_parallel import plan_mesh
+        ranked = plan_mesh(self._stats(), n_devices=64, batch=64,
+                           hbm_bytes=16e9)
+        best = ranked[0]
+        assert best.feasible, best.rationale
+        # 6.7B bf16 + f32 AdamW state cannot fit replicated in 16 GB
+        assert best.mp * best.pp * best.sharding > 1
+        assert best.dp * best.mp * best.pp * best.sharding == 64
+
+    def test_memory_infeasible_plans_ranked_out(self):
+        from paddle_tpu.distributed.auto_parallel import plan_mesh
+        ranked = plan_mesh(self._stats(), n_devices=8, batch=8,
+                           hbm_bytes=16e9)
+        for c in ranked:
+            if c.feasible:
+                # every feasible plan really fits
+                assert c.mem_bytes <= 16e9
+        # the fully replicated layout must be infeasible for 6.7B
+        rep = [c for c in plan_mesh(self._stats(), 8, 8, hbm_bytes=16e9)
+               if c.mp == c.pp == c.sharding == 1]
+        assert not rep or not rep[0].feasible
+
+    def test_pp_requires_divisible_layers(self):
+        from paddle_tpu.distributed.auto_parallel import (plan_mesh,
+                                                          ModelStats)
+        st = ModelStats(n_params=1_000_000, n_layers=7, hidden=64)
+        for c in plan_mesh(st, n_devices=8, batch=8):
+            assert c.pp == 1 or 7 % c.pp == 0

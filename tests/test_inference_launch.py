@@ -123,3 +123,88 @@ def test_elastic_manager_heartbeat():
     assert 1 in m0.dead_nodes()
     assert m0.watch() == ElasticStatus.RESTART
     m0.stop()
+
+
+class _FakeStore:
+    def __init__(self):
+        self.d = {}
+
+    def set(self, k, v):
+        self.d[k] = v
+
+    def get(self, k, wait=False):
+        if k not in self.d:
+            raise KeyError(k)
+        return self.d[k]
+
+
+def test_elastic_scale_in_plan():
+    """A node stops heartbeating -> ELASTIC level proposes a smaller world
+    with densely renumbered ranks + rewritten endpoints (manager.py:127)."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticLevel,
+                                                      ElasticStatus)
+    store = _FakeStore()
+    now = time.time()
+    for r, alive in [(0, True), (1, False), (2, True)]:
+        if alive:
+            store.set(f"heartbeat/j/{r}", str(now).encode())
+        store.set(f"nodes/j/{r}",
+                  f"{now}|10.0.0.{r}:8000".encode())
+    mgr = ElasticManager(store=store, job_id="j", np=3, rank=0,
+                         level=ElasticLevel.ELASTIC)
+    status, plan = mgr.scale_plan(np_min=2)
+    assert status == ElasticStatus.RESTART
+    assert plan == {0: (0, "10.0.0.0:8000"), 2: (1, "10.0.0.2:8000")}
+    env = ElasticManager.rewrite_endpoints(plan)
+    assert env["PADDLE_TRAINERS_NUM"] == "2"
+    assert env["PADDLE_TRAINER_ENDPOINTS"] == \
+        "10.0.0.0:8000,10.0.0.2:8000"
+    assert env["PADDLE_MASTER"] == "10.0.0.0:8000"
+
+
+def test_elastic_scale_out_plan():
+    """A 4th node joins beyond np=3 -> RESTART at the larger world."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticLevel,
+                                                      ElasticStatus)
+    store = _FakeStore()
+    now = time.time()
+    for r in range(4):
+        store.set(f"heartbeat/j/{r}", str(now).encode())
+        store.set(f"nodes/j/{r}",
+                  f"{now}|10.0.0.{r}:8000".encode())
+    mgr = ElasticManager(store=store, job_id="j", np=3, rank=0,
+                         level=ElasticLevel.ELASTIC)
+    status, plan = mgr.scale_plan(np_min=1, np_max=8)
+    assert status == ElasticStatus.RESTART
+    assert len(plan) == 4 and plan[3] == (3, "10.0.0.3:8000")
+    # capped by np_max
+    status, plan = mgr.scale_plan(np_min=1, np_max=2)
+    assert len(plan) == 2
+
+
+def test_elastic_unchanged_world_is_completed():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticLevel,
+                                                      ElasticStatus)
+    store = _FakeStore()
+    now = time.time()
+    for r in range(2):
+        store.set(f"heartbeat/j/{r}", str(now).encode())
+        store.set(f"nodes/j/{r}", f"{now}|h{r}:1".encode())
+    mgr = ElasticManager(store=store, job_id="j", np=2, rank=0,
+                         level=ElasticLevel.ELASTIC)
+    status, plan = mgr.scale_plan()
+    assert status == ElasticStatus.COMPLETED
+    assert plan == {0: (0, "h0:1"), 1: (1, "h1:1")}
+
+
+def test_elastic_below_min_errors():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticLevel,
+                                                      ElasticStatus)
+    mgr = ElasticManager(store=_FakeStore(), job_id="j", np=3, rank=0,
+                         level=ElasticLevel.ELASTIC)
+    status, plan = mgr.scale_plan(np_min=2)
+    assert status == ElasticStatus.ERROR and plan is None

@@ -1,0 +1,103 @@
+"""Long-tail tensor API: inplace variants, arrays, utilities (reference:
+python/paddle/tensor/__init__.py inplace rows + fluid array ops)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_inplace_variants_rebind_value_and_graph():
+    x = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+    out = x.sqrt_()
+    assert out is x
+    np.testing.assert_allclose(np.asarray(x._value), [1.0, 2.0])
+    x.add_(paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(np.asarray(x._value), [2.0, 3.0])
+    x.clip_(0.0, 2.5)
+    np.testing.assert_allclose(np.asarray(x._value), [2.0, 2.5])
+
+
+def test_inplace_keeps_autograd_chain():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3
+    y.exp_()
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               [3 * np.exp(6.0)], rtol=1e-5)
+
+
+def test_frexp_quantile_inverse():
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0, 0.5], np.float32)))
+    np.testing.assert_allclose(np.asarray(m._value), [0.5, 0.5])
+    np.testing.assert_allclose(np.asarray(e._value), [4.0, 0.0])
+    q = paddle.quantile(paddle.to_tensor(np.arange(10, dtype=np.float32)),
+                        0.5)
+    assert float(q) == 4.5
+    nq = paddle.nanquantile(paddle.to_tensor(
+        np.array([1.0, np.nan, 3.0], np.float32)), 0.5)
+    assert float(nq) == 2.0
+    a = np.array([[2.0, 0.0], [0.0, 4.0]], np.float32)
+    inv = paddle.inverse(paddle.to_tensor(a))
+    np.testing.assert_allclose(np.asarray(inv._value),
+                               np.linalg.inv(a), rtol=1e-6)
+
+
+def test_attribute_utilities():
+    x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    assert int(paddle.numel(x)) == 12
+    assert int(paddle.rank(x)) == 2
+    assert paddle.is_floating_point(x)
+    assert not paddle.is_integer(x)
+    assert not paddle.is_complex(x)
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_reverse_vsplit():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(6, 1))
+    r = paddle.reverse(x, axis=0)
+    np.testing.assert_allclose(np.asarray(r._value).reshape(-1),
+                               [5, 4, 3, 2, 1, 0])
+    parts = paddle.vsplit(x, 3)
+    assert [p.shape for p in parts] == [[2, 1]] * 3
+    parts = paddle.vsplit(x, [2, 5])
+    assert [p.shape for p in parts] == [[2, 1], [3, 1], [1, 1]]
+    with pytest.raises(ValueError):
+        paddle.vsplit(paddle.to_tensor(np.zeros(3, np.float32)), 3)
+
+
+def test_shard_index():
+    ids = paddle.to_tensor(np.array([0, 5, 9, 14], np.int64))
+    local = paddle.shard_index(ids, index_num=16, nshards=2, shard_id=0)
+    np.testing.assert_array_equal(np.asarray(local._value), [0, 5, -1, -1])
+    local = paddle.shard_index(ids, index_num=16, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(np.asarray(local._value), [-1, -1, 1, 6])
+    with pytest.raises(ValueError):
+        paddle.shard_index(ids, 16, 2, 5)
+
+
+def test_tensor_array_ops():
+    arr = paddle.create_array()
+    paddle.array_write(paddle.to_tensor(np.ones(2, np.float32)), 0, arr)
+    paddle.array_write(paddle.to_tensor(np.zeros(3, np.float32)),
+                       paddle.to_tensor(np.int64(2)), arr)
+    assert int(paddle.array_length(arr)) == 3
+    assert paddle.array_read(arr, 0).shape == [2]
+    assert arr[1] is None
+    assert paddle.array_read(arr, 2).shape == [3]
+
+
+def test_inplace_on_grad_leaf_raises_but_no_grad_allowed():
+    """Reference parity: mutating a leaf that requires grad in place is an
+    error; the paddle.no_grad() parameter-update idiom works and keeps the
+    leaf's requires-grad status."""
+    x = paddle.to_tensor(np.array([5.0], np.float32), stop_gradient=False)
+    with pytest.raises(RuntimeError, match="in-place"):
+        x.exp_()
+    for _ in range(30):
+        y = (x * x).sum()
+        y.backward()
+        with paddle.no_grad():
+            x.subtract_(paddle.to_tensor(0.1) * x.grad)
+        x.grad = None
+    assert abs(float(x)) < 0.02
+    assert not x.stop_gradient
