@@ -61,8 +61,55 @@ from . import linalg  # noqa: F401
 from . import utils  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
-from .device import set_device, get_device, CPUPlace, TPUPlace, CUDAPlace  # noqa: F401
+from .device import (  # noqa: F401
+    set_device, get_device, CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    NPUPlace, XPUPlace, MLUPlace, IPUPlace,
+)
 from .jit import to_static  # noqa: F401
+
+from .framework.dtype import DType as dtype, iinfo, finfo  # noqa: F401
+from .framework.lazy import LazyGuard  # noqa: F401
+from .framework.random import (  # noqa: F401
+    get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+)
+from .batch import batch  # noqa: F401
+from .nn.initializer_util import ParamAttr  # noqa: F401
+from .distributed import DataParallel  # noqa: F401
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """`paddle.create_parameter` — a free-standing trainable Parameter.
+    Reference analog: python/paddle/tensor/creation.py create_parameter
+    (LayerHelper.create_parameter)."""
+    from .nn.initializer_util import materialize_parameter
+    p = materialize_parameter(shape, attr=attr, dtype=dtype, is_bias=is_bias,
+                              default_initializer=default_initializer)
+    if name is not None:
+        p.name = name
+    return p
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference:
+    python/paddle/fluid/data_feeder.py:185 check_shape)."""
+    if isinstance(shape, Tensor):
+        return
+    if not isinstance(shape, (list, tuple)):
+        raise TypeError(f"shape must be a list/tuple/Tensor, got {shape!r}")
+    for s in shape:
+        if not isinstance(s, (int, Tensor)):
+            raise TypeError(f"shape elements must be int/Tensor, got {s!r}")
+        if isinstance(s, int) and s < -1:
+            raise ValueError(f"invalid dimension {s} in shape {shape}")
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """`paddle.flops` — see hapi.dynamic_flops.flops."""
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size=input_size, inputs=inputs,
+                  custom_ops=custom_ops, print_detail=print_detail)
 
 # paddle.disable_static / enable_static parity: dygraph is the default mode
 _static_mode = False

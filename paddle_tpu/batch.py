@@ -1,0 +1,31 @@
+"""`paddle.batch` — wrap a sample reader into a mini-batch reader.
+
+Reference analog: python/paddle/batch.py:18 (the legacy reader-decorator
+API kept for BC; new code uses paddle.io.DataLoader).
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Return a reader yielding lists of `batch_size` samples from `reader`.
+
+    `reader` is a no-arg callable returning an iterable of samples (the
+    classic paddle reader protocol).
+    """
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

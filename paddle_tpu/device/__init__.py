@@ -124,3 +124,21 @@ def synchronize():
     """Block until all enqueued device work completes."""
     for d in jax.live_arrays():
         d.block_until_ready()
+
+
+class NPUPlace(_PlaceBase):
+    """Parity shims for the reference's vendor places (no such backends
+    here; they exist so configs naming them still parse)."""
+    device_type = "npu"
+
+
+class XPUPlace(_PlaceBase):
+    device_type = "xpu"
+
+
+class MLUPlace(_PlaceBase):
+    device_type = "mlu"
+
+
+class IPUPlace(_PlaceBase):
+    device_type = "ipu"

@@ -66,3 +66,69 @@ def fftshift(x, axes=None, name=None):
 def ifftshift(x, axes=None, name=None):
     return unary("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=axes),
                  ensure_tensor(x))
+
+
+def _hermitian_axes(x_ndim, s, axes):
+    if axes is None:
+        axes = tuple(range(x_ndim)) if s is None else \
+            tuple(range(x_ndim - len(s), x_ndim))
+    return tuple(a % x_ndim for a in axes)
+
+
+def _hfftn_impl(v, s, axes, norm):
+    # hfftn = forward FFT of a Hermitian-symmetric signal (real spectrum):
+    # backward-norm identity hfft(a, n) == irfft(conj(a), n) * n, extended
+    # over the leading axes by plain complex FFT (reference fft_c2r kernel)
+    axes = _hermitian_axes(v.ndim, s, axes)
+    y = jnp.fft.irfftn(jnp.conj(v), s=s, axes=axes, norm="backward")
+    n_total = 1
+    for a in axes:
+        n_total *= y.shape[a]
+    if norm == "backward":
+        return y * n_total
+    if norm == "ortho":
+        return y * (n_total ** 0.5)
+    if norm == "forward":
+        return y
+    raise ValueError(f"invalid norm {norm!r}")
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    # ihfft(a, n) == conj(rfft(a, n)) / n under backward norm
+    axes = _hermitian_axes(v.ndim, s, axes)
+    y = jnp.conj(jnp.fft.rfftn(v, s=s, axes=axes, norm="backward"))
+    n_total = 1
+    for a in axes:
+        n_total *= v.shape[a] if s is None else s[list(axes).index(a)]
+    if norm == "backward":
+        return y / n_total
+    if norm == "ortho":
+        return y / (n_total ** 0.5)
+    if norm == "forward":
+        return y
+    raise ValueError(f"invalid norm {norm!r}")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D FFT of a signal with Hermitian symmetry (real spectrum).
+    Reference: python/paddle/fft.py:778 (fft_c2r kernel)."""
+    return unary("hfftn", lambda v: _hfftn_impl(v, s, axes, norm),
+                 ensure_tensor(x))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn. Reference: python/paddle/fft.py:827."""
+    return unary("ihfftn", lambda v: _ihfftn_impl(v, s, axes, norm),
+                 ensure_tensor(x))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT. Reference: python/paddle/fft.py:1127."""
+    return hfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm, name=name)
+
+
+__all__ += ["hfft2", "hfftn", "ihfft2", "ihfftn"]

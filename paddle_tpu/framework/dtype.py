@@ -150,3 +150,47 @@ def is_integer_dtype(dtype) -> bool:
 
 def is_complex_dtype(dtype) -> bool:
     return convert_dtype(dtype) in _COMPLEX_NAMES
+
+
+class iinfo:
+    """Integer type info (paddle.iinfo). Reference analog:
+    python/paddle/framework exposing np.iinfo-backed machine limits."""
+
+    def __init__(self, dtype):
+        npd = to_jax_dtype(dtype)
+        info = np.iinfo(npd)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = convert_dtype(dtype)
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class finfo:
+    """Float type info (paddle.finfo) — works for bfloat16 too (np.finfo
+    supports ml_dtypes.bfloat16 via jax's numpy extension types)."""
+
+    def __init__(self, dtype):
+        npd = to_jax_dtype(dtype)
+        try:
+            info = np.finfo(npd)
+        except ValueError:
+            # np.finfo rejects the ml_dtypes extension types (bfloat16,
+            # float8_*) — ml_dtypes ships its own finfo for them
+            import ml_dtypes
+            info = ml_dtypes.finfo(npd)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = convert_dtype(dtype)
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})")

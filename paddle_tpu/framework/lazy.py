@@ -1,0 +1,43 @@
+"""Lazy layer construction (`paddle.LazyGuard`).
+
+Reference analog: python/paddle/fluid/lazy_init.py — under LazyGuard, layer
+construction does not allocate/initialize parameters on the accelerator.
+
+TPU-first reading: the reason to defer init is to avoid materializing a
+model too big for one chip before its sharding is known. Here parameters
+created under the guard are initialized on the *host* (CPU backend) — a
+cheap, deterministic materialization in host RAM; the first jitted use (or
+an explicit NamedSharding placement) moves them to device with the final
+layout, so no oversized device allocation ever happens.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["LazyGuard", "in_lazy_mode"]
+
+_state = threading.local()
+
+
+def in_lazy_mode() -> bool:
+    return getattr(_state, "depth", 0) > 0
+
+
+class LazyGuard:
+    def __enter__(self):
+        _state.depth = getattr(_state, "depth", 0) + 1
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+            self._dev_ctx = jax.default_device(cpu)
+            self._dev_ctx.__enter__()
+        except RuntimeError:  # no host backend registered — degrade to eager
+            self._dev_ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._dev_ctx is not None:
+            self._dev_ctx.__exit__(*exc)
+        _state.depth -= 1
+        return False

@@ -95,3 +95,23 @@ def get_rng_key():
 
 def split_key(n: int):
     return jax.random.split(get_rng_key(), n)
+
+
+def get_rng_state():
+    """Snapshot of the global generator state (list-of-states for parity
+    with the reference's per-device GeneratorState list)."""
+    with default_generator._lock:
+        return [default_generator._key]
+
+
+def set_rng_state(state):
+    if isinstance(state, (list, tuple)):
+        state = state[0] if state else None
+    with default_generator._lock:
+        default_generator._key = state
+
+
+# CUDA-named aliases kept for API parity (there is one logical generator
+# here; reference: python/paddle/framework/random.py get_cuda_rng_state)
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state

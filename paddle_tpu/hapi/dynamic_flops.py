@@ -1,0 +1,144 @@
+"""FLOPs counter (`paddle.flops`).
+
+Reference analog: python/paddle/hapi/dynamic_flops.py — per-layer-type FLOP
+rules evaluated via forward hooks on a dummy run. Counts multiply-adds as
+the reference does (one MAC = 1 FLOP here, matching its convention).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["flops"]
+
+
+def _numel(t):
+    if isinstance(t, Tensor):
+        return int(np.prod(t.shape)) if t.shape else 1
+    return 0
+
+
+def _first(out):
+    if isinstance(out, (list, tuple)):
+        for o in out:
+            if isinstance(o, Tensor):
+                return o
+    return out
+
+
+def _count_linear(layer, inp, out):
+    out = _first(out)
+    in_f = int(layer.weight.shape[0])
+    return _numel(out) * in_f
+
+
+def _count_conv(layer, inp, out):
+    out = _first(out)
+    w = layer.weight
+    kernel_ops = int(np.prod(w.shape[1:]))  # C_in/groups * kh * kw
+    return _numel(out) * kernel_ops
+
+
+def _count_norm(layer, inp, out):
+    return 2 * _numel(_first(out))
+
+
+def _count_act(layer, inp, out):
+    return _numel(_first(out))
+
+
+def _count_pool(layer, inp, out):
+    return _numel(_first(out))
+
+
+def _count_embedding(layer, inp, out):
+    return 0
+
+
+def _rules():
+    from .. import nn
+    rules = {}
+
+    def add(names, fn):
+        for n in names:
+            cls = getattr(nn, n, None)
+            if cls is not None:
+                rules[cls] = fn
+
+    add(["Linear"], _count_linear)
+    add(["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+         "Conv3DTranspose"], _count_conv)
+    add(["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+         "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+         "InstanceNorm3D", "SyncBatchNorm"], _count_norm)
+    add(["ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LeakyReLU",
+         "Hardswish", "Hardsigmoid", "SiLU", "PReLU", "ELU"], _count_act)
+    add(["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+         "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+         "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+         "AdaptiveMaxPool3D"], _count_pool)
+    add(["Embedding"], _count_embedding)
+    return rules
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Count forward FLOPs of `net` on a dummy input of `input_size`.
+
+    custom_ops: {LayerClass: fn(layer, inputs, output) -> int} overrides.
+    Returns the total as an int.
+    """
+    import jax.numpy as jnp
+
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops needs input_size or inputs")
+        shape = tuple(1 if (d is None or d == -1) else int(d)
+                      for d in input_size)
+        inputs = [Tensor(jnp.ones(shape, jnp.float32), stop_gradient=True)]
+    elif not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    rules = _rules()
+    if custom_ops:
+        rules.update(custom_ops)
+
+    counts = []
+    hooks = []
+
+    def make_hook(fn, name):
+        def hook(layer, inp, out):
+            counts.append((name, type(layer).__name__, int(fn(layer, inp, out))))
+        return hook
+
+    layers = [("", net)] if not list(net.children()) else \
+        list(net.named_sublayers())
+    for name, sub in layers:
+        if list(sub.children()):
+            continue
+        fn = rules.get(type(sub))
+        if fn is None:  # walk the MRO so subclasses inherit their rule
+            for cls, f in rules.items():
+                if isinstance(sub, cls):
+                    fn = f
+                    break
+        if fn is not None:
+            hooks.append(sub.register_forward_post_hook(make_hook(fn, name)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(c for _, _, c in counts)
+    if print_detail:
+        for name, typ, c in counts:
+            print(f"{name:<40} {typ:<20} {c:>16,}")
+    print(f"Total Flops: {total}")
+    return total

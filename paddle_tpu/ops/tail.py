@@ -24,7 +24,7 @@ __all__ = [
     "add_", "subtract_", "ceil_", "clip_", "erfinv_", "exp_", "flatten_",
     "floor_", "index_add_", "lerp_", "put_along_axis_", "reciprocal_",
     "remainder_", "round_", "rsqrt_", "scale_", "sqrt_", "tanh_",
-    "frexp", "inverse", "quantile", "nanquantile", "numel", "rank",
+    "frexp", "inverse", "quantile", "nanquantile", "numel", "rank", "renorm",
     "broadcast_shape", "reverse", "vsplit", "is_complex",
     "is_floating_point", "is_integer", "set_printoptions", "shard_index",
     "create_array", "array_read", "array_write", "array_length",
@@ -242,3 +242,23 @@ def shape(input, name=None):
     """The runtime shape as an int32 tensor (reference: paddle.shape op)."""
     return Tensor(jnp.asarray(ensure_tensor(input)._value.shape, jnp.int32),
                   stop_gradient=True)
+
+
+@register_op("renorm", "math", ref="python/paddle/tensor/math.py:1997 renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` so each slice's p-norm is at most
+    `max_norm` (slices already within the bound are unchanged)."""
+    x = ensure_tensor(x)
+    ndim = x._value.ndim
+    ax = axis + ndim if axis < 0 else axis
+    other = tuple(d for d in range(ndim) if d != ax)
+
+    def fn(v):
+        fv = v.astype(jnp.float32) if v.dtype == jnp.bfloat16 else v
+        norms = jnp.sum(jnp.abs(fv) ** p, axis=other, keepdims=True) \
+            ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return (fv * scale).astype(v.dtype)
+
+    return call_op("renorm", fn, (x,))

@@ -1,0 +1,118 @@
+"""API-surface parity additions (round 3): top-level misc + Hermitian FFTs.
+
+Reference analogs: python/paddle/__init__.py __all__, python/paddle/fft.py,
+python/paddle/batch.py, python/paddle/hapi/dynamic_flops.py.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestTopLevelMisc:
+    def test_renorm_matches_torch(self):
+        x = np.random.RandomState(0).randn(3, 4, 5).astype("float32")
+        got = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0).numpy()
+        ref = torch.renorm(torch.tensor(x), 2.0, 0, 1.0).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_renorm_keeps_small_slices(self):
+        x = np.full((2, 3), 0.01, "float32")
+        got = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 5.0).numpy()
+        np.testing.assert_allclose(got, x)
+
+    def test_iinfo_finfo(self):
+        assert paddle.iinfo(paddle.int32).max == 2**31 - 1
+        assert paddle.iinfo("int8").min == -128
+        f = paddle.finfo(paddle.bfloat16)
+        assert f.bits == 16 and f.eps == 0.0078125
+        assert paddle.finfo("float32").eps == np.finfo(np.float32).eps
+
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5], [6]]
+        r = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5]]
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter([]), batch_size=0)
+
+    def test_create_parameter(self):
+        p = paddle.create_parameter([4, 3], "float32", name="w0")
+        assert p.shape == [4, 3] and p.trainable and p.name == "w0"
+        b = paddle.create_parameter(
+            [4], "float32", is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+        np.testing.assert_allclose(b.numpy(), np.zeros(4, "float32"))
+
+    def test_check_shape(self):
+        paddle.check_shape([1, -1, 4])
+        with pytest.raises(TypeError):
+            paddle.check_shape("bad")
+        with pytest.raises(ValueError):
+            paddle.check_shape([1, -2])
+
+    def test_flops_linear(self):
+        net = nn.Linear(8, 16)
+        total = paddle.flops(net, [2, 8])
+        assert total == 2 * 16 * 8  # out_numel * in_features
+
+    def test_lazy_guard_params_usable(self):
+        with paddle.LazyGuard():
+            lin = nn.Linear(4, 4)
+        y = lin(paddle.to_tensor(np.ones((2, 4), "float32")))
+        assert y.shape == [2, 4]
+
+    def test_rng_state_roundtrip(self):
+        paddle.seed(7)
+        st = paddle.get_rng_state()
+        a = paddle.rand([3]).numpy()
+        paddle.set_rng_state(st)
+        b = paddle.rand([3]).numpy()
+        np.testing.assert_allclose(a, b)
+        assert paddle.get_cuda_rng_state is not None
+
+    def test_place_shims(self):
+        assert paddle.NPUPlace(1).get_device_id() == 1
+        assert paddle.CUDAPinnedPlace() == paddle.CUDAPinnedPlace()
+
+    def test_dtype_alias(self):
+        assert isinstance(paddle.float32, paddle.dtype)
+
+
+class TestHermitianFFT:
+    norms = ["backward", "ortho", "forward"]
+
+    @pytest.mark.parametrize("norm", norms)
+    def test_hfftn_ihfftn_match_torch(self, norm):
+        rng = np.random.RandomState(1)
+        x = (rng.randn(4, 5, 6) + 1j * rng.randn(4, 5, 6)).astype("complex64")
+        xr = rng.randn(4, 5, 6).astype("float32")
+        got = paddle.fft.hfftn(paddle.to_tensor(x), norm=norm).numpy()
+        ref = torch.fft.hfftn(torch.tensor(x), norm=norm).numpy()
+        np.testing.assert_allclose(got, ref, atol=2e-3)
+        got = paddle.fft.ihfftn(paddle.to_tensor(xr), norm=norm).numpy()
+        ref = torch.fft.ihfftn(torch.tensor(xr), norm=norm).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    @pytest.mark.parametrize("norm", norms)
+    def test_hfft2_ihfft2_match_torch(self, norm):
+        rng = np.random.RandomState(2)
+        x = (rng.randn(3, 4, 5) + 1j * rng.randn(3, 4, 5)).astype("complex64")
+        xr = rng.randn(3, 4, 5).astype("float32")
+        got = paddle.fft.hfft2(paddle.to_tensor(x), norm=norm).numpy()
+        ref = torch.fft.hfft2(torch.tensor(x), norm=norm).numpy()
+        np.testing.assert_allclose(got, ref, atol=2e-3)
+        got = paddle.fft.ihfft2(paddle.to_tensor(xr), norm=norm).numpy()
+        ref = torch.fft.ihfft2(torch.tensor(xr), norm=norm).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_hfftn_with_s(self):
+        rng = np.random.RandomState(3)
+        x = (rng.randn(4, 5) + 1j * rng.randn(4, 5)).astype("complex64")
+        got = paddle.fft.hfftn(paddle.to_tensor(x), s=(4, 8),
+                               axes=(0, 1)).numpy()
+        ref = torch.fft.hfftn(torch.tensor(x), s=(4, 8), dim=(0, 1)).numpy()
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(got, ref, atol=2e-3)
