@@ -13,6 +13,8 @@ from .layer.pooling import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .layer.rnn import RNNCellBase  # noqa: F401
+from .layer.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 

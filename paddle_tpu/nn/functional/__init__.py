@@ -5,6 +5,8 @@ from .conv import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
-from .attention import scaled_dot_product_attention  # noqa: F401
+from .attention import scaled_dot_product_attention, sparse_attention  # noqa: F401
+from .vision import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 
-from ...ops.manipulation import pad  # noqa: F401  (paddle exposes F.pad)
+from ...ops.manipulation import pad, diag_embed  # noqa: F401  (paddle exposes F.pad)

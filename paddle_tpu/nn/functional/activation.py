@@ -231,3 +231,32 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
         slope = a.astype(v.dtype) if hasattr(a, "astype") else a
         return jnp.where(v >= 0, v, slope * v)
     return unary("rrelu", fn, x)
+
+
+def _make_inplace(fn, name):
+    """Inplace variant: rebind the input Tensor's value + autograd edge to
+    the op result (same contract as ops/tail.py _inplace)."""
+    def op_(x, *args, **kwargs):
+        from ...framework.autograd import is_grad_enabled, AccumulationNode
+        if is_grad_enabled() and not x.stop_gradient and \
+                (x._grad_node is None
+                 or isinstance(x._grad_node, AccumulationNode)):
+            raise RuntimeError(
+                f"a leaf Tensor that requires grad is used in an in-place "
+                f"operation ({name}); wrap the update in paddle.no_grad()")
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        if not out.stop_gradient:
+            x._grad_node = out._grad_node
+            x._out_index = out._out_index
+            x.stop_gradient = False
+        return x
+    op_.__name__ = name
+    return op_
+
+
+elu_ = _make_inplace(elu, "elu_")
+softmax_ = _make_inplace(softmax, "softmax_")
+tanh_ = _make_inplace(tanh, "tanh_")
+
+__all__ += ["elu_", "softmax_", "tanh_"]

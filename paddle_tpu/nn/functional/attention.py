@@ -107,3 +107,56 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         return _plain_attention(qq, kk, vv, mask_v, is_causal, scale,
                                 dropout_p if training else 0.0, drop_key)
     return call_op("scaled_dot_product_attention", fn, (q, k, v))
+
+
+@register_op("sparse_attention", "attention",
+             ref="fluid/operators/sparse_attention_op.cu")
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-free CSR-sampled attention: for each query row i, attend only
+    to the key columns listed in the CSR pattern (offset/columns per
+    [batch, head]).
+
+    TPU-first: the reference's cuSPARSE SDDMM+softmax+SpMM chain becomes a
+    fixed-width gather — rows are padded to the max row degree so shapes
+    stay static under jit; padded slots get -inf before the softmax.
+    Layouts follow the reference: q/k/v [B, H, M, D], offset [B, H, M+1],
+    columns [B, H, nnz].
+    """
+    import numpy as np
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    off = np.asarray(ensure_tensor(sparse_csr_offset)._value)
+    cols = np.asarray(ensure_tensor(sparse_csr_columns)._value)
+
+    B, H, M, D = q._value.shape
+    deg = np.diff(off, axis=-1)                      # [B, H, M]
+    width = int(deg.max()) if deg.size else 1
+    # static gather table: [B, H, M, width] column ids + validity
+    col_tab = np.zeros((B, H, M, width), np.int32)
+    val_tab = np.zeros((B, H, M, width), bool)
+    for b in range(B):
+        for h in range(H):
+            for m in range(M):
+                s, e = off[b, h, m], off[b, h, m + 1]
+                col_tab[b, h, m, :e - s] = cols[b, h, s:e]
+                val_tab[b, h, m, :e - s] = True
+    col_j = jnp.asarray(col_tab)
+    valid = jnp.asarray(val_tab)
+
+    def fn(qv, kv, vv):
+        scale = 1.0 / math.sqrt(D)
+        kg = jnp.take_along_axis(kv[:, :, None], col_j[..., None], axis=3)
+        scores = jnp.einsum("bhmd,bhmwd->bhmw", qv, kg) * scale
+        scores = jnp.where(valid, scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(valid, p, 0.0)
+        vg = jnp.take_along_axis(vv[:, :, None], col_j[..., None], axis=3)
+        return jnp.einsum("bhmw,bhmwd->bhmd", p, vg)
+
+    return call_op("sparse_attention", fn, (q, k, v))
+
+
+__all__ += ["sparse_attention"]

@@ -379,3 +379,185 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.sum(loss)
         return loss
     return call_op("ctc_loss", fn, (log_probs,))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)); label in {-1, 1}. Reference:
+    python/paddle/nn/functional/loss.py soft_margin_loss."""
+    def fn(x, y):
+        return jnp.log1p(jnp.exp(-y * x))
+    return binary("soft_margin_loss", _apply_reduction(fn, reduction),
+                  ensure_tensor(input), ensure_tensor(label, "float32"))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """Per-class BCE-with-logits averaged over classes (reference:
+    multilabel_soft_margin_loss)."""
+    def fn(x, y, *w):
+        logsig = jax.nn.log_sigmoid
+        per = -(y * logsig(x) + (1.0 - y) * logsig(-x))
+        if w:
+            per = per * w[0]
+        return jnp.mean(per, axis=-1)
+    args = [ensure_tensor(input), ensure_tensor(label, "float32")]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return nary("multi_label_soft_margin_loss",
+                _apply_reduction(fn, reduction), args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class hinge: mean_j max(0, margin - x[y] + x[j])^p, j != y."""
+    def fn(x, y, *w):
+        C = x.shape[-1]
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], axis=-1)
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            m = m * w[0][y][:, None]
+        mask = jax.nn.one_hot(y, C, dtype=x.dtype)
+        return jnp.sum(m * (1.0 - mask), axis=-1) / C
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return nary("multi_margin_loss", _apply_reduction(fn, reduction), args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a user distance fn (reference:
+    triplet_margin_with_distance_loss). The custom callable operates on
+    Tensors, so this path composes at the python level (still jittable —
+    the distance fn is traced along with the rest)."""
+    input = ensure_tensor(input)
+    positive = ensure_tensor(positive)
+    negative = ensure_tensor(negative)
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   p=2.0, swap=swap, reduction=reduction)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    d_neg_swap = distance_function(positive, negative) if swap else None
+
+    def fn(dp, dn, *rest):
+        dn_eff = jnp.minimum(dn, rest[0]) if rest else dn
+        return jnp.maximum(0.0, dp - dn_eff + margin)
+
+    args = [ensure_tensor(d_pos), ensure_tensor(d_neg)]
+    if d_neg_swap is not None:
+        args.append(ensure_tensor(d_neg_swap))
+    return nary("triplet_margin_with_distance_loss",
+                _apply_reduction(fn, reduction), args)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: phi/kernels/hsigmoid_loss_kernel.h; MatrixBitCodeFunctor
+    in fluid/operators/math/matrix_bit_code.h).
+
+    Default tree: class c's code is (c + num_classes) in a heap layout;
+    internal node ids are the heap path nodes minus 1 (root excluded by
+    construction), bit = parity of each path node."""
+    input = ensure_tensor(input)
+    label = ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    if path_table is not None:
+        pt = ensure_tensor(path_table)
+        pc = ensure_tensor(path_code)
+
+        def fn(x, y, w, *b):
+            tbl = pt._value
+            code = pc._value.astype(jnp.float32)
+            rows = tbl[y.astype(jnp.int32)] if tbl.ndim == 2 and \
+                tbl.shape[0] != y.shape[0] else tbl
+            codes = code[y.astype(jnp.int32)] if code.ndim == 2 and \
+                code.shape[0] != y.shape[0] else code
+            valid = rows >= 0
+            safe = jnp.where(valid, rows, 0).astype(jnp.int32)
+            wv = w[safe]                       # [B, L, D]
+            logits = jnp.einsum("bld,bd->bl", wv, x)
+            if b:
+                logits = logits + b[0].reshape(-1)[safe]
+            per = jnp.where(
+                valid,
+                jnp.log1p(jnp.exp(-jnp.where(codes > 0, logits, -logits))),
+                0.0)
+            return jnp.sum(per, axis=-1, keepdims=True)
+        return nary("hsigmoid_loss", _apply_reduction(fn, "mean"), args)
+
+    # default complete-binary-tree path, depth = ceil(log2(num_classes))
+    import math
+    depth = max(1, int(math.ceil(math.log2(max(2, num_classes)))))
+
+    def fn(x, y, w, *b):
+        heap = y.astype(jnp.int32) + num_classes   # leaf heap id
+        logits_sum = jnp.zeros((x.shape[0],), jnp.float32)
+        node = heap
+        for _ in range(depth):
+            parent = node // 2
+            bit = (node % 2).astype(jnp.float32)   # right child => 1
+            active = parent >= 1
+            nid = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+            logit = jnp.einsum("bd,bd->b", w[nid], x)
+            if b:
+                logit = logit + b[0].reshape(-1)[nid]
+            # bit=1 -> sigmoid(logit), bit=0 -> sigmoid(-logit)
+            term = jnp.log1p(jnp.exp(-jnp.where(bit > 0, logit, -logit)))
+            logits_sum = logits_sum + jnp.where(active, term, 0.0)
+            node = parent
+        return logits_sum[:, None]
+
+    return nary("hsigmoid_loss", _apply_reduction(fn, "mean"), args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-family margin softmax (reference:
+    fluid/operators/margin_cross_entropy_op.cu): the target-class cosine
+    is replaced by cos(m1*theta + m2) - m3, everything scaled by `scale`.
+    `group` is accepted for API parity; the model-parallel class split is
+    expressed via sharded logits under shard_map instead."""
+    logits = ensure_tensor(logits)
+    label = ensure_tensor(label)
+
+    def fn(x, y):
+        y = y.astype(jnp.int32).reshape(-1)
+        cos = jnp.clip(x.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(jnp.clip(
+            jnp.take_along_axis(cos, y[:, None], axis=-1), -1.0, 1.0))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, x.shape[-1], dtype=cos.dtype)
+        adjusted = scale * (cos * (1 - onehot) + target * onehot)
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=-1)
+        return loss, jnp.exp(logp)
+
+    from ...ops.dispatch import call_op_multi
+    loss, softmax = call_op_multi(
+        "margin_cross_entropy", fn,
+        (logits, label), num_outputs=2)
+    if reduction == "mean":
+        from ...ops import mean as _mean
+        loss = _mean(loss)
+    elif reduction == "sum":
+        from ...ops import sum as _sum
+        loss = _sum(loss)
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+__all__ += ["soft_margin_loss", "multi_label_soft_margin_loss",
+            "multi_margin_loss", "triplet_margin_with_distance_loss",
+            "hsigmoid_loss", "margin_cross_entropy"]

@@ -273,3 +273,58 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, False, False,
                           "adaptive_max_pool3d", return_mask)
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, n, output_size,
+                channel_last, op_name):
+    """Scatter pooled values back to the pre-pool positions recorded in
+    `indices` (the flat-spatial mask from max_poolNd(return_mask=True)).
+    Reference analog: phi/kernels/unpool_kernel.h."""
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    kernel_t = _norm(kernel_size, n)
+    stride_t = _norm(stride if stride is not None else kernel_size, n)
+    p = _norm(padding, n)
+    spatial_off = 1 if channel_last else 2
+    in_spatial = x._value.shape[spatial_off:spatial_off + n]
+    if output_size is None:
+        out_spatial = tuple(
+            (in_spatial[i] - 1) * stride_t[i] - 2 * p[i] + kernel_t[i]
+            for i in range(n))
+    else:
+        out_spatial = tuple(int(s) for s in tuple(output_size)[-n:])
+    if channel_last:
+        raise NotImplementedError(f"{op_name}: NHWC unpool not supported")
+    N, C = x._value.shape[0], x._value.shape[1]
+    P = int(np.prod(out_spatial))
+
+    def fn(v, idx):
+        flat_v = v.reshape(N * C, -1)
+        flat_i = idx.reshape(N * C, -1).astype(jnp.int32)
+        out = jnp.zeros((N * C, P), v.dtype)
+        rows = jnp.arange(N * C)[:, None]
+        out = out.at[rows, flat_i].set(flat_v)
+        return out.reshape((N, C) + out_spatial)
+
+    return call_op(op_name, fn, (x, indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 1,
+                       output_size, data_format == "NLC", "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 2,
+                       output_size, data_format == "NHWC", "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, 3,
+                       output_size, data_format == "NDHWC", "max_unpool3d")
+
+
+__all__ += ["max_unpool1d", "max_unpool2d", "max_unpool3d"]

@@ -1,0 +1,62 @@
+"""Sequence utilities: sequence_mask, gather_tree.
+
+Reference analogs: phi/kernels/sequence_mask_kernel.h (fluid
+sequence_mask_op) and phi/kernels/gather_tree_kernel.h (beam-search
+backtrace). TPU-first: gather_tree's per-beam backward walk is a
+`lax.scan` over time — one compiled loop, no host round-trips.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import Tensor
+from ...framework.dtype import to_jax_dtype
+from ...ops._helpers import ensure_tensor, call_op
+from ...ops.registry import register_op
+
+__all__ = ["sequence_mask", "gather_tree"]
+
+
+@register_op("sequence_mask", "sequence", differentiable=False,
+             ref="phi/kernels/sequence_mask_kernel.h")
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[..., j] = j < x[...]. If maxlen is None, use max(x)."""
+    x = ensure_tensor(x)
+    xv = x._value
+    if maxlen is None:
+        maxlen = int(jnp.max(xv))
+    elif hasattr(maxlen, "_value"):
+        maxlen = int(maxlen._value)
+    r = jnp.arange(int(maxlen))
+    mask = r[None, :] < xv.reshape(-1, 1)
+    mask = mask.reshape(tuple(xv.shape) + (int(maxlen),))
+    return Tensor(mask.astype(to_jax_dtype(dtype)), stop_gradient=True)
+
+
+@register_op("gather_tree", "sequence", differentiable=False,
+             ref="phi/kernels/gather_tree_kernel.h")
+def gather_tree(ids, parents, name=None):
+    """Reconstruct full beam-search sequences from per-step ids and parent
+    beam indices. ids/parents: [max_time, batch, beam]."""
+    ids = ensure_tensor(ids)
+    parents = ensure_tensor(parents)
+
+    def fn(idv, parv):
+        T = idv.shape[0]
+        beam = jnp.arange(idv.shape[2], dtype=parv.dtype)
+        beam0 = jnp.broadcast_to(beam, idv.shape[1:])  # [batch, beam]
+
+        def step(carry, t):
+            cur_beam = carry
+            rev_t = T - 1 - t
+            out_t = jnp.take_along_axis(idv[rev_t], cur_beam.astype(jnp.int32),
+                                        axis=1)
+            next_beam = jnp.take_along_axis(parv[rev_t],
+                                            cur_beam.astype(jnp.int32), axis=1)
+            return next_beam, out_t
+
+        _, outs = lax.scan(step, beam0, jnp.arange(T))
+        return outs[::-1]  # scan produced reversed time order
+
+    return call_op("gather_tree", fn, (ids, parents))

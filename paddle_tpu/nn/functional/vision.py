@@ -1,0 +1,190 @@
+"""Geometric / video functional ops: affine_grid, grid_sample,
+temporal_shift, zeropad2d.
+
+Reference analogs: phi/kernels/affine_grid_kernel.h,
+phi/kernels/grid_sample_kernel.h, fluid/operators/temporal_shift_op.cu,
+python/paddle/nn/functional/common.py zeropad2d. TPU-first: grid_sample is
+pure gather arithmetic (jnp.take along flattened spatial) — XLA lowers it
+to dynamic-gathers that vectorize on the VPU; no per-pixel scalar loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops._helpers import ensure_tensor, call_op
+from ...ops.registry import register_op
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift", "zeropad2d"]
+
+
+@register_op("affine_grid", "vision",
+             ref="phi/kernels/affine_grid_kernel.h")
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a sampling grid from batched affine matrices.
+    theta [N,2,3] + out_shape [N,C,H,W] -> grid [N,H,W,2];
+    theta [N,3,4] + out_shape [N,C,D,H,W] -> grid [N,D,H,W,3]."""
+    theta = ensure_tensor(theta)
+    if hasattr(out_shape, "_value"):
+        out_shape = [int(s) for s in np.asarray(out_shape._value)]
+    out_shape = [int(s) for s in out_shape]
+
+    def line(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    def fn(th):
+        if th.shape[-2:] == (2, 3):
+            N, _, H, W = out_shape
+            ys, xs = jnp.meshgrid(line(H), line(W), indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # H,W,3
+            grid = jnp.einsum("hwk,njk->nhwj", base, th)
+            return grid.astype(th.dtype)
+        N, _, D, H, W = out_shape
+        zs, ys, xs = jnp.meshgrid(line(D), line(H), line(W), indexing="ij")
+        base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], axis=-1)
+        grid = jnp.einsum("dhwk,njk->ndhwj", base, th)
+        return grid.astype(th.dtype)
+
+    return call_op("affine_grid", fn, (theta,))
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect(x, size, align_corners):
+    if size == 1:
+        return jnp.zeros_like(x)
+    if align_corners:
+        span = 2.0 * (size - 1)
+        x = jnp.abs(x) % span
+        return jnp.where(x > size - 1, span - x, x)
+    span = 2.0 * size
+    x = jnp.abs(x + 0.5) % span
+    x = jnp.where(x > size, span - x, x) - 0.5
+    return jnp.clip(x, 0, size - 1)
+
+
+@register_op("grid_sample", "vision",
+             ref="phi/kernels/grid_sample_kernel.h")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at grid locations. 4-D: x [N,C,H,W], grid [N,Ho,Wo,2]
+    (last dim = (x, y) in [-1, 1]); 5-D: x [N,C,D,H,W],
+    grid [N,Do,Ho,Wo,3]."""
+    x = ensure_tensor(x)
+    grid = ensure_tensor(grid)
+    ndim_sp = grid._value.shape[-1]
+    if ndim_sp not in (2, 3):
+        raise ValueError("grid last dim must be 2 or 3")
+
+    def fn(v, g):
+        N, C = v.shape[0], v.shape[1]
+        spatial = v.shape[2:]  # (H,W) or (D,H,W)
+        n = len(spatial)
+        g32 = g.astype(jnp.float32)
+        # grid's last axis orders coords fastest-varying-first: (x, y[, z])
+        coords = [_unnormalize(g32[..., n - 1 - d], spatial[d],
+                               align_corners) for d in range(n)]
+
+        def resolve(cs):
+            """cs: list of float coords per dim -> (int idx per dim, valid)"""
+            idxs, valid = [], None
+            for d, c in enumerate(cs):
+                size = spatial[d]
+                if padding_mode == "border":
+                    c = jnp.clip(c, 0, size - 1)
+                elif padding_mode == "reflection":
+                    c = _reflect(c, size, align_corners)
+                ok = (c >= 0) & (c <= size - 1)
+                valid = ok if valid is None else (valid & ok)
+                idxs.append(jnp.clip(c, 0, size - 1).astype(jnp.int32))
+            return idxs, valid
+
+        def gather(idxs):
+            flat = jnp.zeros_like(idxs[0])
+            for d in range(n):
+                flat = flat * spatial[d] + idxs[d]
+            vflat = v.reshape(N, C, -1)  # [N,C,P]
+            fl = flat.reshape(N, -1)     # [N,Q]
+            out = jnp.take_along_axis(vflat, fl[:, None, :], axis=2)
+            return out.reshape((N, C) + flat.shape[1:])
+
+        if mode == "nearest":
+            idxs, valid = resolve([jnp.floor(c + 0.5) for c in coords])
+            out = gather(idxs)
+            if padding_mode == "zeros":
+                out = out * valid[:, None].astype(v.dtype)
+            return out.astype(v.dtype)
+
+        # bilinear / trilinear: blend the 2^n corners
+        lo = [jnp.floor(c) for c in coords]
+        frac = [c - l for c, l in zip(coords, lo)]
+        out = 0.0
+        for corner in range(2 ** n):
+            bits = [(corner >> d) & 1 for d in range(n)]
+            cs = [l + b for l, b in zip(lo, bits)]
+            w = 1.0
+            for d in range(n):
+                w = w * (frac[d] if bits[d] else (1.0 - frac[d]))
+            idxs, valid = resolve(cs)
+            g_val = gather(idxs)
+            if padding_mode == "zeros":
+                w = w * valid.astype(jnp.float32)
+            out = out + g_val.astype(jnp.float32) * w[:, None]
+        return out.astype(v.dtype)
+
+    return call_op("grid_sample", fn, (x, grid))
+
+
+@register_op("temporal_shift", "video",
+             ref="fluid/operators/temporal_shift_op.cu")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift: within each segment of seg_num frames, the first
+    `shift_ratio` of channels take the previous frame (out[t] = x[t-1]),
+    the next `shift_ratio` take the following frame (out[t] = x[t+1]);
+    frames shifted in from outside the segment are zero."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        nhwc = data_format == "NHWC"
+        if nhwc:
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        NT, C, H, W = v.shape
+        N = NT // seg_num
+        v5 = v.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        pad = jnp.zeros((N, 1, C, H, W), v.dtype)
+        prev = jnp.concatenate([pad, v5[:, :-1]], axis=1)   # out[t] = x[t-1]
+        nxt = jnp.concatenate([v5[:, 1:], pad], axis=1)     # out[t] = x[t+1]
+        out = jnp.concatenate([prev[:, :, :c1], nxt[:, :, c1:c2],
+                               v5[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if nhwc:
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return call_op("temporal_shift", fn, (x,))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad the last two spatial dims; padding = [left, right, top,
+    bottom] (reference: python/paddle/nn/functional/common.py zeropad2d)."""
+    x = ensure_tensor(x)
+    left, right, top, bottom = [int(p) for p in padding]
+
+    def fn(v):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (top, bottom), (left, right)]
+        else:
+            cfg = [(0, 0), (top, bottom), (left, right), (0, 0)]
+        return jnp.pad(v, cfg)
+
+    return call_op("zeropad2d", fn, (x,))

@@ -202,3 +202,19 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self._lower, self._upper, self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference:
+    python/paddle/nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        from .. import functional as F
+        assert len(x.shape) in (3, 4), "Softmax2D expects 3D/4D input"
+        return F.softmax(x, axis=-3)
+
+
+__all__ += ["Softmax2D"]

@@ -304,3 +304,57 @@ class MaxUnPool2D(Layer):
             out = out.at[bidx, cidx, iflat].set(flat)
             return out.reshape(n, c, oh, ow)
         return call_op("max_unpool2d", fn, (x,))
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)
+
+
+class PairwiseDistance(Layer):
+    """p-norm of (x - y + epsilon) along the last dim (reference:
+    python/paddle/nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops._helpers import call_op, ensure_tensor as _et
+        def fn(a, b):
+            d = a - b + self.epsilon
+            return jnp.sum(jnp.abs(d) ** self.p, axis=-1,
+                           keepdims=self.keepdim) ** (1.0 / self.p)
+        return call_op("pairwise_distance", fn, (_et(x), _et(y)))
+
+
+__all__ += ["MaxUnPool1D", "MaxUnPool3D", "PairwiseDistance"]

@@ -1,0 +1,195 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference analog: python/paddle/nn/layer/rnn.py (the fluid
+layers/rnn.py BeamSearchDecoder/dynamic_decode pair re-exported by
+paddle.nn). TPU-first note: the decode loop here is the eager/dygraph
+path (host loop, mirrors the reference's dygraph branch); the
+compiled serving path for generation is `model.generate()`-style
+lax.scan decode in models (see models/gpt.py) — this API exists for
+seq2seq parity (attention/RNN cells, beam backtrace via gather_tree).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ..layer_base import Layer
+from ...ops._helpers import ensure_tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "Decoder"]
+
+
+class Decoder:
+    """Abstract decoder API: initialize / step / finalize
+    (reference: fluid/layers/rnn.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+BeamSearchState = namedtuple("BeamSearchState",
+                             ["cell_states", "log_probs", "finished",
+                              "lengths"])
+BeamSearchOutput = namedtuple("BeamSearchOutput",
+                              ["scores", "predicted_ids", "parent_ids"])
+
+
+def _map_structure(fn, obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(fn, o) for o in obj)
+    return fn(obj)
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNNCell. reference:
+    python/paddle/fluid/layers/rnn.py BeamSearchDecoder."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.kinf = 1e9
+
+    # -- beam/batch merge helpers (reference: _merge_batch_beams etc.) --
+    def _merge(self, x):
+        v = ensure_tensor(x)._value
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _split(self, x):
+        v = ensure_tensor(x)._value
+        return Tensor(v.reshape((-1, self.beam_size) + v.shape[1:]))
+
+    def _tile_beam(self, x):
+        v = ensure_tensor(x)._value
+        v = jnp.repeat(v[:, None], self.beam_size, axis=1)
+        return Tensor(v)
+
+    def initialize(self, initial_cell_states):
+        states = _map_structure(self._tile_beam, initial_cell_states)
+        batch = ensure_tensor(
+            states[0] if isinstance(states, (list, tuple)) else states
+        )._value.shape[0]
+        # beam 0 active, others -inf so the first step picks distinct tokens
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-self.kinf] * (self.beam_size - 1),
+                      jnp.float32), (batch, 1))
+        init_ids = jnp.full((batch, self.beam_size), self.start_token,
+                            jnp.int64)
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int64)
+        state = BeamSearchState(states, Tensor(log_probs), Tensor(finished),
+                                Tensor(lengths))
+        return Tensor(init_ids), state, Tensor(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states = states.cell_states
+        inp = inputs
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        merged_inp = self._merge(inp)
+        merged_states = _map_structure(self._merge, cell_states)
+        cell_out, next_cell_states = self.cell(merged_inp, merged_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = self._split(cell_out)._value.astype(jnp.float32)
+        B, K, V = logits.shape
+
+        step_log_probs = jax.nn.log_softmax(logits, axis=-1)
+        fin = states.finished._value
+        # finished beams only extend with end_token at probability 1
+        noend_mask = jnp.full((V,), -self.kinf).at[self.end_token].set(0.0)
+        step_log_probs = jnp.where(fin[..., None], noend_mask[None, None],
+                                   step_log_probs)
+        log_probs = states.log_probs._value[..., None] + step_log_probs
+        flat = log_probs.reshape(B, K * V)
+        topk_lp, topk_idx = jax.lax.top_k(flat, K)
+        parent = (topk_idx // V).astype(jnp.int64)
+        token = (topk_idx % V).astype(jnp.int64)
+
+        def gather_beam(x):
+            v = self._split(x)._value
+            return Tensor(jnp.take_along_axis(
+                v, parent.reshape((B, K) + (1,) * (v.ndim - 2)), axis=1))
+
+        next_cell_states = _map_structure(
+            lambda s: gather_beam(s), next_cell_states)
+        prev_fin = jnp.take_along_axis(fin, parent, axis=1)
+        next_fin = prev_fin | (token == self.end_token)
+        prev_len = jnp.take_along_axis(states.lengths._value, parent, axis=1)
+        next_len = prev_len + (~prev_fin).astype(jnp.int64)
+
+        beam_state = BeamSearchState(next_cell_states, Tensor(topk_lp),
+                                     Tensor(next_fin), Tensor(next_len))
+        output = BeamSearchOutput(Tensor(topk_lp), Tensor(token),
+                                  Tensor(parent))
+        return output, beam_state, Tensor(token), Tensor(next_fin)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from ..functional.sequence import gather_tree
+        ids = gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run `decoder` until every sequence finishes or `max_step_num`.
+    Returns (outputs, final_states[, sequence_lengths]) like the
+    reference (fluid/layers/rnn.py dynamic_decode dygraph branch)."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    time = 0
+    limit = int(max_step_num) if max_step_num is not None else 10 ** 9
+    while time < limit:
+        out, states, inputs, finished = decoder.step(time, inputs, states,
+                                                     **kwargs)
+        step_outputs.append(out)
+        time += 1
+        if bool(np.asarray(ensure_tensor(finished)._value).all()):
+            break
+
+    def stack_field(i):
+        return Tensor(jnp.stack(
+            [ensure_tensor(o[i])._value for o in step_outputs]))
+
+    if isinstance(step_outputs[0], tuple):
+        outputs = type(step_outputs[0])(
+            *[stack_field(i) for i in range(len(step_outputs[0]))])
+    else:
+        outputs = stack_field(0)
+
+    seq_len = getattr(states, "lengths", None)
+    final_outputs, final_states = decoder.finalize(outputs, states, seq_len)
+
+    if not output_time_major:
+        def to_batch_major(t):
+            v = ensure_tensor(t)._value
+            return Tensor(jnp.swapaxes(v, 0, 1))
+        final_outputs = _map_structure(to_batch_major, final_outputs)
+
+    if return_length:
+        return final_outputs, final_states, seq_len
+    return final_outputs, final_states

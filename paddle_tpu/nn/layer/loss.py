@@ -182,3 +182,71 @@ class SoftMarginLoss(Layer):
         return call_op("soft_margin_loss",
                        _apply_reduction(fn, self.reduction),
                        (ensure_tensor(input), ensure_tensor(label)))
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid layer (reference:
+    python/paddle/nn/layer/loss.py HSigmoidLoss): owns the internal-node
+    weight [num_classes-1, feature_size] and optional bias."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from ..initializer_util import materialize_parameter
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = materialize_parameter(
+            [n_nodes, feature_size], weight_attr, self._dtype)
+        self.bias = materialize_parameter(
+            [n_nodes, 1], bias_attr, self._dtype, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+__all__ += ["MultiLabelSoftMarginLoss", "MultiMarginLoss",
+            "TripletMarginWithDistanceLoss", "HSigmoidLoss"]

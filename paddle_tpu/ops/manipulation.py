@@ -31,6 +31,7 @@ __all__ = [
     "swapaxes", "as_complex", "as_real", "cast", "tensordot", "unstack",
     "take", "tolist", "crop", "fill_diagonal_", "view", "view_as", "unfold",
     "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter", "diagonal_scatter",
+    "diag_embed",
 ]
 
 
@@ -626,3 +627,27 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
             vm = vm.at[..., i - offset, i].set(u)
         return jnp.moveaxis(vm, (-2, -1), (axis1, axis2))
     return call_op("diagonal_scatter", fn, (x, y))
+
+
+@register_op("diag_embed", "manipulation",
+             ref="python/paddle/nn/functional/extension.py diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed the last dim of `input` as diagonals of new matrices placed on
+    (dim1, dim2) of the output (torch/paddle diag_embed semantics)."""
+    x = ensure_tensor(input)
+
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        out_ndim = v.ndim + 1
+        d1 = dim1 + out_ndim if dim1 < 0 else dim1
+        d2 = dim2 + out_ndim if dim2 < 0 else dim2
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        if offset >= 0:
+            base = base.at[..., i, i + offset].set(v)
+        else:
+            base = base.at[..., i - offset, i].set(v)
+        # diagonals currently live on the last two axes; move to (d1, d2)
+        return jnp.moveaxis(base, (-2, -1), (d1, d2))
+
+    return call_op("diag_embed", fn, (x,))

@@ -118,3 +118,203 @@ def relu(x):
     if isinstance(x, SparseCooTensor):
         return SparseCooTensor(x.indices, dense_relu(x.values), x.shape)
     return dense_relu(x)
+
+
+# --- value-wise unary ops (zero-preserving → sparsity pattern unchanged) ---
+# Reference analog: python/paddle/sparse/unary.py (phi sparse_coo/csr
+# kernels). Values go through the dense op dispatch so autograd flows.
+
+def _unary_sparse(op_name):
+    def op(x, name=None):
+        from .. import ops as O
+        fn = getattr(O, op_name)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices, fn(x.values), x.shape,
+                                   coalesced=x.coalesced)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x.crows, x.cols, fn(x.values), x.shape)
+        return fn(ensure_tensor(x))
+    op.__name__ = op_name
+    return op
+
+
+sin = _unary_sparse("sin")
+tan = _unary_sparse("tan")
+asin = _unary_sparse("asin")
+atan = _unary_sparse("atan")
+sinh = _unary_sparse("sinh")
+tanh = _unary_sparse("tanh")
+asinh = _unary_sparse("asinh")
+atanh = _unary_sparse("atanh")
+sqrt = _unary_sparse("sqrt")
+square = _unary_sparse("square")
+log1p = _unary_sparse("log1p")
+abs = _unary_sparse("abs")
+expm1 = _unary_sparse("expm1")
+deg2rad = _unary_sparse("deg2rad")
+rad2deg = _unary_sparse("rad2deg")
+
+
+def neg(x, name=None):
+    from ..ops import scale
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, scale(x.values, -1.0), x.shape,
+                               coalesced=x.coalesced)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows, x.cols, scale(x.values, -1.0),
+                               x.shape)
+    return scale(ensure_tensor(x), -1.0)
+
+
+def pow(x, factor, name=None):
+    from ..ops import pow as dense_pow
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x.indices, dense_pow(x.values, factor),
+                               x.shape, coalesced=x.coalesced)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x.crows, x.cols, dense_pow(x.values, factor),
+                               x.shape)
+    return dense_pow(ensure_tensor(x), factor)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework.dtype import to_jax_dtype
+    def cv(t, dt):
+        return Tensor(t._value.astype(to_jax_dtype(dt))) if dt else t
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(cv(x.indices, index_dtype),
+                               cv(x.values, value_dtype), x.shape,
+                               coalesced=x.coalesced)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(cv(x.crows, index_dtype),
+                               cv(x.cols, index_dtype),
+                               cv(x.values, value_dtype), x.shape)
+    return cv(ensure_tensor(x), value_dtype)
+
+
+# --- structure ops ---
+
+def coalesce(x, name=None):
+    """Merge duplicate COO indices by summation (reference:
+    phi/kernels/sparse/coalesce_kernel.h). Segment-sum over the
+    linearized index — the TPU-native pattern for scatter-reduce."""
+    assert isinstance(x, SparseCooTensor)
+    idx = x.indices._value.astype(jnp.int64)
+    shape = x.shape
+    sparse_ndim = idx.shape[0]
+    flat = jnp.zeros_like(idx[0])
+    for d in range(sparse_ndim):
+        flat = flat * shape[d] + idx[d]
+    uniq, inv = jnp.unique(flat, return_inverse=True, size=flat.shape[0],
+                           fill_value=-1)
+    n_uniq = int(jnp.sum(uniq >= 0))
+    vals = jnp.zeros((flat.shape[0],) + x.values._value.shape[1:],
+                     x.values._value.dtype)
+    vals = vals.at[inv.reshape(-1)].add(x.values._value)
+    # unravel kept (sorted-unique) flat indices back to nd
+    kept = jnp.where(uniq >= 0, uniq, 0)
+    new_idx = []
+    rem = kept
+    for d in reversed(range(sparse_ndim)):
+        new_idx.append(rem % shape[d])
+        rem = rem // shape[d]
+    new_idx = jnp.stack(list(reversed(new_idx)))
+    return SparseCooTensor(Tensor(new_idx[:, :n_uniq].astype(idx.dtype)),
+                           Tensor(vals[:n_uniq]), shape, coalesced=True)
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        idx = x.indices._value
+        new_idx = jnp.stack([idx[p] for p in perm])
+        new_shape = [x.shape[p] for p in perm]
+        return SparseCooTensor(Tensor(new_idx), x.values, new_shape)
+    from ..ops import transpose as dense_t
+    return dense_t(to_dense(x), perm)
+
+
+def reshape(x, shape, name=None):
+    assert isinstance(x, SparseCooTensor), "sparse.reshape expects COO"
+    old_shape = x.shape
+    total = int(np.prod(old_shape))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = total // known
+    idx = x.indices._value.astype(jnp.int64)
+    flat = jnp.zeros_like(idx[0])
+    for d in range(len(old_shape)):
+        flat = flat * old_shape[d] + idx[d]
+    new_idx = []
+    rem = flat
+    for d in reversed(range(len(shape))):
+        new_idx.append(rem % shape[d])
+        rem = rem // shape[d]
+    new_idx = jnp.stack(list(reversed(new_idx)))
+    return SparseCooTensor(Tensor(new_idx.astype(idx.dtype)), x.values,
+                           shape, coalesced=x.coalesced)
+
+
+# --- matmul family ---
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector. Reference:
+    phi/kernels/sparse/mv_kernel.h. COO path is a gather+segment-sum —
+    maps to XLA scatter-add, no dense [M,N] materialization."""
+    vec = ensure_tensor(vec)
+    if isinstance(x, SparseCooTensor) and len(x.shape) == 2:
+        rows = x.indices._value[0]
+        cols = x.indices._value[1]
+        contrib = x.values._value * vec._value[cols]
+        out = jnp.zeros((x.shape[0],), contrib.dtype).at[rows].add(contrib)
+        return Tensor(out)
+    from ..ops import matmul as dense_matmul
+    return dense_matmul(to_dense(x), vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (reference:
+    python/paddle/sparse/multiary.py addmm)."""
+    from ..ops import matmul as dense_matmul, scale, add as dense_add
+    prod = dense_matmul(to_dense(x), to_dense(y))
+    return dense_add(scale(to_dense(input), beta), scale(prod, alpha))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """SDDMM: (x @ y) sampled at `mask`'s sparsity pattern → sparse out
+    (reference: phi/kernels/sparse/masked_matmul kernel on cuSPARSE).
+    TPU-first: per-nonzero row·col dot via gather — O(nnz·K), no dense
+    [M,N] product."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    if isinstance(mask, SparseCsrTensor):
+        crows = np.asarray(mask.crows._value)
+        cols_v = mask.cols._value
+        rows_np = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        rows_v = jnp.asarray(rows_np)
+        vals = jnp.sum(x._value[rows_v] * y._value[:, cols_v].T, axis=-1)
+        return SparseCsrTensor(mask.crows, mask.cols, Tensor(vals),
+                               [x.shape[0], y.shape[1]])
+    assert isinstance(mask, SparseCooTensor)
+    rows_v = mask.indices._value[0]
+    cols_v = mask.indices._value[1]
+    vals = jnp.sum(x._value[rows_v] * y._value[:, cols_v].T, axis=-1)
+    return SparseCooTensor(mask.indices, Tensor(vals),
+                           [x.shape[0], y.shape[1]])
+
+
+def subtract(x, y, name=None):
+    from ..ops import subtract as dense_sub
+    return _dense_op(dense_sub)(x, y)
+
+
+def divide(x, y, name=None):
+    from ..ops import divide as dense_div
+    return _dense_op(dense_div)(x, y)
+
+
+__all__ += ["SparseCsrTensor", "sin", "tan", "asin", "atan", "sinh", "tanh",
+            "asinh", "atanh", "sqrt", "square", "log1p", "abs", "pow",
+            "cast", "neg", "deg2rad", "rad2deg", "expm1", "mv",
+            "masked_matmul", "addmm", "subtract", "transpose", "divide",
+            "coalesce", "reshape"]

@@ -1,0 +1,352 @@
+"""Round-3 nn surface completion: unpool, grid ops, new losses, beam
+search decode, sparse ops.
+
+Reference analogs: python/paddle/nn/functional/{vision,loss,extension}.py,
+python/paddle/fluid/layers/rnn.py, python/paddle/sparse/.
+"""
+import itertools
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.sparse as sparse
+
+
+class TestUnpool:
+    def test_max_unpool2d_roundtrip_matches_torch(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, 2).numpy()
+        tout, tmask = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        tup = torch.nn.functional.max_unpool2d(tout, tmask, 2, 2).numpy()
+        np.testing.assert_allclose(up, tup)
+
+    @pytest.mark.parametrize("nd", [1, 3])
+    def test_max_unpool_1d_3d(self, nd):
+        rng = np.random.RandomState(1)
+        if nd == 1:
+            x = rng.randn(2, 3, 10).astype("float32")
+            o, m = F.max_pool1d(paddle.to_tensor(x), 2, 2, return_mask=True)
+            up = F.max_unpool1d(o, m, 2, 2).numpy()
+            to, tm = torch.nn.functional.max_pool1d(
+                torch.tensor(x), 2, 2, return_indices=True)
+            ref = torch.nn.functional.max_unpool1d(to, tm, 2, 2).numpy()
+        else:
+            x = rng.randn(2, 2, 4, 4, 4).astype("float32")
+            o, m = F.max_pool3d(paddle.to_tensor(x), 2, 2, return_mask=True)
+            up = F.max_unpool3d(o, m, 2, 2).numpy()
+            to, tm = torch.nn.functional.max_pool3d(
+                torch.tensor(x), 2, 2, return_indices=True)
+            ref = torch.nn.functional.max_unpool3d(to, tm, 2, 2).numpy()
+        np.testing.assert_allclose(up, ref)
+
+    def test_unpool_layers(self):
+        x = np.random.RandomState(2).randn(1, 2, 6).astype("float32")
+        o, m = F.max_pool1d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        up = nn.MaxUnPool1D(2, 2)(o, m)
+        assert up.shape == [1, 2, 6]
+        x3 = np.random.RandomState(3).randn(1, 2, 4, 4, 4).astype("float32")
+        o3, m3 = F.max_pool3d(paddle.to_tensor(x3), 2, 2, return_mask=True)
+        assert nn.MaxUnPool3D(2, 2)(o3, m3).shape == [1, 2, 4, 4, 4]
+
+
+class TestGridOps:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_affine_grid(self, align):
+        th = np.random.RandomState(0).randn(2, 2, 3).astype("float32")
+        got = F.affine_grid(paddle.to_tensor(th), [2, 3, 5, 7],
+                            align_corners=align).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(th), [2, 3, 5, 7], align_corners=align).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    @pytest.mark.parametrize(
+        "mode,pad,align",
+        list(itertools.product(["bilinear", "nearest"],
+                               ["zeros", "border", "reflection"],
+                               [True, False])))
+    def test_grid_sample_4d(self, mode, pad, align):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 6, 7).astype("float32")
+        g = (rng.rand(2, 5, 4, 2).astype("float32") * 2.4 - 1.2)
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g), mode,
+                            pad, align).numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(g), mode, pad, align).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_grid_sample_5d(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 2, 4, 5, 6).astype("float32")
+        g = (rng.rand(2, 3, 3, 3, 3).astype("float32") * 2 - 1)
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g)).numpy()
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(g), align_corners=True).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_temporal_shift_kernel_semantics(self):
+        x = np.arange(4 * 8, dtype="float32").reshape(4, 8, 1, 1)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        v5 = x.reshape(2, 2, 8, 1, 1)
+        ref = np.zeros_like(v5)
+        for t in range(2):
+            ref[:, t, :2] = v5[:, t - 1, :2] if t >= 1 else 0
+            ref[:, t, 2:4] = v5[:, t + 1, 2:4] if t + 1 < 2 else 0
+            ref[:, t, 4:] = v5[:, t, 4:]
+        np.testing.assert_allclose(out, ref.reshape(4, 8, 1, 1))
+
+    def test_zeropad2d(self):
+        x = np.random.RandomState(3).randn(1, 2, 3, 4).astype("float32")
+        got = F.zeropad2d(paddle.to_tensor(x), [1, 2, 3, 4]).numpy()
+        ref = torch.nn.functional.pad(torch.tensor(x), (1, 2, 3, 4)).numpy()
+        np.testing.assert_allclose(got, ref)
+
+    def test_diag_embed(self):
+        x = np.random.RandomState(4).randn(2, 3, 4).astype("float32")
+        for off, d1, d2 in [(0, -2, -1), (1, -2, -1), (-2, -2, -1), (0, 0, 2)]:
+            got = F.diag_embed(paddle.to_tensor(x), off, d1, d2).numpy()
+            ref = torch.diag_embed(torch.tensor(x), off, d1, d2).numpy()
+            np.testing.assert_allclose(got, ref)
+
+
+class TestNewLosses:
+    @pytest.mark.parametrize("red", ["mean", "sum", "none"])
+    def test_soft_margin(self, red):
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 5).astype("float32")
+        y = np.sign(rng.randn(6, 5)).astype("float32")
+        got = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 red).numpy()
+        ref = torch.nn.functional.soft_margin_loss(
+            torch.tensor(x), torch.tensor(y), reduction=red).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("red", ["mean", "sum", "none"])
+    def test_multi_label_soft_margin(self, red):
+        rng = np.random.RandomState(1)
+        x = rng.randn(6, 5).astype("float32")
+        y = (rng.rand(6, 5) > 0.5).astype("float32")
+        w = rng.rand(5).astype("float32")
+        got = F.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y), paddle.to_tensor(w),
+            red).numpy()
+        ref = torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(y), torch.tensor(w),
+            reduction=red).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("p,red", [(1, "mean"), (2, "sum"), (1, "none")])
+    def test_multi_margin(self, p, red):
+        rng = np.random.RandomState(2)
+        x = rng.randn(6, 5).astype("float32")
+        y = rng.randint(0, 5, (6,)).astype("int64")
+        got = F.multi_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                  p=p, margin=0.7, reduction=red).numpy()
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(x), torch.tensor(y), p=p, margin=0.7,
+            reduction=red).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("swap", [False, True])
+    def test_triplet_with_distance(self, swap):
+        rng = np.random.RandomState(3)
+        a, b, c = [rng.randn(4, 8).astype("float32") for _ in range(3)]
+        df = lambda u, v: paddle.sqrt(
+            paddle.sum(paddle.square(paddle.subtract(u, v)), axis=-1))
+        tdf = lambda u, v: torch.sqrt(((u - v) ** 2).sum(-1))
+        got = F.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(b), paddle.to_tensor(c),
+            distance_function=df, margin=0.8, swap=swap).numpy()
+        ref = torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(c),
+            distance_function=tdf, margin=0.8, swap=swap).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_margin_cross_entropy_zero_margin_is_scaled_ce(self):
+        rng = np.random.RandomState(4)
+        cos = np.clip(rng.randn(6, 10) * 0.3, -1, 1).astype("float32")
+        y = rng.randint(0, 10, (6,)).astype("int64")
+        got = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(y), margin1=1.0,
+            margin2=0.0, margin3=0.0, scale=4.0).numpy()
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(cos * 4.0), torch.tensor(y)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+        # adding the additive-angle margin must increase the loss
+        with_margin = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(y), scale=4.0).numpy()
+        assert with_margin > got
+
+    def test_margin_ce_return_softmax(self):
+        rng = np.random.RandomState(5)
+        cos = np.clip(rng.randn(4, 6) * 0.3, -1, 1).astype("float32")
+        y = rng.randint(0, 6, (4,)).astype("int64")
+        loss, sm = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(y), return_softmax=True)
+        np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, atol=1e-5)
+
+    def test_hsigmoid_loss_trains(self):
+        rng = np.random.RandomState(6)
+        inp = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        inp.stop_gradient = False
+        w = paddle.to_tensor(rng.randn(9, 8).astype("float32"))
+        lbl = paddle.to_tensor(rng.randint(0, 10, (4,)).astype("int64"))
+        loss = F.hsigmoid_loss(inp, lbl, 10, w)
+        loss.backward()
+        assert inp.grad is not None and np.isfinite(float(loss.numpy()))
+
+    def test_hsigmoid_layer(self):
+        layer = nn.HSigmoidLoss(8, 10)
+        rng = np.random.RandomState(7)
+        loss = layer(paddle.to_tensor(rng.randn(4, 8).astype("float32")),
+                     paddle.to_tensor(rng.randint(0, 10, (4,)).astype("int64")))
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_loss_layer_classes(self):
+        rng = np.random.RandomState(8)
+        x = rng.randn(5, 4).astype("float32")
+        yl = (rng.rand(5, 4) > 0.5).astype("float32")
+        yi = rng.randint(0, 4, (5,)).astype("int64")
+        assert np.isfinite(float(nn.MultiLabelSoftMarginLoss()(
+            paddle.to_tensor(x), paddle.to_tensor(yl)).numpy()))
+        assert np.isfinite(float(nn.MultiMarginLoss()(
+            paddle.to_tensor(x), paddle.to_tensor(yi)).numpy()))
+        a, b, c = [paddle.to_tensor(rng.randn(3, 6).astype("float32"))
+                   for _ in range(3)]
+        assert np.isfinite(float(
+            nn.TripletMarginWithDistanceLoss()(a, b, c).numpy()))
+
+
+class TestSequenceOps:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3, 2])),
+                            maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            m, [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+        m2 = F.sequence_mask(paddle.to_tensor(np.array([[1, 2], [3, 0]])),
+                             dtype="bool").numpy()
+        assert m2.shape == (2, 2, 3) and m2.dtype == np.bool_
+
+    def test_gather_tree_backtrace(self):
+        ids = np.array([[[2, 2]], [[6, 1]], [[7, 8]]], dtype="int64")
+        par = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], dtype="int64")
+        got = F.gather_tree(paddle.to_tensor(ids),
+                            paddle.to_tensor(par)).numpy()
+        expect = np.zeros_like(ids)
+        for b in range(2):
+            beam = b
+            for t in range(2, -1, -1):
+                expect[t, 0, b] = ids[t, 0, beam]
+                beam = par[t, 0, beam]
+        np.testing.assert_array_equal(got, expect)
+
+    def test_beam_search_decode(self):
+        paddle.seed(0)
+        V, H, B = 6, 8, 2
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        proj = nn.Linear(H, V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        ids, final = nn.dynamic_decode(dec, inits=paddle.zeros([B, H]),
+                                       max_step_num=5)
+        assert ids.shape[0] == B and ids.shape[2] == 3
+        scores = final.log_probs.numpy()
+        assert (np.diff(scores, axis=1) <= 1e-5).all()  # beams sorted
+
+    def test_sparse_attention_full_pattern_equals_dense(self):
+        rng = np.random.RandomState(0)
+        B, H, M, D = 1, 2, 4, 8
+        q, k, v = [rng.randn(B, H, M, D).astype("float32") for _ in range(3)]
+        off = np.tile(np.arange(0, (M + 1) * M, M), (B, H, 1)).astype("int32")
+        cols = np.tile(np.tile(np.arange(M), M), (B, H, 1)).astype("int32")
+        got = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(off), paddle.to_tensor(cols)).numpy()
+        s = np.einsum("bhmd,bhnd->bhmn", q, k) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhmn,bhnd->bhmd", p, v)
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_inplace_activations(self):
+        x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        xv = x.numpy()
+        F.tanh_(x)
+        np.testing.assert_allclose(x.numpy(), np.tanh(xv), atol=1e-6)
+        y = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        F.softmax_(y)
+        np.testing.assert_allclose(y.numpy().sum(-1), 1.0, atol=1e-5)
+
+
+class TestSparseModule:
+    def _coo(self):
+        idx = np.array([[0, 0, 1, 2], [1, 1, 0, 2]])
+        vals = np.array([1., 2., 3., 4.], dtype="float32")
+        return sparse.coalesce(
+            sparse.sparse_coo_tensor(idx, vals, [3, 3]))
+
+    def test_coalesce(self):
+        c = self._coo()
+        ref = np.zeros((3, 3), "float32")
+        ref[0, 1] = 3; ref[1, 0] = 3; ref[2, 2] = 4
+        np.testing.assert_allclose(c.to_dense().numpy(), ref)
+        assert c.nnz() == 3
+
+    def test_unary_keeps_pattern(self):
+        c = self._coo()
+        dense = c.to_dense().numpy()
+        np.testing.assert_allclose(sparse.sin(c).to_dense().numpy(),
+                                   np.sin(dense), atol=1e-6)
+        np.testing.assert_allclose(sparse.neg(c).to_dense().numpy(), -dense)
+        np.testing.assert_allclose(sparse.pow(c, 2).to_dense().numpy(),
+                                   dense ** 2, atol=1e-5)
+
+    def test_mv_addmm(self):
+        c = self._coo()
+        dense = c.to_dense().numpy()
+        v = np.array([1., 2., 3.], dtype="float32")
+        np.testing.assert_allclose(
+            sparse.mv(c, paddle.to_tensor(v)).numpy(), dense @ v)
+        eye = paddle.to_tensor(np.eye(3, dtype="float32"))
+        ones = paddle.to_tensor(np.ones((3, 3), "float32"))
+        got = sparse.addmm(ones, c, eye, beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(got, 0.5 + 2.0 * dense)
+
+    def test_masked_matmul_coo_csr(self):
+        c = self._coo()
+        dense = c.to_dense().numpy()
+        rng = np.random.RandomState(0)
+        A = rng.randn(3, 4).astype("float32")
+        B = rng.randn(4, 3).astype("float32")
+        full = A @ B
+        expect = np.where(dense != 0, full, 0.0)
+        got = sparse.masked_matmul(paddle.to_tensor(A), paddle.to_tensor(B),
+                                   c).to_dense().numpy()
+        np.testing.assert_allclose(got, expect, atol=1e-5)
+        csr = sparse.sparse_csr_tensor(
+            np.array([0, 1, 2, 3]), np.array([1, 0, 2]),
+            np.array([3., 3., 4.], dtype="float32"), [3, 3])
+        got2 = sparse.masked_matmul(paddle.to_tensor(A), paddle.to_tensor(B),
+                                    csr).to_dense().numpy()
+        np.testing.assert_allclose(got2, expect, atol=1e-5)
+
+    def test_reshape_transpose(self):
+        c = self._coo()
+        dense = c.to_dense().numpy()
+        np.testing.assert_allclose(
+            sparse.reshape(c, [9]).to_dense().numpy(), dense.reshape(9))
+        np.testing.assert_allclose(
+            sparse.transpose(c, [1, 0]).to_dense().numpy(), dense.T)
+
+    def test_cast(self):
+        c = self._coo()
+        cz = sparse.cast(c, value_dtype="float64")
+        assert str(cz.values.dtype).endswith("float64")
