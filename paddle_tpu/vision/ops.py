@@ -438,3 +438,438 @@ class RoIPool:
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self.output_size,
                         self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: python/paddle/vision/ops.py
+    deform_conv2d over phi deformable_conv kernel). Offsets are
+    (dy, dx) interleaved per kernel tap; mask enables the v2 modulated
+    variant.
+
+    TPU-first: the im2col+offset gather is expressed as one vectorized
+    bilinear gather over [N, kh*kw, Ho, Wo] sample points, then the
+    contraction with the weight is a plain einsum the MXU executes."""
+    x = ensure_tensor(x)
+    offset = ensure_tensor(offset)
+    weight = ensure_tensor(weight)
+    to2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    sh, sw = to2(stride)
+    ph, pw = to2(padding)
+    dh, dw = to2(dilation)
+
+    inputs = [x, offset, weight]
+    if mask is not None:
+        inputs.append(ensure_tensor(mask))
+    if bias is not None:
+        inputs.append(ensure_tensor(bias))
+
+    has_bias = bias is not None
+    has_mask = mask is not None
+
+    def fn(xv, offv, wv, *rest):
+        rest = list(rest)
+        mv = rest.pop(0) if has_mask else None
+        bv = rest.pop(0) if has_bias else None
+        N, Cin, H, W = xv.shape
+        Cout, Cin_g, kh, kw = wv.shape
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        K = kh * kw
+        G = deformable_groups
+
+        # base sampling positions per output pixel and tap: [K, Ho, Wo]
+        oy = jnp.arange(Ho) * sh - ph
+        ox = jnp.arange(Wo) * sw - pw
+        ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                              indexing="ij")
+        base_y = ky.reshape(K, 1, 1) + oy[None, :, None]
+        base_x = kx.reshape(K, 1, 1) + ox[None, None, :]
+
+        off = offv.reshape(N, G, K, 2, Ho, Wo)
+        py = base_y[None, None] + off[:, :, :, 0]     # [N, G, K, Ho, Wo]
+        px = base_x[None, None] + off[:, :, :, 1]
+
+        # bilinear gather: sample all channels of the matching deformable
+        # group at each (n, g, k, i, j)
+        cpg = Cin // G
+
+        def sample_one(feat, yy, xx):
+            # feat [Cin, H, W]; yy/xx [G, K, Ho, Wo] -> [Cin, K, Ho, Wo]
+            fg = feat.reshape(G, cpg, H, W)
+
+            def per_group(fg_g, y_g, x_g):
+                return _bilinear_sample(fg_g, y_g, x_g)  # [cpg, K, Ho, Wo]
+
+            out = jax.vmap(per_group)(fg, yy, xx)       # [G, cpg, K, Ho, Wo]
+            return out.reshape(Cin, K, Ho, Wo)
+
+        col = jax.vmap(sample_one)(xv, py, px)          # [N, Cin, K, Ho, Wo]
+        if mv is not None:
+            m = mv.reshape(N, G, 1, K, Ho, Wo)
+            col = (col.reshape(N, G, cpg, K, Ho, Wo) * m) \
+                .reshape(N, Cin, K, Ho, Wo)
+
+        # grouped contraction with the weight
+        cg_in = Cin // groups
+        cg_out = Cout // groups
+        colg = col.reshape(N, groups, cg_in, K, Ho, Wo)
+        wg = wv.reshape(groups, cg_out, Cin_g, K)
+        out = jnp.einsum("ngckhw,gock->ngohw", colg, wg)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if bv is not None:
+            out = out + bv.reshape(1, Cout, 1, 1)
+        return out
+
+    return call_op("deform_conv2d", fn, tuple(inputs))
+
+
+class DeformConv2D:
+    """Layer wrapper owning weight/bias (reference:
+    python/paddle/vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from ..nn.initializer_util import materialize_parameter
+        from ..nn import initializer as I
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = materialize_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]], weight_attr,
+            "float32", default_initializer=I.Uniform(-bound, bound))
+        self.bias = materialize_parameter(
+            [out_channels], bias_attr, "float32", is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+class PSRoIPool:
+    """Layer wrapper over psroi_pool (reference: vision/ops.py PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): parallel decay of scores by overlap instead of
+    sequential suppression. Reference: phi matrix_nms kernel
+    (python/paddle/vision/ops.py matrix_nms). Host numpy — O(K^2) on the
+    already-thresholded candidate set, post-network bookkeeping."""
+    bb = np.asarray(_np(bboxes), np.float32)   # [N, M, 4]
+    sc = np.asarray(_np(scores), np.float32)   # [N, C, M]
+    N, C, M = sc.shape
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(N):
+        dets, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            keep = np.nonzero(sc[n, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[n, c, keep])][:nms_top_k]
+            boxes_c = bb[n, order]
+            scores_c = sc[n, c, order]
+            K = len(order)
+            if K == 0:
+                continue
+            # IoU matrix (upper triangle: j suppressed by i<j)
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            off = 0.0 if normalized else 1.0
+            iw = np.clip(x2 - x1 + off, 0, None)
+            ih = np.clip(y2 - y1 + off, 0, None)
+            inter = iw * ih
+            area = ((boxes_c[:, 2] - boxes_c[:, 0] + off)
+                    * (boxes_c[:, 3] - boxes_c[:, 1] + off))
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-10)
+            iou = np.triu(iou, k=1)
+            comp = iou.max(axis=0)             # max overlap with higher-score
+            if use_gaussian:
+                decay = np.exp((comp[:, None] ** 2 - iou ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay, 1.0)
+            decayed = scores_c * decay.min(axis=0)
+            ok = decayed > post_threshold
+            for k in np.nonzero(ok)[0]:
+                dets.append([c, decayed[k], *boxes_c[k]])
+                idxs.append(n * M + order[k])
+        if dets:
+            dets = np.asarray(dets, np.float32)
+            idxs = np.asarray(idxs, np.int64)
+            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets, idxs = dets[top], idxs[top]
+        else:
+            dets = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        all_out.append(dets)
+        all_idx.append(idxs)
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, 0)), stop_gradient=True)
+    index = Tensor(jnp.asarray(np.concatenate(all_idx, 0)[:, None]),
+                   stop_gradient=True)
+    nums = Tensor(jnp.asarray(np.asarray(rois_num, np.int32)),
+                  stop_gradient=True)
+    res = (out,)
+    if return_index:
+        res = res + (index,)
+    if return_rois_num:
+        res = res + (nums,)
+    return res if len(res) > 1 else res[0]
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (reference: python/paddle/vision/ops.py
+    generate_proposals over phi generate_proposals_v2). Host numpy
+    post-processing: decode → clip → filter → top-k → NMS per image."""
+    sc = np.asarray(_np(scores), np.float32)        # [N, A, H, W]
+    bd = np.asarray(_np(bbox_deltas), np.float32)   # [N, 4A, H, W]
+    ims = np.asarray(_np(img_size), np.float32)     # [N, 2] (h, w)
+    an = np.asarray(_np(anchors), np.float32).reshape(-1, 4)
+    va = np.asarray(_np(variances), np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    rois_all, nums = [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], va[order]
+        # decode (anchor + delta, variance-scaled)
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000. / 16.))) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000. / 16.))) * ah
+        boxes = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], axis=1)
+        ih, iw = ims[n]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = _nms_single(jnp.asarray(boxes), jnp.asarray(s),
+                               nms_thresh)
+            kept = np.asarray(kept)[:post_nms_top_n]
+            boxes = boxes[kept]
+        rois_all.append(boxes.astype(np.float32))
+        nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_all, 0)
+                              if rois_all else np.zeros((0, 4), np.float32)),
+                  stop_gradient=True)
+    nums_t = Tensor(jnp.asarray(np.asarray(nums, np.int32)),
+                    stop_gradient=True)
+    if return_rois_num:
+        return rois, nums_t
+    return rois
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py
+    read_file over phi read_file kernel)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data), stop_gradient=True)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes to a CHW uint8 tensor (reference: vision/ops.py
+    decode_jpeg over nvjpeg). Host-side decode (PIL) — image IO feeds the
+    input pipeline, not the accelerator."""
+    import io as _io
+    from PIL import Image
+    data = bytes(np.asarray(_np(x), np.uint8))
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr), stop_gradient=True)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 detection loss (reference: fluid/operators/yolov3_loss_op.h).
+
+    x: [N, mask*(5+class_num), H, W] raw head output;
+    gt_box: [N, B, 4] (cx, cy, w, h in image units); gt_label: [N, B];
+    anchors: flat (w, h) pairs; anchor_mask: indices of anchors this head
+    predicts. Returns per-sample loss [N].
+
+    Matching follows the reference: each gt picks its best-IoU anchor over
+    ALL anchors (shape-only IoU); the cell containing the gt center on
+    this head's grid owns the target if that anchor is in anchor_mask.
+    Predictions overlapping any gt above ignore_thresh are excluded from
+    the no-objectness loss."""
+    x = ensure_tensor(x)
+    gt_box = ensure_tensor(gt_box)
+    gt_label = ensure_tensor(gt_label)
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_np = np.asarray(anchor_mask, np.int32)
+    n_mask = len(mask_np)
+
+    inputs = [x, gt_box, gt_label]
+    if gt_score is not None:
+        inputs.append(ensure_tensor(gt_score))
+
+    def fn(xv, gbv, glv, *rest):
+        gsv = rest[0] if rest else None
+        N, _, H, W = xv.shape
+        pred = xv.reshape(N, n_mask, 5 + class_num, H, W)
+        px = jax.nn.sigmoid(pred[:, :, 0])
+        py = jax.nn.sigmoid(pred[:, :, 1])
+        pw = pred[:, :, 2]
+        ph = pred[:, :, 3]
+        pobj = pred[:, :, 4]
+        pcls = pred[:, :, 5:]
+        input_size = downsample_ratio * H
+
+        B = gbv.shape[1]
+        gx = gbv[..., 0] / input_size * W      # grid units
+        gy = gbv[..., 1] / input_size * H
+        gw = gbv[..., 2]
+        gh = gbv[..., 3]
+        valid = (gw > 0) & (gh > 0)
+
+        # best anchor per gt by shape-only IoU over ALL anchors
+        aw = anchors_np[:, 0][None, None]
+        ah = anchors_np[:, 1][None, None]
+        inter = (jnp.minimum(gw[..., None], aw)
+                 * jnp.minimum(gh[..., None], ah))
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)
+
+        # map to this head's local anchor slot (-1 if not ours)
+        local = -jnp.ones_like(best_anchor)
+        for slot, a_id in enumerate(mask_np):
+            local = jnp.where(best_anchor == a_id, slot, local)
+
+        ci = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+        owns = valid & (local >= 0)
+
+        # scatter gt targets onto the [N, n_mask, H, W] grid
+        def scatter(vals, fill=0.0):
+            out = jnp.full((N, n_mask, H, W), fill, jnp.float32)
+            nn_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+            sl = jnp.clip(local, 0, n_mask - 1)
+            return out.at[nn_idx, sl, cj, ci].set(
+                jnp.where(owns, vals, out[nn_idx, sl, cj, ci]))
+
+        tx = scatter(gx - jnp.floor(gx))
+        ty = scatter(gy - jnp.floor(gy))
+        mask_aw = anchors_np[mask_np][:, 0]
+        mask_ah = anchors_np[mask_np][:, 1]
+        tw_val = jnp.log(jnp.maximum(
+            gw / jnp.maximum(mask_aw[jnp.clip(local, 0, n_mask - 1)], 1e-9),
+            1e-9))
+        th_val = jnp.log(jnp.maximum(
+            gh / jnp.maximum(mask_ah[jnp.clip(local, 0, n_mask - 1)], 1e-9),
+            1e-9))
+        tw = scatter(tw_val)
+        th = scatter(th_val)
+        tobj = scatter(jnp.ones_like(gx))
+        tscore = scatter(gsv if gsv is not None else jnp.ones_like(gx))
+        box_scale = scatter(2.0 - gw * gh / (input_size * input_size))
+
+        # class targets: one-hot scatter
+        tcls = jnp.zeros((N, n_mask, class_num, H, W), jnp.float32)
+        nn_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+        sl = jnp.clip(local, 0, n_mask - 1)
+        cls_idx = jnp.clip(glv.astype(jnp.int32), 0, class_num - 1)
+        smooth_pos = 1.0
+        smooth_neg = 0.0
+        if use_label_smooth:
+            delta = 1.0 / max(class_num, 1)
+            smooth_pos, smooth_neg = 1.0 - delta, delta
+            tcls = jnp.where(tobj[:, :, None] > 0, smooth_neg, 0.0)
+        tcls = tcls.at[nn_idx, sl, cls_idx, cj, ci].set(
+            jnp.where(owns, smooth_pos, tcls[nn_idx, sl, cls_idx, cj, ci]))
+
+        # ignore mask: predicted boxes with IoU > thresh vs any gt
+        grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None]
+        grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        bx = (px + grid_x) / W * input_size
+        by = (py + grid_y) / H * input_size
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * mask_aw[None, :, None, None]
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * mask_ah[None, :, None, None]
+        p1x, p1y = bx - bw / 2, by - bh / 2
+        p2x, p2y = bx + bw / 2, by + bh / 2
+        g1x = (gbv[..., 0] - gbv[..., 2] / 2)
+        g1y = (gbv[..., 1] - gbv[..., 3] / 2)
+        g2x = (gbv[..., 0] + gbv[..., 2] / 2)
+        g2y = (gbv[..., 1] + gbv[..., 3] / 2)
+        px_ = p1x[..., None]
+        iw = (jnp.minimum(p2x[..., None], g2x[:, None, None, None])
+              - jnp.maximum(px_, g1x[:, None, None, None]))
+        ih = (jnp.minimum(p2y[..., None], g2y[:, None, None, None])
+              - jnp.maximum(p1y[..., None], g1y[:, None, None, None]))
+        inter_p = jnp.clip(iw, 0) * jnp.clip(ih, 0)
+        area_p = (bw * bh)[..., None]
+        area_g = ((g2x - g1x) * (g2y - g1y))[:, None, None, None]
+        iou_pg = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-10)
+        iou_pg = jnp.where(valid[:, None, None, None], iou_pg, 0.0)
+        ignore = jnp.max(iou_pg, axis=-1) > ignore_thresh
+
+        def bce(logit, target):
+            return (jnp.maximum(logit, 0) - logit * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+        obj_mask = tobj > 0
+        loss_xy = (bce(pred[:, :, 0], tx) + bce(pred[:, :, 1], ty)) \
+            * box_scale * tscore
+        loss_wh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * box_scale * tscore
+        loss_obj = bce(pobj, jnp.ones_like(pobj)) * tscore
+        loss_noobj = bce(pobj, jnp.zeros_like(pobj)) * (~ignore)
+        loss_cls = jnp.sum(bce(pcls, tcls), axis=2) * tscore
+
+        per = jnp.where(obj_mask, loss_xy + loss_wh + loss_obj + loss_cls,
+                        jnp.where(~obj_mask, loss_noobj, 0.0))
+        return jnp.sum(per.reshape(N, -1), axis=-1)
+
+    return call_op("yolo_loss", fn, tuple(inputs))
+
+
+__all__ += ["deform_conv2d", "DeformConv2D", "PSRoIPool", "matrix_nms",
+            "generate_proposals", "read_file", "decode_jpeg", "yolo_loss"]

@@ -259,3 +259,207 @@ class BrightnessTransform(BaseTransform):
         factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
         out = arr * factor
         return np.clip(out, 0, 255 if arr.max() > 1 else 1.0)
+
+
+from .functional import (  # noqa: F401,E402
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
+    to_grayscale, crop, center_crop, pad, erase, rotate, affine, perspective,
+)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("saturation value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        factor = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, factor)
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue in random order
+    (reference: python/paddle/vision/transforms/transforms.py
+    ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        channel_last = arr.ndim == 2 or (arr.ndim == 3
+                                         and arr.shape[-1] in (1, 3, 4))
+        h, w = (arr.shape[:2] if channel_last else arr.shape[1:3])
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shear = self.shear
+            if isinstance(shear, numbers.Number):
+                shear = (-abs(shear), abs(shear))
+            if len(shear) == 2:
+                sh = (np.random.uniform(shear[0], shear[1]), 0.0)
+            else:
+                sh = (np.random.uniform(shear[0], shear[1]),
+                      np.random.uniform(shear[2], shear[3]))
+        return affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return _to_numpy(img)
+        arr = _to_numpy(img)
+        channel_last = arr.ndim == 2 or (arr.ndim == 3
+                                         and arr.shape[-1] in (1, 3, 4))
+        h, w = (arr.shape[:2] if channel_last else arr.shape[1:3])
+        d = self.distortion_scale
+        hd = int(h * d / 2)
+        wd = int(w * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, wd + 1), np.random.randint(0, hd + 1)),
+               (w - 1 - np.random.randint(0, wd + 1),
+                np.random.randint(0, hd + 1)),
+               (w - 1 - np.random.randint(0, wd + 1),
+                h - 1 - np.random.randint(0, hd + 1)),
+               (np.random.randint(0, wd + 1),
+                h - 1 - np.random.randint(0, hd + 1))]
+        return perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (reference: transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img if isinstance(img, Tensor) else _to_numpy(img)
+        arr = _to_numpy(img)
+        channel_last = arr.ndim == 2 or (arr.ndim == 3
+                                         and arr.shape[-1] in (1, 3, 4))
+        h, w = (arr.shape[:2] if channel_last else arr.shape[1:3])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            aspect = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / aspect)))
+            ew = int(round(np.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    if channel_last:
+                        v = np.random.rand(eh, ew, *arr.shape[2:])
+                    else:
+                        v = np.random.rand(arr.shape[0], eh, ew)
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img if isinstance(img, Tensor) else arr
+
+
+__all__ += ["adjust_brightness", "adjust_contrast", "adjust_hue",
+            "adjust_saturation", "to_grayscale", "crop", "center_crop",
+            "pad", "erase", "rotate", "affine", "perspective",
+            "BaseTransform", "ColorJitter", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "Grayscale",
+            "RandomRotation", "RandomAffine", "RandomPerspective",
+            "RandomErasing"]

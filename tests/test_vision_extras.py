@@ -1,0 +1,190 @@
+"""Round-3 vision surface: transform functionals/classes + detection ops.
+
+Reference analogs: python/paddle/vision/transforms/functional_cv2.py,
+python/paddle/vision/ops.py (deform_conv2d, matrix_nms,
+generate_proposals, yolo_loss, decode_jpeg).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.ops as V
+import paddle_tpu.vision.transforms as T
+import paddle_tpu.vision.transforms.functional as TF
+
+
+class TestTransformFunctionals:
+    img = (np.random.RandomState(0).rand(8, 10, 3) * 255).astype("uint8")
+
+    def test_rotate_90_square(self):
+        sq = (np.random.RandomState(1).rand(9, 9, 3) * 255).astype("uint8")
+        np.testing.assert_array_equal(
+            TF.rotate(sq, 90, interpolation="nearest"), np.rot90(sq, 1))
+
+    def test_rotate_identity(self):
+        np.testing.assert_array_equal(TF.rotate(self.img, 0), self.img)
+
+    def test_affine_translate(self):
+        t = TF.affine(self.img, 0, (2, 1), 1.0, (0, 0))
+        np.testing.assert_array_equal(t[1:, 2:], self.img[:-1, :-2])
+
+    def test_perspective_identity_and_translate(self):
+        pts = [(0, 0), (9, 0), (9, 7), (0, 7)]
+        np.testing.assert_array_equal(
+            TF.perspective(self.img, pts, pts), self.img)
+        dst = [(1, 0), (10, 0), (10, 7), (1, 7)]
+        pt = TF.perspective(self.img, pts, dst)
+        np.testing.assert_array_equal(pt[:, 1:], self.img[:, :-1])
+
+    def test_color_ops(self):
+        b = TF.adjust_brightness(self.img, 2.0)
+        assert b.dtype == np.uint8
+        assert TF.to_grayscale(self.img).shape == (8, 10, 1)
+        assert TF.to_grayscale(self.img, 3).shape == (8, 10, 3)
+        assert TF.adjust_contrast(self.img, 0.5).shape == self.img.shape
+        h0 = TF.adjust_hue(self.img, 0.0)
+        assert np.abs(h0.astype(int) - self.img.astype(int)).max() <= 1
+        # full hue cycle returns the original colors
+        h1 = TF.adjust_hue(TF.adjust_hue(self.img, 0.5), 0.5)
+        assert np.abs(h1.astype(int) - self.img.astype(int)).max() <= 2
+        with pytest.raises(ValueError):
+            TF.adjust_hue(self.img, 0.7)
+
+    def test_crop_pad_erase(self):
+        assert TF.crop(self.img, 1, 2, 3, 4).shape == (3, 4, 3)
+        assert TF.center_crop(self.img, 4).shape == (4, 4, 3)
+        assert TF.pad(self.img, 2).shape == (12, 14, 3)
+        er = TF.erase(self.img, 1, 1, 2, 2, 0)
+        assert (er[1:3, 1:3] == 0).all()
+
+    def test_transform_classes_run(self):
+        for cls in [T.ColorJitter(0.2, 0.2, 0.2, 0.2), T.Grayscale(),
+                    T.RandomRotation(30),
+                    T.RandomAffine(15, translate=(0.1, 0.1),
+                                   scale=(0.8, 1.2), shear=10),
+                    T.RandomPerspective(prob=1.0),
+                    T.RandomErasing(prob=1.0),
+                    T.ContrastTransform(0.3), T.SaturationTransform(0.3),
+                    T.HueTransform(0.3)]:
+            out = cls(self.img)
+            assert out is not None
+
+    def test_grayscale_matches_rec601(self):
+        g = TF.to_grayscale(self.img)[..., 0]
+        ref = (self.img[..., 0] * 0.299 + self.img[..., 1] * 0.587
+               + self.img[..., 2] * 0.114)
+        np.testing.assert_allclose(g.astype(np.float32), ref, atol=1.0)
+
+
+class TestDeformConv:
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(2, 4, 8, 8).astype("float32")
+        self.w = rng.randn(6, 4, 3, 3).astype("float32")
+        self.b = rng.randn(6).astype("float32")
+        self.off = np.zeros((2, 18, 6, 6), "float32")
+
+    def test_zero_offset_equals_conv(self):
+        got = V.deform_conv2d(paddle.to_tensor(self.x),
+                              paddle.to_tensor(self.off),
+                              paddle.to_tensor(self.w),
+                              paddle.to_tensor(self.b)).numpy()
+        ref = torch.nn.functional.conv2d(
+            torch.tensor(self.x), torch.tensor(self.w),
+            torch.tensor(self.b)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_mask_modulation(self):
+        m = np.full((2, 9, 6, 6), 0.5, "float32")
+        got = V.deform_conv2d(paddle.to_tensor(self.x),
+                              paddle.to_tensor(self.off),
+                              paddle.to_tensor(self.w), None,
+                              mask=paddle.to_tensor(m)).numpy()
+        ref = 0.5 * torch.nn.functional.conv2d(
+            torch.tensor(self.x), torch.tensor(self.w)).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+    def test_integer_offset_is_shift(self):
+        off = self.off.copy()
+        off[:, 0::2] = 1.0  # dy=+1 on every tap
+        got = V.deform_conv2d(paddle.to_tensor(self.x),
+                              paddle.to_tensor(off),
+                              paddle.to_tensor(self.w)).numpy()
+        xs = np.zeros_like(self.x)
+        xs[:, :, :-1] = self.x[:, :, 1:]
+        ref = torch.nn.functional.conv2d(torch.tensor(xs),
+                                         torch.tensor(self.w)).numpy()
+        np.testing.assert_allclose(got[:, :, :-1], ref[:, :, :-1], atol=1e-3)
+
+    def test_layer_and_grad(self):
+        layer = V.DeformConv2D(4, 6, 3)
+        x = paddle.to_tensor(self.x)
+        x.stop_gradient = False
+        out = layer(x, paddle.to_tensor(self.off))
+        assert out.shape == [2, 6, 6, 6]
+        paddle.sum(out).backward()
+        assert x.grad is not None and layer.weight.grad is not None
+
+
+class TestDetectionOps:
+    def test_matrix_nms_decays_overlaps(self):
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [50, 50, 60, 60]]], "float32")
+        scores = np.concatenate(
+            [np.zeros((1, 1, 3), "float32"),
+             np.array([[[0.9, 0.8, 0.7]]], "float32")], axis=1)
+        out, nums = V.matrix_nms(paddle.to_tensor(bboxes),
+                                 paddle.to_tensor(scores), 0.1, 0.0,
+                                 keep_top_k=10)
+        o = out.numpy()
+        assert int(nums.numpy()[0]) == 3
+        assert abs(o[:, 1].max() - 0.9) < 1e-6       # top box untouched
+        assert o[o[:, 2] == 1][0, 1] < 0.8           # overlapped decayed
+        assert abs(o[o[:, 2] == 50][0, 1] - 0.7) < 1e-3  # isolated kept
+
+    def test_generate_proposals(self):
+        rng = np.random.RandomState(0)
+        N, A, H, W = 1, 3, 4, 4
+        sc = rng.rand(N, A, H, W).astype("float32")
+        bd = (rng.randn(N, 4 * A, H, W) * 0.1).astype("float32")
+        anchors = np.tile(
+            np.array([[0, 0, 15, 15], [0, 0, 31, 31], [0, 0, 7, 7]],
+                     "float32"), (H * W, 1)).reshape(H, W, A, 4)
+        rois, rn = V.generate_proposals(
+            paddle.to_tensor(sc), paddle.to_tensor(bd),
+            paddle.to_tensor(np.array([[64, 64]], "float32")),
+            paddle.to_tensor(anchors), paddle.to_tensor(np.ones_like(anchors)),
+            pre_nms_top_n=20, post_nms_top_n=5, min_size=1.0)
+        r = rois.numpy()
+        assert r.shape[1] == 4 and 0 < int(rn.numpy()[0]) <= 5
+        assert (r >= 0).all() and (r <= 64).all()  # clipped to image
+
+    def test_yolo_loss_trains(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(
+            rng.randn(2, 3 * 9, 5, 5).astype("float32") * 0.1)
+        x.stop_gradient = False
+        gtb = np.zeros((2, 3, 4), "float32")
+        gtb[0, 0] = [40, 40, 30, 30]
+        gtb[1, 0] = [20, 60, 25, 18]
+        gtl = np.zeros((2, 3), "int64")
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+        loss = V.yolo_loss(x, paddle.to_tensor(gtb), paddle.to_tensor(gtl),
+                           anchors, [3, 4, 5], 4, 0.7, 16)
+        assert loss.shape == [2] and np.isfinite(loss.numpy()).all()
+        paddle.sum(loss).backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+        rng = np.random.RandomState(2)
+        arr = (rng.rand(16, 20, 3) * 255).astype("uint8")
+        fp = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(fp, quality=95)
+        dec = V.decode_jpeg(V.read_file(fp))
+        assert dec.shape == [3, 16, 20]
+        # lossy codec: just require rough agreement
+        got = dec.numpy().transpose(1, 2, 0).astype(int)
+        assert np.abs(got - arr.astype(int)).mean() < 16
