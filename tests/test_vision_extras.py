@@ -179,12 +179,12 @@ class TestDetectionOps:
 
     def test_jpeg_roundtrip(self, tmp_path):
         from PIL import Image
-        rng = np.random.RandomState(2)
-        arr = (rng.rand(16, 20, 3) * 255).astype("uint8")
+        # smooth gradient (noise doesn't survive lossy JPEG)
+        yy, xx = np.mgrid[0:16, 0:20]
+        arr = np.stack([yy * 8, xx * 6, (yy + xx) * 4], -1).astype("uint8")
         fp = str(tmp_path / "t.jpg")
         Image.fromarray(arr).save(fp, quality=95)
         dec = V.decode_jpeg(V.read_file(fp))
         assert dec.shape == [3, 16, 20]
-        # lossy codec: just require rough agreement
         got = dec.numpy().transpose(1, 2, 0).astype(int)
-        assert np.abs(got - arr.astype(int)).mean() < 16
+        assert np.abs(got - arr.astype(int)).mean() < 8
