@@ -422,3 +422,190 @@ def _kl_bernoulli(p, q):
     qq = jnp.clip(q._probs, 1e-7, 1 - 1e-7)
     return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) +
                   (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base: entropy via the Bregman identity
+    H = F(θ) - <θ, ∇F(θ)> using jax autodiff on the log-normalizer
+    (reference: python/paddle/distribution/exponential_family.py, which
+    uses the same trick with paddle.grad)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [jnp.asarray(_val(p)) for p in self._natural_parameters]
+        log_norm, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nat))
+        ent = log_norm - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - jnp.sum(p * g)
+        return Tensor(jnp.asarray(ent))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_rank` batch dims of a
+    base distribution as event dims (reference:
+    python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape)
+        if self._rank > len(shape):
+            raise ValueError(
+                "reinterpreted_batch_rank exceeds base batch rank")
+        super().__init__(shape[:len(shape) - self._rank],
+                         shape[len(shape) - self._rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _val(self._base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self._rank, 0))))
+
+    def entropy(self):
+        ent = _val(self._base.entropy())
+        return Tensor(jnp.sum(ent, axis=tuple(range(-self._rank, 0))))
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+
+class Transform:
+    """Bijection API (reference: python/paddle/distribution/transform.py)."""
+
+    def forward(self, x):
+        return Tensor(self._forward(_val(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_val(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_val(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _val(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks on raw jnp values
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of bijections (reference:
+    python/paddle/distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self._base = base
+        self._chain = ChainTransform(list(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = _val(self._base.sample(shape))
+        return Tensor(self._chain._forward(x))
+
+    def rsample(self, shape=()):
+        x = _val(self._base.rsample(shape))
+        return Tensor(self._chain._forward(x))
+
+    def log_prob(self, value):
+        yv = _val(value)
+        xv = self._chain._inverse(yv)
+        base_lp = _val(self._base.log_prob(Tensor(xv)))
+        ldj = self._chain._forward_log_det_jacobian(xv)
+        return Tensor(base_lp - ldj)
+
+
+__all__ += ["ExponentialFamily", "Independent", "TransformedDistribution",
+            "Transform", "AffineTransform", "ExpTransform",
+            "SigmoidTransform", "ChainTransform"]
