@@ -493,7 +493,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                 jnp.log1p(jnp.exp(-jnp.where(codes > 0, logits, -logits))),
                 0.0)
             return jnp.sum(per, axis=-1, keepdims=True)
-        return nary("hsigmoid_loss", _apply_reduction(fn, "mean"), args)
+        # reference hsigmoid_loss has no reduction: per-sample cost [N, 1]
+        return nary("hsigmoid_loss", fn, args)
 
     # default complete-binary-tree path, depth = ceil(log2(num_classes))
     import math
@@ -517,7 +518,8 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
             node = parent
         return logits_sum[:, None]
 
-    return nary("hsigmoid_loss", _apply_reduction(fn, "mean"), args)
+    # reference hsigmoid_loss has no reduction: per-sample cost [N, 1]
+    return nary("hsigmoid_loss", fn, args)
 
 
 def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,

@@ -610,8 +610,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             iou = np.triu(iou, k=1)
             comp = iou.max(axis=0)             # max overlap with higher-score
             if use_gaussian:
+                # reference decay_score<T, true> multiplies the exponent by
+                # sigma (phi/kernels/cpu/matrix_nms_kernel.cc)
                 decay = np.exp((comp[:, None] ** 2 - iou ** 2)
-                               / gaussian_sigma)
+                               * gaussian_sigma)
             else:
                 decay = (1.0 - iou) / np.maximum(1.0 - comp[:, None], 1e-10)
             decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay, 1.0)
@@ -679,8 +681,16 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         ih, iw = ims[n]
         boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
         boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
-        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
-                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        # reference phi generate_proposals_v2 clamps min_size to >= 1.0 and,
+        # with pixel_offset, drops boxes whose center lies outside the image
+        ms = max(min_size, 1.0)
+        bw = boxes[:, 2] - boxes[:, 0] + off
+        bh = boxes[:, 3] - boxes[:, 1] + off
+        keep = (bw >= ms) & (bh >= ms)
+        if pixel_offset:
+            cx_k = boxes[:, 0] + bw * 0.5
+            cy_k = boxes[:, 1] + bh * 0.5
+            keep &= (cx_k <= iw) & (cy_k <= ih)
         boxes, s = boxes[keep], s[keep]
         if len(boxes):
             kept = _nms_single(jnp.asarray(boxes), jnp.asarray(s),

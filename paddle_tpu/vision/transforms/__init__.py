@@ -446,10 +446,16 @@ class RandomErasing(BaseTransform):
                 i = np.random.randint(0, h - eh + 1)
                 j = np.random.randint(0, w - ew + 1)
                 if self.value == "random":
-                    if channel_last:
-                        v = np.random.rand(eh, ew, *arr.shape[2:])
-                    else:
-                        v = np.random.rand(arr.shape[0], eh, ew)
+                    shape = ((eh, ew) + arr.shape[2:] if channel_last
+                             else (arr.shape[0], eh, ew))
+                    # normal noise like the reference; numpy/PIL images are
+                    # in [0, 255] range, so scale (reference scales the
+                    # non-tensor branch by 255 regardless of dtype)
+                    v = np.random.normal(size=shape)
+                    if not isinstance(img, Tensor):
+                        v = v * 255.0
+                        if np.issubdtype(arr.dtype, np.integer):
+                            v = np.clip(v, 0, 255)
                 else:
                     v = self.value
                 return erase(img, i, j, eh, ew, v, self.inplace)

@@ -70,6 +70,16 @@ class TestTransformFunctionals:
             out = cls(self.img)
             assert out is not None
 
+    def test_random_erasing_random_fill_uint8(self):
+        # value="random" on a uint8 image must fill with non-zero noise,
+        # not uniform [0,1) values that truncate to all-zeros
+        np.random.seed(3)
+        img = np.full((32, 32, 3), 128, np.uint8)
+        out = T.RandomErasing(prob=1.0, value="random")(img)
+        changed = out != img
+        assert changed.any()
+        assert out[changed].std() > 1.0  # actual noise, not a constant
+
     def test_grayscale_matches_rec601(self):
         g = TF.to_grayscale(self.img)[..., 0]
         ref = (self.img[..., 0] * 0.299 + self.img[..., 1] * 0.587
@@ -142,6 +152,25 @@ class TestDetectionOps:
         assert abs(o[:, 1].max() - 0.9) < 1e-6       # top box untouched
         assert o[o[:, 2] == 1][0, 1] < 0.8           # overlapped decayed
         assert abs(o[o[:, 2] == 50][0, 1] - 0.7) < 1e-3  # isolated kept
+
+    def test_matrix_nms_gaussian_decay(self):
+        # reference decay_score<T, true>: exp((max_iou^2 - iou^2) * sigma)
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [50, 50, 60, 60]]], "float32")
+        scores = np.concatenate(
+            [np.zeros((1, 1, 3), "float32"),
+             np.array([[[0.9, 0.8, 0.7]]], "float32")], axis=1)
+        sigma = 2.0
+        out, nums = V.matrix_nms(paddle.to_tensor(bboxes),
+                                 paddle.to_tensor(scores), 0.1, 0.0,
+                                 keep_top_k=10, use_gaussian=True,
+                                 gaussian_sigma=sigma)
+        o = out.numpy()
+        inter = 9.0 * 9.0
+        iou01 = inter / (100.0 + 100.0 - inter)
+        expect = 0.8 * np.exp((0.0 - iou01 ** 2) * sigma)
+        got = o[o[:, 2] == 1][0, 1]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
 
     def test_generate_proposals(self):
         rng = np.random.RandomState(0)
