@@ -134,15 +134,18 @@ class Categorical(Distribution):
             out = out[0]
         return Tensor(out.astype(jnp.int64))
 
-    def log_prob(self, value):
+    def _select(self, value):
         idx = _val(value).astype(jnp.int32)
-        p = jnp.take_along_axis(self._probs, idx[..., None], axis=-1)[..., 0]
-        return Tensor(jnp.log(jnp.clip(p, 1e-30, None)))
+        lead = jnp.broadcast_shapes(idx.shape, self._probs.shape[:-1])
+        pb = jnp.broadcast_to(self._probs, lead + self._probs.shape[-1:])
+        ib = jnp.broadcast_to(idx, lead)
+        return jnp.take_along_axis(pb, ib[..., None], axis=-1)[..., 0]
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(jnp.clip(self._select(value), 1e-30, None)))
 
     def probs(self, value):
-        idx = _val(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(self._probs, idx[..., None],
-                                          axis=-1)[..., 0])
+        return Tensor(self._select(value))
 
     def entropy(self):
         p = self._probs

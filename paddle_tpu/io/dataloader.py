@@ -5,12 +5,14 @@ fluid/dataloader/dataloader_iter.py (_DataLoaderIterMultiProcess: index
 queue -> worker subprocesses -> reorder-by-batch-index), and the C++
 double-buffering reader (operators/reader/buffered_reader.cc).
 
-TPU-first: with num_workers > 0 batches are assembled in forked worker
-PROCESSES (numpy-only in the children — a forked child must never touch the
-parent's initialized XLA runtime), reordered by batch index in the parent,
-and staged through a bounded prefetch queue so host input processing
-overlaps device compute. Device transfer happens lazily on first use
-(jnp.asarray), which XLA pipelines.
+TPU-first: with num_workers > 0 batches are assembled in worker PROCESSES
+started via a FORKSERVER (numpy-only in the children — a worker must never
+touch the parent's initialized XLA runtime, and forking the multithreaded
+JAX parent directly is a deadlock hazard the reference avoids with
+spawn-capable worker plumbing), reordered by batch index in the parent, and
+staged through a bounded prefetch queue so host input processing overlaps
+device compute. Device transfer happens lazily on first use (jnp.asarray),
+which XLA pipelines.
 """
 from __future__ import annotations
 
@@ -79,6 +81,28 @@ def _worker_loop(dataset, task_q, result_q, worker_id, worker_init_fn,
             result_q.put((bidx, None, f"{type(e).__name__}: {e}"))
 
 
+_WORKER_CTX = None
+
+
+def _worker_context():
+    """Worker process context. Forking the parent is unsafe once JAX's
+    runtime threads exist (CPython 3.12 warns it may deadlock), so workers
+    come from a FORKSERVER: one clean server process preloads this module
+    (paying the import once), then forks cheap numpy-only children from
+    its single-threaded state. Falls back to spawn where forkserver is
+    unavailable. Reference analog: fluid/dataloader/dataloader_iter.py's
+    spawn-capable worker plumbing."""
+    global _WORKER_CTX
+    if _WORKER_CTX is None:
+        try:
+            ctx = multiprocessing.get_context("forkserver")
+            ctx.set_forkserver_preload(["paddle_tpu.io.dataloader"])
+        except ValueError:                        # platform without it
+            ctx = multiprocessing.get_context("spawn")
+        _WORKER_CTX = ctx
+    return _WORKER_CTX
+
+
 class _MultiprocessProducer:
     """Fan out index batches to forked workers; yield results IN ORDER.
 
@@ -89,7 +113,7 @@ class _MultiprocessProducer:
 
     def __init__(self, dataset, batches, num_workers, worker_init_fn,
                  timeout, raw_samples, prefetch_factor=2):
-        ctx = multiprocessing.get_context("fork")
+        ctx = _worker_context()
         self._task_q = ctx.SimpleQueue()
         self._result_q = ctx.Queue()
         self._timeout = timeout

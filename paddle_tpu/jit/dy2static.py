@@ -9,12 +9,19 @@ jitted program instead of failing the trace.
 
 Scope (the pragmatic subset the transformer guarantees):
   - `if`/`while` whose condition may be a traced Tensor;
+  - `for` over `range(...)` with possibly-traced bounds (lowered to a
+    lax.while_loop with fori semantics) and `for` over a Tensor (iterates
+    the leading axis; static trip count, dynamic indexing);
+  - `break`/`continue` in `for`/`while` bodies, lowered to carried boolean
+    flags with guarded tails (reference analog: loop_transformer.py +
+    break_continue_transformer.py);
   - branch/loop bodies that communicate through assigned local variables
     (the transformer computes the carried-name set);
-  - bodies containing `return`/`break`/`continue` are left untransformed
-    (python semantics; they only work with concrete conditions);
-  - python-valued conditions keep exact python semantics (the runtime
-    helpers fall back to ordinary branching when the predicate is concrete).
+  - bodies containing `return` are left untransformed (python semantics;
+    they only work with concrete conditions);
+  - python-valued conditions/bounds keep exact python semantics (the
+    runtime helpers fall back to ordinary branching/looping when the
+    predicate is concrete).
 """
 from __future__ import annotations
 
@@ -28,8 +35,8 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 
-__all__ = ["convert_ifelse", "convert_while", "ast_transform",
-           "Dy2StaticError"]
+__all__ = ["convert_ifelse", "convert_while", "convert_range_for",
+           "convert_iter_for", "ast_transform", "Dy2StaticError"]
 
 
 class Dy2StaticError(RuntimeError):
@@ -134,6 +141,155 @@ def convert_while(cond_fn, body_fn, carry):
     return rebuild(out_vals)
 
 
+def and_not_flag(flag, cond_thunk):
+    """`(not flag) and cond()` that stays lazily short-circuit for concrete
+    flags and lowers to logical ops for traced ones (used as the loop
+    condition of a `while` containing `break`)."""
+    f = _raw(flag)
+    if not _is_tracer(f):
+        if bool(f):
+            return False
+        return cond_thunk()
+    c = _raw(cond_thunk())
+    return Tensor(jnp.logical_and(
+        jnp.logical_not(jnp.asarray(f, bool).reshape(())),
+        jnp.asarray(c, bool).reshape(())), stop_gradient=True)
+
+
+def keep_going(*flags):
+    """`not (flag1 or flag2 ...)` — guard for statements following a
+    lowered break/continue."""
+    rs = [_raw(f) for f in flags]
+    if not any(_is_tracer(r) for r in rs):
+        return not any(bool(r) for r in rs)
+    acc = jnp.zeros((), bool)
+    for r in rs:
+        acc = jnp.logical_or(acc, jnp.asarray(r, bool).reshape(()))
+    return Tensor(jnp.logical_not(acc), stop_gradient=True)
+
+
+def _traced_loop(trip, item_of, item_seed, body_fn, carry, item_idx,
+                 brk_idx):
+    """lax.while_loop with fori semantics: k counts 0..trip, the loop
+    variable is item_of(k); an optional break flag short-circuits the
+    condition. Seeds an unbound loop variable with item_seed so the carry
+    structure is stable (the body overwrites it before any read)."""
+    carry = list(carry)
+    if item_idx is not None and isinstance(carry[item_idx], _Undefined):
+        if item_seed is None:
+            raise Dy2StaticError(
+                "bind the loop variable before a traced `for` whose "
+                "iterable may be empty")
+        carry[item_idx] = Tensor(jnp.asarray(item_seed), stop_gradient=True)
+    vals, rebuild, slots = _pack(tuple(carry))
+    brk_slot = slots[brk_idx] if brk_idx is not None else None
+    if brk_idx is not None and brk_slot is None:
+        raise Dy2StaticError("the lowered break flag must stay boolean")
+
+    def cond(state):
+        k, vs = state
+        c = jnp.asarray(k < trip, bool).reshape(())
+        if brk_slot is not None:
+            c = jnp.logical_and(c, jnp.logical_not(
+                jnp.asarray(vs[brk_slot], bool).reshape(())))
+        return c
+
+    def body(state):
+        k, vs = state
+        item = Tensor(jnp.asarray(item_of(k)), stop_gradient=True)
+        out = body_fn(item, *rebuild(vs))
+        ovals, _, oslots = _pack(out)
+        if oslots != slots:
+            raise Dy2StaticError(
+                "a traced `for` body must keep the same set of "
+                "tensor-valued locals across iterations (bind loop "
+                "variables before the loop)")
+        return (k + 1, tuple(ovals))
+
+    _, out_vals = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), tuple(vals)))
+    return rebuild(out_vals)
+
+
+def convert_range_for(rargs, body_fn, carry, item_idx=None, brk_idx=None):
+    """Runtime of a transformed `for ... in range(...)`: python loop for
+    concrete bounds; lax.while_loop (fori semantics) when a bound — or a
+    data-dependent break flag — traces."""
+    if len(rargs) == 1:
+        start, stop, step = 0, rargs[0], 1
+    elif len(rargs) == 2:
+        start, stop, step = rargs[0], rargs[1], 1
+    else:
+        start, stop, step = rargs
+    b0, b1, b2 = (_raw(v) for v in (start, stop, step))
+    carry0 = tuple(carry)
+
+    def traced():
+        s0, s1, st = (jnp.asarray(b) for b in (b0, b1, b2))
+        trip = jnp.maximum(0, (s1 - s0 + st - jnp.sign(st)) // st)
+        return _traced_loop(trip, lambda k: s0 + k * st, s0, body_fn,
+                            carry0, item_idx, brk_idx)
+
+    if any(_is_tracer(b) for b in (b0, b1, b2)):
+        return traced()
+    cur = carry0
+    for v in range(int(b0), int(b1), int(b2)):
+        nxt = body_fn(v, *cur)
+        if brk_idx is not None:
+            f = _raw(nxt[brk_idx])
+            if _is_tracer(f):
+                # the break became data-dependent under trace: restart the
+                # whole loop as a while_loop (the partial trace is dead code
+                # that XLA eliminates)
+                return traced()
+            cur = nxt
+            if bool(f):
+                break
+        else:
+            cur = nxt
+    return cur
+
+
+def convert_iter_for(iterable, body_fn, carry, item_idx=None, brk_idx=None):
+    """Runtime of a transformed `for` over a non-range iterable. Tensors
+    iterate their leading axis (traced: static trip count + dynamic
+    indexing); plain python iterables keep python semantics."""
+    r = _raw(iterable)
+    is_arr = _is_tracer(r) or isinstance(r, (jax.Array, jnp.ndarray))
+    carry0 = tuple(carry)
+    if is_arr:
+        n = int(r.shape[0])
+
+        def traced():
+            if n == 0:
+                return carry0
+            return _traced_loop(n, lambda k: r[k], r[0], body_fn, carry0,
+                                item_idx, brk_idx)
+
+        if _is_tracer(r):
+            return traced()
+        items = [Tensor(r[k], stop_gradient=True) for k in range(n)]
+    else:
+        items = list(iterable)
+    cur = carry0
+    for item in items:
+        nxt = body_fn(item, *cur)
+        if brk_idx is not None:
+            f = _raw(nxt[brk_idx])
+            if _is_tracer(f):
+                if is_arr:
+                    return traced()
+                raise Dy2StaticError(
+                    "a data-dependent `break` requires iterating a Tensor "
+                    "or range(...)")
+            cur = nxt
+            if bool(f):
+                break
+        else:
+            cur = nxt
+    return cur
+
+
 # ---------------------------------------------------------------------------
 # AST transformation
 # ---------------------------------------------------------------------------
@@ -171,16 +327,18 @@ def _assigned(stmts):
 def _has_flow_escape(stmts):
     """True if the statement list contains top-scope return/break/continue
     (not inside a nested function or a nested loop for break/continue)."""
+    if _has_return(stmts):
+        return True
+    return any(_find_bc(stmts))
+
+
+def _has_return(stmts):
+    """`return` anywhere in the region (descends into nested loops, not
+    into nested function scopes)."""
     class V(ast.NodeVisitor):
         found = False
 
         def visit_Return(self, node):
-            self.found = True
-
-        def visit_Break(self, node):
-            self.found = True
-
-        def visit_Continue(self, node):
             self.found = True
 
         def visit_FunctionDef(self, node):
@@ -190,13 +348,96 @@ def _has_flow_escape(stmts):
 
         def visit_Lambda(self, node):
             pass
-
-        # break/continue inside a NESTED loop don't escape our region, but a
-        # nested loop's body may still contain `return`; keep scanning loops.
     v = V()
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+def _find_bc(stmts):
+    """(has_break, has_continue) at THIS loop's scope — a nested loop's
+    BODY owns its break/continue, but its `else` clause belongs to us;
+    nested functions own everything."""
+    class V(ast.NodeVisitor):
+        brk = False
+        cont = False
+
+        def visit_Break(self, node):
+            self.brk = True
+
+        def visit_Continue(self, node):
+            self.cont = True
+
+        def visit_For(self, node):
+            for s in node.orelse:
+                self.visit(s)
+
+        visit_AsyncFor = visit_For
+        visit_While = visit_For
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.brk, v.cont
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _lower_escapes(stmts, brk, cont):
+    """Replace this loop's break/continue with flag assignments, guarding
+    every statement that follows a possible flag-set with
+    `if _d2s_keep_going(flags): ...` (reference analog:
+    break_continue_transformer.py). Returns None when the region holds a
+    break/continue inside a construct we don't lower (try/with)."""
+    out = []
+    for i, s in enumerate(stmts):
+        may = False
+        if isinstance(s, ast.Break):
+            out.append(_assign_const(brk, True))
+            may = True
+        elif isinstance(s, ast.Continue):
+            out.append(_assign_const(cont, True))
+            may = True
+        elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+            if any(_find_bc([s])):
+                return None        # break/continue in the inner loop's else
+            out.append(s)          # inner loop owns its body's break/continue
+        elif isinstance(s, ast.If) and any(_find_bc([s])):
+            b = _lower_escapes(s.body, brk, cont)
+            o = _lower_escapes(s.orelse, brk, cont)
+            if b is None or o is None:
+                return None
+            out.append(ast.If(test=s.test, body=b or [ast.Pass()],
+                              orelse=o))
+            may = True
+        elif any(_find_bc([s])):
+            return None            # break/continue under try/with etc.
+        else:
+            out.append(s)
+        if may:
+            rest = stmts[i + 1:]
+            if rest:
+                lowered = _lower_escapes(rest, brk, cont)
+                if lowered is None:
+                    return None
+                flags = [f for f in (brk, cont) if f is not None]
+                out.append(ast.If(
+                    test=ast.Call(
+                        func=ast.Name(id="_d2s_keep_going", ctx=ast.Load()),
+                        args=[ast.Name(id=f, ctx=ast.Load())
+                              for f in flags],
+                        keywords=[]),
+                    body=lowered, orelse=[]))
+            return out
+    return out
 
 
 def _names_tuple(names, ctx):
@@ -271,12 +512,41 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                             value=call)
         return [_undef_guard(n) for n in names] + [tfn, ffn, assign]
 
+    def _visit_stmts(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+        return out
+
+    def _prep_loop_body(self, body):
+        """Lower break/continue to flags. Returns (body', brk, cont) or
+        None when the loop must stay untransformed."""
+        hb, hc = _find_bc(body)
+        brk = self._fresh("brk") if hb else None
+        cont = self._fresh("cont") if hc else None
+        if hb or hc:
+            body = _lower_escapes(body, brk, cont)
+            if body is None:
+                return None
+        if cont is not None:
+            body = [_assign_const(cont, False)] + body
+        return body, brk, cont
+
     def visit_While(self, node):
-        self.generic_visit(node)
-        if node.orelse or _has_flow_escape(node.body):
+        if node.orelse or _has_return(node.body):
+            self.generic_visit(node)
             return node
-        names = sorted(_assigned(node.body))
+        prep = self._prep_loop_body(node.body)
+        if prep is None:
+            self.generic_visit(node)
+            return node
+        body, brk, cont = prep
+        new_body = self._visit_stmts(body)
+        node.test = self.visit(node.test)
+        names = sorted(_assigned(new_body))
         if not names:
+            node.body = new_body
             return node
         cname = self._fresh("cond")
         bname = self._fresh("body")
@@ -284,10 +554,24 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
             posonlyargs=[], args=[ast.arg(arg=n) for n in names],
             vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
             defaults=[])
+        if brk is not None:
+            # (not _brk) and (test), short-circuit-safe and trace-safe
+            test = ast.Call(
+                func=ast.Name(id="_d2s_and_not", ctx=ast.Load()),
+                args=[ast.Name(id=brk, ctx=ast.Load()),
+                      ast.Lambda(
+                          args=ast.arguments(
+                              posonlyargs=[], args=[], vararg=None,
+                              kwonlyargs=[], kw_defaults=[], kwarg=None,
+                              defaults=[]),
+                          body=node.test)],
+                keywords=[])
+        else:
+            test = node.test
         cfn = ast.FunctionDef(
-            name=cname, args=cargs, body=[ast.Return(value=node.test)],
+            name=cname, args=cargs, body=[ast.Return(value=test)],
             decorator_list=[], returns=None, type_params=[])
-        bfn = self._make_fn(bname, names, node.body, names)
+        bfn = self._make_fn(bname, names, new_body, names)
         call = ast.Call(
             func=ast.Name(id="_d2s_convert_while", ctx=ast.Load()),
             args=[ast.Name(id=cname, ctx=ast.Load()),
@@ -295,7 +579,65 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                   _names_tuple(names, ast.Load)], keywords=[])
         assign = ast.Assign(targets=[_names_tuple(names, ast.Store)],
                             value=call)
-        return [_undef_guard(n) for n in names] + [cfn, bfn, assign]
+        guards = [_undef_guard(n) for n in names if n not in (brk, cont)]
+        inits = [_assign_const(f, False) for f in (brk, cont)
+                 if f is not None]
+        return guards + inits + [cfn, bfn, assign]
+
+    def visit_For(self, node):
+        if node.orelse or _has_return(node.body):
+            self.generic_visit(node)
+            return node
+        if isinstance(node.target, ast.Name):
+            tnames = [node.target.id]
+        elif isinstance(node.target, ast.Tuple) and all(
+                isinstance(e, ast.Name) for e in node.target.elts):
+            tnames = [e.id for e in node.target.elts]
+        else:
+            self.generic_visit(node)
+            return node
+        prep = self._prep_loop_body(node.body)
+        if prep is None:
+            self.generic_visit(node)
+            return node
+        body, brk, cont = prep
+        item = self._fresh("item")
+        tassign = ast.Assign(targets=[node.target],
+                             value=ast.Name(id=item, ctx=ast.Load()))
+        # continue-flag reset must precede the target assign; _prep put it
+        # at index 0 when present
+        if cont is not None:
+            body = [body[0], tassign] + body[1:]
+        else:
+            body = [tassign] + body
+        new_body = self._visit_stmts(body)
+        node.iter = self.visit(node.iter)
+        names = sorted(_assigned(new_body))
+        item_idx = names.index(tnames[0]) if len(tnames) == 1 else None
+        brk_idx = names.index(brk) if brk is not None else None
+        bname = self._fresh("body")
+        bfn = self._make_fn(bname, [item] + names, new_body, names)
+        if isinstance(node.iter, ast.Call) and \
+                isinstance(node.iter.func, ast.Name) and \
+                node.iter.func.id == "range" and not node.iter.keywords and \
+                not any(isinstance(a, ast.Starred) for a in node.iter.args):
+            fn_name = "_d2s_convert_range_for"
+            iter_arg = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
+        else:
+            fn_name = "_d2s_convert_iter_for"
+            iter_arg = node.iter
+        call = ast.Call(
+            func=ast.Name(id=fn_name, ctx=ast.Load()),
+            args=[iter_arg, ast.Name(id=bname, ctx=ast.Load()),
+                  _names_tuple(names, ast.Load),
+                  ast.Constant(value=item_idx),
+                  ast.Constant(value=brk_idx)], keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(names, ast.Store)],
+                            value=call)
+        guards = [_undef_guard(n) for n in names if n not in (brk, cont)]
+        inits = [_assign_const(f, False) for f in (brk, cont)
+                 if f is not None]
+        return guards + inits + [bfn, assign]
 
 
 def ast_transform(func):
@@ -319,9 +661,17 @@ def ast_transform(func):
     ns = dict(raw.__globals__)
     ns["_d2s_convert_ifelse"] = convert_ifelse
     ns["_d2s_convert_while"] = convert_while
+    ns["_d2s_convert_range_for"] = convert_range_for
+    ns["_d2s_convert_iter_for"] = convert_iter_for
+    ns["_d2s_and_not"] = and_not_flag
+    ns["_d2s_keep_going"] = keep_going
     ns["_d2s_UNDEFINED"] = UNDEFINED
-    code = compile(new_tree, filename=f"<dy2static:{raw.__name__}>",
-                   mode="exec")
+    try:
+        code = compile(new_tree, filename=f"<dy2static:{raw.__name__}>",
+                       mode="exec")
+    except (SyntaxError, ValueError):
+        # a construct the transformer mishandled — fall back to untransformed
+        return None
     exec(code, ns)
     new_fn = ns[fndef.name]
     new_fn.__dy2static__ = True

@@ -1,5 +1,14 @@
 """Multiprocess DataLoader workers (reference analog:
-fluid/dataloader/dataloader_iter.py _DataLoaderIterMultiProcess)."""
+fluid/dataloader/dataloader_iter.py _DataLoaderIterMultiProcess).
+
+Workers start from a forkserver, so datasets / worker_init_fn must be
+picklable (module-level), exactly like the reference's spawn-capable
+plumbing — and unlike a raw fork, no "multi-threaded process" fork warnings
+may appear.
+"""
+import os
+import warnings
+
 import numpy as np
 import pytest
 
@@ -16,6 +25,28 @@ class _DS(Dataset):
         return np.full((3,), i, np.float32), np.int64(i % 4)
 
 
+class _BadDS(_DS):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom")
+        return super().__getitem__(i)
+
+
+def _touch_marker(worker_id, directory):
+    with open(os.path.join(directory, f"w{worker_id}"), "w") as f:
+        f.write(str(worker_id))
+
+
+class _InitFn:
+    """Picklable worker_init_fn writing a per-worker marker file."""
+
+    def __init__(self, directory):
+        self.directory = directory
+
+    def __call__(self, worker_id):
+        _touch_marker(worker_id, self.directory)
+
+
 def test_mp_workers_preserve_order_and_content():
     dl = DataLoader(_DS(), batch_size=4, num_workers=2)
     batches = list(dl)
@@ -23,6 +54,17 @@ def test_mp_workers_preserve_order_and_content():
     got = np.concatenate([np.asarray(b[0]._value)[:, 0] for b in batches])
     np.testing.assert_array_equal(got, np.arange(23))
     assert batches[0][1].shape == [4]
+
+
+def test_mp_no_fork_warnings():
+    # forking the multithreaded JAX parent would emit CPython's
+    # "multi-threaded, use of fork() may lead to deadlocks" warning;
+    # the forkserver path must be clean
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        list(DataLoader(_DS(), batch_size=4, num_workers=2))
+    msgs = [str(w.message) for w in caught]
+    assert not any("fork" in m and "thread" in m for m in msgs), msgs
 
 
 def test_mp_custom_collate_runs_in_parent():
@@ -33,27 +75,15 @@ def test_mp_custom_collate_runs_in_parent():
 
 
 def test_mp_worker_error_propagates():
-    class Bad(_DS):
-        def __getitem__(self, i):
-            if i == 5:
-                raise ValueError("boom")
-            return super().__getitem__(i)
-
     with pytest.raises(RuntimeError, match="boom"):
-        list(DataLoader(Bad(), batch_size=4, num_workers=2))
+        list(DataLoader(_BadDS(), batch_size=4, num_workers=2))
 
 
-def test_mp_worker_init_fn_called():
-    import multiprocessing
-    marks = multiprocessing.get_context("fork").Queue()
-
-    def init(worker_id):
-        marks.put(worker_id)
-
+def test_mp_worker_init_fn_called(tmp_path):
     list(DataLoader(_DS(), batch_size=4, num_workers=2,
-                    worker_init_fn=init))
-    seen = {marks.get(timeout=5) for _ in range(2)}
-    assert seen == {0, 1}
+                    worker_init_fn=_InitFn(str(tmp_path))))
+    seen = {f for f in os.listdir(str(tmp_path))}
+    assert seen == {"w0", "w1"}
 
 
 def test_mp_shuffle_covers_dataset():

@@ -1,0 +1,332 @@
+"""Distribution package tests against scipy references.
+
+Covers every class exported from paddle_tpu.distribution, in particular the
+Transform stack (Transform/Affine/Exp/Sigmoid/Chain/TransformedDistribution/
+Independent/ExponentialFamily) and the distributions added late in round 3
+(Gumbel/Cauchy/Geometric/LogNormal/Multinomial).  Reference analog:
+python/paddle/distribution/ unittests (tests/unittests/distribution/).
+"""
+import math
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestLogProbVsScipy:
+    """log_prob of every distribution against the scipy pdf/pmf."""
+
+    def setup_method(self, _):
+        paddle.seed(0)
+
+    def test_normal(self):
+        d = D.Normal(t(1.5), t(2.0))
+        x = np.linspace(-3, 5, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.norm.logpdf(x, 1.5, 2.0), rtol=1e-5)
+
+    def test_uniform(self):
+        d = D.Uniform(t(-1.0), t(3.0))
+        x = np.array([-0.5, 0.0, 2.9], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.uniform.logpdf(x, -1.0, 4.0),
+            rtol=1e-5)
+        assert np.isneginf(d.log_prob(t(np.array([5.0]))).numpy()).all()
+
+    def test_bernoulli(self):
+        d = D.Bernoulli(t(0.3))
+        np.testing.assert_allclose(
+            d.log_prob(t(1.0)).numpy(), math.log(0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            d.log_prob(t(0.0)).numpy(), math.log(0.7), rtol=1e-5)
+
+    def test_categorical(self):
+        # paddle Categorical logits are unnormalized probabilities
+        w = np.array([1.0, 2.0, 3.0], np.float32)
+        d = D.Categorical(logits=t(w))
+        p = w / w.sum()
+        for k in range(3):
+            np.testing.assert_allclose(
+                d.log_prob(t(np.array([k], np.int64))).numpy(),
+                [math.log(p[k])], rtol=1e-5)
+
+    def test_beta(self):
+        d = D.Beta(t(2.0), t(5.0))
+        x = np.array([0.1, 0.4, 0.8], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.beta.logpdf(x, 2.0, 5.0), rtol=1e-4)
+
+    def test_dirichlet(self):
+        a = np.array([1.5, 2.0, 3.0], np.float32)
+        d = D.Dirichlet(t(a))
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.dirichlet.logpdf(x, a), rtol=1e-4)
+
+    def test_exponential(self):
+        d = D.Exponential(t(1.7))
+        x = np.array([0.1, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.expon.logpdf(x, scale=1 / 1.7),
+            rtol=1e-5)
+
+    def test_gamma(self):
+        d = D.Gamma(t(3.0), t(2.0))
+        x = np.array([0.5, 1.5, 4.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.gamma.logpdf(x, 3.0, scale=0.5),
+            rtol=1e-4)
+
+    def test_laplace(self):
+        d = D.Laplace(t(0.5), t(1.2))
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.laplace.logpdf(x, 0.5, 1.2),
+            rtol=1e-5)
+
+    def test_lognormal(self):
+        d = D.LogNormal(t(0.3), t(0.8))
+        x = np.array([0.5, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(),
+            st.lognorm.logpdf(x, 0.8, scale=math.exp(0.3)), rtol=1e-4)
+
+    def test_gumbel(self):
+        d = D.Gumbel(t(1.0), t(2.0))
+        x = np.array([-1.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.gumbel_r.logpdf(x, 1.0, 2.0),
+            rtol=1e-5)
+
+    def test_cauchy(self):
+        d = D.Cauchy(t(0.5), t(1.5))
+        x = np.array([-2.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(), st.cauchy.logpdf(x, 0.5, 1.5),
+            rtol=1e-5)
+
+    def test_geometric(self):
+        # trials convention (support {1, 2, ...}) == scipy.stats.geom
+        d = D.Geometric(t(0.25))
+        k = np.array([1.0, 2.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(k)).numpy(), st.geom.logpmf(k, 0.25), rtol=1e-5)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        d = D.Multinomial(10, t(p))
+        x = np.array([2.0, 3.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(),
+            st.multinomial.logpmf(x, 10, p), rtol=1e-4)
+
+
+class TestEntropyAndKL:
+    def test_entropy_vs_scipy(self):
+        np.testing.assert_allclose(D.Normal(t(0.0), t(2.0)).entropy().numpy(),
+                                   st.norm.entropy(0.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(D.Uniform(t(0.0), t(4.0)).entropy().numpy(),
+                                   st.uniform.entropy(0, 4), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Bernoulli(t(0.3)).entropy().numpy(),
+            st.bernoulli.entropy(0.3), rtol=1e-5)
+        np.testing.assert_allclose(
+            D.Beta(t(2.0), t(5.0)).entropy().numpy(),
+            st.beta.entropy(2.0, 5.0), rtol=1e-4)
+        w = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            D.Categorical(logits=t(w)).entropy().numpy(),
+            st.entropy(w / w.sum()), rtol=1e-5)
+
+    def test_kl_registry(self):
+        p, q = D.Normal(t(0.0), t(1.0)), D.Normal(t(1.0), t(2.0))
+        expect = (math.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        np.testing.assert_allclose(D.kl_divergence(p, q).numpy(), expect,
+                                   rtol=1e-5)
+        # method alias
+        np.testing.assert_allclose(p.kl_divergence(q).numpy(), expect,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            D.kl_divergence(D.Uniform(t(1.0), t(2.0)),
+                            D.Uniform(t(0.0), t(4.0))).numpy(),
+            math.log(4.0 / 1.0), rtol=1e-5)
+        pb, qb = D.Bernoulli(t(0.3)), D.Bernoulli(t(0.6))
+        expect = (0.3 * math.log(0.3 / 0.6) + 0.7 * math.log(0.7 / 0.4))
+        np.testing.assert_allclose(D.kl_divergence(pb, qb).numpy(), expect,
+                                   rtol=1e-5)
+        w1 = np.array([1.0, 1.0, 2.0], np.float32)
+        w2 = np.array([2.0, 1.0, 1.0], np.float32)
+        p1, p2 = w1 / w1.sum(), w2 / w2.sum()
+        np.testing.assert_allclose(
+            D.kl_divergence(D.Categorical(logits=t(w1)),
+                            D.Categorical(logits=t(w2))).numpy(),
+            (p1 * np.log(p1 / p2)).sum(), rtol=1e-5)
+
+    def test_kl_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(t(0.0), t(1.0)),
+                            D.Laplace(t(0.0), t(1.0)))
+
+    def test_register_kl_decorator(self):
+        class _A(D.Distribution):
+            pass
+
+        @D.register_kl(_A, _A)
+        def _kl_a(p, q):
+            return t(42.0)
+
+        assert float(D.kl_divergence(_A(), _A()).numpy()) == 42.0
+
+
+class TestSampling:
+    def setup_method(self, _):
+        paddle.seed(7)
+
+    def test_moments(self):
+        n = (4096,)
+        s = D.Gumbel(t(1.0), t(2.0)).sample(n).numpy()
+        np.testing.assert_allclose(s.mean(), 1.0 + 2.0 * np.euler_gamma,
+                                   atol=0.15)
+        s = D.LogNormal(t(0.2), t(0.5)).sample(n).numpy()
+        assert (s > 0).all()
+        np.testing.assert_allclose(np.log(s).mean(), 0.2, atol=0.05)
+        s = D.Geometric(t(0.4)).sample(n).numpy()
+        assert (s >= 1).all()
+        np.testing.assert_allclose(s.mean(), 1 / 0.4, atol=0.2)
+        # Cauchy has no mean; the sample median estimates loc
+        s = D.Cauchy(t(0.5), t(1.0)).sample(n).numpy()
+        np.testing.assert_allclose(np.median(s), 0.5, atol=0.15)
+
+    def test_multinomial_counts(self):
+        s = D.Multinomial(10, t([0.2, 0.3, 0.5])).sample((64,)).numpy()
+        assert s.shape == (64, 3)
+        np.testing.assert_array_equal(s.sum(-1), 10.0)
+        np.testing.assert_allclose(s.mean(0) / 10.0, [0.2, 0.3, 0.5],
+                                   atol=0.1)
+
+    def test_batch_shapes(self):
+        d = D.Normal(t(np.zeros((2, 3))), t(np.ones((2, 3))))
+        assert d.sample((5,)).shape == [5, 2, 3]
+        assert d.batch_shape == (2, 3)
+
+
+class TestTransforms:
+    def _check_bijector(self, tr, x):
+        """Round-trip + finite-difference check of the log-det-jacobian."""
+        y = tr.forward(t(x)).numpy()
+        back = tr.inverse(t(y)).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+        eps = 1e-3
+        fd = (tr.forward(t(x + eps)).numpy()
+              - tr.forward(t(x - eps)).numpy()) / (2 * eps)
+        np.testing.assert_allclose(
+            tr.forward_log_det_jacobian(t(x)).numpy(),
+            np.log(np.abs(fd)), atol=1e-3)
+        # inverse ldj is the negated forward ldj at the preimage
+        np.testing.assert_allclose(
+            tr.inverse_log_det_jacobian(t(y)).numpy(),
+            -tr.forward_log_det_jacobian(t(x)).numpy(), atol=1e-5)
+
+    def test_affine(self):
+        self._check_bijector(D.AffineTransform(t(1.0), t(-2.5)),
+                             np.linspace(-2, 2, 5).astype(np.float32))
+
+    def test_exp(self):
+        self._check_bijector(D.ExpTransform(),
+                             np.linspace(-1, 1.5, 5).astype(np.float32))
+
+    def test_sigmoid(self):
+        self._check_bijector(D.SigmoidTransform(),
+                             np.linspace(-2, 2, 5).astype(np.float32))
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(t(0.5), t(2.0)),
+                                  D.ExpTransform()])
+        x = np.linspace(-1, 1, 5).astype(np.float32)
+        np.testing.assert_allclose(chain.forward(t(x)).numpy(),
+                                   np.exp(0.5 + 2.0 * x), rtol=1e-5)
+        self._check_bijector(chain, x)
+
+    def test_call_alias(self):
+        tr = D.ExpTransform()
+        np.testing.assert_allclose(tr(t(0.3)).numpy(),
+                                   tr.forward(t(0.3)).numpy())
+
+
+class TestTransformedDistribution:
+    def test_lognormal_via_exp_of_normal(self):
+        d = D.TransformedDistribution(D.Normal(t(0.3), t(0.8)),
+                                      D.ExpTransform())
+        x = np.array([0.5, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(t(x)).numpy(),
+            st.lognorm.logpdf(x, 0.8, scale=math.exp(0.3)), rtol=1e-4)
+        paddle.seed(11)
+        s = d.sample((2048,)).numpy()
+        assert (s > 0).all()
+        np.testing.assert_allclose(np.log(s).mean(), 0.3, atol=0.1)
+
+    def test_chain_of_transforms(self):
+        # sigmoid(2*z + 1) of a standard normal, log_prob checked by change
+        # of variables computed manually
+        base = D.Normal(t(0.0), t(1.0))
+        d = D.TransformedDistribution(
+            base, [D.AffineTransform(t(1.0), t(2.0)), D.SigmoidTransform()])
+        y = np.array([0.3, 0.6, 0.9], np.float32)
+        z = (np.log(y / (1 - y)) - 1.0) / 2.0
+        ldj = np.log(y * (1 - y)) + math.log(2.0)
+        np.testing.assert_allclose(
+            d.log_prob(t(y)).numpy(), st.norm.logpdf(z) - ldj, rtol=1e-4)
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        loc = np.zeros((4, 3), np.float32)
+        scale = np.ones((4, 3), np.float32)
+        base = D.Normal(t(loc), t(scale))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (4,) and ind.event_shape == (3,)
+        x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            ind.log_prob(t(x)).numpy(),
+            base.log_prob(t(x)).numpy().sum(-1), rtol=1e-5)
+        np.testing.assert_allclose(
+            ind.entropy().numpy(), base.entropy().numpy().sum(-1), rtol=1e-5)
+
+    def test_rank_check(self):
+        with pytest.raises(ValueError):
+            D.Independent(D.Normal(t(np.zeros(3)), t(np.ones(3))), 2)
+
+
+class TestExponentialFamily:
+    def test_normal_entropy_via_bregman(self):
+        """A Normal expressed in natural parameters: entropy from the
+        log-normalizer via autodiff must match the closed form."""
+        import jax.numpy as jnp
+
+        class NatNormal(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc, self.scale = float(loc), float(scale)
+                super().__init__(())
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * math.log(2 * math.pi)
+
+        ent = NatNormal(1.3, 2.1).entropy().numpy()
+        np.testing.assert_allclose(ent, st.norm.entropy(1.3, 2.1), rtol=1e-5)

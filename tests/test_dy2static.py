@@ -6,7 +6,8 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.jit.dy2static import (ast_transform, convert_ifelse,
-                                      convert_while, Dy2StaticError)
+                                      convert_while, convert_range_for,
+                                      convert_iter_for, Dy2StaticError)
 
 
 def test_tensor_if_compiles_both_branches():
@@ -113,6 +114,99 @@ def test_convert_helpers_concrete_fallback():
     assert out == (6,)
     out = convert_while(lambda i: i < 3, lambda i: (i + 1,), (0,))
     assert out == (3,)
+
+
+def test_tensor_bounded_for_compiles():
+    # `range(n)` with a traced bound: one lax.while_loop, not a retrace
+    # per n (reference analog: loop_transformer.py for_loop conversion)
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            s = s + x
+        return s
+
+    x = paddle.to_tensor(np.full(3, 2.0, np.float32))
+    for n in (3, 5):
+        out = f(x, paddle.to_tensor(np.int32(n)))
+        np.testing.assert_allclose(np.asarray(out._value), 2.0 * n)
+    assert len(f._jitted) == 1
+
+
+def test_for_loop_carried_state_parity():
+    # transformed function == eager python semantics, incl. start/step
+    def f(x):
+        s = x * 0
+        for i in range(1, 8, 2):
+            s = s + x * i
+        return s, i
+
+    g = ast_transform(f)
+    assert g is not None
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    s1, i1 = f(x)
+    s2, i2 = g(x)
+    np.testing.assert_allclose(np.asarray(s1._value), np.asarray(s2._value))
+    assert int(i1) == 7 and int(i2) == 7
+
+
+def test_while_with_break():
+    @paddle.jit.to_static
+    def f(x, limit):
+        s = x
+        while s.sum() < 1000.0:
+            s = s * 2
+            if s.sum() > limit:
+                break
+        return s
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    out = np.asarray(f(x, paddle.to_tensor(np.float32(10.0)))._value)
+    assert out.sum() > 10.0 and out.sum() / 2 <= 10.0
+
+
+def test_for_with_continue():
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            if i % 2 == 1:
+                continue
+            s = s + x
+        return s
+
+    x = paddle.to_tensor(np.full(2, 3.0, np.float32))
+    out = np.asarray(f(x, paddle.to_tensor(np.int32(6)))._value)
+    np.testing.assert_allclose(out, 9.0)   # i = 0, 2, 4
+
+
+def test_for_over_tensor_with_break():
+    @paddle.jit.to_static
+    def f(xs, limit):
+        s = xs[0] * 0
+        for v in xs:
+            if v.sum() > limit:
+                break
+            s = s + v
+        return s
+
+    xs = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    out = np.asarray(f(xs, paddle.to_tensor(np.float32(3.5)))._value)
+    np.testing.assert_allclose(out, 0.0 + 1 + 2 + 3)
+
+
+def test_convert_for_helpers_concrete():
+    out = convert_range_for((3,), lambda v, s: (s + v,), (0,))
+    assert out == (3,)     # 0 + 1 + 2
+    out = convert_range_for((1, 8, 2), lambda v, s: (s + v,), (0,))
+    assert out == (16,)
+    out = convert_iter_for([4, 5], lambda v, s: (s + v,), (1,))
+    assert out == (10,)
+    # break flag honored in the python path (flag at index 1)
+    out = convert_range_for(
+        (10,), lambda v, s, brk: (s + v, v >= 2), (0, False),
+        item_idx=None, brk_idx=1)
+    assert out[0] == 0 + 1 + 2
 
 
 def test_mismatched_branches_raise():
