@@ -103,25 +103,36 @@ class ProcessGroupXLA:
             fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
                                    out_specs=P("g")))
         elif kind == "reduce_scatter":
+            # block [1, n, chunk...] -> each device keeps its reduced chunk
             def body(x):
-                return jax.lax.psum_scatter(x, "g", tiled=True)
+                if reduce_op == ReduceOp.SUM:
+                    return jax.lax.psum_scatter(x[0], "g",
+                                                scatter_dimension=0)[None]
+                y = red(x[0], "g")                       # [n, chunk...]
+                return jnp.take(y, jax.lax.axis_index("g"), axis=0)[None]
             fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
                                    out_specs=P("g")))
         elif kind == "broadcast":
             src = kw["src_index"]
 
             def body(x):
-                idx = jax.lax.axis_index("g")
                 from_src = jax.lax.all_gather(x, "g")[src]
                 return from_src
 
             fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
                                    out_specs=P("g")))
         elif kind == "alltoall":
+            # block [1, n, chunk...]: row j goes to device j
             def body(x):
-                # x per-device: [n_dev, chunk, ...] -> exchanged
-                return jax.lax.all_to_all(x, "g", split_axis=0, concat_axis=0,
-                                          tiled=True)
+                return jax.lax.all_to_all(x[0], "g", split_axis=0,
+                                          concat_axis=0)[None]
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
+                                   out_specs=P("g")))
+        elif kind == "p2p":
+            perm = kw["perm"]
+
+            def body(x):
+                return jax.lax.ppermute(x, "g", list(perm))
             fn = jax.jit(shard_map(body, mesh=mesh, in_specs=P("g"),
                                    out_specs=P("g")))
         else:
@@ -132,27 +143,77 @@ class ProcessGroupXLA:
     # -- helpers -------------------------------------------------------------
     def _replicated(self, value):
         """Stack a host value once per device → device-sharded global array of
-        shape [n, ...]."""
+        shape [n, ...] (single-controller path)."""
         n = self.size
         stacked = jnp.stack([value] * n) if not isinstance(value, np.ndarray) \
             else jnp.asarray(np.stack([value] * n))
         sharding = NamedSharding(self.mesh, P("g"))
         return jax.device_put(stacked, sharding)
 
+    def _global(self, value):
+        """Global [n, ...] array, row i = the value device i's process
+        contributed. Multi-controller: every process commits its local value
+        to its own addressable devices and the rows assemble into one global
+        array (reference analog: each NCCL rank's input buffer)."""
+        if jax.process_count() == 1:
+            return self._replicated(value)
+        v = jnp.asarray(value)
+        pi = jax.process_index()
+        local = [d for d in self.devices if d.process_index == pi]
+        rows = [jax.device_put(v[None], d) for d in local]
+        return jax.make_array_from_single_device_arrays(
+            (self.size,) + v.shape, NamedSharding(self.mesh, P("g")), rows)
+
+    def _local_shard(self, out):
+        """This process's shard of a P('g')-sharded result."""
+        return jnp.asarray(out.addressable_shards[0].data)
+
+    def _row0(self, out):
+        if jax.process_count() == 1:
+            return out[0]
+        return self._local_shard(out)[0]
+
     def all_reduce(self, value, op=ReduceOp.SUM):
-        n = self.size
-        if n == 1:
+        if self.size == 1:
             return value
-        g = self._replicated(value)
-        out = self._compiled("all_reduce", op)(g)
-        return out[0]
+        out = self._compiled("all_reduce", op)(self._global(value))
+        return self._row0(out)
 
     def broadcast(self, value, src_index):
         if self.size == 1:
             return value
-        g = self._replicated(value)
-        out = self._compiled("broadcast", None, src_index=src_index)(g)
-        return out[0]
+        out = self._compiled("broadcast", None,
+                             src_index=src_index)(self._global(value))
+        return self._row0(out)
+
+    def gather_all(self, value):
+        """[n, ...] — every group member's value, on every member."""
+        if self.size == 1:
+            return jnp.asarray(value)[None]
+        out = self._compiled("all_gather", None)(self._global(value))
+        if jax.process_count() == 1:
+            return out[:self.size]      # device 0's (complete) gather
+        return self._local_shard(out)
+
+    def reduce_scatter(self, value_rows, op=ReduceOp.SUM):
+        """value_rows: [n, chunk...] per rank; returns this rank's reduced
+        chunk [chunk...]."""
+        out = self._compiled("reduce_scatter", op)(self._global(value_rows))
+        return self._row0(out)
+
+    def alltoall(self, value_rows):
+        """value_rows: [n, chunk...]; row j is for rank j. Returns the
+        [n, chunk...] this rank received (row i from rank i)."""
+        out = self._compiled("alltoall", None)(self._global(value_rows))
+        return self._row0(out)
+
+    def p2p(self, value, src_index, dst_index):
+        """One collective-permute step: src's value lands on dst. Both ends
+        (and every group member, SPMD) must call with the same pair."""
+        out = self._compiled("p2p", None,
+                             perm=((src_index, dst_index),))(
+                                 self._global(value))
+        return self._row0(out)
 
 
 _groups = {}
@@ -188,12 +249,25 @@ class Group:
         return f"Group(rank={self.rank}, nranks={self.nranks}, id={self.id})"
 
 
+def _rank_devices():
+    """One device per RANK. Multi-process: rank == process, represented by
+    its first local device (a process with several chips still contributes
+    exactly one row to eager rank-level collectives — data-plane sharding
+    uses the full Mesh, not this path). Single-process: rank == device."""
+    devices = jax.devices()
+    if jax.process_count() == 1:
+        return devices
+    by_proc = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, d)
+    return [by_proc[p] for p in sorted(by_proc)]
+
+
 def _ensure_default_group():
     global _default_group
     if _default_group is None:
         from .env import get_rank, get_world_size
-        devices = jax.devices()
-        pg = ProcessGroupXLA(devices, gid=0)
+        pg = ProcessGroupXLA(_rank_devices(), gid=0)
         _default_group = Group(get_rank(), get_world_size(), id=0,
                                ranks=list(range(get_world_size())), pg=pg)
         _groups[0] = _default_group
@@ -209,7 +283,7 @@ def new_group(ranks=None, backend=None, timeout=None):
     _next_gid += 1
     my_rank = get_rank()
     group_rank = ranks.index(my_rank) if my_rank in ranks else -1
-    devices = jax.devices()
+    devices = _rank_devices()
     # device-backed subgroup when the "ranks" map onto devices 1:1
     sub = [devices[r] for r in ranks if r < len(devices)] or devices[:1]
     pg = ProcessGroupXLA(sub, gid=gid)
@@ -264,18 +338,34 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         tensor_list.append(tensor.clone() if hasattr(tensor, "clone")
                            else tensor)
         return Task([tensor._value])
-    g = group.pg._replicated(tensor._value)
-    out = group.pg._compiled("all_gather", None)(g)
-    per = jnp.split(out[0], group.nranks, axis=0)
+    rows = group.pg.gather_all(tensor._value)
     tensor_list.clear()
-    tensor_list.extend(Tensor(p) for p in per)
-    return Task([out])
+    tensor_list.extend(Tensor(rows[i], stop_gradient=True)
+                       for i in range(group.nranks))
+    return Task([rows])
 
 
 def all_gather_object(object_list, obj, group=None):
+    """Gather arbitrary picklable objects (reference:
+    communication/all_gather.py all_gather_object: pickle → uint8 tensor →
+    padded all_gather)."""
     group = _group_or_default(group)
+    if group.nranks == 1 or not _multi_process(group):
+        object_list.clear()
+        object_list.extend([obj] * group.nranks)
+        return
+    import pickle
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    length = jnp.asarray([payload.size], jnp.int32)
+    lengths = np.asarray(group.pg.gather_all(length))[:, 0]
+    cap = int(lengths.max())
+    padded = np.zeros((cap,), np.uint8)
+    padded[:payload.size] = payload
+    rows = np.asarray(group.pg.gather_all(jnp.asarray(padded)))
     object_list.clear()
-    object_list.extend([obj] * group.nranks)
+    object_list.extend(
+        pickle.loads(rows[i, :int(lengths[i])].tobytes())
+        for i in range(group.nranks))
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -291,14 +381,30 @@ def broadcast(tensor, src, group=None, sync_op=True):
     return Task([tensor._value])
 
 
+def _my_index(group):
+    from .env import get_rank
+    return group.get_group_rank(get_rank())
+
+
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     group = _group_or_default(group)
     if group.nranks == 1 or not _multi_process(group):
         if tensor_list:
             tensor._assign_value_(tensor_list[0]._value)
         return Task([tensor._value])
-    raise NotImplementedError(
-        "multi-process scatter: use sharded arrays (NamedSharding) instead")
+    n = group.nranks
+    src_index = group.get_group_rank(src)
+    if src_index < 0:
+        raise ValueError(f"scatter src rank {src} is not a member of "
+                         f"group {group.ranks}")
+    if tensor_list:
+        stacked = jnp.stack([t._value for t in tensor_list])
+    else:   # non-src ranks contribute a same-shaped placeholder
+        stacked = jnp.zeros((n,) + tuple(tensor._value.shape),
+                            tensor._value.dtype)
+    rows = group.pg.broadcast(stacked, src_index)
+    tensor._assign_value_(rows[_my_index(group)])
+    return Task([tensor._value])
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
@@ -307,8 +413,12 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
         out_tensor_list.clear()
         out_tensor_list.extend(in_tensor_list)
         return Task([t._value for t in in_tensor_list])
-    raise NotImplementedError(
-        "multi-process alltoall: use the MoE dispatch path (global_scatter)")
+    stacked = jnp.stack([t._value for t in in_tensor_list])   # [n, chunk...]
+    mine = group.pg.alltoall(stacked)
+    out_tensor_list.clear()
+    out_tensor_list.extend(Tensor(mine[i], stop_gradient=True)
+                           for i in range(group.nranks))
+    return Task([mine])
 
 
 def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
@@ -317,7 +427,20 @@ def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
     if group.nranks == 1 or not _multi_process(group):
         out_tensor._assign_value_(in_tensor._value)
         return Task([out_tensor._value])
-    raise NotImplementedError
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with unequal splits is not supported; pad to "
+            "equal chunks")
+    n = group.nranks
+    v = in_tensor._value
+    if v.shape[0] % n:
+        raise ValueError(
+            f"alltoall_single dim0 ({v.shape[0]}) must divide the group "
+            f"size {n}")
+    rows = v.reshape((n, v.shape[0] // n) + tuple(v.shape[1:]))
+    mine = group.pg.alltoall(rows)
+    out_tensor._assign_value_(mine.reshape(v.shape))
+    return Task([out_tensor._value])
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
@@ -329,20 +452,47 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
             acc = acc + t._value
         tensor._assign_value_(acc if group.nranks == 1 else acc)
         return Task([tensor._value])
-    g = group.pg._replicated(jnp.concatenate([t._value for t in tensor_list]))
-    out = group.pg._compiled("reduce_scatter", op)(g)
-    tensor._assign_value_(out[0])
+    rows = jnp.stack([t._value for t in tensor_list])         # [n, chunk...]
+    mine = group.pg.reduce_scatter(rows, op)
+    tensor._assign_value_(mine)
     return Task([tensor._value])
 
 
+_p2p_seq = {}
+
+
+def _p2p_key(group, src, dst):
+    """Monotonic per-direction key so repeated sends never collide."""
+    k = (group.id, src, dst)
+    _p2p_seq[k] = _p2p_seq.get(k, 0) + 1
+    return f"p2p/{group.id}/{src}->{dst}/{_p2p_seq[k]}"
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
+    """Eager point-to-point send (reference analog: collective/send_v2
+    over NCCL). Cross-process: host-mediated through the rendezvous
+    TCPStore — pairwise-correct for ANY send/recv pattern, unlike an SPMD
+    collective which would require every rank to participate. The
+    *performance* p2p path is ppermute inside compiled programs
+    (spmd_pipeline / ProcessGroupXLA.p2p); eager send/recv is control-plane
+    traffic."""
     group = _group_or_default(group)
     if group.nranks == 1 or not _multi_process(group):
         _p2p_buffers.setdefault(group.id, {})[dst] = tensor._value
         return Task([tensor._value])
-    raise NotImplementedError(
-        "cross-process eager send/recv: use ppermute inside shard_map "
-        "(pipeline parallel path)")
+    from .env import get_store
+    store = get_store()
+    if store is None:
+        # bootstrapped without our store (external jax.distributed init):
+        # SPMD collective-permute — both ends must call in matching order
+        out = group.pg.p2p(tensor._value, _my_index(group),
+                           group.get_group_rank(dst))
+        return Task([out])
+    import pickle
+    arr = np.asarray(tensor._value)
+    store.set(_p2p_key(group, _my_index(group), group.get_group_rank(dst)),
+              pickle.dumps(arr, protocol=4))
+    return Task([tensor._value])
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
@@ -353,7 +503,19 @@ def recv(tensor, src=0, group=None, sync_op=True):
         if get_rank() in buf:
             tensor._assign_value_(buf.pop(get_rank()))
         return Task([tensor._value])
-    raise NotImplementedError
+    from .env import get_store
+    store = get_store()
+    if store is None:
+        row = group.pg.p2p(tensor._value, group.get_group_rank(src),
+                           _my_index(group))
+        tensor._assign_value_(row)
+        return Task([tensor._value])
+    import pickle
+    key = _p2p_key(group, group.get_group_rank(src), _my_index(group))
+    arr = pickle.loads(store.get(key))
+    store.delete_key(key)
+    tensor._assign_value_(jnp.asarray(arr))
+    return Task([tensor._value])
 
 
 _p2p_buffers = {}

@@ -14,7 +14,7 @@ import os
 import jax
 
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
-           "is_initialized", "parallel_device_count"]
+           "is_initialized", "parallel_device_count", "get_store"]
 
 _initialized = False
 
@@ -27,6 +27,31 @@ def _env_int(*names, default=0):
     return default
 
 
+_store = None
+
+
+def _rendezvous_store(master, rank, nranks):
+    """Native TCPStore rendezvous (reference: parallel.py:265 — rank 0 runs
+    the store master). The store agrees on the JAX coordinator endpoint and
+    barriers the ranks around backend bring-up; it stays alive as the
+    process-group KV store."""
+    global _store
+    from ..core import TCPStore
+    host, _, port = master.partition(":")
+    port = int(port or os.environ.get("MASTER_PORT", "8476"))
+    _store = TCPStore(host, port, is_master=(rank == 0),
+                      world_size=nranks, timeout=60.0)
+    if rank == 0:
+        # the coordinator gets its own port, one above the store's
+        _store.set("jax/coordinator", f"{host}:{port + 1}")
+    return _store.get("jax/coordinator").decode()
+
+
+def get_store():
+    """The bring-up TCPStore (None in single-process mode)."""
+    return _store
+
+
 def init_parallel_env():
     """Initialize multi-process jax if a launcher provided the env, else mark
     single-process mode. Env-var conventions mirror the reference launcher
@@ -37,11 +62,27 @@ def init_parallel_env():
     nranks = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
     rank = _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
     master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-    if nranks > 1 and master and jax.process_count() == 1:
-        port = os.environ.get("MASTER_PORT", "8476")
-        addr = master if ":" in master else f"{master}:{port}"
+    # NB: probing jax.process_count() here would itself initialize the XLA
+    # backend, after which jax.distributed.initialize refuses to run — check
+    # the coordination-service state instead
+    from jax._src import distributed as _jax_dist
+    already = getattr(_jax_dist.global_state, "client", None) is not None
+    if nranks > 1 and master and not already:
+        from ..core import native_available
+        if native_available():
+            # rendezvous failures must FAIL FAST — a per-rank fallback would
+            # leave ranks on incompatible transports / hang the others'
+            # barrier. Only the toolchain-less case (deterministically the
+            # same on every rank) uses the fixed-port fallback below.
+            addr = _rendezvous_store(master, rank, nranks)
+        else:
+            port = os.environ.get("MASTER_PORT", "8476")
+            host = master.partition(":")[0]
+            addr = f"{host}:{int(port) + 1}"
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nranks, process_id=rank)
+        if _store is not None:
+            _store.barrier("init_parallel_env")
     _initialized = True
     from .collective import _ensure_default_group
     _ensure_default_group()

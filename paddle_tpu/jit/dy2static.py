@@ -115,13 +115,22 @@ def convert_ifelse(pred, true_fn, false_fn, carry):
 
 def convert_while(cond_fn, body_fn, carry):
     """Runtime of a transformed `while`: python loop for concrete
-    predicates, lax.while_loop once the condition traces."""
+    predicates, lax.while_loop once the condition traces — including a
+    condition that only BECOMES traced mid-loop (e.g. a lowered break flag
+    fed by traced data), in which case the loop restarts traced (the
+    partial python trace is dead code XLA eliminates)."""
+    carry0 = tuple(carry)
     first = _raw(cond_fn(*carry))
     if not _is_tracer(first):
         # concrete: plain python loop (re-evaluating the condition eagerly)
-        while bool(_raw(cond_fn(*carry))):
+        while True:
+            c = _raw(cond_fn(*carry))
+            if _is_tracer(c):
+                carry = carry0
+                break
+            if not bool(c):
+                return carry
             carry = body_fn(*carry)
-        return carry
     vals, rebuild, slots = _pack(carry)
 
     def cond(vs):

@@ -1,0 +1,118 @@
+"""One rank of the multi-process collective harness.
+
+Launched by tests/test_multiproc_collective.py via subprocess.Popen with
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER set (reference
+analog: the trainer scripts TestDistBase forks,
+unittests/test_dist_base.py:1150 + collective/collective_sendrecv_api.py).
+
+Each rank: TCPStore rendezvous -> jax.distributed.initialize -> runs every
+eager collective across REAL processes and asserts the cross-process result.
+"""
+import os
+import sys
+
+
+def main():
+    # the axon sitecustomize preselects a TPU platform; the harness must be
+    # CPU and must be forced in-process (env vars are too late)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == int(os.environ["PADDLE_TRAINERS_NUM"]), \
+        (world, os.environ["PADDLE_TRAINERS_NUM"])
+    assert jax.process_count() == world
+
+    def t(arr):
+        return paddle.to_tensor(np.asarray(arr, np.float32))
+
+    # --- all_reduce: sum of (rank+1) over ranks -----------------------------
+    x = t([float(rank + 1)] * 4)
+    dist.all_reduce(x)
+    expect = sum(r + 1 for r in range(world))
+    np.testing.assert_allclose(np.asarray(x._value), expect)
+
+    # --- broadcast from rank 0 ---------------------------------------------
+    b = t([rank * 10.0, rank * 10.0])
+    dist.broadcast(b, src=0)
+    np.testing.assert_allclose(np.asarray(b._value), 0.0)
+
+    # --- all_gather ---------------------------------------------------------
+    gathered = []
+    dist.all_gather(gathered, t([float(rank)] * 3))
+    assert len(gathered) == world
+    for r in range(world):
+        np.testing.assert_allclose(np.asarray(gathered[r]._value), float(r))
+
+    # --- send/recv: ring r -> (r+1) % world ---------------------------------
+    # EVERY rank sends first — the host-mediated p2p must not deadlock on
+    # crossing sends (an SPMD-collective p2p would)
+    payload = t([float(100 + rank)] * 2)
+    inbox = t([0.0, 0.0])
+    src = (rank - 1) % world
+    dst = (rank + 1) % world
+    dist.send(payload, dst=dst)
+    dist.recv(inbox, src=src)
+    np.testing.assert_allclose(np.asarray(inbox._value), float(100 + src))
+    # second round (reversed ring) proves sequence keys don't collide
+    dist.send(payload, dst=src)
+    dist.recv(inbox, src=dst)
+    np.testing.assert_allclose(np.asarray(inbox._value), float(100 + dst))
+
+    # --- reduce_scatter -----------------------------------------------------
+    parts = [t([float(rank + 1)] * 2) for _ in range(world)]
+    out = t([0.0, 0.0])
+    dist.reduce_scatter(out, parts)
+    np.testing.assert_allclose(np.asarray(out._value), expect)
+
+    # --- alltoall -----------------------------------------------------------
+    ins = [t([float(rank * world + j)] * 2) for j in range(world)]
+    outs = []
+    dist.alltoall(ins, outs)
+    for i in range(world):
+        np.testing.assert_allclose(np.asarray(outs[i]._value),
+                                   float(i * world + rank))
+
+    # --- alltoall_single ----------------------------------------------------
+    flat = t([float(rank * world + j) for j in range(world)])
+    single_out = t([0.0] * world)
+    dist.alltoall_single(flat, single_out)
+    np.testing.assert_allclose(
+        np.asarray(single_out._value),
+        [float(i * world + rank) for i in range(world)])
+
+    # --- scatter from rank 0 ------------------------------------------------
+    chunk = t([0.0, 0.0])
+    if rank == 0:
+        dist.scatter(chunk, [t([float(7 + r)] * 2) for r in range(world)],
+                     src=0)
+    else:
+        dist.scatter(chunk, src=0)
+    np.testing.assert_allclose(np.asarray(chunk._value), float(7 + rank))
+
+    # --- all_gather_object (pickled, ragged) --------------------------------
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    assert [o["rank"] for o in objs] == list(range(world))
+    assert all(objs[r]["tag"] == "x" * (r + 1) for r in range(world))
+
+    # --- barrier + store round-trip -----------------------------------------
+    dist.barrier()
+    store = dist.env.get_store()
+    assert store is not None
+    store.set(f"mark/{rank}", str(rank))
+    store.barrier("marks")
+    for r in range(world):
+        assert store.get(f"mark/{r}").decode() == str(r)
+
+    print(f"RANK {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -165,6 +165,25 @@ def test_while_with_break():
     assert out.sum() > 10.0 and out.sum() / 2 <= 10.0
 
 
+def test_while_concrete_cond_traced_break():
+    # concrete loop condition, but the lowered break flag becomes traced
+    # mid-loop: convert_while must restart as a lax.while_loop
+    @paddle.jit.to_static
+    def f(x, limit):
+        i = 0
+        s = x * 0
+        while i < 5:
+            s = s + x
+            if s.sum() > limit:
+                break
+            i = i + 1
+        return s
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    out = np.asarray(f(x, paddle.to_tensor(np.float32(4.5)))._value)
+    np.testing.assert_allclose(out, 3.0)     # breaks once sum() = 6 > 4.5
+
+
 def test_for_with_continue():
     @paddle.jit.to_static
     def f(x, n):
