@@ -42,8 +42,13 @@ def _rendezvous_store(master, rank, nranks):
     _store = TCPStore(host, port, is_master=(rank == 0),
                       world_size=nranks, timeout=60.0)
     if rank == 0:
-        # the coordinator gets its own port, one above the store's
-        _store.set("jax/coordinator", f"{host}:{port + 1}")
+        # rank 0 picks a FREE port for the coordinator and publishes it —
+        # that agreement is exactly what the store is for
+        import socket
+        with socket.socket() as s:
+            s.bind((host, 0))
+            coord_port = s.getsockname()[1]
+        _store.set("jax/coordinator", f"{host}:{coord_port}")
     return _store.get("jax/coordinator").decode()
 
 
@@ -76,9 +81,11 @@ def init_parallel_env():
             # same on every rank) uses the fixed-port fallback below.
             addr = _rendezvous_store(master, rank, nranks)
         else:
-            port = os.environ.get("MASTER_PORT", "8476")
-            host = master.partition(":")[0]
-            addr = f"{host}:{int(port) + 1}"
+            # same endpoint derivation as the store path: the port embedded
+            # in PADDLE_MASTER wins over MASTER_PORT
+            host, _, mport = master.partition(":")
+            port = int(mport or os.environ.get("MASTER_PORT", "8476"))
+            addr = f"{host}:{port + 1}"
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nranks, process_id=rank)
         if _store is not None:

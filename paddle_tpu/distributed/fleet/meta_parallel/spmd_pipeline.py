@@ -220,13 +220,17 @@ def spmd_pipeline(stage_fn, stage_params, x, *, mesh, axis="pipe", key=None,
     return lax.index_in_dim(stacked, S - 1, 0, keepdims=False)
 
 
-def find_block_run(layers, num_stages):
+def find_block_run(layers, num_stages, require_multiple=True):
     """Locate the longest contiguous run of structurally identical layers
     (the pipeline-able transformer blocks) in `layers`.
 
-    Returns (start, count) with count a positive multiple of num_stages, or
-    raises if no such run exists. Layers outside the run become the prologue
-    (before) and epilogue (after), executed un-pipelined.
+    Returns (start, count); with require_multiple (the uniform schedule)
+    count is rounded down to a positive multiple of num_stages, otherwise
+    (ragged LayerDesc partitions) any count >= num_stages is kept. Raises
+    if no usable run exists. Layers outside the run become the prologue
+    (before) and epilogue (after) — executed outside the pipelined region
+    with their parameters sharded over the pipe axis (see
+    PipelineTrainStep._place_edge_params), not replicated.
     """
     def sig(layer):
         return (type(layer).__name__,
@@ -244,7 +248,10 @@ def find_block_run(layers, num_stages):
             best = (i, j - i)
         i = j
     start, count = best
-    count = (count // num_stages) * num_stages
+    if require_multiple:
+        count = (count // num_stages) * num_stages
+    elif count < num_stages:
+        count = 0
     if count == 0:
         raise ValueError(
             f"no contiguous run of >= {num_stages} structurally identical "
@@ -254,16 +261,53 @@ def find_block_run(layers, num_stages):
 
 
 def stack_stage_params(blocks, num_stages, mesh, axis="pipe",
-                       num_virtual=1):
+                       num_virtual=1, stage_sizes=None):
     """Stack the parameters of `blocks` (len = V * S * per) into leaves of
     shape [S, per, *param_shape] (V=1) or [V, S, per, *param_shape] (V>1,
     interleaved: chunk l*S+s — blocks [(l*S+s)*per, ...) — lands at
     leaf[l, s]), sharded over `axis` on the stage dim and preserving each
     parameter's existing named sharding on the trailing dims (so Megatron
-    "model"-axis placements survive stacking)."""
+    "model"-axis placements survive stacking).
+
+    stage_sizes (V=1 only): per-stage block counts for HETEROGENEOUS
+    partitions (reference analog: LayerDesc segmentation, pp_layers.py:92
+    SegmentLayers — stages need not be equal). Leaves become
+    [S, max(stage_sizes), ...] padded with copies of each stage's first
+    block (NaN-safe placeholders the masked schedule never selects);
+    returns (stacked, valid_mask[S, per_max])."""
     S, V = num_stages, num_virtual
-    per = len(blocks) // (S * V)
     proto_params = blocks[0].parameters()
+    if stage_sizes is not None:
+        if V != 1:
+            raise ValueError("ragged stage_sizes require num_virtual=1")
+        if len(stage_sizes) != S or sum(stage_sizes) != len(blocks):
+            raise ValueError(
+                f"stage_sizes {stage_sizes} must have {S} entries summing "
+                f"to {len(blocks)} blocks")
+        per_max = max(stage_sizes)
+        offsets = np.cumsum([0] + list(stage_sizes))
+        mask = np.zeros((S, per_max), bool)
+        stacked = []
+        for k, pp in enumerate(proto_params):
+            rows = []
+            for s in range(S):
+                vals = [blocks[offsets[s] + j].parameters()[k]._value
+                        for j in range(stage_sizes[s])]
+                mask[s, :stage_sizes[s]] = True
+                vals += [vals[0]] * (per_max - stage_sizes[s])
+                rows.append(jnp.stack(vals))
+            leaf = jnp.stack(rows)
+            spec = P()
+            shd = getattr(pp._value, "sharding", None)
+            if isinstance(shd, NamedSharding):
+                spec = shd.spec
+            full_spec = P(axis, None, *tuple(spec))
+            stacked.append(jax.device_put(leaf,
+                                          NamedSharding(mesh, full_spec)))
+        mask_leaf = jax.device_put(jnp.asarray(mask),
+                                   NamedSharding(mesh, P(axis, None)))
+        return stacked, mask_leaf
+    per = len(blocks) // (S * V)
     stacked = []
     for k, pp in enumerate(proto_params):
         laps = []
@@ -322,14 +366,25 @@ class PipelineTrainStep:
 
     def __init__(self, layers, loss_fn, optimizer, *, mesh=None,
                  num_microbatches=1, axis="pipe", remat=True,
-                 num_virtual=1):
+                 num_virtual=1, stage_sizes=None):
         from .pp_layers import PipelineLayer
+        self._pp_segments = None
         if isinstance(layers, PipelineLayer):
             flat = [l for stage in layers._stage_layers for l in stage]
             if loss_fn is None:
                 loss_fn = layers._loss_fn
+            self._pp_segments = list(layers.segment_parts)
         else:
             flat = list(layers)
+        self._stage_sizes = list(stage_sizes) if stage_sizes else None
+        if self._stage_sizes is not None:
+            if num_virtual > 1:
+                raise ValueError(
+                    "ragged stage_sizes require num_virtual=1 (the "
+                    "interleaved schedule assumes equal chunks)")
+            if any(s <= 0 for s in self._stage_sizes):
+                raise ValueError(f"stage_sizes must be positive, got "
+                                 f"{self._stage_sizes}")
         if mesh is None:
             from ...mesh import get_global_mesh
             mesh = get_global_mesh()
@@ -354,15 +409,67 @@ class PipelineTrainStep:
         self._jitted = None
 
     # -- construction -----------------------------------------------------
+    def _resolve_stage_sizes(self, flat, start, count):
+        """Per-stage block counts. Priority: explicit stage_sizes → a
+        PipelineLayer's LayerDesc segmentation (reference analog:
+        SegmentLayers, pp_layers.py:92) → uniform."""
+        S = self.num_stages
+        if self._stage_sizes is not None:
+            if len(self._stage_sizes) != S:
+                raise ValueError(
+                    f"stage_sizes has {len(self._stage_sizes)} entries for "
+                    f"{S} pipeline stages")
+            return self._stage_sizes
+        if self._pp_segments is not None and len(self._pp_segments) == S + 1:
+            sizes = []
+            for s in range(S):
+                a, b = self._pp_segments[s], self._pp_segments[s + 1]
+                sizes.append(max(0, min(b, start + count) - max(a, start)))
+            if sum(sizes) == count and all(sz > 0 for sz in sizes):
+                return sizes
+        return None
+
+    def _place_edge_params(self, outer):
+        """Shard prologue/epilogue parameters over the PIPE axis instead of
+        replicating them on every stage group. The reference balances an
+        embedding-heavy stage 0 by segmentation (pp_layers.py:208); the
+        TPU-first answer distributes the edge tensors across ALL pipe
+        groups (largest divisible dim, e.g. the vocab dim of wte/lm_head)
+        and lets the auto partitioner place the lookup/projection compute —
+        better balanced than any single-stage placement, and a tied
+        embedding (SharedLayerDesc) is one sharded leaf serving both
+        ends."""
+        if self.num_stages <= 1:
+            return
+        for p in outer:
+            shd = getattr(p._value, "sharding", None)
+            spec = tuple(shd.spec) if isinstance(shd, NamedSharding) else ()
+            target = _acc_sharding(self.mesh, P(*spec), p._value.shape,
+                                   axis=self.axis)
+            p._value = jax.device_put(p._value, target)
+
     def _build(self):
         S = self.num_stages
         V = self.num_virtual
         flat = self._flat
-        start, count = find_block_run(flat, S * V)
+        may_ragged = V == 1 and (self._stage_sizes is not None
+                                 or self._pp_segments is not None)
+        start, count = find_block_run(flat, S * V,
+                                      require_multiple=not may_ragged)
+        sizes = self._resolve_stage_sizes(flat, start, count) if may_ragged \
+            else None
+        if sizes is not None and len(set(sizes)) == 1:
+            sizes = None                       # uniform after all
+        if sizes is None and count % (S * V) != 0:
+            count = (count // (S * V)) * (S * V)
+            if count == 0:
+                raise ValueError(
+                    f"cannot split the block run into {S * V} stages")
         self._blocks = flat[start:start + count]
         pre_layers = flat[:start]
         post_layers = flat[start + count:]
-        per = count // (S * V)
+        self._stage_sizes_eff = sizes
+        per = max(sizes) if sizes is not None else count // (S * V)
         self._per_stage = per
 
         # outer (non-pipelined) params, deduped by identity so tied weights
@@ -373,6 +480,7 @@ class PipelineTrainStep:
                 if id(p) not in seen:
                     seen.add(id(p))
                     outer.append(p)
+        self._place_edge_params(outer)
         self._outer_params = outer
         proto = self._blocks[0]
         self._proto_params = proto.parameters()
@@ -380,9 +488,15 @@ class PipelineTrainStep:
         opt = self.optimizer
 
         # stacked block params [S, per, ...] (or [V, S, per, ...]) over the
-        # pipe axis
-        self._stacked = stack_stage_params(self._blocks, S, self.mesh,
-                                           self.axis, num_virtual=V)
+        # pipe axis; ragged partitions add a [S, per_max] validity mask
+        if sizes is not None:
+            self._stacked, self._block_mask = stack_stage_params(
+                self._blocks, S, self.mesh, self.axis, num_virtual=V,
+                stage_sizes=sizes)
+        else:
+            self._stacked = stack_stage_params(self._blocks, S, self.mesh,
+                                               self.axis, num_virtual=V)
+            self._block_mask = None
 
         # accumulators: probe shapes/dtypes with the real (un-stacked) params
         probe = [p for p in outer + self._proto_params if not p.stop_gradient]
@@ -459,22 +573,34 @@ class PipelineTrainStep:
         if self._remat:
             block_apply = jax.checkpoint(block_apply)
 
+        ragged = self._block_mask is not None
+
         def stage_fn(stage_leaves, x, k=None):
+            if ragged:
+                mask, stage_leaves = stage_leaves[-1], stage_leaves[:-1]
             for j in range(per):
                 kj = None if k is None else jax.random.fold_in(k, j)
-                x = block_apply([leaf[j] for leaf in stage_leaves], x, kj)
+                y = block_apply([leaf[j] for leaf in stage_leaves], x, kj)
+                # ragged: padded slots are identity (the padding params are
+                # NaN-safe copies, their output discarded and their grads
+                # zeroed by the where-transpose)
+                x = jnp.where(mask[j], y, x) if ragged else y
             return x
 
         outer_trainable = [p for p in outer if not p.stop_gradient]
         proto_trainable_ix = [k for k, p in enumerate(self._proto_params)
                               if not p.stop_gradient]
 
+        block_mask = self._block_mask
+
         def loss_of(outer_vals, stacked_vals, x, y, key):
             with _random.tracing_key_scope(key):
                 h = swap_apply(pre_layers, outer, outer_vals, x)
                 mb_shape = (M, h.shape[0] // M) + h.shape[1:]
                 hm = jnp.reshape(h, mb_shape)
-                ym = spmd_pipeline(stage_fn, stacked_vals, hm,
+                sv = stacked_vals if block_mask is None \
+                    else list(stacked_vals) + [block_mask]
+                ym = spmd_pipeline(stage_fn, sv, hm,
                                    mesh=mesh, axis=axis,
                                    key=jax.random.fold_in(key, 0x5049),
                                    num_virtual=V)
@@ -601,6 +727,25 @@ class PipelineTrainStep:
                 "applied to the stacked stage state")
         return Tensor(loss, stop_gradient=True)
 
+    def _block_coords(self):
+        """(block_index, leading-index tuple into a stacked leaf) for every
+        REAL block — ragged padding slots are skipped."""
+        S, V, per = self.num_stages, self.num_virtual, self._per_stage
+        if self._stage_sizes_eff is not None:
+            off = 0
+            for s, sz in enumerate(self._stage_sizes_eff):
+                for j in range(sz):
+                    yield off + j, (s, j)
+                off += sz
+        elif V == 1:
+            for c in range(S):
+                for j in range(per):
+                    yield c * per + j, (c, j)
+        else:
+            for c in range(S * V):
+                for j in range(per):
+                    yield c * per + j, (c // S, c % S, j)
+
     def sync_to_model(self):
         """Write the step's state back into the wrapper Parameters AND the
         optimizer's accumulator dict, so eager inspection (state_dict,
@@ -608,25 +753,14 @@ class PipelineTrainStep:
         values."""
         for p, v in zip(self._outer_params, self._outer_vals):
             p._value = v
-        per = self._per_stage
-        S, V = self.num_stages, self.num_virtual
-
-        def chunk_entry(arr, c, j):
-            # chunk c = l*S + s lives at arr[s, ...] (V=1) or arr[l, s, ...]
-            if V == 1:
-                return arr[c, j]
-            return arr[c // S, c % S, j]
-
         for k, leaf in enumerate(self._stacked):
             # ONE host transfer per stacked leaf, then numpy slicing —
             # per-(stage, block) device indexing would issue thousands of
             # small cross-device slices for a large model
             host = np.asarray(jax.device_get(leaf))
-            for c in range(S * V):
-                for j in range(per):
-                    blk = self._blocks[c * per + j]
-                    blk.parameters()[k]._value = jnp.asarray(
-                        chunk_entry(host, c, j))
+            for b, coord in self._block_coords():
+                self._blocks[b].parameters()[k]._value = jnp.asarray(
+                    host[coord])
         opt = self.optimizer
         names = self._acc_names
         t_outer = [p for p in self._outer_params if not p.stop_gradient]
@@ -645,8 +779,7 @@ class PipelineTrainStep:
                     continue
                 # batched like the param loop: one host transfer per leaf
                 host = np.asarray(jax.device_get(a))
-                for c in range(S * V):
-                    for j in range(per):
-                        blk_p = self._blocks[c * per + j].parameters()[k]
-                        opt._accumulators[n][blk_p.name] = jnp.asarray(
-                            chunk_entry(host, c, j))
+                for b, coord in self._block_coords():
+                    blk_p = self._blocks[b].parameters()[k]
+                    opt._accumulators[n][blk_p.name] = jnp.asarray(
+                        host[coord])

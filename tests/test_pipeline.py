@@ -123,21 +123,22 @@ class TestFindBlockRun:
         assert count == 4
 
 
-def _train_losses_pipeline(pp, mp, steps=5, num_micro=4, lr=1e-2):
+def _train_losses_pipeline(pp, mp, steps=5, num_micro=4, lr=1e-2,
+                           stage_sizes=None, layers=4):
     n_dev = 8
     dp = n_dev // (pp * mp)
     mesh = build_mesh(dp=dp, pp=pp, sharding=1, sep=1, mp=mp,
                       devices=jax.devices()[:n_dev])
     set_global_mesh(mesh)
     paddle.seed(0)
-    model = GPTForCausalLM(tiny_cfg())
+    model = GPTForCausalLM(tiny_cfg(num_hidden_layers=layers))
     if mp > 1:
         shard_gpt(model, mesh)
     step = PipelineTrainStep(
         gpt_pipeline_layers(model), GPTPretrainingCriterion(),
         paddle.optimizer.AdamW(learning_rate=lr,
                                parameters=model.parameters()),
-        mesh=mesh, num_microbatches=num_micro)
+        mesh=mesh, num_microbatches=num_micro, stage_sizes=stage_sizes)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 128, (8, 16))
     labels = rng.integers(0, 128, (8, 16))
@@ -224,6 +225,72 @@ class TestPipelineTraining:
         step.sync_to_model()
         wte_after = np.asarray(model.gpt.wte.weight._value)
         assert not np.allclose(wte_before, wte_after)
+
+    def test_edge_params_sharded_over_pipe_not_replicated(self):
+        """Heterogeneous edges: the embedding/head (prologue/epilogue)
+        parameters must be SHARDED over the pipe axis, not replicated on
+        every stage group (reference analog: LayerDesc places them on edge
+        stages, pp_layers.py:208; here they distribute across all pipe
+        groups)."""
+        _, step, model = _train_losses_pipeline(pp=2, mp=1, steps=1)
+        from jax.sharding import NamedSharding
+        wte = model.gpt.wte.weight._value
+        shd = wte.sharding
+        assert isinstance(shd, NamedSharding)
+        flat_axes = set()
+        for d in shd.spec:
+            flat_axes.update(d if isinstance(d, tuple) else (d,))
+        assert "pipe" in flat_axes, shd.spec
+        # each pipe group holds half the table, not a full copy
+        assert wte.addressable_shards[0].data.size <= wte.size // 2
+
+    def test_ragged_stage_sizes_match_single_device(self):
+        """Heterogeneous partition: stage 0 gets 1 block, stage 1 gets 3
+        (reference analog: SegmentLayers non-uniform segmentation). The
+        masked schedule must reproduce single-device training exactly."""
+        ref = _train_losses_single()
+        got, step, _ = _train_losses_pipeline(pp=2, mp=1,
+                                              stage_sizes=[1, 3])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        assert step._stage_sizes_eff == [1, 3]
+        assert step._per_stage == 3          # padded to the widest stage
+
+    def test_ragged_sync_to_model_skips_padding(self):
+        _, step, model = _train_losses_pipeline(pp=2, mp=1, steps=2,
+                                                stage_sizes=[3, 1])
+        step.sync_to_model()
+        for p in model.parameters():
+            assert np.all(np.isfinite(np.asarray(p._value)))
+
+    def test_pipeline_layer_segments_drive_ragged_partition(self):
+        """A PipelineLayer whose SegmentLayers split is non-uniform flows
+        its per-stage block counts into the masked pipeline."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import \
+            PipelineLayer
+        n_dev = 8
+        mesh = build_mesh(dp=4, pp=2, sharding=1, sep=1, mp=1,
+                          devices=jax.devices()[:n_dev])
+        set_global_mesh(mesh)
+        paddle.seed(0)
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=5))
+        pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2)
+        # 7 layers -> uniform segmentation [0,4,7]: stage0 = emb + 3 blocks,
+        # stage1 = 2 blocks + head -> ragged block split [3, 2]
+        step = PipelineTrainStep(
+            pl, GPTPretrainingCriterion(),
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()),
+            mesh=mesh, num_microbatches=4)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        l0 = float(step(ids, labels))
+        l1 = float(step(ids, labels))
+        assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+        assert step._stage_sizes_eff == [3, 2]
+        # parity with single-device on the same 5-layer model
+        ref = _train_losses_single(steps=2, layers=5)
+        np.testing.assert_allclose([l0, l1], ref, rtol=1e-5, atol=1e-5)
 
     def test_batch_not_divisible_raises(self):
         set_global_mesh(build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
