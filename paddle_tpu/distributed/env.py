@@ -42,12 +42,12 @@ def _rendezvous_store(master, rank, nranks):
     _store = TCPStore(host, port, is_master=(rank == 0),
                       world_size=nranks, timeout=60.0)
     if rank == 0:
-        # rank 0 picks a FREE port for the coordinator and publishes it —
-        # that agreement is exactly what the store is for
-        import socket
-        with socket.socket() as s:
-            s.bind((host, 0))
-            coord_port = s.getsockname()[1]
+        # deterministic (operator-firewallable) coordinator endpoint: the
+        # store port + 1, overridable via PADDLE_COORDINATOR_PORT; an
+        # ephemeral pick would add a close-then-rebind race and an
+        # unpredictable port for restricted clusters
+        coord_port = int(os.environ.get("PADDLE_COORDINATOR_PORT",
+                                        port + 1))
         _store.set("jax/coordinator", f"{host}:{coord_port}")
     return _store.get("jax/coordinator").decode()
 

@@ -17,9 +17,19 @@ _RUNNER = os.path.join(os.path.dirname(__file__), "multiproc_runner.py")
 
 
 def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    """A port P with P and P+1 both currently bindable (the coordinator
+    deterministically uses store port + 1)."""
+    for _ in range(32):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+        try:
+            with socket.socket() as s2:
+                s2.bind(("127.0.0.1", p + 1))
+            return p
+        except OSError:
+            continue
+    raise RuntimeError("no consecutive free port pair found")
 
 
 def _launch(world_size, timeout=240):
