@@ -99,9 +99,28 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
     shard_optimizer_states(optimizer)
     if offload:
         _offload_states_to_host(optimizer)
+        optimizer = _OffloadedStateOptimizer(optimizer)
     if level in ("os_g", "p_g_os"):
         optimizer = _ShardedGradOptimizer(optimizer, mesh)
     return model, optimizer, scaler
+
+
+class _OffloadedStateOptimizer:
+    """Maintain host placement of optimizer states ACROSS steps: the update
+    writes fresh on-device accumulator arrays, so they are put back to host
+    after every step (reference: group_sharded_stage3.py offload — states
+    live on CPU and transit to device for the update). This is the naive
+    round-trip; measured cost is recorded in BASELINE.md."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def step(self):
+        self._inner.step()
+        _offload_states_to_host(self._inner)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 def _offload_states_to_host(optimizer):

@@ -65,6 +65,55 @@ class name_scope:
         return False
 
 
+class _FetchTarget:
+    """Opaque fetch handle (reference analog: the fetch Variables
+    load_inference_model returns from static/io.py)."""
+
+    def __init__(self, index, name):
+        self.index = index
+        self.name = name
+
+    def __repr__(self):
+        return f"FetchTarget({self.name})"
+
+
+class _InferenceProgram(Program):
+    """A loaded inference artifact with feed/fetch rewiring: Executor.run
+    feeds by NAME in the saved order and fetches by target (reference:
+    static/io.py load_inference_model -> [program, feed_names, fetches],
+    run through fluid/executor.py feed/fetch rewiring)."""
+
+    def __init__(self, translated, feed_names):
+        super().__init__()
+        self._translated = translated
+        self.feed_names = list(feed_names)
+
+    def _run(self, feed, fetch_list=None):
+        import numpy as np
+        feed = feed or {}
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise KeyError(
+                f"feed is missing {missing}; expected names "
+                f"{self.feed_names}")
+        args = [feed[n] for n in self.feed_names]
+        out = self._translated(*args)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        outs = [o.numpy() if hasattr(o, "numpy") else np.asarray(o)
+                for o in outs]
+        if fetch_list:
+            picked = []
+            for f in fetch_list:
+                idx = f.index if isinstance(f, _FetchTarget) else int(f)
+                if idx >= len(outs):
+                    raise IndexError(
+                        f"fetch target {f!r} out of range: program "
+                        f"produced {len(outs)} outputs")
+                picked.append(outs[idx])
+            return picked
+        return outs
+
+
 class Executor:
     """Reference analog: fluid/executor.py:911 — here jit/XLA executes, so run()
     simply invokes captured callables."""
@@ -73,6 +122,8 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if isinstance(program, _InferenceProgram):
+            return program._run(feed, fetch_list)
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
@@ -130,8 +181,43 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         raise RuntimeError(
             "save_inference_model: StableHLO export failed: "
             + str(payload.get("stablehlo_error", "no input_spec given")))
+    # feed/fetch metadata sidecar so load_inference_model can rewire by
+    # name (reference: static/io.py records feed_target_names /
+    # fetch_targets in the serialized program)
+    import json as _json
+    feed_names = []
+    for i, v in enumerate(spec or []):
+        feed_names.append(getattr(v, "name", None) or f"feed_{i}")
+    with open(path_prefix + ".pdmodel.meta", "w") as f:
+        _json.dump({"feed_names": feed_names}, f)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] (the reference
+    static/io.py contract). Run via Executor.run(program, feed={name: np},
+    fetch_list=fetch_targets)."""
+    import os
+    import json as _json
     from ..jit.api import load as jit_load
-    return jit_load(path_prefix)
+    # accept both the bare prefix and a full ".pdmodel" path
+    if path_prefix.endswith(".pdmodel"):
+        path_prefix = path_prefix[:-len(".pdmodel")]
+    translated = jit_load(path_prefix + ".pdmodel"
+                          if os.path.exists(path_prefix + ".pdmodel")
+                          else path_prefix)
+    meta_path = path_prefix + ".pdmodel.meta"
+    feed_names = []
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            feed_names = _json.load(f).get("feed_names", [])
+    program = _InferenceProgram(translated, feed_names)
+    # one fetch target per model output (out_avals minus the updated-buffer
+    # outputs the export appends), mirroring the reference's one target per
+    # fetch var
+    n_out = 1
+    exported = getattr(translated, "_exported", None)
+    if exported is not None:
+        n_buf = translated._payload.get("n_buffer_outputs", 0)
+        n_out = max(1, len(exported.out_avals) - n_buf)
+    fetch_targets = [_FetchTarget(i, f"fetch_{i}") for i in range(n_out)]
+    return [program, feed_names, fetch_targets]

@@ -151,6 +151,44 @@ class TestStage2:
         assert _per_device_fraction(grads) < 1.5 / N_DEV
 
 
+class TestOffload:
+    def test_offload_keeps_states_on_host_across_steps(self):
+        """Round-3 regression: offloaded accumulators silently migrated
+        back to device after the first update. The _OffloadedStateOptimizer
+        wrapper must re-pin them to host after EVERY step, with losses
+        identical to the un-offloaded run (cost recorded in BASELINE.md)."""
+        ref, _, _ = _train(None)
+        set_global_mesh(build_mesh(dp=1, pp=1, sharding=N_DEV, sep=1, mp=1,
+                                   devices=jax.devices()[:N_DEV]))
+        paddle.seed(0)
+        model = _mlp()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, "os_g",
+                                               offload=True)
+        x, y = _data()
+        losses = []
+        for _ in range(6):
+            loss = _loss(model, x, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        np.testing.assert_allclose(losses, ref, rtol=1e-5, atol=1e-6)
+        inner = opt
+        while hasattr(inner, "_inner"):
+            inner = inner._inner
+        host = jax.devices("cpu")[0]
+        n = 0
+        for per in inner._accumulators.values():
+            for v in per.values():
+                if hasattr(v, "devices"):
+                    assert v.devices() == {host}, \
+                        "state not pinned to the host device"
+                    n += 1
+        assert n > 0
+
+
 class TestStage3:
     def test_stage3_loss_parity(self):
         ref, _, _ = _train(None)

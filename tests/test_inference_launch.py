@@ -57,8 +57,16 @@ def test_save_inference_model(tmp_path):
     prefix = str(tmp_path / "inf")
     paddle.static.save_inference_model(
         prefix, [InputSpec([1, 4], "float32")], net)
-    art = paddle.static.load_inference_model(prefix + ".pdmodel")
-    assert art.has_forward
+    # reference static/io.py contract: [program, feed_names, fetch_targets]
+    program, feed_names, fetches = paddle.static.load_inference_model(
+        prefix + ".pdmodel")
+    assert program._translated.has_forward
+    assert len(feed_names) == 1 and len(fetches) == 1
+    x = np.random.default_rng(0).standard_normal((1, 4)).astype(np.float32)
+    out = paddle.static.Executor().run(program, feed={feed_names[0]: x},
+                                       fetch_list=fetches)
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
 
 
 def test_launch_two_workers(tmp_path):

@@ -209,3 +209,76 @@ class TestStaticShell:
         t = paddle.to_tensor(np.array([5.0], np.float32))
         res = exe.run(fetch_list=[t])
         np.testing.assert_allclose(res[0], [5.0])
+
+
+class TestInferenceModelRoundTrip:
+    """save_inference_model -> load_inference_model -> Executor.run with
+    feed/fetch rewiring, parity with the live model (reference:
+    python/paddle/static/io.py + fluid/io.py load_inference_model
+    returning [program, feed_target_names, fetch_targets])."""
+
+    def _model(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(3)
+        return nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    def test_roundtrip_parity(self, tmp_path):
+        from paddle_tpu.static import (Executor, InputSpec,
+                                       save_inference_model,
+                                       load_inference_model)
+        model = self._model()
+        prefix = str(tmp_path / "infer")
+        save_inference_model(
+            prefix, [InputSpec([2, 6], "float32", name="x")], model)
+        program, feed_names, fetch_targets = load_inference_model(prefix)
+        assert feed_names == ["x"]
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6).astype("float32")
+        exe = Executor()
+        got = exe.run(program, feed={"x": x}, fetch_list=fetch_targets)
+        want = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)
+
+    def test_feed_by_name_order_independent(self, tmp_path):
+        """Feed dict order must not matter — rewiring is by NAME."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import (Executor, InputSpec,
+                                       save_inference_model,
+                                       load_inference_model)
+
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, a, b):
+                return self.lin(a) + 2.0 * b
+
+        paddle.seed(4)
+        model = TwoIn()
+        prefix = str(tmp_path / "two")
+        save_inference_model(
+            prefix, [InputSpec([3, 4], "float32", name="a"),
+                     InputSpec([3, 4], "float32", name="b")], model)
+        program, feed_names, fetches = load_inference_model(prefix)
+        assert feed_names == ["a", "b"]
+        rng = np.random.RandomState(1)
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(3, 4).astype("float32")
+        exe = Executor()
+        # dict literal in the "wrong" order — names drive the wiring
+        got = exe.run(program, feed={"b": b, "a": a}, fetch_list=fetches)
+        want = model(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)
+
+    def test_missing_feed_raises(self, tmp_path):
+        from paddle_tpu.static import (Executor, InputSpec,
+                                       save_inference_model,
+                                       load_inference_model)
+        model = self._model()
+        prefix = str(tmp_path / "miss")
+        save_inference_model(
+            prefix, [InputSpec([2, 6], "float32", name="x")], model)
+        program, _, fetches = load_inference_model(prefix)
+        with pytest.raises(KeyError, match="x"):
+            Executor().run(program, feed={}, fetch_list=fetches)
