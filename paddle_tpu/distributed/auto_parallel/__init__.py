@@ -5,12 +5,15 @@ data model paddle/fluid/distributed/auto_parallel/ (process_mesh.h,
 dist_attr.h).
 
 TPU-first: the reference implements dist-attr *completion* (propagating
-shardings op-by-op), a program *partitioner*, and explicit *reshard* insertion
-— all of which is exactly what XLA GSPMD does natively. So here:
+shardings op-by-op), a program *partitioner*, and explicit *reshard* insertion.
+XLA GSPMD natively completes INTERMEDIATE tensors and inserts resharding
+collectives; the framework completes the PARAMETER graph from partial
+annotations (completion.py here). So:
   ProcessMesh      -> jax.sharding.Mesh
   dims_mapping     -> PartitionSpec
   shard_tensor     -> device_put / with_sharding_constraint (NamedSharding)
-  completion       -> GSPMD sharding propagation inside jit
+  completion       -> complete_model_sharding (parameter graph) + GSPMD
+                      sharding propagation inside jit (intermediates)
   reshard          -> XLA resharding collectives, inserted by the compiler
   Engine           -> pjit'd train/eval/predict steps
 """
@@ -20,10 +23,11 @@ from .api import (  # noqa: F401
     get_dist_attr)
 from .strategy import Strategy  # noqa: F401
 from .engine import Engine  # noqa: F401
+from .completion import complete_model_sharding  # noqa: F401
 
 __all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor",
            "shard_op", "dtensor_from_fn", "reshard", "unshard_dtensor",
-           "get_dist_attr", "Strategy", "Engine"]
+           "get_dist_attr", "Strategy", "Engine", "complete_model_sharding"]
 from .planner import (  # noqa: F401
     ModelStats, PlanChoice, plan_mesh, gpt_stats,
 )

@@ -1,0 +1,145 @@
+"""Sharding completion: finish PARTIAL user annotations across a model.
+
+Reference analog: python/paddle/distributed/auto_parallel/completion.py —
+the reference walks the ProgramDesc completing a DistAttr for every op/var
+from the user's few shard_tensor marks, then partitioner.py splits the
+program and reshard.py inserts the comm ops.
+
+TPU-first split of that work: XLA GSPMD already completes every
+INTERMEDIATE tensor and inserts the resharding collectives once the
+parameter leaves carry shardings. What is left for the framework is the
+PARAMETER graph: propagate the user's partial marks to the unannotated
+parameters with Megatron pairing rules, then device_put each decision
+(the eager analog of reshard.py's inserted comm). The rules:
+
+  - a Linear whose weight is sharded on its OUTPUT dim (column-parallel,
+    weight [in, out] dim 1) propagates: its bias shards on the same axis,
+    and the NEXT Linear completes row-parallel (weight dim 0 on that axis,
+    bias replicated) — GSPMD places the psum;
+  - a row-parallel mark likewise closes the pair (nothing is carried
+    forward);
+  - an Embedding weight sharded on the feature dim behaves like a column
+    mark for the following Linear;
+  - 1-D norm/scale params between a column and row partner stay
+    replicated;
+  - anything with no annotated neighbor completes as replicated.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .process_mesh import get_current_process_mesh
+
+__all__ = ["complete_model_sharding"]
+
+
+def _axes_of(spec_entry):
+    if spec_entry is None:
+        return ()
+    if isinstance(spec_entry, (list, tuple)):
+        return tuple(spec_entry)
+    return (spec_entry,)
+
+
+def _existing_spec(p):
+    attr = getattr(p, "_dist_attr", None)
+    if attr is not None:
+        spec = list(attr[1])
+        return spec + [None] * (p._value.ndim - len(spec))
+    shd = getattr(p._value, "sharding", None)
+    if isinstance(shd, NamedSharding) and any(
+            s is not None for s in shd.spec):
+        return list(shd.spec) + [None] * (p._value.ndim - len(shd.spec))
+    return None
+
+
+def _annotation_mesh(model):
+    """The ProcessMesh the user's shard_tensor marks reference (first one
+    found) — completion must place everything on THAT mesh, not on a
+    fallback the Engine happened to construct."""
+    for p in model.parameters():
+        attr = getattr(p, "_dist_attr", None)
+        if attr is not None:
+            return attr[0]
+    return None
+
+
+def _apply(p, mesh, spec):
+    sharding = NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec))
+    p._value = jax.device_put(p._value, sharding)
+    p._dist_attr = (mesh, list(spec))
+
+
+def complete_model_sharding(model, process_mesh=None):
+    """Complete missing parameter placements from the model's partial
+    shard_tensor annotations. Returns {param_name: spec} for every
+    parameter (the completed "dist context"). Idempotent: annotated
+    parameters are left untouched."""
+    mesh = _annotation_mesh(model) or process_mesh \
+        or get_current_process_mesh()
+    if mesh is None:
+        raise ValueError("complete_model_sharding needs a ProcessMesh "
+                         "(annotation, argument or active context)")
+    decisions = {}
+    open_axis = None            # mp axis carried from a column-parallel mark
+    for layer in model.sublayers(include_self=True):
+        params = list(getattr(layer, "_parameters", {}).items())
+        if not params:
+            continue
+        kind = type(layer).__name__.lower()
+        is_linear = "linear" in kind and any(
+            p is not None and p._value.ndim == 2 for _, p in params)
+        is_embedding = "embedding" in kind
+        specs = {n: _existing_spec(p) for n, p in params if p is not None}
+        annotated = {n: s for n, s in specs.items() if s is not None}
+
+        if is_linear:
+            wname, w = next((n, p) for n, p in params
+                            if p is not None and p._value.ndim == 2)
+            wspec = specs.get(wname)
+            if wspec is not None:
+                out_axes = _axes_of(wspec[1])
+                in_axes = _axes_of(wspec[0])
+                if out_axes:                       # column-parallel mark
+                    open_axis = out_axes[0]
+                    for n, p in params:
+                        if p is None or n == wname:
+                            continue
+                        if specs.get(n) is None and p._value.ndim == 1:
+                            _apply(p, mesh, [open_axis])
+                            decisions[p.name] = [open_axis]
+                elif in_axes:                      # row-parallel mark
+                    open_axis = None
+                else:
+                    # an explicitly replicated weight CLOSES the pair —
+                    # the user pinned it, the carried axis must not leak
+                    # onto later layers
+                    open_axis = None
+            elif open_axis is not None:
+                # complete the row-parallel partner of the carried axis
+                _apply(w, mesh, [open_axis, None])
+                decisions[w.name] = [open_axis, None]
+                for n, p in params:
+                    if p is None or n == wname:
+                        continue
+                    if specs.get(n) is None:
+                        _apply(p, mesh, [None] * p._value.ndim)
+                        decisions[p.name] = [None] * p._value.ndim
+                open_axis = None
+        elif is_embedding and annotated:
+            wspec = next(iter(annotated.values()))
+            feat_axes = _axes_of(wspec[-1])
+            if feat_axes:                          # feature-dim shard ==
+                open_axis = feat_axes[0]           # column mark downstream
+
+        # default: anything still unannotated completes replicated
+        for n, p in params:
+            if p is None:
+                continue
+            if _existing_spec(p) is None and p.name not in decisions:
+                _apply(p, mesh, [None] * p._value.ndim)
+                decisions[p.name] = [None] * p._value.ndim
+            elif p.name not in decisions:
+                decisions[p.name] = _existing_spec(p)
+    return decisions

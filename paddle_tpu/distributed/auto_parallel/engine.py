@@ -67,6 +67,18 @@ class Engine:
         return out
 
     # ----------------------------------------------------------------- steps
+    def _complete_sharding(self):
+        """Finish the user's partial shard_tensor marks before any tracing:
+        parameters complete Megatron-style on the ANNOTATIONS' mesh, GSPMD
+        completes the intermediates (reference: engine.py running
+        completion.py before partition). Runs once, for every execution
+        path (fit incl. gradient-merge, evaluate, predict)."""
+        if getattr(self, "_completed", False):
+            return
+        from .completion import complete_model_sharding
+        complete_model_sharding(self._model, self._mesh())
+        self._completed = True
+
     def _get_train_step(self):
         if self._train_step is None:
             from ...jit.train_step import TrainStep
@@ -89,6 +101,7 @@ class Engine:
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=True, collate_fn=collate_fn)
+        self._complete_sharding()
         k_steps = self._strategy.gradient_merge.k_steps \
             if self._strategy.gradient_merge.enable else 1
         # gradient merge accumulates eagerly; the fused functional step is
@@ -131,6 +144,7 @@ class Engine:
         from ...framework.autograd import no_grad
         loader = eval_data if isinstance(eval_data, DataLoader) else \
             DataLoader(eval_data, batch_size=batch_size, collate_fn=collate_fn)
+        self._complete_sharding()
         self._model.eval()
         losses = []
         with no_grad():
@@ -150,6 +164,7 @@ class Engine:
         loader = test_data if isinstance(test_data, DataLoader) else \
             DataLoader(test_data, batch_size=batch_size,
                        collate_fn=collate_fn)
+        self._complete_sharding()
         self._model.eval()
         outs = []
         with no_grad():

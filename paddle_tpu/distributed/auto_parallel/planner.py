@@ -8,13 +8,21 @@ factorization XLA's partitioner already places every intermediate, so the
 planner only has to weigh the collective traffic and memory of each
 factorization and hand the winner to pjit.
 
-Cost model (per training step, relative units):
-  - dp:   ring all-reduce of grads        2 * (dp-1)/dp * P_bytes
-  - mp:   2 all-reduces of activations per block
-          2 * 2 * L * (mp-1)/mp * B*S*H_bytes
-  - pp:   bubble overhead multiplies compute: (S-1)/(M+S-1)
-  - sharding (ZeRO): all-gather params + reduce-scatter grads ~ dp cost
-          but divides optimizer-state memory by the degree
+Cost model (per training step, SECONDS, alpha-beta form — volume/bandwidth
+plus latency floors, with comm/compute overlap):
+  - compute: 6 * N * tokens_per_device / peak, scaled by the pipeline
+    bubble (S-1)/(V*M+S-1)
+  - dp: ring all-reduce of grads 2*(dp-1)/dp * P_bytes / ici_bw, HIDDEN
+    behind the backward pass up to DP_OVERLAP * compute (XLA latency-hiding
+    scheduler); exposed excess + log2(dp)*ALPHA_COLL remains
+  - mp: 4 activation all-reduces per block ON the critical path:
+    volume / ici_bw + 4*L/pp * ALPHA_COLL
+  - pp: (M + S - 1) p2p hops, each one micro-batch activation / ici_bw
+    plus ALPHA_P2P schedule/launch latency
+  - sharding (ZeRO): enters the dp ring factor and divides optimizer-state
+    memory by the degree
+Constants calibrated against measured step-time ORDERING on the 8-device
+virtual mesh (tests/test_auto_parallel.py TestPlannerValidation).
 Feasibility: params + grads + optimizer states + activations per device
 must fit in `hbm_bytes`.
 """
@@ -67,6 +75,13 @@ def _divisors(n):
 
 PEAK_FLOPS = 200e12      # ~v5e bf16 chip
 ICI_BW = 100e9           # bytes/s per link, order-of-magnitude
+ALPHA_COLL = 1e-6        # latency floor per collective issue (alpha term)
+ALPHA_P2P = 2e-6         # per-hop p2p/schedule latency for the pipeline
+DP_OVERLAP = 0.66        # fraction of compute the grad all-reduce hides
+#                          behind (XLA latency-hiding scheduler overlaps it
+#                          with the backward pass) — calibrated against
+#                          measured step-time ordering on the virtual mesh
+#                          (tests/test_auto_parallel.py TestPlannerValidation)
 
 
 def _evaluate(st: ModelStats, dp, mp, pp, sh, batch, micro_batches,
@@ -81,19 +96,36 @@ def _evaluate(st: ModelStats, dp, mp, pp, sh, batch, micro_batches,
         * st.act_factor * (st.n_layers / pp)
     mem = params_dev + opt_dev + act_dev
 
-    # step-time estimate in SECONDS so compute and comm are commensurable
-    grad_bytes = P / (mp * pp)
-    c_dp = 2 * (dp * sh - 1) / max(dp * sh, 1) * grad_bytes / ici_bw
-    act_bytes = (batch / max(dp * sh, 1)) * st.seq_len * st.hidden \
-        * st.bytes_per_param
-    c_mp = 4 * st.n_layers / pp * (mp - 1) / max(mp, 1) * act_bytes / ici_bw
+    # step-time estimate in SECONDS (alpha-beta model: volume/bandwidth +
+    # latency floors), so compute and comm are commensurable
     compute = 6 * st.n_params * (batch / max(dp * sh, 1)) * st.seq_len \
         / (mp * pp) / peak
+    grad_bytes = P / (mp * pp)
+    c_dp = 2 * (dp * sh - 1) / max(dp * sh, 1) * grad_bytes / ici_bw
+    # the grad all-reduce overlaps the backward pass; only the excess over
+    # DP_OVERLAP * compute is exposed, plus a log-depth latency floor
+    c_dp = max(0.0, c_dp - DP_OVERLAP * compute)
+    if dp * sh > 1:
+        c_dp += math.log2(dp * sh) * ALPHA_COLL
+    act_bytes = (batch / max(dp * sh, 1)) * st.seq_len * st.hidden \
+        * st.bytes_per_param
+    # mp activation all-reduces sit ON the critical path: volume + a
+    # latency floor for each of the 4 collectives per block
+    c_mp = 4 * st.n_layers / pp * (mp - 1) / max(mp, 1) * act_bytes / ici_bw
+    if mp > 1:
+        c_mp += 4 * st.n_layers / pp * ALPHA_COLL
     bubble = (pp - 1) / (micro_batches + pp - 1) if pp > 1 else 0.0
-    cost = compute * (1 + bubble) + c_dp + c_mp
+    # pipeline p2p: (M + S - 1) hops, each moving one micro-batch
+    # activation plus a scheduling/launch latency
+    c_pp = 0.0
+    if pp > 1:
+        hops = micro_batches + pp - 1
+        c_pp = hops * (act_bytes / max(micro_batches, 1) / ici_bw
+                       + ALPHA_P2P)
+    cost = compute * (1 + bubble) + c_dp + c_mp + c_pp
     # near-tie regularizer: hybrid axes carry real overheads the coarse
-    # model can't see (p2p latency, resharding, schedule complexity) —
-    # prefer the simpler topology unless it genuinely wins
+    # model can't see (resharding, schedule complexity) — prefer the
+    # simpler topology unless it genuinely wins
     cost *= (1 + 0.05 * (mp > 1) + 0.05 * (pp > 1) + 0.02 * (sh > 1))
     return cost, mem
 

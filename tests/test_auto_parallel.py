@@ -183,3 +183,237 @@ class TestMeshPlanner:
         st = ModelStats(n_params=1_000_000, n_layers=7, hidden=64)
         for c in plan_mesh(st, n_devices=8, batch=8):
             assert c.pp == 1 or 7 % c.pp == 0
+
+
+class TestCompletion:
+    """Parameter-graph sharding completion from PARTIAL annotations
+    (reference analog: auto_parallel/completion.py propagating DistAttrs;
+    here Megatron pairing over the parameter graph, GSPMD finishing the
+    intermediates)."""
+
+    def _mesh(self):
+        n = len(jax.devices())
+        return ProcessMesh(np.arange(n).reshape(1, n),
+                           dim_names=["data", "model"])
+
+    def test_column_mark_completes_row_partner(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        pm = self._mesh()
+        model = nn.Sequential(nn.Linear(8, 32), nn.GELU(),
+                              nn.Linear(32, 8), nn.LayerNorm(8))
+        # the ONLY user annotation: column-parallel first weight
+        shard_tensor(model[0].weight, pm, [None, "model"])
+        decisions = complete_model_sharding(model, pm)
+        # bias of the column linear follows the axis
+        assert tuple(model[0].bias._value.sharding.spec) == ("model",)
+        # the next linear completes ROW-parallel
+        assert tuple(model[2].weight._value.sharding.spec)[0] == "model"
+        # its bias and the LayerNorm complete replicated
+        for p in [model[2].bias, model[3].weight, model[3].bias]:
+            spec = p._value.sharding.spec
+            assert all(s is None for s in spec), spec
+        assert len(decisions) == len(list(model.parameters()))
+
+    def test_completion_idempotent_on_annotated(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        pm = self._mesh()
+        model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 8))
+        shard_tensor(model[0].weight, pm, [None, "model"])
+        shard_tensor(model[2].weight, pm, ["model", None])
+        complete_model_sharding(model, pm)
+        assert tuple(model[0].weight._value.sharding.spec)[-1] == "model"
+        assert tuple(model[2].weight._value.sharding.spec)[0] == "model"
+
+    def test_engine_fit_with_partial_annotation_matches_full(self):
+        """Engine.fit on a NON-GPT model where only the first weight is
+        annotated: completion must produce the same training trajectory as
+        the fully-annotated Megatron layout."""
+        def run(annotate_all):
+            paddle.seed(0)
+            n = len(jax.devices())
+            pm = ProcessMesh(np.arange(n).reshape(1, n),
+                             dim_names=["data", "model"])
+            model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                                  nn.Linear(32, 1))
+            shard_tensor(model[0].weight, pm, [None, "model"])
+            if annotate_all:
+                shard_tensor(model[0].bias, pm, ["model"])
+                shard_tensor(model[2].weight, pm, ["model", None])
+
+            class DS(paddle.io.Dataset):
+                def __init__(self):
+                    rng = np.random.default_rng(1)
+                    self.x = rng.standard_normal((32, 8)).astype(np.float32)
+                    self.y = self.x.sum(-1, keepdims=True).astype(np.float32)
+
+                def __getitem__(self, i):
+                    return self.x[i], self.y[i]
+
+                def __len__(self):
+                    return 32
+
+            opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=model.parameters())
+            st = Strategy({"dataset": {"batch_dim": "data"}})
+            engine = Engine(model, loss=nn.MSELoss(), optimizer=opt,
+                            strategy=st, process_mesh=pm)
+            hist = engine.fit(DS(), epochs=3, batch_size=32, verbose=0)
+            return hist["loss"], model
+
+        partial_losses, pmodel = run(annotate_all=False)
+        full_losses, _ = run(annotate_all=True)
+        np.testing.assert_allclose(partial_losses, full_losses,
+                                   rtol=1e-5, atol=1e-6)
+        assert partial_losses[-1] < partial_losses[0]
+        # completion actually placed the row partner
+        assert tuple(pmodel[2].weight._value.sharding.spec)[0] == "model"
+
+
+class TestPlannerValidation:
+    """The planner's analytic ordering vs MEASURED step times on the
+    virtual mesh (VERDICT round-3 item 4: relative ordering, not absolute;
+    the virtual CPU mesh timeshares cores, so only well-separated pairs are
+    asserted)."""
+
+    def test_planner_ordering_matches_measured(self):
+        import time
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.auto_parallel import (plan_mesh,
+                                                          gpt_stats)
+        from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+        from paddle_tpu.distributed.fleet.meta_parallel import \
+            PipelineTrainStep
+        from paddle_tpu.incubate.models import (
+            GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+            gpt_pipeline_layers, shard_gpt)
+        from paddle_tpu.jit import TrainStep
+
+        # compute-dominant workload: the virtual mesh cannot price real ICI
+        # traffic, so the validation regime is one where both the analytic
+        # model and the measurement agree compute/overheads dominate
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=4,
+                        num_attention_heads=4, intermediate_size=128,
+                        max_position_embeddings=512, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        use_flash_attention=False)
+        batch, seq, steps = 32, 512, 3
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 256, (batch, seq)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 256, (batch, seq)), jnp.int32)
+
+        def measure(dp, mp, pp):
+            mesh = build_mesh(dp=dp, pp=pp, sharding=1, sep=1, mp=mp,
+                              devices=jax.devices()[:8])
+            set_global_mesh(mesh)
+            paddle.seed(0)
+            model = GPTForCausalLM(cfg)
+            if mp > 1:
+                shard_gpt(model, mesh)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         parameters=model.parameters())
+            crit = GPTPretrainingCriterion()
+            if pp > 1:
+                step = PipelineTrainStep(gpt_pipeline_layers(model), crit,
+                                         opt, mesh=mesh, num_microbatches=pp)
+            else:
+                step = TrainStep(model, lambda o, y: crit(o, y), opt)
+            x = paddle.Tensor(ids, stop_gradient=True)
+            y = paddle.Tensor(labels, stop_gradient=True)
+            float(step(x, y))                 # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                l = step(x, y)
+            float(l)
+            return (time.perf_counter() - t0) / steps
+
+        configs = [(8, 1, 1), (2, 4, 1), (4, 1, 2)]
+        measured = {c: measure(*c) for c in configs}
+
+        stats = gpt_stats(cfg, seq_len=seq)
+        ranked = plan_mesh(stats, n_devices=8, batch=batch,
+                           micro_batches=2)
+        cost = {(c.dp, c.mp, c.pp): c.cost for c in ranked}
+        planned = {c: cost[c] for c in configs}
+
+        # argmin agreement: the planner picks the config that actually
+        # measures fastest
+        best_measured = min(measured, key=measured.get)
+        best_planned = min(planned, key=planned.get)
+        assert best_planned == best_measured, (measured, planned)
+        # pairwise agreement wherever the measured separation is decisive
+        for a in configs:
+            for b in configs:
+                if measured[a] > 1.5 * measured[b]:
+                    assert planned[a] > planned[b], \
+                        (a, b, measured, planned)
+
+
+class TestCompletionEdgeCases:
+    """Regressions from review: short shard_specs, user-pinned replication
+    closing the Megatron pair, and annotation-mesh preference."""
+
+    def test_short_spec_annotation_pads(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        n = len(jax.devices())
+        pm = ProcessMesh(np.arange(n).reshape(1, n),
+                         dim_names=["data", "model"])
+        model = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 8))
+        # spec shorter than ndim — shard_tensor accepts it; completion
+        # must pad, not crash
+        shard_tensor(model[0].weight, pm, ["model"])
+        complete_model_sharding(model, pm)
+        assert tuple(model[0].weight._value.sharding.spec)[0] == "model"
+
+    def test_pinned_replication_closes_pair(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        n = len(jax.devices())
+        pm = ProcessMesh(np.arange(n).reshape(1, n),
+                         dim_names=["data", "model"])
+        model = nn.Sequential(nn.Linear(8, 16), nn.Linear(16, 16),
+                              nn.Linear(16, 8))
+        shard_tensor(model[0].weight, pm, [None, "model"])   # column mark
+        shard_tensor(model[1].weight, pm, [None, None])      # user pin
+        complete_model_sharding(model, pm)
+        # the pinned layer closed the pair: layer 2 completes REPLICATED,
+        # the carried axis must not leak onto it
+        spec = tuple(model[2].weight._value.sharding.spec)
+        assert all(s is None for s in spec), spec
+
+    def test_engine_uses_annotation_mesh(self):
+        """Engine built WITHOUT process_mesh while the marks reference a
+        2-D mesh: completion must run on the annotations' mesh, not the
+        Engine's 1-D fallback."""
+        paddle.seed(0)
+        n = len(jax.devices())
+        pm = ProcessMesh(np.arange(n).reshape(1, n),
+                         dim_names=["data", "model"])
+        model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                              nn.Linear(32, 1))
+        shard_tensor(model[0].weight, pm, [None, "model"])
+
+        class DS(paddle.io.Dataset):
+            def __init__(self):
+                rng = np.random.default_rng(1)
+                self.x = rng.standard_normal((16, 8)).astype(np.float32)
+                self.y = self.x.sum(-1, keepdims=True).astype(np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return 16
+
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        engine = Engine(model, loss=nn.MSELoss(), optimizer=opt)
+        hist = engine.fit(DS(), epochs=2, batch_size=16, verbose=0)
+        assert np.isfinite(hist["loss"][-1])
+        assert tuple(model[2].weight._value.sharding.spec)[0] == "model"
