@@ -7,10 +7,27 @@ embedding tables too big for trainer memory.
 
 TPU-native scope: dense compute belongs on chips; the PS niche that remains
 is the huge-sparse-embedding path, so this module provides exactly that —
-dense tables (pull/push with server-side SGD) and lazily-materialized sparse
-tables (embedding pull/push by id) served over paddle_tpu.distributed.rpc.
-Handlers are top-level functions (picklable by reference) operating on the
-server process's table registry.
+dense tables (pull/push with server-side SGD), lazily-materialized sparse
+tables (embedding pull/push by id), a CTR accessor tier (per-row show/click
+statistics with score-based shrink, reference ps/table/ctr_accessor.cc), and
+an ASYNC COMMUNICATOR (background merge-and-send of queued gradients,
+reference ps/service/communicator/communicator.h AsyncCommunicator) served
+over paddle_tpu.distributed.rpc. Handlers are top-level functions (picklable
+by reference) operating on the server process's table registry.
+
+Explicitly NOT in scope (the descope note SURVEY §2 requires):
+  - SSD / RocksDB-backed tables (ps/table/ssd_sparse_table.cc): the
+    TPU-native capacity path is host-RAM sharded tables + Orbax-style
+    checkpoint spill; block-device caching belongs to the storage layer,
+    not the framework.
+  - Graph tables for GNN sampling (ps/table/common_graph_table.h): graph
+    storage/sampling is a workload-specific service; paddle_tpu.geometric
+    covers on-device message passing, and an external graph store can feed
+    it through the DataLoader.
+  - HeterPS / BoxPS GPU-resident CTR caches (framework/fleet/heter_ps/):
+    vendor-specific CTR serving infrastructure tied to GPU hashtables —
+    on TPU the equivalent capacity tier is host RAM over ICI-attached
+    hosts, already covered by the sharded tables here.
 """
 from __future__ import annotations
 
@@ -20,7 +37,8 @@ import numpy as np
 
 from ...framework.core import Tensor
 
-__all__ = ["PSServer", "PSClient", "DenseTable", "SparseTable"]
+__all__ = ["PSServer", "PSClient", "DenseTable", "SparseTable",
+           "CTRSparseTable", "AsyncCommunicator"]
 
 # ---------------------------------------------------------------- tables
 
@@ -82,6 +100,168 @@ class SparseTable:
             self.rows[k] = self.rows[k] - lr * g
 
 
+class CTRSparseTable(SparseTable):
+    """Sparse table with CTR accessor semantics (reference:
+    ps/table/ctr_accessor.cc CtrCommonAccessor): each row carries
+    show/click statistics; `shrink` evicts rows whose decayed score falls
+    below a threshold — the reference's day-level table shrink."""
+
+    def __init__(self, name, dim, initializer="uniform", seed=0,
+                 show_decay_rate=0.98, nonclk_coeff=0.1, click_coeff=1.0):
+        super().__init__(name, dim, initializer, seed)
+        self.stats = {}                    # id -> [show, click]
+        self.show_decay_rate = show_decay_rate
+        self.nonclk_coeff = nonclk_coeff
+        self.click_coeff = click_coeff
+
+    def pull(self, ids, shows=None, clicks=None):
+        out = super().pull(ids)
+        for i, key in enumerate(ids):
+            k = int(key)
+            st = self.stats.setdefault(k, [0.0, 0.0])
+            if shows is not None:
+                st[0] += float(shows[i])
+            if clicks is not None:
+                st[1] += float(clicks[i])
+        return out
+
+    def score(self, key):
+        show, click = self.stats.get(int(key), (0.0, 0.0))
+        return (show - click) * self.nonclk_coeff + click * self.click_coeff
+
+    def shrink(self, threshold=0.0):
+        """Decay statistics and evict rows scoring at/below threshold.
+        Returns the number of evicted rows."""
+        evicted = 0
+        for k in list(self.rows):
+            st = self.stats.get(k)
+            if st is not None:
+                st[0] *= self.show_decay_rate
+                st[1] *= self.show_decay_rate
+            if self.score(k) <= threshold:
+                self.rows.pop(k, None)
+                self.stats.pop(k, None)
+                evicted += 1
+        return evicted
+
+
+class AsyncCommunicator:
+    """Trainer-side async push tier (reference:
+    ps/service/communicator/communicator.h AsyncCommunicator): gradients
+    queue locally; a background thread MERGES pending pushes per table
+    (dense grads sum, sparse grads accumulate by id) and sends them every
+    `send_interval` seconds or `batches_per_send` enqueues, so the trainer
+    never blocks on the PS round-trip. flush() drains synchronously."""
+
+    def __init__(self, client, send_interval=0.05, batches_per_send=4):
+        self._client = client
+        self._interval = send_interval
+        self._batches = max(1, batches_per_send)
+        self._pending = {}                 # name -> list of payloads
+        self._count = 0
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()   # serializes actual sends so
+        #                                      flush() waits for in-flight
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = None
+        self._error = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            self._wake.wait(self._interval)
+            self._wake.clear()
+            try:
+                self._drain()
+            except Exception as e:     # keep the thread alive; surface the
+                self._error = e        # failure on the trainer's next call
+            if self._stop:
+                return
+
+    def _check_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "AsyncCommunicator background send failed") from err
+
+    def _merge_and_send(self, name, items):
+        # one merged push per (kind, lr): merging across learning rates
+        # would silently mis-scale part of the update
+        by_lr = {}
+        for it in items:
+            by_lr.setdefault((it[0], it[-1]), []).append(it)
+        for (kind, lr), group in by_lr.items():
+            if kind == "dense":
+                total = group[0][1].copy()
+                for _, g, _ in group[1:]:
+                    total += g
+                self._client.push_dense(name, total, lr=lr)
+            else:
+                acc = {}
+                for _, ids, grads, _ in group:
+                    for k, g in zip(ids, grads):
+                        k = int(k)
+                        acc[k] = acc[k] + g if k in acc else g.copy()
+                if acc:
+                    ids = np.fromiter(acc.keys(), np.int64, len(acc))
+                    grads = np.stack([acc[int(k)] for k in ids])
+                    self._client.push_sparse(name, ids, grads, lr=lr)
+
+    def _drain(self):
+        with self._send_lock:
+            with self._lock:
+                pending, self._pending = self._pending, {}
+                self._count = 0
+            for name, items in pending.items():
+                self._merge_and_send(name, items)
+
+    def push_dense_async(self, name, grad, lr=0.1):
+        self._check_error()
+        g = np.asarray(grad._value if isinstance(grad, Tensor) else grad,
+                       np.float32)
+        with self._lock:
+            self._pending.setdefault(name, []).append(("dense", g, lr))
+            self._count += 1
+            kick = self._count >= self._batches
+        if kick:
+            self._wake.set()
+
+    def push_sparse_async(self, name, ids, grads, lr=0.1):
+        self._check_error()
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        g = np.asarray(grads._value if isinstance(grads, Tensor) else grads,
+                       np.float32).reshape(len(ids_np), -1)
+        with self._lock:
+            self._pending.setdefault(name, []).append(
+                ("sparse", ids_np, g, lr))
+            self._count += 1
+            kick = self._count >= self._batches
+        if kick:
+            self._wake.set()
+
+    def flush(self):
+        """Synchronously drain everything queued so far AND wait for any
+        in-flight background send (the send lock serializes them)."""
+        self._drain()
+        self._check_error()
+
+    def stop(self):
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._drain()
+        self._check_error()
+
+
 # ------------------------------------------- server-side rpc handlers
 # top-level so the rpc layer pickles them by reference
 
@@ -125,6 +305,24 @@ def _ps_table_size(name):
     with _LOCK:
         t = _TABLES[name]
         return len(t.rows) if isinstance(t, SparseTable) else t.value.size
+
+
+def _ps_create_ctr(name, dim, initializer, seed, accessor_kwargs):
+    with _LOCK:
+        if name not in _TABLES:
+            _TABLES[name] = CTRSparseTable(name, dim, initializer, seed,
+                                           **(accessor_kwargs or {}))
+    return True
+
+
+def _ps_pull_ctr(name, ids, shows, clicks):
+    with _LOCK:
+        return _TABLES[name].pull(ids, shows=shows, clicks=clicks)
+
+
+def _ps_shrink(name, threshold):
+    with _LOCK:
+        return _TABLES[name].shrink(threshold)
 
 
 class PSServer:
@@ -179,6 +377,27 @@ class PSClient:
     def table_size(self, name):
         return self._rpc().rpc_sync(self.server, _ps_table_size, args=(name,))
 
+    def create_ctr_table(self, name, dim, initializer="uniform", seed=0,
+                         **accessor_kwargs):
+        self._rpc().rpc_sync(self.server, _ps_create_ctr,
+                             args=(name, dim, initializer, seed,
+                                   accessor_kwargs))
+
+    def pull_ctr(self, name, ids, shows=None, clicks=None):
+        """pull_sparse + accumulate show/click statistics server-side
+        (reference: ctr accessor pull path)."""
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        return Tensor(np.asarray(self._rpc().rpc_sync(
+            self.server, _ps_pull_ctr,
+            args=(name, ids_np,
+                  None if shows is None else list(map(float, shows)),
+                  None if clicks is None else list(map(float, clicks))))))
+
+    def shrink(self, name, threshold=0.0):
+        return self._rpc().rpc_sync(self.server, _ps_shrink,
+                                    args=(name, threshold))
+
 
 class LocalPSClient(PSClient):
     """In-process client: tables live in this process (no rpc) — the
@@ -216,3 +435,15 @@ class LocalPSClient(PSClient):
 
     def table_size(self, name):
         return _ps_table_size(name)
+
+    def create_ctr_table(self, name, dim, initializer="uniform", seed=0,
+                         **accessor_kwargs):
+        _ps_create_ctr(name, dim, initializer, seed, accessor_kwargs)
+
+    def pull_ctr(self, name, ids, shows=None, clicks=None):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                            np.int64).reshape(-1)
+        return Tensor(np.asarray(_ps_pull_ctr(name, ids_np, shows, clicks)))
+
+    def shrink(self, name, threshold=0.0):
+        return _ps_shrink(name, threshold)

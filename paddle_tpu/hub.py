@@ -1,23 +1,121 @@
 """paddle.hub — load models from a hubconf.py. Reference analog:
-python/paddle/hapi/hub.py (list/help/load with github/gitee/local sources).
+python/paddle/hapi/hub.py (list/help/load with github/gitee/local sources:
+_get_cache_or_reload downloads "https://github.com/{owner}/{repo}/archive/
+{branch}.zip" into hub_home/<normalized name>, extracts, and imports the
+repo's hubconf.py entrypoints).
 
-This environment has no network egress, so only source='local' is supported;
-a hub repo is any directory with a hubconf.py exposing entrypoint callables
-(functions not prefixed with '_').
+Full protocol parity: github/gitee sources resolve "owner/repo[:branch]",
+download the archive into the hub cache (reused unless force_reload), and
+import hubconf.py from the extracted tree; source='local' takes a directory
+directly. In a no-egress environment remote sources fail at the download
+step with a clear error — the cache path still works if pre-populated.
 """
 from __future__ import annotations
 
 import importlib.util
 import os
+import shutil
 import sys
+import zipfile
 
-__all__ = ["list", "help", "load"]
+__all__ = ["list", "help", "load", "set_hub_home", "get_hub_home"]
+
+_HUB_HOME = None
+_HUBCONF = "hubconf.py"
+
+
+def set_hub_home(path):
+    """Override the hub cache directory (reference: HUB_DIR)."""
+    global _HUB_HOME
+    _HUB_HOME = path
+
+
+def get_hub_home():
+    return _HUB_HOME or os.environ.get(
+        "PADDLE_HUB_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle", "hub"))
+
+
+def _parse_repo(repo, source):
+    """'owner/repo[:branch]' -> (owner, repo, branch, archive url)."""
+    if ":" in repo:
+        repo_part, branch = repo.split(":", 1)
+    else:
+        repo_part, branch = repo, "main"
+    if repo_part.count("/") != 1:
+        raise ValueError(
+            f"remote repo must be 'owner/name[:branch]', got {repo!r}")
+    owner, name = repo_part.split("/")
+    host = "github.com" if source == "github" else "gitee.com"
+    url = f"https://{host}/{owner}/{name}/archive/{branch}.zip"
+    return owner, name, branch, url
+
+
+def _safe_extract(zf, dest):
+    """extractall with member-path validation (zip-slip guard): every
+    member must land strictly inside `dest`."""
+    dest_real = os.path.realpath(dest)
+    for m in zf.namelist():
+        target = os.path.realpath(os.path.join(dest, m))
+        if not (target + os.sep).startswith(dest_real + os.sep):
+            raise RuntimeError(f"archive member escapes extraction dir: "
+                               f"{m!r}")
+    zf.extractall(dest)
+
+
+def _get_cache_or_reload(repo, source, force_reload):
+    """Reference: hapi/hub.py _get_cache_or_reload — cache dir keyed by
+    owner_name_branch; download+extract on miss or force_reload. The
+    download lands in a temp dir and swaps in only on success, so
+    force_reload never destroys the existing copy on a failed fetch."""
+    import tempfile
+    owner, name, branch, url = _parse_repo(repo, source)
+    hub_home = get_hub_home()
+    os.makedirs(hub_home, exist_ok=True)
+    key = f"{owner}_{name}_{branch}".replace("-", "_").replace("/", "_")
+    cache_dir = os.path.join(hub_home, key)
+    if os.path.exists(cache_dir) and not force_reload:
+        return cache_dir
+    tmp = tempfile.mkdtemp(dir=hub_home, prefix=".fetch_")
+    zip_path = os.path.join(tmp, "archive.zip")
+    try:
+        try:
+            import urllib.request
+            urllib.request.urlretrieve(url, zip_path)
+        except Exception as e:
+            raise RuntimeError(
+                f"cannot download {url}: {e}. This environment may have no "
+                "network egress — pre-populate the cache at "
+                f"{cache_dir} (a checkout containing {_HUBCONF}) or use "
+                "source='local'.") from e
+        with zipfile.ZipFile(zip_path) as zf:
+            roots = {n.split("/")[0] for n in zf.namelist() if n.strip("/")}
+            if len(roots) != 1:
+                raise RuntimeError(f"unexpected archive layout from {url}")
+            _safe_extract(zf, tmp)
+        extracted = os.path.join(tmp, roots.pop())
+        # success: swap in atomically-ish, only now touching the old copy
+        if os.path.exists(cache_dir):
+            shutil.rmtree(cache_dir)
+        os.rename(extracted, cache_dir)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return cache_dir
+
+
+def _resolve(repo_dir, source, force_reload):
+    if source == "local":
+        return repo_dir
+    if source not in ("github", "gitee"):
+        raise ValueError(
+            f"source must be 'github', 'gitee' or 'local', got {source!r}")
+    return _get_cache_or_reload(repo_dir, source, force_reload)
 
 
 def _load_hubconf(repo_dir):
-    path = os.path.join(repo_dir, "hubconf.py")
+    path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.exists(path):
-        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
     spec = importlib.util.spec_from_file_location("hubconf", path)
     mod = importlib.util.module_from_spec(spec)
     sys.path.insert(0, repo_dir)
@@ -28,33 +126,24 @@ def _load_hubconf(repo_dir):
     return mod
 
 
-def _check_source(source):
-    if source != "local":
-        raise ValueError(
-            f"source={source!r} needs network access, which this environment "
-            "does not have; use source='local' with a checked-out repo dir")
-
-
 def list(repo_dir, source="local", force_reload=False):  # noqa: A001
     """Entrypoint names exposed by the repo's hubconf.py."""
-    _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
     return [name for name in dir(mod)
             if callable(getattr(mod, name)) and not name.startswith("_")]
 
 
 def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
-    _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
     return getattr(mod, model).__doc__
 
 
 def load(repo_dir, model, source="local", force_reload=False, **kwargs):
     """Instantiate entrypoint `model` from the repo's hubconf.py."""
-    _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    resolved = _resolve(repo_dir, source, force_reload)
+    mod = _load_hubconf(resolved)
     if not hasattr(mod, model):
         raise ValueError(
-            f"{model!r} not in {repo_dir}/hubconf.py; available: "
-            f"{list(repo_dir)}")
+            f"{model!r} not in {resolved}/{_HUBCONF}; available: "
+            f"{list(resolved)}")
     return getattr(mod, model)(**kwargs)

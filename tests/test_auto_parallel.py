@@ -340,10 +340,14 @@ class TestPlannerValidation:
         planned = {c: cost[c] for c in configs}
 
         # argmin agreement: the planner picks the config that actually
-        # measures fastest
+        # measures fastest — asserted only when the measurement is
+        # decisive (>1.3x over the runner-up) so scheduler noise on the
+        # timeshared CPU mesh can't flip the test
         best_measured = min(measured, key=measured.get)
         best_planned = min(planned, key=planned.get)
-        assert best_planned == best_measured, (measured, planned)
+        runner_up = sorted(measured.values())[1]
+        if runner_up > 1.3 * measured[best_measured]:
+            assert best_planned == best_measured, (measured, planned)
         # pairwise agreement wherever the measured separation is decisive
         for a in configs:
             for b in configs:

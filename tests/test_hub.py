@@ -1,0 +1,83 @@
+"""paddle.hub protocol (reference analog: python/paddle/hapi/hub.py +
+test_hub.py: list/help/load over local and cached remote repos)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import hub
+
+
+HUBCONF = textwrap.dedent('''
+    import paddle_tpu as paddle
+
+    def tiny_mlp(hidden=4):
+        """A tiny MLP entrypoint."""
+        return paddle.nn.Sequential(paddle.nn.Linear(2, hidden),
+                                    paddle.nn.ReLU(),
+                                    paddle.nn.Linear(hidden, 1))
+
+    def _private_helper():
+        return None
+''')
+
+
+def _make_repo(path):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "hubconf.py"), "w") as f:
+        f.write(HUBCONF)
+    return str(path)
+
+
+def test_local_list_help_load(tmp_path):
+    repo = _make_repo(tmp_path / "repo")
+    names = hub.list(repo, source="local")
+    assert "tiny_mlp" in names and "_private_helper" not in names
+    assert "tiny MLP" in hub.help(repo, "tiny_mlp")
+    model = hub.load(repo, "tiny_mlp", hidden=8)
+    out = model(paddle.to_tensor(np.ones((3, 2), np.float32)))
+    assert out.shape == [3, 1]
+
+
+def test_unknown_entrypoint_raises(tmp_path):
+    repo = _make_repo(tmp_path / "repo2")
+    with pytest.raises(ValueError, match="tiny_mlp"):
+        hub.load(repo, "nope")
+
+
+def test_remote_cache_hit_skips_download(tmp_path):
+    """A pre-populated cache (owner_name_branch dir) serves github loads
+    without any network touch (reference: _get_cache_or_reload reusing
+    hub_home unless force_reload)."""
+    hub.set_hub_home(str(tmp_path / "hubhome"))
+    try:
+        _make_repo(tmp_path / "hubhome" / "acme_models_main")
+        names = hub.list("acme/models", source="github")
+        assert "tiny_mlp" in names
+        m = hub.load("acme/models:main", "tiny_mlp", source="github")
+        assert m is not None
+    finally:
+        hub.set_hub_home(None)
+
+
+def test_remote_without_cache_errors_clearly(tmp_path):
+    hub.set_hub_home(str(tmp_path / "empty"))
+    try:
+        with pytest.raises((RuntimeError, Exception)) as ei:
+            hub.load("acme/absent", "x", source="github")
+        assert "download" in str(ei.value) or "egress" in str(ei.value)
+    finally:
+        hub.set_hub_home(None)
+
+
+def test_bad_source_and_repo_format(tmp_path):
+    with pytest.raises(ValueError, match="source"):
+        hub.list("x", source="bitbucket")
+    hub.set_hub_home(str(tmp_path / "h"))
+    try:
+        with pytest.raises(ValueError, match="owner/name"):
+            hub.list("not-a-repo-path", source="github")
+    finally:
+        hub.set_hub_home(None)

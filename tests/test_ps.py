@@ -45,6 +45,14 @@ else:
     loss = ((e @ w - label) ** 2).mean()
     assert loss < 1e-3, loss
     assert client.table_size("emb") == 2   # only ids 3 and 7 materialized
+
+    # CTR accessor over rpc: stats accumulate server-side, shrink evicts
+    # by decayed score (reference: ps/table/ctr_accessor.cc)
+    client.create_ctr_table("ctr", dim=2, show_decay_rate=0.98)
+    client.pull_ctr("ctr", np.array([1, 2], np.int64),
+                    shows=[5.0, 5.0], clicks=[5.0, 0.0])
+    ev = client.shrink("ctr", threshold=0.5)
+    assert ev == 1, ev                     # the click-less row goes
     print("PS_OK", loss)
 
 rpc.shutdown()
@@ -69,3 +77,71 @@ def test_ps_server_trainer(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
     assert "PS_OK" in outs[1]
+
+
+def test_ctr_table_shrink():
+    """CTR accessor semantics: show/click scoring + score-based eviction
+    (reference: ps/table/ctr_accessor.cc shrink)."""
+    import numpy as np
+    from paddle_tpu.distributed.ps import CTRSparseTable
+    t = CTRSparseTable("ctr", dim=4, show_decay_rate=0.5)
+    # id 1: shown and clicked (high score); id 2: shown never clicked (low)
+    t.pull(np.array([1, 2]), shows=[10.0, 10.0], clicks=[5.0, 0.0])
+    assert t.score(1) > t.score(2) > 0
+    # threshold between the two scores evicts only the click-less row
+    evicted = t.shrink(threshold=(t.score(1) + t.score(2)) / 4)
+    assert evicted == 1 and 1 in t.rows and 2 not in t.rows
+    # repeated shrink decays the survivor's stats until it too goes
+    for _ in range(20):
+        t.shrink(threshold=0.5)
+    assert len(t.rows) == 0
+
+
+def test_async_communicator_merges_and_sends():
+    """AsyncCommunicator queues pushes, merges per table, sends in the
+    background (reference: communicator.h AsyncCommunicator)."""
+    import numpy as np
+    import paddle_tpu.distributed.ps as ps
+
+    ps._TABLES.clear()
+    client = ps.LocalPSClient()
+    client.create_dense_table("w", shape=[4], initializer="zeros")
+    client.create_sparse_table("emb", dim=2, initializer="zeros")
+    comm = ps.AsyncCommunicator(client, send_interval=0.01,
+                                batches_per_send=100).start()
+    # 3 dense pushes of -1 each merge into one push of -3: w = 0.1 * 3
+    for _ in range(3):
+        comm.push_dense_async("w", -np.ones(4, np.float32), lr=0.1)
+    # sparse: id 5 pushed twice accumulates, id 9 once
+    comm.push_sparse_async("emb", np.array([5], np.int64),
+                           -np.ones((1, 2), np.float32), lr=1.0)
+    comm.push_sparse_async("emb", np.array([5, 9], np.int64),
+                           -np.ones((2, 2), np.float32), lr=1.0)
+    comm.flush()
+    np.testing.assert_allclose(client.pull_dense("w").numpy(), 0.3,
+                               rtol=1e-6)
+    rows = client.pull_sparse("emb", np.array([5, 9], np.int64)).numpy()
+    np.testing.assert_allclose(rows[0], 2.0)   # two accumulated grads
+    np.testing.assert_allclose(rows[1], 1.0)
+    comm.stop()
+
+
+def test_async_communicator_background_thread_drains():
+    import time
+    import numpy as np
+    import paddle_tpu.distributed.ps as ps
+
+    ps._TABLES.clear()
+    client = ps.LocalPSClient()
+    client.create_dense_table("bg", shape=[2], initializer="zeros")
+    comm = ps.AsyncCommunicator(client, send_interval=0.01,
+                                batches_per_send=2).start()
+    comm.push_dense_async("bg", np.ones(2, np.float32), lr=1.0)
+    comm.push_dense_async("bg", np.ones(2, np.float32), lr=1.0)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if np.allclose(client.pull_dense("bg").numpy(), -2.0):
+            break
+        time.sleep(0.01)
+    np.testing.assert_allclose(client.pull_dense("bg").numpy(), -2.0)
+    comm.stop()
