@@ -13,7 +13,8 @@ from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
     all_gather_object, reduce, broadcast, scatter, alltoall, alltoall_single,
     reduce_scatter, send, recv, isend, irecv, barrier, wait,
-    destroy_process_group, get_backend, ProcessGroupXLA,
+    destroy_process_group, get_backend, ProcessGroupXLA, partial_send,
+    partial_recv, P2POp, batch_isend_irecv,
 )
 from .parallel import DataParallel  # noqa: F401
 from ..core import TCPStore  # noqa: F401  (reference: core.TCPStore)

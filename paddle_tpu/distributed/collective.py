@@ -27,7 +27,8 @@ __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "all_gather_object", "reduce", "broadcast", "scatter",
            "alltoall", "alltoall_single", "reduce_scatter", "send", "recv",
            "isend", "irecv", "barrier", "wait", "destroy_process_group",
-           "get_backend", "ProcessGroupXLA"]
+           "get_backend", "ProcessGroupXLA", "partial_send", "partial_recv",
+           "P2POp", "batch_isend_irecv"]
 
 
 class ReduceOp:
@@ -522,6 +523,72 @@ _p2p_buffers = {}
 
 isend = send
 irecv = recv
+
+
+def _partial_bounds(tensor, nranks, rank_id):
+    numel = int(np.prod(tensor.shape)) if tensor.shape else 1
+    if numel % nranks:
+        raise ValueError(
+            f"partial send/recv needs numel ({numel}) divisible by "
+            f"nranks ({nranks})")
+    per = numel // nranks
+    return per * rank_id, per * (rank_id + 1)
+
+
+def partial_send(tensor, dst=0, group=None, nranks=1, rank_id=0):
+    """Send one 1/nranks flat slice of `tensor` (reference:
+    collective/partial_send_op.cc — the pipeline's tensor-slice p2p that
+    lets mp-sharded ranks exchange only the slice they own)."""
+    lo, hi = _partial_bounds(tensor, nranks, rank_id)
+    flat = jnp.reshape(tensor._value, (-1,))[lo:hi]
+    return send(Tensor(flat, stop_gradient=True), dst=dst, group=group)
+
+
+def partial_recv(tensor, src=0, group=None, nranks=1, rank_id=0):
+    """Receive into one 1/nranks flat slice of `tensor` (reference:
+    collective/partial_recv_op.cc)."""
+    lo, hi = _partial_bounds(tensor, nranks, rank_id)
+    buf = Tensor(jnp.zeros((hi - lo,), tensor._value.dtype),
+                 stop_gradient=True)
+    task = recv(buf, src=src, group=group)
+    flat = jnp.reshape(tensor._value, (-1,))
+    flat = flat.at[lo:hi].set(buf._value)
+    tensor._assign_value_(jnp.reshape(flat, tensor._value.shape))
+    return task
+
+
+class P2POp:
+    """One operation of a batched p2p round (reference:
+    communication/batch_isend_irecv.py P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be paddle.distributed.isend or "
+                             "irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Run a batch of isend/irecv ops; returns their tasks (reference:
+    communication/batch_isend_irecv.py — the NCCL group-call batching;
+    here each op is host-mediated/pairwise so issuing in order is the
+    batching)."""
+    if not p2p_op_list:
+        return []
+    # sends issue FIRST regardless of list order — recv blocks until the
+    # peer's send lands, so a [irecv, isend] batch on both ends (the
+    # canonical ring exchange) must not deadlock
+    tasks = [None] * len(p2p_op_list)
+    for i, op in enumerate(p2p_op_list):
+        if op.op in (isend, send):
+            tasks[i] = send(op.tensor, dst=op.peer, group=op.group)
+    for i, op in enumerate(p2p_op_list):
+        if tasks[i] is None:
+            tasks[i] = recv(op.tensor, src=op.peer, group=op.group)
+    return tasks
 
 
 def barrier(group=None):

@@ -218,13 +218,27 @@ class AsyncCommunicator:
             with self._lock:
                 pending, self._pending = self._pending, {}
                 self._count = 0
+            first_err = None
             for name, items in pending.items():
-                self._merge_and_send(name, items)
+                try:
+                    self._merge_and_send(name, items)
+                except Exception as e:
+                    # keep the failed table's items for the next attempt
+                    # and keep sending the OTHER tables — one bad table
+                    # must not drop everyone's gradients
+                    first_err = first_err or e
+                    with self._lock:
+                        self._pending.setdefault(name, [])[:0] = items
+                        self._count += len(items)
+            if first_err is not None:
+                raise first_err
 
     def push_dense_async(self, name, grad, lr=0.1):
         self._check_error()
-        g = np.asarray(grad._value if isinstance(grad, Tensor) else grad,
-                       np.float32)
+        # copy at enqueue: the caller may reuse/zero its grad buffer before
+        # the background drain runs
+        g = np.array(grad._value if isinstance(grad, Tensor) else grad,
+                     np.float32, copy=True)
         with self._lock:
             self._pending.setdefault(name, []).append(("dense", g, lr))
             self._count += 1
@@ -234,10 +248,10 @@ class AsyncCommunicator:
 
     def push_sparse_async(self, name, ids, grads, lr=0.1):
         self._check_error()
-        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
-                            np.int64).reshape(-1)
-        g = np.asarray(grads._value if isinstance(grads, Tensor) else grads,
-                       np.float32).reshape(len(ids_np), -1)
+        ids_np = np.array(ids._value if isinstance(ids, Tensor) else ids,
+                          np.int64, copy=True).reshape(-1)
+        g = np.array(grads._value if isinstance(grads, Tensor) else grads,
+                     np.float32, copy=True).reshape(len(ids_np), -1)
         with self._lock:
             self._pending.setdefault(name, []).append(
                 ("sparse", ids_np, g, lr))

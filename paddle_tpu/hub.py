@@ -72,7 +72,10 @@ def _get_cache_or_reload(repo, source, force_reload):
     owner, name, branch, url = _parse_repo(repo, source)
     hub_home = get_hub_home()
     os.makedirs(hub_home, exist_ok=True)
-    key = f"{owner}_{name}_{branch}".replace("-", "_").replace("/", "_")
+    # collision-free cache key: path separators quoted, no lossy '-'/'_'
+    # folding (quote('-') == '-', so 'my-repo' and 'my_repo' stay distinct)
+    from urllib.parse import quote
+    key = "_".join(quote(part, safe="") for part in (owner, name, branch))
     cache_dir = os.path.join(hub_home, key)
     if os.path.exists(cache_dir) and not force_reload:
         return cache_dir

@@ -65,6 +65,27 @@ def main():
     dist.recv(inbox, src=dst)
     np.testing.assert_allclose(np.asarray(inbox._value), float(100 + dst))
 
+    # --- partial_send/partial_recv: exchange one half-slice ------------------
+    big = t([float(rank)] * 8)
+    slot = t([0.0] * 8)
+    dist.partial_send(big, dst=dst, nranks=2, rank_id=1)
+    dist.partial_recv(slot, src=src, nranks=2, rank_id=1)
+    got = np.asarray(slot._value)
+    np.testing.assert_allclose(got[:4], 0.0)       # untouched half
+    np.testing.assert_allclose(got[4:], float(src))
+
+    # --- batch_isend_irecv ---------------------------------------------------
+    # every rank lists irecv FIRST (the canonical ring-exchange order):
+    # the batch must hoist the sends, or both ends would deadlock
+    outbox = t([float(rank * 2)] * 2)
+    inbox2 = t([0.0, 0.0])
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.irecv, inbox2, src),
+        dist.P2POp(dist.isend, outbox, dst)])
+    for tk in tasks:
+        tk.wait()
+    np.testing.assert_allclose(np.asarray(inbox2._value), float(src * 2))
+
     # --- reduce_scatter -----------------------------------------------------
     parts = [t([float(rank + 1)] * 2) for _ in range(world)]
     out = t([0.0, 0.0])
