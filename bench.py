@@ -66,6 +66,11 @@ def with_retry(fn, name, attempts=4, delays=(15, 45, 90)):
             time.sleep(delay)
 
 
+def _platform():
+    import jax
+    return jax.devices()[0].platform
+
+
 def peak_flops_per_chip():
     """bf16 peak for the local chip. TPU v5 lite (v5e): 197 TFLOP/s."""
     import jax
@@ -225,6 +230,7 @@ def bench_vit(on_tpu):
         "extra": {"unfused_images_per_sec": round(unfused_ips, 1),
                   "fused_mfu": round(fused_mfu, 4),
                   "unfused_mfu": round(unfused_mfu, 4),
+                  "platform": _platform(),
                   "trace": tdir},
     }
 
@@ -298,6 +304,7 @@ def bench_decode(on_tpu):
         "vs_baseline": round(0.08 / ms_per_step, 4) if on_tpu else 0.0,
         "extra": {"batch": B, "buffer_len": T, "steps": steps,
                   "tokens_per_sec": round(B / (ms_per_step / 1e3), 1),
+                  "platform": platform,
                   "trace": tdir},
     }
 
@@ -310,7 +317,21 @@ def main():
         jax.devices()       # force backend bring-up inside the retry loop
         return jax
 
-    jax = with_retry(init, "backend_init")
+    try:
+        jax = with_retry(init, "backend_init")
+    except Exception as e:
+        if not _is_transient(e):
+            raise       # install/version bugs must die loudly, not mask
+                        # themselves as an outage
+        # the TPU tunnel can be down for hours (round-3 outage): fall back
+        # to CPU with the platform EXPLICIT in every record rather than
+        # dying with no number at all
+        print(json.dumps({"event": "tpu_unreachable_falling_back_to_cpu",
+                          "error": str(e)[:200]}), flush=True)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        _reset_backends()
+        jax.devices()
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
 
     results = {}
