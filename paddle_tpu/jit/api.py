@@ -349,6 +349,13 @@ def save(layer, path, input_spec=None, **configs):
             # are part of the signature but not the state dict)
             payload["export_state"] = [np.asarray(t._value)
                                        for t in params + buffers]
+            # map each export_state slot to its state_dict key so a
+            # program-only artifact (static.serialize_program strips the
+            # values) can be re-armed from deserialize_persistables
+            by_id = {id(v): k for k, v in payload.get("state_dict",
+                                                      {}).items()}
+            payload["export_state_keys"] = [by_id.get(id(t))
+                                            for t in params + buffers]
             # the exported pure fn returns model outputs + updated buffers;
             # load needs the split point
             payload["n_buffer_outputs"] = len(buffers)
@@ -397,6 +404,33 @@ class TranslatedLayer:
 
     def state_dict(self):
         return dict(self._state_dict)
+
+    def set_state(self, state):
+        """Arm a program-only artifact (static.serialize_program strips
+        weights) with persistables from deserialize_persistables: values
+        map into export-state slots by their state_dict keys."""
+        keys = self._payload.get("export_state_keys")
+        if not keys:
+            raise RuntimeError(
+                "this artifact predates export_state_keys; re-save it")
+        aux = self._payload.get("export_state_aux") or {}
+        vals = []
+        for i, k in enumerate(keys):
+            if k is None:
+                # non-persistable buffer: not a persistable by definition —
+                # its value rides with the program (export_state_aux)
+                if i not in aux:
+                    raise KeyError(
+                        f"program artifact lacks the non-persistable "
+                        f"buffer for export slot {i}")
+                vals.append(jnp.asarray(aux[i]))
+                continue
+            if k not in state:
+                raise KeyError(f"persistables missing state slot {k!r}")
+            v = state[k]
+            vals.append(v._value if isinstance(v, Tensor) else
+                        jnp.asarray(v))
+        self._param_values = vals
 
     def __call__(self, *args):
         if self._exported is None:

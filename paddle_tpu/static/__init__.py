@@ -8,11 +8,28 @@ jit capture.
 """
 from __future__ import annotations
 
+import contextlib
+
+import numpy as np
+
 from ..jit.api import InputSpec  # noqa: F401
 
 __all__ = ["InputSpec", "Program", "default_main_program",
-           "default_startup_program", "program_guard", "Executor", "name_scope",
-           "py_func", "save_inference_model", "load_inference_model"]
+           "default_startup_program", "program_guard", "Executor",
+           "name_scope", "py_func", "save_inference_model",
+           "load_inference_model", "data", "Variable", "append_backward",
+           "gradients", "create_global_var", "create_parameter",
+           "global_scope", "scope_guard", "BuildStrategy",
+           "ExecutionStrategy", "CompiledProgram", "ParallelExecutor",
+           "Print", "WeightNormParamAttr", "ExponentialMovingAverage",
+           "accuracy", "auc", "ctr_metric_bundle", "exponential_decay",
+           "device_guard", "cpu_places", "cuda_places", "xpu_places",
+           "npu_places", "mlu_places", "save", "load", "serialize_program",
+           "serialize_persistables", "save_to_file", "deserialize_program",
+           "deserialize_persistables", "load_from_file",
+           "normalize_program", "load_program_state", "set_program_state",
+           "ipu_shard_guard", "IpuCompiledProgram", "IpuStrategy",
+           "set_ipu_shard"]
 
 
 class Program:
@@ -221,3 +238,491 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
         n_out = max(1, len(exported.out_avals) - n_buf)
     fetch_targets = [_FetchTarget(i, f"fetch_{i}") for i in range(n_out)]
     return [program, feed_names, fetch_targets]
+
+
+# --------------------------------------------------------------------------
+# static-graph surface (reference: python/paddle/static/{input,io,nn}.py +
+# fluid shells). Eager-first: "variables" are Tensors, the graph is the
+# traced jaxpr, so most entries execute directly; the legacy executor/
+# build-strategy machinery is an API-parity shell (XLA owns scheduling).
+# --------------------------------------------------------------------------
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed slot (reference: static/input.py:26). Returns a named
+    InputSpec consumed by save_inference_model / to_static input_spec."""
+    return InputSpec(shape, dtype or "float32", name=name)
+
+
+def _tensor_cls():
+    from ..framework.core import Tensor
+    return Tensor
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Reference: fluid/backward.py append_backward — builds the grad ops.
+    Eager: runs backward() and returns the (param, grad) pairs."""
+    loss.backward()
+    if parameter_list is not None:
+        params = parameter_list
+    else:
+        from ..framework.core import Parameter
+        params = [t for t in _live_parameters() if not t.stop_gradient]
+    return [(p, p.grad) for p in params if getattr(p, "grad", None)
+            is not None]
+
+
+def _live_parameters():
+    """Parameters touched by the current tape (best effort for the
+    parameter_list=None legacy path)."""
+    import gc
+    from ..framework.core import Parameter
+    return [o for o in gc.get_objects() if isinstance(o, Parameter)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) (reference: fluid/backward.py gradients)."""
+    from ..framework.autograd import grad as _grad
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+class Variable:          # reference: static Variable ≙ eager Tensor here
+    def __new__(cls, *args, **kwargs):
+        return _tensor_cls()(*args, **kwargs)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    import jax.numpy as jnp
+    t = _tensor_cls()(jnp.full(tuple(shape), value, dtype), stop_gradient=True)
+    if name:
+        t.name = name
+        global_scope().vars[name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.core import Parameter
+    import jax.numpy as jnp
+    from ..nn import initializer as I
+    if default_initializer is None:
+        default_initializer = I.Constant(0.0) if is_bias \
+            else I.XavierUniform()       # seeded by paddle.seed
+    if isinstance(default_initializer, I.Initializer):
+        val = default_initializer(tuple(shape), dtype)
+    else:                                # callable applied to a prototype
+        from ..framework.core import Tensor as _T
+        proto = _T(jnp.zeros(tuple(shape), dtype))
+        default_initializer(proto)
+        val = proto._value
+    p = Parameter(val)
+    if name:
+        p.name = name
+    return p
+
+
+# ----------------------------------------------------------------- scopes
+class Scope:
+    """Name -> Tensor registry (reference: framework/scope.h). The XLA
+    runtime owns real variable lifetime; this serves the find_var/get
+    legacy API."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        from ..framework.core import Tensor
+        import jax.numpy as jnp
+        if name not in self.vars:
+            self.vars[name] = Tensor(jnp.zeros((), jnp.float32),
+                                     stop_gradient=True)
+        return self.vars[name]
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ------------------------------------------------- legacy executor shells
+class BuildStrategy:
+    """Graph-build knobs (reference: details/build_strategy.h). XLA fuses
+    and schedules; the attributes are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+        self.gradient_scale_strategy = None
+        self.build_cinn_pass = False
+
+    def __setattr__(self, k, v):        # accept any knob, like the pybind
+        object.__setattr__(self, k, v)  # struct does
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_thread_barrier = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class CompiledProgram:
+    """Reference: compiler.py CompiledProgram — wraps a program with build
+    strategies. XLA compiles on first run, so this records and passes
+    through."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        return self
+
+    def __call__(self, *args, **kwargs):
+        return self._program(*args, **kwargs) if callable(self._program) \
+            else self._program
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor shell (reference:
+    framework/parallel_executor.cc). The SPMD mesh replaces it; runs the
+    program via the standard Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        self._program = main_program
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference: fluid/layers/control_flow.py Print):
+    eager-prints and passes the tensor through."""
+    vals = np.asarray(input._value).reshape(-1)[:summarize]
+    head = (message + " ") if message else ""
+    name = getattr(input, "name", "") if print_tensor_name else ""
+    print(f"{head}{name} shape={tuple(input._value.shape)} "
+          f"dtype={input._value.dtype} values={vals}")
+    return input
+
+
+class WeightNormParamAttr:
+    """Reference: fluid/param_attr.py WeightNormParamAttr — marks a param
+    for weight normalization along `dim` (consumed by nn.utils.weight_norm
+    here)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference:
+    fluid/optimizer.py ExponentialMovingAverage): update() after each step,
+    apply()/restore() swap the shadow weights in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._shadow = {}
+        self._backup = {}
+        self._params = None
+        self._step = 0
+
+    def _targets(self):
+        if self._params is None:
+            self._params = [p for p in _live_parameters()
+                            if not p.stop_gradient]
+        return self._params
+
+    def register(self, parameters=None):
+        self._params = list(parameters) if parameters is not None else None
+        for p in self._targets():
+            self._shadow[id(p)] = p._value
+        return self
+
+    def update(self):
+        import jax.numpy as jnp
+        self._step += 1
+        # reference semantics: the (1+t)/(10+t) warmup ramp applies only
+        # when thres_steps is given; otherwise decay is constant
+        d = self._decay if self._thres_steps is None else \
+            min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._targets():
+            prev = self._shadow.get(id(p), p._value)
+            self._shadow[id(p)] = (d * prev.astype(jnp.float32)
+                                   + (1 - d) * p._value.astype(jnp.float32))
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._targets()}
+        for p in self._targets():
+            if id(p) in self._shadow:
+                p._value = self._shadow[id(p)].astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._targets():
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}
+
+
+# ------------------------------------------------------------- metrics
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy (reference: static/nn/metric.py accuracy)."""
+    import jax.numpy as jnp
+    logits = input._value
+    lab = label._value.reshape(-1)
+    topk = jnp.argsort(-logits, axis=-1)[:, :k]
+    hit = (topk == lab[:, None]).any(axis=-1)
+    return _tensor_cls()(jnp.mean(hit.astype(jnp.float32)),
+                         stop_gradient=True)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Area under the ROC curve of P(class 1) (reference:
+    static/nn/metric.py auc)."""
+    import jax.numpy as jnp
+    probs = np.asarray(input._value)
+    pos_score = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+        else probs.reshape(-1)
+    lab = np.asarray(label._value).reshape(-1)
+    order = np.argsort(-pos_score)
+    lab = lab[order]
+    tps = np.cumsum(lab)
+    fps = np.cumsum(1 - lab)
+    tpr = tps / max(tps[-1], 1)
+    fpr = fps / max(fps[-1], 1)
+    val = float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+        else float(np.trapz(tpr, fpr))
+    return _tensor_cls()(jnp.asarray(val, jnp.float32), stop_gradient=True)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR eval bundle: (auc, mae, rmse, predicted_ctr, actual_ctr)
+    (reference: static/nn/metric.py ctr_metric_bundle)."""
+    import jax.numpy as jnp
+    T = _tensor_cls()
+    probs = np.asarray(input._value).reshape(-1)
+    lab = np.asarray(label._value).reshape(-1).astype(np.float32)
+    a = auc(input, label)
+    mae = float(np.abs(probs - lab).mean())
+    rmse = float(np.sqrt(((probs - lab) ** 2).mean()))
+    return (a, T(jnp.asarray(mae, jnp.float32), stop_gradient=True),
+            T(jnp.asarray(rmse, jnp.float32), stop_gradient=True),
+            T(jnp.asarray(float(probs.mean()), jnp.float32),
+              stop_gradient=True),
+            T(jnp.asarray(float(lab.mean()), jnp.float32),
+              stop_gradient=True))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Reference: fluid/layers/learning_rate_scheduler.py —
+    lr * decay_rate^(step/decay_steps), with staircase flooring the
+    exponent (flat plateaus of decay_steps)."""
+    from ..optimizer.lr import LRScheduler
+
+    class _ExpDecayBySteps(LRScheduler):
+        def get_lr(self):
+            t = max(self.last_epoch, 0) / float(decay_steps)
+            if staircase:
+                t = float(int(t))
+            return self.base_lr * (decay_rate ** t)
+
+    return _ExpDecayBySteps(learning_rate=learning_rate)
+
+
+# ------------------------------------------------------------- places
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference: static/device_guard — pins op placement. XLA/GSPMD place
+    ops; accepted for parity."""
+    yield
+
+
+def cpu_places(device_count=None):
+    import jax
+    cpus = jax.devices("cpu")
+    return cpus[:device_count] if device_count else cpus
+
+
+def cuda_places(device_ids=None):
+    return []          # no CUDA in the TPU build
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def npu_places(device_ids=None):
+    return []
+
+
+def mlu_places(device_ids=None):
+    return []
+
+
+# ------------------------------------------------- program serialization
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Program bytes (reference: static/io.py serialize_program). The
+    TPU-native program is the jax.export StableHLO blob."""
+    import pickle
+    from ..jit.api import save as jit_save
+    import tempfile, os as _os
+    layer = program if program is not None else fetch_vars
+    with tempfile.TemporaryDirectory() as td:
+        path = _os.path.join(td, "prog.pdmodel")
+        jit_save(layer, path, input_spec=list(feed_vars) if feed_vars
+                 else None)
+        from ..framework.io import load as fload
+        payload = fload(path)
+    # program only, no persistables — but NON-persistable buffers are part
+    # of the program machinery, not the weights: keep their slot values so
+    # set_state can re-arm the artifact
+    keys = payload.get("export_state_keys") or []
+    export_state = payload.pop("export_state", None) or []
+    payload["export_state_aux"] = {
+        i: v for i, (k, v) in enumerate(zip(keys, export_state))
+        if k is None}
+    payload.pop("state_dict", None)
+    return pickle.dumps(payload)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """Weight bytes (reference: static/io.py serialize_persistables)."""
+    import pickle
+    layer = program if program is not None else fetch_vars
+    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    from ..jit.api import TranslatedLayer
+    return TranslatedLayer(pickle.loads(data))
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    return pickle.loads(data)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference: static/io.py normalize_program prunes to the inference
+    graph; export already captures exactly the forward, so identity."""
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    """paddle.static.save: persist a Layer-backed 'program' state
+    (reference: static/io.py save -> .pdparams/.pdopt)."""
+    from ..framework import io as _io
+    target = getattr(program, "_program", program)
+    _io.save(target.state_dict() if hasattr(target, "state_dict")
+             else target, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework import io as _io
+    state = _io.load(model_path + ".pdparams")
+    target = getattr(program, "_program", program)
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework import io as _io
+    state = _io.load(model_path + ".pdparams")
+    return {k: np.asarray(v._value) if hasattr(v, "_value") else
+            np.asarray(v) for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    target = getattr(program, "_program", program)
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state_dict)
+
+
+# ------------------------------------------------------------- IPU shims
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU support is vendor-specific and not part of the TPU build; "
+        "use the mesh axes (paddle_tpu.distributed) for placement")
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU support is vendor-specific and not part of the TPU build")
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU support is vendor-specific and not part of the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU support is vendor-specific and not part of the TPU build")
