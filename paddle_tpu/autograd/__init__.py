@@ -5,10 +5,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..framework.autograd import grad, no_grad, enable_grad, set_grad_enabled  # noqa: F401
+from ..framework.autograd import (  # noqa: F401
+    grad, no_grad, enable_grad, set_grad_enabled, saved_tensors_hooks)
 from ..framework.core import Tensor
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "saved_tensors_hooks",
            "PyLayer", "PyLayerContext", "jacobian", "hessian", "vjp", "jvp"]
 
 

@@ -142,3 +142,37 @@ class MLUPlace(_PlaceBase):
 
 class IPUPlace(_PlaceBase):
     device_type = "ipu"
+
+
+def get_cudnn_version():
+    """No cuDNN in the TPU build (reference: device/__init__.py returns
+    None when not compiled with CUDA)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def get_available_custom_device():
+    """Custom-device inventory (reference: device/__init__.py) — the TPU
+    build's accelerators surface through jax."""
+    import jax
+    try:
+        return [f"{d.platform}:{d.id}" for d in jax.devices()
+                if d.platform not in ("cpu",)]
+    except RuntimeError:
+        return []
+
+
+__all__ += ["get_cudnn_version", "is_compiled_with_ipu",
+            "is_compiled_with_cinn", "is_compiled_with_mlu",
+            "get_available_custom_device"]

@@ -90,7 +90,8 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "edges", "out_avals", "pending",
-                 "out_hooks", "retain_count", "fwd_fn", "in_vals")
+                 "out_hooks", "retain_count", "fwd_fn", "in_vals",
+                 "unpack_hook")
 
     def __init__(self, name, vjp_fn, edges, out_avals):
         self.name = name
@@ -108,6 +109,7 @@ class GradNode:
         # TPU-first analog of eager/general_grad.h double-grad nodes)
         self.fwd_fn = None
         self.in_vals = None
+        self.unpack_hook = None
 
     # -- engine interface ---------------------------------------------------
     def add_grad(self, out_index: int, g):
@@ -147,6 +149,57 @@ class GradNode:
 
 
 _RELEASED = object()
+
+# ---------------------------------------------------------------------------
+# saved-tensors hooks (reference: python/paddle/autograd
+# saved_tensors_hooks / eager/saved_tensors_hooks.cc). Scope here: the
+# tape's REPLAY-saved input values (GradNode.in_vals, consumed by
+# create_graph double-grad replay) — XLA owns its vjp residuals, so the
+# canonical pack-to-host memory trade applies to the tape-held state.
+
+_saved_tensor_hooks = []
+
+
+class saved_tensors_hooks:
+    """Context manager: `pack_hook(tensor)` runs when the tape saves a
+    tensor, its result is stored instead; `unpack_hook(packed)` runs when
+    backward/replay needs the value back."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.pop()
+        return False
+
+
+def pack_saved_values(vals, edges=None):
+    """Called by the dispatch funnel at record time: returns
+    (stored_values, unpack_hook_or_None). Only inputs that replay will
+    actually READ from in_vals (edge is None — stop-gradient constants)
+    are packed; differentiable inputs replay through their producer edges,
+    so packing them would run side-effectful hooks for values never
+    unpacked."""
+    if not _saved_tensor_hooks:
+        return vals, None
+    from .core import Tensor
+    pack, unpack = _saved_tensor_hooks[-1]
+    stored = tuple(
+        pack(Tensor(v, stop_gradient=True))
+        if edges is None or edges[i] is None else v
+        for i, v in enumerate(vals))
+    return stored, unpack
+
+
+def _run_unpack(unpack, packed):
+    from .core import Tensor
+    out = unpack(packed)
+    return out._value if isinstance(out, Tensor) else jnp.asarray(out)
 
 
 class AccumulationNode(GradNode):
@@ -311,7 +364,10 @@ def replay_pure(outputs, inputs):
             args = []
             for i, edge in enumerate(node.edges):
                 if edge is None:
-                    args.append(node.in_vals[i])
+                    v = node.in_vals[i]
+                    if node.unpack_hook is not None:
+                        v = _run_unpack(node.unpack_hook, v)
+                    args.append(v)
                 else:
                     args.append(value_of(*edge))
             outs = node.fwd_fn(*args)

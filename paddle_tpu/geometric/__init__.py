@@ -129,6 +129,26 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     return call_op("send_uv", fn, (x, y))
 
 
+def _reindex_impl(x_np, nbrs, cnts):
+    """Shared id-compaction: ids keep x first, then new neighbor ids in
+    order of first appearance (the paddle reindex semantics); returns
+    (reindexed neighbor lists, dst lists, out_nodes)."""
+    order = {}
+    for v in x_np:
+        if v not in order:
+            order[v] = len(order)
+    for nbr in nbrs:
+        for v in nbr:
+            if v not in order:
+                order[v] = len(order)
+    remap = np.vectorize(order.__getitem__)
+    re_nbrs = [remap(n) if n.size else n for n in nbrs]
+    out_nodes = np.array(sorted(order, key=order.__getitem__))
+    dsts = [np.repeat(remap(x_np), c) if c.size else np.array([], np.int64)
+            for c in cnts]
+    return re_nbrs, dsts, out_nodes
+
+
 def reindex_graph(x, neighbors, count, name=None):
     """Compact global node ids to local contiguous ids. Reference analog:
     geometric/reindex.py reindex_graph. Host-side (index bookkeeping, not a
@@ -136,19 +156,9 @@ def reindex_graph(x, neighbors, count, name=None):
     x_np = np.asarray(ensure_tensor(x)._value)
     nbr = np.asarray(ensure_tensor(neighbors)._value)
     cnt = np.asarray(ensure_tensor(count)._value)
-    # paddle semantics: ids keep x first, then new neighbor ids in order of
-    # first appearance
-    order = {}
-    for v in np.concatenate([x_np, nbr]):
-        if v not in order:
-            order[v] = len(order)
-    remap = np.vectorize(order.__getitem__)
-    reindex_nbr = remap(nbr) if nbr.size else nbr
-    out_nodes = np.array(sorted(order, key=order.__getitem__))
-    # edge dst repeated per count
-    dst = np.repeat(remap(x_np), cnt) if cnt.size else np.array([], np.int64)
-    return (Tensor(jnp.asarray(reindex_nbr.astype(np.int64))),
-            Tensor(jnp.asarray(dst.astype(np.int64))),
+    re_nbrs, dsts, out_nodes = _reindex_impl(x_np, [nbr], [cnt])
+    return (Tensor(jnp.asarray(re_nbrs[0].astype(np.int64))),
+            Tensor(jnp.asarray(dsts[0].astype(np.int64))),
             Tensor(jnp.asarray(out_nodes.astype(np.int64))))
 
 
@@ -183,3 +193,22 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                    else np.array([], np.int64))
         outs += (Tensor(jnp.asarray(sampled.astype(np.int64))),)
     return outs
+
+
+def reindex_heter_graph(x, neighbors, count, name=None):
+    """Reindex a heterogeneous graph: per-edge-type neighbor/count lists
+    share ONE node-id mapping (reference: geometric/reindex.py
+    reindex_heter_graph)."""
+    from ..framework.core import Tensor as _T
+    xs = np.asarray(ensure_tensor(x)._value)
+    nbrs = [np.asarray(ensure_tensor(n)._value) for n in neighbors]
+    cnts = [np.asarray(ensure_tensor(c)._value) for c in count]
+    re_nbrs, dsts, out_nodes = _reindex_impl(xs, nbrs, cnts)
+    cat = lambda arrs: (np.concatenate(arrs) if arrs
+                        else np.array([], np.int64))
+    return (_T(jnp.asarray(cat(re_nbrs).astype(np.int64))),
+            _T(jnp.asarray(cat(dsts).astype(np.int64))),
+            _T(jnp.asarray(out_nodes.astype(np.int64))))
+
+
+__all__.append("reindex_heter_graph")

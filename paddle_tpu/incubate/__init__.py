@@ -1,7 +1,140 @@
-"""paddle.incubate equivalent."""
+"""paddle.incubate equivalent (reference: python/paddle/incubate/__init__.py
+__all__: LookAhead/ModelAverage optimizers, fused softmax-mask ops, graph
+ops, segment reductions, identity_loss)."""
 from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import models  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# graph/segment ops live in paddle_tpu.geometric; the incubate names are
+# the reference's older aliases for the same kernels
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Reference: incubate/operators/graph_send_recv.py — the older name
+    for geometric.send_u_recv."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Reference: incubate/operators/graph_sample_neighbors.py."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reference: incubate/operators/graph_reindex.py."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference:
+    incubate/operators/graph_khop_sampler.py): sample each hop from the
+    previous frontier, then reindex the union. Returns
+    (edge_src, edge_dst, sample_index, reindex_nodes) (+ edge_eids)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    from ..geometric import sample_neighbors, reindex_graph
+    frontier = input_nodes
+    all_nbr, all_dst_nodes, all_cnt, all_eids = [], [], [], []
+    for size in sample_sizes:
+        outs = sample_neighbors(row, colptr, frontier, sample_size=size,
+                                eids=sorted_eids, return_eids=return_eids)
+        nbr, cnt = outs[0], outs[1]
+        if return_eids:
+            all_eids.append(np.asarray(outs[2]._value))
+        all_nbr.append(np.asarray(nbr._value))
+        all_cnt.append(np.asarray(cnt._value))
+        all_dst_nodes.append(np.asarray(
+            (frontier._value if isinstance(frontier, Tensor)
+             else jnp.asarray(frontier))))
+        # next frontier: unique new neighbors, order of first appearance
+        frontier = Tensor(jnp.asarray(
+            np.unique(np.asarray(nbr._value)).astype(np.int64)))
+    neighbors = np.concatenate(all_nbr) if all_nbr else np.array([], np.int64)
+    counts = np.concatenate(all_cnt) if all_cnt else np.array([], np.int64)
+    centers = np.concatenate(all_dst_nodes) if all_dst_nodes else \
+        np.array([], np.int64)
+    reindex_src, reindex_dst, out_nodes = reindex_graph(
+        Tensor(jnp.asarray(centers.astype(np.int64))),
+        Tensor(jnp.asarray(neighbors.astype(np.int64))),
+        Tensor(jnp.asarray(counts.astype(np.int64))))
+    # reference contract: sample_index = ORIGINAL ids aligned with the
+    # local ids (features[sample_index] rows match reindexed edges);
+    # reindex_nodes = local ids of the INPUT nodes
+    in_np = np.asarray((input_nodes._value if isinstance(input_nodes,
+                                                         Tensor)
+                        else jnp.asarray(input_nodes))).reshape(-1)
+    out_np = np.asarray(out_nodes._value)
+    local_of = {int(v): i for i, v in enumerate(out_np)}
+    reindex_nodes = Tensor(jnp.asarray(
+        np.array([local_of[int(v)] for v in in_np], np.int64)))
+    res = (reindex_src, reindex_dst, out_nodes, reindex_nodes)
+    if return_eids:
+        eids = np.concatenate(all_eids) if all_eids else np.array([],
+                                                                  np.int64)
+        res = res + (Tensor(jnp.asarray(eids.astype(np.int64))),)
+    return res
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) over the last dim in one fused program
+    (reference: incubate/operators/softmax_mask_fuse.py over
+    fused_softmax_mask kernels — XLA fuses the add into the softmax)."""
+    import jax
+    from ..framework.core import Tensor
+    from ..ops._helpers import ensure_tensor
+    from ..ops.dispatch import call_op
+    xv = ensure_tensor(x)
+    mv = ensure_tensor(mask)
+    return call_op("softmax_mask_fuse",
+                   lambda a, m: jax.nn.softmax(a + m, axis=-1),
+                   [xv, mv])
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle-masked) softmax for GPT attention scores
+    [B, H, T, T] (reference:
+    incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops._helpers import ensure_tensor
+    from ..ops.dispatch import call_op
+
+    def fn(a):
+        t = a.shape[-1]
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e9), axis=-1)
+
+    return call_op("softmax_mask_fuse_upper_triangle", fn,
+                   [ensure_tensor(x)])
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss head (reference:
+    fluid/layers/loss.py:1311): reduction 0/'sum', 1/'mean', 2/'none'."""
+    from .. import ops
+    if reduction in (0, "sum"):
+        return ops.sum(x)
+    if reduction in (1, "mean"):
+        return ops.mean(x)
+    if reduction in (2, "none"):
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")

@@ -1,0 +1,133 @@
+"""Incubate optimizers: LookAhead, ModelAverage.
+
+Reference analogs: python/paddle/incubate/optimizer/lookahead.py:25
+(slow/fast weights, sync every k steps) and modelaverage.py (running
+average of parameters with apply/restore windows).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead (https://arxiv.org/abs/1907.08610): the inner optimizer
+    updates the fast weights every step; every k steps the slow weights
+    move alpha of the way toward the fast weights and the fast weights
+    reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._parameter_list
+                if not p.stop_gradient]
+
+    def step(self):
+        if not self._slow:
+            for p in self._params():
+                self._slow[id(p)] = p._value
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            a = self.alpha
+            for p in self._params():
+                slow = self._slow.get(id(p), p._value)
+                slow = slow + a * (p._value - slow)
+                self._slow[id(p)] = slow
+                p._value = slow.astype(p._value.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_steps"] = self._steps
+        return sd
+
+    def set_state_dict(self, state):
+        self._steps = state.get("lookahead_steps", 0)
+        inner = {k: v for k, v in state.items() if k != "lookahead_steps"}
+        self.inner_optimizer.set_state_dict(inner)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window; apply() swaps
+    the averaged weights in for evaluation, restore() swaps back
+    (reference: incubate/optimizer/modelaverage.py)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = list(parameters or [])
+        self._sum = {}
+        self._count = {}
+        self._backup = {}
+
+    def _targets(self):
+        return [p for p in self._params if not p.stop_gradient]
+
+    def step(self):
+        """Accumulate the current weights into the running window."""
+        for p in self._targets():
+            k = id(p)
+            n = self._count.get(k, 0)
+            window = max(self.min_average_window,
+                         min(self.max_average_window,
+                             int(n * self.average_window_rate) or 1))
+            if n >= window:
+                # restart the window (reference's num_updates rollover)
+                self._sum[k] = p._value.astype(jnp.float32)
+                self._count[k] = 1
+            else:
+                self._sum[k] = self._sum.get(
+                    k, jnp.zeros_like(p._value, jnp.float32)) \
+                    + p._value.astype(jnp.float32)
+                self._count[k] = n + 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._targets()}
+        for p in self._targets():
+            k = id(p)
+            if self._count.get(k):
+                avg = self._sum[k] / self._count[k]
+                p._value = avg.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._targets():
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}

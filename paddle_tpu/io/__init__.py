@@ -14,4 +14,5 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, BatchSampler,
     DistributedBatchSampler, WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, get_worker_info, WorkerInfo)

@@ -26,7 +26,7 @@ from ..framework.core import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn"]
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info", "WorkerInfo"]
 
 
 def _np_collate(batch):
@@ -62,10 +62,15 @@ def _to_tensors(data):
 
 
 def _worker_loop(dataset, task_q, result_q, worker_id, worker_init_fn,
-                 raw_samples):
+                 raw_samples, num_workers=0, base_seed=0):
     """Body of one worker subprocess (reference:
     dataloader_iter.py _worker_loop). Pulls (batch_idx, indices), pushes
     (batch_idx, payload) — numpy only."""
+    global _worker_info
+    # per-worker distinct seed (reference: base_seed + worker_id), so
+    # random augmentations differ across workers
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset,
+                              seed=base_seed + worker_id)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -119,10 +124,12 @@ class _MultiprocessProducer:
         self._timeout = timeout
         self._depth = max(1, num_workers * max(prefetch_factor, 1))
         self._workers = []
+        base_seed = int(np.random.SeedSequence().entropy % (2 ** 31))
         for w in range(num_workers):
             p = ctx.Process(target=_worker_loop,
                             args=(dataset, self._task_q, self._result_q, w,
-                                  worker_init_fn, raw_samples),
+                                  worker_init_fn, raw_samples, num_workers,
+                                  base_seed),
                             daemon=True)
             p.start()
             self._workers.append(p)
@@ -314,3 +321,23 @@ class DataLoader:
             return _PrefetchIterator(self._produce,
                                      prefetch=self.prefetch_factor)
         return self._produce()
+
+
+class WorkerInfo:
+    """Reference: fluid/dataloader/worker.py WorkerInfo — identifies the
+    current DataLoader worker process."""
+
+    def __init__(self, id, num_workers, dataset, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: its WorkerInfo; in the main process:
+    None (reference: fluid/dataloader/worker.py get_worker_info)."""
+    return _worker_info

@@ -144,6 +144,12 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
+        from . import ProgramTranslator
+        if not ProgramTranslator().enable_to_static:
+            # reference: ProgramTranslator.enable(False) runs dygraph
+            fwd = self._layer.forward if self._layer is not None \
+                else self._function
+            return fwd(*args, **kwargs)
         params, buffers = _collect_state(
             self._layer if self._layer is not None else self._function)
         tensor_args = []

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
-from ..framework.autograd import GradNode, is_grad_enabled
+from ..framework.autograd import pack_saved_values as _pack_saved, GradNode, is_grad_enabled
 from ..framework.flags import _FLAGS
 
 __all__ = ["call_op", "call_op_multi"]
@@ -119,7 +119,7 @@ def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Te
     node = GradNode(name, wrapped_vjp, _make_edges(inputs),
                     ((out_val.shape, out_val.dtype),))
     node.fwd_fn = fn
-    node.in_vals = vals
+    node.in_vals, node.unpack_hook = _pack_saved(vals, node.edges)
     out = Tensor(out_val, stop_gradient=False)
     out._grad_node = node
     out._out_index = 0
@@ -169,7 +169,7 @@ def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
     node = GradNode(name, wrapped_vjp, _make_edges(inputs),
                     tuple((v.shape, v.dtype) for v in out_vals))
     node.fwd_fn = fn
-    node.in_vals = vals
+    node.in_vals, node.unpack_hook = _pack_saved(vals, node.edges)
     outs = []
     for j, v in enumerate(out_vals):
         t = Tensor(v, stop_gradient=False)

@@ -3,3 +3,31 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_IMAGE_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    """Reference: vision/image.py set_image_backend ('pil' or 'cv2')."""
+    global _IMAGE_BACKEND
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"backend must be 'pil' or 'cv2', got {backend!r}")
+    _IMAGE_BACKEND = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference: vision/image.py image_load). With the
+    'pil' backend returns a PIL.Image; 'cv2' is not bundled here and raises
+    with the alternative."""
+    backend = backend or _IMAGE_BACKEND
+    if backend == "cv2":
+        raise RuntimeError(
+            "the cv2 backend is not bundled in the TPU build; use "
+            "set_image_backend('pil')")
+    from PIL import Image
+    return Image.open(path)    # mode preserved (grayscale/palette/RGBA)

@@ -1,0 +1,191 @@
+"""Surface completion extras (round 4): incubate graph/segment/fused ops,
+LookAhead/ModelAverage, saved_tensors_hooks, worker info, jit
+ProgramTranslator switch, vision image backend, device probes.
+
+Reference analogs: python/paddle/incubate/__init__.py __all__,
+autograd/saved_tensors_hooks, fluid/dataloader/worker.py,
+dygraph_to_static/program_translator.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.autograd as autograd
+
+
+class TestIncubateGraphOps:
+    def test_graph_send_recv_aliases_send_u_recv(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        src = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        dst = paddle.to_tensor(np.array([1, 1, 0], np.int64))
+        out = incubate.graph_send_recv(x, src, dst, pool_type="sum")
+        want = np.zeros((3, 2), np.float32)
+        want[1] = x.numpy()[0] + x.numpy()[1]
+        want[0] = x.numpy()[2]
+        np.testing.assert_allclose(out.numpy(), want)
+
+    def test_segment_reexports(self):
+        data = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(
+            incubate.segment_sum(data, ids).numpy(), [3.0, 7.0])
+        np.testing.assert_allclose(
+            incubate.segment_mean(data, ids).numpy(), [1.5, 3.5])
+
+    def test_graph_khop_sampler(self):
+        # CSC chain graph: 0<-1<-2<-3 (colptr over 4 nodes)
+        row = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 1, 2, 3, 3], np.int64))
+        nodes = paddle.to_tensor(np.array([0], np.int64))
+        src, dst, sample_index, reindex_nodes = incubate.graph_khop_sampler(
+            row, colptr, nodes, sample_sizes=[1, 1])
+        # sample_index: ORIGINAL ids aligned with local ids, inputs first
+        assert sample_index.numpy()[0] == 0
+        assert set(sample_index.numpy().tolist()) == {0, 1, 2}
+        # reindex_nodes: local ids of the input nodes
+        np.testing.assert_array_equal(reindex_nodes.numpy(), [0])
+        assert len(src.numpy()) == len(dst.numpy()) == 2
+        # edges reference valid local ids
+        n_local = len(sample_index.numpy())
+        assert (src.numpy() < n_local).all() and \
+            (dst.numpy() < n_local).all()
+
+    def test_softmax_mask_fuse(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 2, 4), np.float32))
+        mask = paddle.to_tensor(
+            np.array([0, 0, -1e9, -1e9], np.float32).reshape(1, 1, 1, 4))
+        out = incubate.softmax_mask_fuse(x, mask).numpy()
+        np.testing.assert_allclose(out[0, 0, 0], [0.5, 0.5, 0, 0],
+                                   atol=1e-6)
+
+    def test_softmax_mask_fuse_upper_triangle_is_causal(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32))
+        out = incubate.softmax_mask_fuse_upper_triangle(x).numpy()[0, 0]
+        np.testing.assert_allclose(out[0], [1, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(out[2], [1 / 3] * 3, atol=1e-6)
+
+    def test_identity_loss_reductions(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        assert float(incubate.identity_loss(x, 0).numpy()) == 6.0
+        assert float(incubate.identity_loss(x, "mean").numpy()) == 2.0
+        np.testing.assert_allclose(
+            incubate.identity_loss(x, "none").numpy(), [1, 2, 3])
+        with pytest.raises(ValueError):
+            incubate.identity_loss(x, "bogus")
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_syncs_slow_weights(self):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        inner = paddle.optimizer.SGD(0.5, parameters=[w])
+        la = incubate.LookAhead(inner, alpha=0.5, k=2)
+        # two steps of d(loss)/dw = 1 -> fast goes 1.0 -> 0.0; slow syncs
+        # to 1.0 + 0.5*(0.0 - 1.0) = 0.5 at step k
+        for _ in range(2):
+            loss = w.sum()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        np.testing.assert_allclose(np.asarray(w._value), 0.5, atol=1e-6)
+
+    def test_lookahead_validates(self):
+        inner = paddle.optimizer.SGD(
+            0.1, parameters=[paddle.to_tensor(np.ones(1, np.float32),
+                                              stop_gradient=False)])
+        with pytest.raises(ValueError):
+            incubate.LookAhead(inner, alpha=2.0)
+        with pytest.raises(ValueError):
+            incubate.LookAhead(inner, k=0)
+
+    def test_model_average_apply_restore(self):
+        w = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        ma = incubate.ModelAverage(1.0, parameters=[w],
+                                   min_average_window=100)
+        for v in (1.0, 2.0, 3.0):
+            w._value = np.full(3, v, np.float32) + 0 * w._value
+            ma.step()
+        with ma.apply():
+            np.testing.assert_allclose(np.asarray(w._value), 2.0)
+        np.testing.assert_allclose(np.asarray(w._value), 3.0)  # restored
+
+
+class TestSavedTensorsHooks:
+    def test_pack_unpack_roundtrip_through_double_grad(self):
+        # a saved CONSTANT operand (stop_gradient) must round-trip through
+        # pack at record time and unpack at double-grad replay;
+        # differentiable operands replay through their producer edges
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        c = paddle.to_tensor(np.array([5.0, 7.0], np.float32))
+        events = []
+        with autograd.saved_tensors_hooks(
+                lambda t: (events.append("pack"),
+                           np.asarray(t._value))[1],
+                lambda p: (events.append("unpack"),
+                           paddle.to_tensor(p))[1]):
+            y = (x * x * c).sum()
+        g = paddle.grad(y, x, create_graph=True)[0]   # 2xc
+        g2 = paddle.grad(g.sum(), x)[0]               # 2c, via replay
+        np.testing.assert_allclose(np.asarray(g2._value),
+                                   2 * np.array([5.0, 7.0]), rtol=1e-5)
+        assert "pack" in events and "unpack" in events
+
+    def test_hooks_scope_exits(self):
+        from paddle_tpu.framework.autograd import _saved_tensor_hooks
+        with autograd.saved_tensors_hooks(lambda t: t, lambda p: p):
+            assert len(_saved_tensor_hooks) == 1
+        assert len(_saved_tensor_hooks) == 0
+
+
+class TestWorkerInfo:
+    def test_main_process_returns_none(self):
+        assert paddle.io.get_worker_info() is None
+
+
+class TestProgramTranslatorSwitch:
+    def test_enable_false_runs_dygraph(self):
+        from paddle_tpu.jit import ProgramTranslator
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        try:
+            ProgramTranslator().enable(False)
+            out = f(x)
+            np.testing.assert_allclose(np.asarray(out._value), 2.0)
+            assert len(f._jitted) == 0       # nothing was traced/compiled
+        finally:
+            ProgramTranslator().enable(True)
+        f(x)
+        assert len(f._jitted) == 1           # jit path restored
+
+
+class TestVisionImageBackend:
+    def test_backend_roundtrip_and_load(self, tmp_path):
+        import paddle_tpu.vision as vision
+        assert vision.get_image_backend() == "pil"
+        with pytest.raises(ValueError):
+            vision.set_image_backend("turbo")
+        from PIL import Image
+        p = str(tmp_path / "img.png")
+        Image.fromarray(np.full((4, 4, 3), 128, np.uint8)).save(p)
+        img = vision.image_load(p)
+        assert img.size == (4, 4)
+
+
+class TestDeviceProbes:
+    def test_probes(self):
+        import paddle_tpu.device as device
+        assert device.get_cudnn_version() is None
+        assert device.is_compiled_with_ipu() is False
+        assert device.is_compiled_with_cinn() is False
+        assert device.is_compiled_with_mlu() is False
+        assert isinstance(device.get_available_custom_device(), list)
