@@ -1,23 +1,37 @@
 """Benchmark suite: one JSON line per config, headline (GPT-2 train) LAST.
 
 Configs (BASELINE.md):
-  2: GPT-2 124M train   — tokens/s/chip + MFU (target 0.45)
-  5: ViT-L/16 train     — images/s, fused vs unfused (fused >= unfused)
-  serving: GPT-2 decode — ms/step, compiled per-token program (<= 0.08 ms)
+  2:  GPT-2 124M train   — tokens/s/chip + MFU (target 0.45)
+  2b: GPT-2 355M train   — tokens/s/chip + MFU (target 0.45)
+  2c: GPT-2 seq-4096 flash-attention train — tokens/s/chip + MFU
+  5:  ViT-L/16 train     — images/s, fused vs unfused (fused >= unfused)
+  serving: GPT-2 decode  — ms/step, compiled per-token program (<= 0.08 ms)
 
-Each config retries with backoff around transient compile-service faults
-(the round-3 bench died on `remote_compile ... Connection refused`), and
-saves a profiler trace under bench_traces/<platform>/<config>/ (reference
-analog: profiler/timer.py ips + operators/benchmark/op_tester.cc).
+Hang-proof architecture (rounds 3/4 produced rc=1 / rc=124 because the TPU
+tunnel can HANG — not raise — inside backend init or compile, and an
+in-process retry loop cannot interrupt a hung C++ call):
 
-The LAST stdout line is the headline GPT-2 record whose "extra" embeds the
-other configs' results, so a driver that parses only one JSON line still
-captures everything.
+  parent (no jax import, pure orchestration)
+    ├─ `bench.py --probe`            subprocess, hard timeout ≤120 s
+    │     prints the live platform; timeout/err ⇒ platform=cpu
+    ├─ `bench.py --config NAME ...`  one subprocess per config, each with a
+    │     hard timeout budgeted against a global wall-clock deadline
+    │     (BENCH_BUDGET_S, default 840 s); a hung TPU config is killed and
+    │     retried once on CPU so a record ALWAYS exists
+    └─ headline record printed LAST with every sub-config embedded; exit 0
+       whenever the headline exists (tpu or cpu), nonzero only if even the
+       CPU fallback failed.
+
+Every record carries a top-level "platform". Reference analog for the
+harness: profiler/timer.py ips + operators/benchmark/op_tester.cc.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
+import sys
 import time
 import traceback
 
@@ -49,9 +63,10 @@ def _reset_backends():
         pass
 
 
-def with_retry(fn, name, attempts=4, delays=(15, 45, 90)):
+def with_retry(fn, name, attempts=3, delays=(10, 30), deadline=None):
     """Run fn(); on a transient compile-service fault, reset backends and
-    retry with backoff. Non-transient errors propagate immediately."""
+    retry with backoff. Non-transient errors propagate immediately. Never
+    sleeps past `deadline` (time.monotonic value)."""
     for i in range(attempts):
         try:
             return fn()
@@ -59,16 +74,13 @@ def with_retry(fn, name, attempts=4, delays=(15, 45, 90)):
             if not _is_transient(e) or i == attempts - 1:
                 raise
             delay = delays[min(i, len(delays) - 1)]
+            if deadline is not None and time.monotonic() + delay >= deadline:
+                raise
             print(json.dumps({"event": "retry", "config": name,
                               "attempt": i + 1, "sleep_s": delay,
                               "error": str(e)[:200]}), flush=True)
             _reset_backends()
             time.sleep(delay)
-
-
-def _platform():
-    import jax
-    return jax.devices()[0].platform
 
 
 def peak_flops_per_chip():
@@ -103,27 +115,18 @@ def _trace(config_name, platform, fn):
 
 
 # --------------------------------------------------------------------------
-# config 2: GPT-2 124M training
+# GPT training configs (124M headline, 355M, seq-4096 flash)
 # --------------------------------------------------------------------------
 
-def bench_gpt2_train(on_tpu):
+def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
-    from paddle_tpu.incubate.models import (GPTForCausalLM, gpt2_124m,
+    from paddle_tpu.incubate.models import (GPTForCausalLM,
                                             GPTPretrainingCriterion)
     from paddle_tpu.jit import TrainStep
 
-    seq = 1024
-    # batch sweep on v5e with the Pallas flash fwd+bwd path (2026-07):
-    # 8 -> 108.7k, 16 -> 111.5k, 24 -> 110.8k, 32 -> 103.8k tok/s
-    batch = 16 if on_tpu else 2
-    steps = 10 if on_tpu else 2
-
     paddle.seed(0)
-    cfg = gpt2_124m(hidden_dropout_prob=0.0,
-                    attention_probs_dropout_prob=0.0,
-                    max_position_embeddings=seq)
     model = GPTForCausalLM(cfg)
     n_params = model.num_params()
     if on_tpu:
@@ -155,18 +158,80 @@ def bench_gpt2_train(on_tpu):
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
 
     platform = jax.devices()[0].platform
-    tdir = _trace("gpt2_train", platform,
-                  lambda: float(step(x, y)))
+    tdir = _trace(trace_tag, platform, lambda: float(step(x, y)))
 
     return {
-        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
+        "platform": platform,
         "extra": {"mfu": round(mfu, 4), "loss": round(final, 3),
                   "batch": batch, "seq": seq, "params": n_params,
                   "platform": platform, "trace": tdir},
     }
+
+
+def bench_gpt2_train(on_tpu):
+    from paddle_tpu.incubate.models import gpt2_124m
+    seq = 1024
+    # batch sweep on v5e with the Pallas flash fwd+bwd path (2026-07):
+    # 8 -> 108.7k, 16 -> 111.5k, 24 -> 110.8k, 32 -> 103.8k tok/s
+    batch = 16 if on_tpu else 2
+    steps = 10 if on_tpu else 2
+    cfg = gpt2_124m(hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    max_position_embeddings=seq)
+    if not on_tpu:
+        from paddle_tpu.incubate.models import GPTConfig
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=256,
+                        max_position_embeddings=seq, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    return _gpt_train_record("gpt2_124m_train_tokens_per_sec_per_chip",
+                             cfg, batch, steps, seq, on_tpu, "gpt2_train")
+
+
+def bench_gpt2_355m(on_tpu):
+    """GPT-2 355M: bf16 weights + f32 AdamW masters ≈ 5 GB — fits v5e HBM.
+    BASELINE north-star ramp config 2→4 (VERDICT r4 item 2)."""
+    from paddle_tpu.incubate.models import gpt2_355m, GPTConfig
+    seq = 1024
+    if on_tpu:
+        cfg = gpt2_355m(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        max_position_embeddings=seq)
+        batch, steps = 8, 8
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_hidden_layers=4,
+                        num_attention_heads=4, intermediate_size=256,
+                        max_position_embeddings=seq, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        batch, steps = 2, 2
+    return _gpt_train_record("gpt2_355m_train_tokens_per_sec_per_chip",
+                             cfg, batch, steps, seq, on_tpu, "gpt2_355m")
+
+
+def bench_flash4096(on_tpu):
+    """Long-context case: GPT-2 124M at seq 4096 through the Pallas flash
+    fwd+bwd kernel (attention is ~30% of model FLOPs here, so this is the
+    kernel-bound config)."""
+    from paddle_tpu.incubate.models import gpt2_124m, GPTConfig
+    if on_tpu:
+        seq = 4096
+        cfg = gpt2_124m(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        max_position_embeddings=seq)
+        batch, steps = 4, 6
+    else:
+        seq = 256
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=256,
+                        max_position_embeddings=seq, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        batch, steps = 2, 2
+    return _gpt_train_record("gpt2_124m_seq4096_train_tokens_per_sec_per_chip",
+                             cfg, batch, steps, seq, on_tpu, "flash4096")
 
 
 # --------------------------------------------------------------------------
@@ -214,12 +279,12 @@ def _vit_images_per_sec(fused, on_tpu):
     platform = jax.devices()[0].platform
     tag = "vit_fused" if fused else "vit_unfused"
     tdir = _trace(tag, platform, lambda: float(step(x, y)))
-    return ips, mfu, tdir
+    return ips, mfu, tdir, platform
 
 
 def bench_vit(on_tpu):
-    fused_ips, fused_mfu, tdir = _vit_images_per_sec(True, on_tpu)
-    unfused_ips, unfused_mfu, _ = _vit_images_per_sec(False, on_tpu)
+    fused_ips, fused_mfu, tdir, platform = _vit_images_per_sec(True, on_tpu)
+    unfused_ips, unfused_mfu, _, _ = _vit_images_per_sec(False, on_tpu)
     ratio = fused_ips / unfused_ips
     return {
         "metric": "vit_l16_train_images_per_sec_fused",
@@ -227,10 +292,11 @@ def bench_vit(on_tpu):
         "unit": "images/s",
         # config-5 criterion: fused path >= unfused path
         "vs_baseline": round(ratio, 4),
+        "platform": platform,
         "extra": {"unfused_images_per_sec": round(unfused_ips, 1),
                   "fused_mfu": round(fused_mfu, 4),
                   "unfused_mfu": round(unfused_mfu, 4),
-                  "platform": _platform(),
+                  "platform": platform,
                   "trace": tdir},
     }
 
@@ -302,6 +368,7 @@ def bench_decode(on_tpu):
         "unit": "ms/step",
         # target from BASELINE.md: <= 0.08 ms/step at batch 8
         "vs_baseline": round(0.08 / ms_per_step, 4) if on_tpu else 0.0,
+        "platform": platform,
         "extra": {"batch": B, "buffer_len": T, "steps": steps,
                   "tokens_per_sec": round(B / (ms_per_step / 1e3), 1),
                   "platform": platform,
@@ -310,62 +377,167 @@ def bench_decode(on_tpu):
 
 
 # --------------------------------------------------------------------------
+# child / parent plumbing
+# --------------------------------------------------------------------------
 
-def main():
-    def init():
-        import jax
-        jax.devices()       # force backend bring-up inside the retry loop
-        return jax
+CONFIG_FNS = {
+    "vit": bench_vit,
+    "decode": bench_decode,
+    "flash4096": bench_flash4096,
+    "gpt2_355m": bench_gpt2_355m,
+    "gpt2_train": bench_gpt2_train,
+}
 
-    try:
-        jax = with_retry(init, "backend_init")
-    except Exception as e:
-        if not _is_transient(e):
-            raise       # install/version bugs must die loudly, not mask
-                        # themselves as an outage
-        # the TPU tunnel can be down for hours (round-3 outage): fall back
-        # to CPU with the platform EXPLICIT in every record rather than
-        # dying with no number at all
-        print(json.dumps({"event": "tpu_unreachable_falling_back_to_cpu",
-                          "error": str(e)[:200]}), flush=True)
+# per-config hard timeouts (seconds) when the probe said TPU; CPU smoke
+# versions are tiny and get a flat cap
+TPU_CAPS = {"vit": 180, "decode": 150, "flash4096": 210, "gpt2_355m": 240,
+            "gpt2_train": 280}
+CPU_CAP = 150
+HEADLINE = "gpt2_train"
+HEADLINE_RESERVE = 300      # wall-clock held back for the headline config
+PROBE_TIMEOUT = 120
+
+
+def _child_probe():
+    import jax
+    print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+
+
+def _child_config(name, platform, budget_s):
+    if platform == "cpu":
+        # force CPU in-process: the axon sitecustomize pre-imports jax with
+        # the tunnel platform, so JAX_PLATFORMS=cpu in the env does nothing
         import jax
         jax.config.update("jax_platforms", "cpu")
-        _reset_backends()
-        jax.devices()
+    import jax
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    deadline = time.monotonic() + budget_s
+    rec = with_retry(lambda: CONFIG_FNS[name](on_tpu), name,
+                     deadline=deadline)
+    print(json.dumps(rec), flush=True)
+
+
+def _run_child(argv, timeout):
+    """Run a bench child; return (record_dict | None, rc, note). Forwards
+    the child's non-record stdout lines for observability."""
+    cmd = [sys.executable, os.path.abspath(__file__)] + argv
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        out, rc, note = proc.stdout, proc.returncode, ""
+        if rc != 0:
+            note = (proc.stderr or "")[-400:]
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout if isinstance(e.stdout, str) else \
+            (e.stdout or b"").decode(errors="replace")
+        rc, note = 124, f"killed after {timeout:.0f}s hard timeout"
+    record = None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if "metric" in obj or "platform" in obj:
+            record = obj
+        else:
+            print(line, flush=True)
+    return record, rc, note
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--probe", action="store_true")
+    parser.add_argument("--config", choices=sorted(CONFIG_FNS))
+    parser.add_argument("--platform", default="default")
+    parser.add_argument("--budget-s", type=float, default=240.0)
+    args = parser.parse_args()
+
+    if args.probe:
+        _child_probe()
+        return
+    if args.config:
+        _child_config(args.config, args.platform, args.budget_s)
+        return
+
+    # ---------------- parent orchestrator (never imports jax) -------------
+    budget = float(os.environ.get("BENCH_BUDGET_S", 840))
+    deadline = time.monotonic() + budget
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    probe_rec, rc, note = _run_child(
+        ["--probe"], min(PROBE_TIMEOUT, max(30.0, remaining() - 120)))
+    platform = (probe_rec or {}).get("platform", "cpu")
+    if rc != 0:
+        platform = "cpu"
+    print(json.dumps({"event": "probe", "platform": platform, "rc": rc,
+                      "note": note[:200]}), flush=True)
+
+    def run_config(name, timeout, plat):
+        t0 = time.monotonic()
+        rec, rc, note = _run_child(
+            ["--config", name, "--platform", plat,
+             "--budget-s", str(max(30.0, timeout - 10))], timeout)
+        dur = time.monotonic() - t0
+        if rec is not None and rc == 0 and "metric" in rec:
+            rec.setdefault("platform", plat)
+            return rec
+        return {"metric": name, "error": note or f"rc={rc}", "rc": rc,
+                "platform": plat, "elapsed_s": round(dur, 1)}
 
     results = {}
-    for name, fn in (("vit", bench_vit), ("decode", bench_decode)):
-        try:
-            rec = with_retry(lambda f=fn: f(on_tpu), name)
-            results[name] = rec
-            print(json.dumps(rec), flush=True)
-        except Exception:
-            err = traceback.format_exc(limit=3)
-            results[name] = {"metric": name, "error": err[-400:]}
-            print(json.dumps({"event": "config_failed", "config": name,
-                              "error": err[-400:]}), flush=True)
+    for name in ("vit", "decode", "flash4096", "gpt2_355m"):
+        avail = remaining() - HEADLINE_RESERVE
+        if avail < 45:
+            results[name] = {"metric": name, "skipped": "budget_exhausted",
+                             "platform": platform}
+            print(json.dumps(results[name]), flush=True)
+            continue
+        cap = TPU_CAPS[name] if platform != "cpu" else CPU_CAP
+        rec = run_config(name, min(cap, avail), platform)
+        if "error" in rec and platform != "cpu":
+            # a hung/failed TPU config must still yield a number: CPU retry
+            avail = remaining() - HEADLINE_RESERVE
+            if avail >= 45:
+                print(json.dumps({"event": "cpu_retry", "config": name,
+                                  "cause": rec["error"][:200]}), flush=True)
+                rec = run_config(name, min(CPU_CAP, avail), "cpu")
+        results[name] = rec
+        print(json.dumps(rec), flush=True)
 
-    # headline LAST: GPT-2 train, embedding the other configs' summaries.
-    # A hard failure must still leave a headline-shaped record as the final
-    # stdout line (never a sub-config record) and a nonzero exit.
-    try:
-        head = with_retry(lambda: bench_gpt2_train(on_tpu), "gpt2_train")
-    except Exception:
-        err = traceback.format_exc(limit=3)
+    # headline LAST: GPT-2 124M train, embedding the other configs'
+    # summaries. Always leaves a headline-shaped final stdout line.
+    cap = TPU_CAPS[HEADLINE] if platform != "cpu" else CPU_CAP
+    head = run_config(HEADLINE, min(cap, max(60.0, remaining() - 20)),
+                      platform)
+    if "error" in head and platform != "cpu":
+        print(json.dumps({"event": "cpu_retry", "config": HEADLINE,
+                          "cause": head["error"][:200]}), flush=True)
+        head = run_config(HEADLINE, min(CPU_CAP, max(60.0, remaining() - 10)),
+                          "cpu")
+    if "error" in head:
         print(json.dumps({
             "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "extra": {"error": err[-400:]}}), flush=True)
+            "platform": head.get("platform", platform),
+            "extra": {"error": head["error"][-400:]}}), flush=True)
         raise SystemExit(1)
+
+    head.setdefault("extra", {})
     for name, rec in results.items():
-        if "error" in rec:
-            head["extra"][name] = {"error": rec["error"][-200:]}
+        if "error" in rec or "skipped" in rec:
+            head["extra"][name] = {k: v for k, v in rec.items()
+                                   if k != "metric"}
         else:
             head["extra"][name] = {"metric": rec["metric"],
                                    "value": rec["value"],
                                    "unit": rec["unit"],
-                                   "vs_baseline": rec["vs_baseline"]}
+                                   "vs_baseline": rec["vs_baseline"],
+                                   "platform": rec.get("platform")}
     print(json.dumps(head), flush=True)
 
 

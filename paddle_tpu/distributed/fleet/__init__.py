@@ -10,5 +10,6 @@ from .fleet_base import (  # noqa: F401
     _get_fleet,
 )
 from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
 from .utils import recompute  # noqa: F401
 from . import elastic  # noqa: F401

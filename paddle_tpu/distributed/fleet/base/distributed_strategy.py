@@ -2,6 +2,16 @@
 python/paddle/distributed/fleet/base/distributed_strategy.py:110 (protobuf-
 backed config; hybrid_configs doc at :1307). Plain-python config here — the
 knobs map onto mesh axis degrees and jit options instead of graph passes.
+
+Every behavior flag is CONSUMED: hybrid/pipeline configs by fleet.init and
+the pipeline wrappers; amp/recompute/sharding/gradient_merge/lamb/lars/dgc/
+localsgd by fleet.distributed_optimizer → meta_optimizers.apply_strategy
+(which raises on anything unimplementable); find_unused_parameters by the
+DataParallel wrapper. The remaining knobs (fuse_all_reduce_ops,
+fuse_grad_size_in_MB, nccl_comm_num, sync_nccl_allreduce,
+without_graph_optimization) are accepted for API parity but are XLA's job on
+TPU — fusion, comm scheduling, and graph optimization happen in the
+compiler, not the framework (SURVEY.md §7 descope).
 """
 from __future__ import annotations
 
@@ -24,9 +34,17 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01,
+                             "exclude_from_weight_decay": []}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005, "epsilon": 1e-9,
+                             "exclude_from_weight_decay": []}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1,
                                  "micro_batch_size": 1}

@@ -74,8 +74,19 @@ def get_hybrid_communicate_group():
 
 
 def distributed_model(model):
-    """Reference analog: fleet/model.py:30 — wrap by parallel mode."""
+    """Reference analog: fleet/model.py:30 — wrap by parallel mode, after
+    applying the model-side strategy passes (recompute, amp O2 cast)."""
     hcg = _fleet.hcg
+    strategy = _fleet.strategy
+    if strategy is not None and strategy.recompute:
+        from .meta_optimizers import apply_recompute
+        apply_recompute(model, strategy.recompute_configs)
+    if strategy is not None and strategy.amp:
+        cfg = strategy.amp_configs or {}
+        if cfg.get("level", "O1") == "O2" or cfg.get("use_pure_fp16"):
+            from ...amp import decorate as _amp_decorate
+            _amp_decorate(models=model, level="O2",
+                          dtype=cfg.get("dtype", "bfloat16"))
     if hcg is None:
         return model
     mode = hcg.get_parallel_mode()
@@ -89,16 +100,39 @@ def distributed_model(model):
     if mode == ParallelMode.SHARDING_PARALLEL:
         return ShardingParallel(model, hcg, strategy=_fleet.strategy)
     if get_world_size() > 1:
-        return DataParallel(model, group=hcg.get_data_parallel_group())
+        # DGC / LocalSGD own the dp-axis communication (compressed
+        # all-reduce / periodic param averaging): the per-backward dense
+        # grad sync must be off or the compression is pure overhead
+        own_comm = bool(strategy and (strategy.dgc or strategy.localsgd))
+        return DataParallel(
+            model, group=hcg.get_data_parallel_group(),
+            find_unused_parameters=bool(
+                strategy and strategy.find_unused_parameters),
+            grad_sync=not own_comm)
     return model
 
 
 def distributed_optimizer(optimizer, strategy=None):
     """Reference analog: fleet/optimizer.py → HybridParallelOptimizer
-    (dygraph_optimizer/hybrid_parallel_optimizer.py:186)."""
+    (dygraph_optimizer/hybrid_parallel_optimizer.py:186) after the
+    strategy-driven meta-optimizer chain (fleet/meta_optimizers/*.py) has
+    been applied. Flags with no implementation raise instead of being
+    silently ignored."""
     hcg = _fleet.hcg
+    strategy = strategy or _fleet.strategy
+    from .meta_optimizers import apply_strategy
+    if strategy is not None:
+        optimizer = apply_strategy(optimizer, strategy, hcg=hcg)
     if hcg is None:
         return optimizer
     from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, hcg,
-                                   strategy or _fleet.strategy)
+    from .meta_optimizers import _OptWrapper
+    if isinstance(optimizer, _OptWrapper):
+        # clip/sharding handling belongs to the innermost real optimizer;
+        # the outer merge/localsgd/dgc wrappers keep driving .step()
+        inner = optimizer
+        while isinstance(inner._inner, _OptWrapper):
+            inner = inner._inner
+        inner._inner = HybridParallelOptimizer(inner._inner, hcg, strategy)
+        return optimizer
+    return HybridParallelOptimizer(optimizer, hcg, strategy)

@@ -21,13 +21,19 @@ __all__ = ["DataParallel"]
 class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, grad_sync=True):
+        """grad_sync=False skips the per-backward gradient all-reduce — for
+        optimizers that own their own communication schedule (DGC's
+        compressed all-reduce, LocalSGD's periodic parameter averaging),
+        where a dense per-step sync would nullify the compression
+        (reference analog: dgc_optimizer.py removing the reducer's dense
+        allreduce in favor of the dgc op)."""
         super().__init__()
         self._layers = layers
         self.group = group
         self.find_unused_parameters = find_unused_parameters
         self._grad_hooks = []
-        if get_world_size(group) > 1:
+        if grad_sync and get_world_size(group) > 1:
             self._register_grad_sync()
 
     def _register_grad_sync(self):

@@ -1,6 +1,6 @@
 from . import gpt  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
-    gpt2_124m, gpt3_1p3b, gpt3_6p7b, shard_gpt,
+    gpt2_124m, gpt2_355m, gpt3_1p3b, gpt3_6p7b, shard_gpt,
     GPTEmbeddingPipe, GPTHeadPipe, gpt_pipeline_layers, GPTDecodeStep,
 )

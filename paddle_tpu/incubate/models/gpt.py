@@ -35,7 +35,7 @@ from ...ops import manipulation as manip
 from ...framework.core import Tensor
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
-           "gpt2_124m", "gpt3_1p3b", "gpt3_6p7b", "shard_gpt",
+           "gpt2_124m", "gpt2_355m", "gpt3_1p3b", "gpt3_6p7b", "shard_gpt",
            "GPTEmbeddingPipe", "GPTHeadPipe", "gpt_pipeline_layers",
            "GPTDecodeStep"]
 
@@ -59,6 +59,12 @@ class GPTConfig:
 def gpt2_124m(**overrides):
     return GPTConfig(**{**dict(hidden_size=768, num_hidden_layers=12,
                                num_attention_heads=12, intermediate_size=3072),
+                        **overrides})
+
+
+def gpt2_355m(**overrides):
+    return GPTConfig(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                               num_attention_heads=16, intermediate_size=4096),
                         **overrides})
 
 

@@ -32,6 +32,10 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
+    def _extra_cache_key(self):
+        # _momentum is a trace constant; DGCMomentum toggles it per step
+        return (self._momentum, self._use_nesterov)
+
     def _create_accumulators(self, params):
         for p in params:
             self._add_accumulator("velocity", p)

@@ -1,0 +1,343 @@
+"""Strategy-driven meta-optimizer tests.
+
+Reference analog: unittests/test_fleet_{lamb,lars,dgc,localsgd,
+gradient_merge}_meta_optimizer.py — each asserts the strategy flag actually
+transforms the optimization, and DGC/LocalSGD converge.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    apply_strategy, apply_recompute, GradientMergeOptimizer,
+    LocalSGDOptimizer, DGCMomentum)
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _data(seed=0, n=16):
+    rng = np.random.default_rng(seed)
+    x = paddle.Tensor(jnp.asarray(rng.normal(size=(n, 8)), jnp.float32),
+                      stop_gradient=True)
+    y = paddle.Tensor(jnp.asarray(rng.normal(size=(n, 4)), jnp.float32),
+                      stop_gradient=True)
+    return x, y
+
+
+def _loss(model, x, y):
+    out = model(x)
+    return ((out - y) * (out - y)).mean()
+
+
+def _train(model, opt, steps=4, seed=0):
+    x, y = _data(seed)
+    losses = []
+    for _ in range(steps):
+        loss = _loss(model, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def _params_np(model):
+    return [np.asarray(p._value) for p in model.parameters()]
+
+
+# -------------------------------------------------------------------- swaps
+
+def test_strategy_lamb_swaps_adam():
+    from paddle_tpu.optimizer.optimizers import Lamb
+    model = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.lamb = True
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    out = apply_strategy(opt, strategy)
+    assert isinstance(out, Lamb)
+    assert "lamb" in out._applied_passes
+
+    # strategy-configured run == directly-configured Lamb run
+    m1 = _tiny_model()
+    o1 = apply_strategy(paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m1.parameters()), strategy)
+    _train(m1, o1)
+    m2 = _tiny_model()
+    # the swap carries the Adam hyperparameters over (epsilon=1e-8 here)
+    o2 = Lamb(learning_rate=1e-2, epsilon=1e-8, parameters=m2.parameters())
+    _train(m2, o2)
+    for a, b in zip(_params_np(m1), _params_np(m2)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_strategy_lars_swaps_momentum():
+    from paddle_tpu.optimizer.optimizers import Lars
+    model = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.lars = True
+    opt = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                    parameters=model.parameters())
+    out = apply_strategy(opt, strategy)
+    assert isinstance(out, Lars)
+
+
+def test_strategy_lamb_rejects_momentum():
+    model = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.lamb = True
+    opt = paddle.optimizer.Momentum(learning_rate=1e-2,
+                                    parameters=model.parameters())
+    with pytest.raises(TypeError):
+        apply_strategy(opt, strategy)
+
+
+def test_unimplemented_knob_raises():
+    model = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.heter_ccl_mode = True
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=model.parameters())
+    with pytest.raises(NotImplementedError):
+        apply_strategy(opt, strategy)
+
+
+def test_strategy_sharding_stage2_raises_with_pointer():
+    model = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 2}
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=model.parameters())
+    with pytest.raises(NotImplementedError, match="group_sharded_parallel"):
+        apply_strategy(opt, strategy)
+
+
+# ----------------------------------------------------------- gradient merge
+
+def test_gradient_merge_matches_averaged_batch():
+    """k_steps=2 with avg: two identical micro-steps == one direct step on
+    the same (averaged) gradient."""
+    m1 = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    o1 = apply_strategy(paddle.optimizer.SGD(
+        learning_rate=1e-2, parameters=m1.parameters()), strategy)
+    assert isinstance(o1, GradientMergeOptimizer)
+    x, y = _data()
+    for _ in range(2):                       # same batch twice -> avg == g
+        loss = _loss(m1, x, y)
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+
+    m2 = _tiny_model()
+    o2 = paddle.optimizer.SGD(learning_rate=1e-2,
+                              parameters=m2.parameters())
+    loss = _loss(m2, x, y)
+    loss.backward()
+    o2.step()
+    o2.clear_grad()
+    for a, b in zip(_params_np(m1), _params_np(m2)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_gradient_merge_no_update_between_boundaries():
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 3, "avg": True}
+    opt = apply_strategy(paddle.optimizer.SGD(
+        learning_rate=1e-2, parameters=m.parameters()), strategy)
+    before = _params_np(m)
+    x, y = _data()
+    for i in range(2):                       # steps 1,2 of 3: no apply
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for a, b in zip(before, _params_np(m)):
+        np.testing.assert_array_equal(a, b)
+    loss = _loss(m, x, y)
+    loss.backward()
+    opt.step()                               # step 3: applies
+    assert any(not np.array_equal(a, b)
+               for a, b in zip(before, _params_np(m)))
+
+
+# ----------------------------------------------------------------- localsgd
+
+def test_localsgd_converges_and_averages():
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 2}
+    opt = apply_strategy(paddle.optimizer.SGD(
+        learning_rate=5e-2, parameters=m.parameters()), strategy)
+    assert isinstance(opt, LocalSGDOptimizer)
+    losses = _train(m, opt, steps=30)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_localsgd_world1_matches_plain_sgd():
+    """At world size 1 the averaging is a no-op: LocalSGD == SGD exactly."""
+    m1 = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 2}
+    o1 = apply_strategy(paddle.optimizer.SGD(
+        learning_rate=1e-2, parameters=m1.parameters()), strategy)
+    _train(m1, o1)
+    m2 = _tiny_model()
+    _train(m2, paddle.optimizer.SGD(learning_rate=1e-2,
+                                    parameters=m2.parameters()))
+    for a, b in zip(_params_np(m1), _params_np(m2)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------- dgc
+
+def test_dgc_requires_momentum():
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+    with pytest.raises(TypeError):
+        apply_strategy(opt, strategy)
+
+
+def test_dgc_converges_with_high_sparsity():
+    """Top-k compression with error feedback still converges (the DGC
+    claim): loss must drop substantially even keeping only 10% of grads."""
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.9]}
+    opt = apply_strategy(paddle.optimizer.Momentum(
+        learning_rate=5e-2, momentum=0.9, parameters=m.parameters()),
+        strategy)
+    assert isinstance(opt, DGCMomentum)
+    losses = _train(m, opt, steps=25)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_dgc_rampup_matches_plain_momentum():
+    """During rampup (step <= rampup_begin_step) DGC is plain momentum."""
+    m1 = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 100, "sparsity": [0.999]}
+    o1 = apply_strategy(paddle.optimizer.Momentum(
+        learning_rate=1e-2, momentum=0.9, parameters=m1.parameters()),
+        strategy)
+    _train(m1, o1, steps=3)
+    m2 = _tiny_model()
+    o2 = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                   parameters=m2.parameters())
+    _train(m2, o2, steps=3)
+    for a, b in zip(_params_np(m1), _params_np(m2)):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_dgc_error_feedback_accumulates():
+    """Residuals carry the un-sent mass: after one compressed step the
+    stored error must be nonzero and disjoint from the sent support."""
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.75]}
+    opt = apply_strategy(paddle.optimizer.Momentum(
+        learning_rate=1e-2, momentum=0.9, parameters=m.parameters()),
+        strategy)
+    x, y = _data()
+    loss = _loss(m, x, y)
+    loss.backward()
+    opt.step()
+    errs = [np.asarray(v) for v in opt._e.values()]
+    assert any(np.abs(e).sum() > 0 for e in errs)
+
+
+# ---------------------------------------------------------------- recompute
+
+def test_apply_recompute_wraps_and_preserves_grads():
+    m1 = _tiny_model()
+    apply_recompute(m1, {"checkpoints": ["0", "2"]})
+    m2 = _tiny_model()
+    x, y = _data()
+    l1 = _loss(m1, x, y)
+    l1.backward()
+    l2 = _loss(m2, x, y)
+    l2.backward()
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(np.asarray(a.grad._value),
+                                   np.asarray(b.grad._value),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_apply_recompute_empty_checkpoints_raises():
+    with pytest.raises(ValueError):
+        apply_recompute(_tiny_model(), {"checkpoints": []})
+
+
+# ----------------------------------------------------- amp + state routing
+
+def test_strategy_amp_o2_sets_master_weights():
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters(),
+                                 multi_precision=False)
+    out = apply_strategy(opt, strategy)
+    assert out._multi_precision is True
+    assert "amp_o2_master_weights" in out._applied_passes
+
+
+def test_dgc_state_dict_roundtrip():
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.5]}
+    opt = apply_strategy(paddle.optimizer.Momentum(
+        learning_rate=1e-2, momentum=0.9, parameters=m.parameters()),
+        strategy)
+    _train(m, opt, steps=2)
+    sd = opt.state_dict()
+    assert "_dgc_steps" in sd
+
+    m2 = _tiny_model()
+    opt2 = apply_strategy(paddle.optimizer.Momentum(
+        learning_rate=1e-2, momentum=0.9, parameters=m2.parameters()),
+        strategy)
+    opt2.set_state_dict(sd)
+    assert opt2._steps == opt._steps
+    assert set(opt2._e.keys()) == set(opt._e.keys())
+
+
+def test_stacked_strategy_gradient_merge_over_dgc():
+    """gradient_merge wraps dgc wraps momentum — the chain composes."""
+    m = _tiny_model()
+    strategy = DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.5]}
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    opt = apply_strategy(paddle.optimizer.Momentum(
+        learning_rate=5e-2, momentum=0.9, parameters=m.parameters()),
+        strategy)
+    assert isinstance(opt, GradientMergeOptimizer)
+    assert isinstance(opt._inner, DGCMomentum)
+    losses = _train(m, opt, steps=16)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
