@@ -33,7 +33,6 @@ import os
 import subprocess
 import sys
 import time
-import traceback
 
 import numpy as np
 

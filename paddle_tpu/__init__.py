@@ -59,6 +59,9 @@ from . import sparse  # noqa: F401
 from . import fft  # noqa: F401
 from . import linalg  # noqa: F401
 from . import utils  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import cost_model  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
 from .device import (  # noqa: F401

@@ -1,0 +1,23 @@
+"""paddle.dataset — classic reader-creator datasets.
+
+Reference analog: python/paddle/dataset/ (14 modules: mnist, cifar, imdb,
+imikolov, uci_housing, movielens, conll05, flowers, voc2012, wmt14/16, ...).
+Each module exposes reader CREATORS (`train()`, `test()`) — zero-arg
+callables yielding samples — composable with paddle.reader decorators.
+
+TPU-native environment note: this build runs with zero network egress, so
+every module loads from a local cache path when present and otherwise falls
+back to a DETERMINISTIC synthetic sample with the real schema (same shapes,
+dtypes, vocab behavior) — the same policy as paddle_tpu.vision.datasets.
+The download-heavy modules without schema value beyond their fetch logic
+(flowers, voc2012, wmt14/16, movielens, conll05) are explicit descopes;
+their reference value is the HTTP mirror list, which cannot work here.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import uci_housing  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "imdb", "imikolov", "uci_housing"]

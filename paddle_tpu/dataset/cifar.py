@@ -1,0 +1,35 @@
+"""CIFAR reader creators (reference: python/paddle/dataset/cifar.py —
+train10/test10/train100/test100 yield (3072-float image in [0,1], label)).
+
+Backed by paddle_tpu.vision.datasets.Cifar10/Cifar100 (real pickles when
+cached, deterministic synthetic fallback otherwise)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader_creator(cls_name, mode):
+    def reader():
+        from ..vision import datasets as vd
+        ds = getattr(vd, cls_name)(mode=mode)
+        for img, label in ds:
+            yield np.asarray(img, np.float32).reshape(-1), int(label)
+    return reader
+
+
+def train10():
+    return _reader_creator("Cifar10", "train")
+
+
+def test10():
+    return _reader_creator("Cifar10", "test")
+
+
+def train100():
+    return _reader_creator("Cifar100", "train")
+
+
+def test100():
+    return _reader_creator("Cifar100", "test")

@@ -1,0 +1,74 @@
+"""Shared dataset plumbing (reference: python/paddle/dataset/common.py —
+DATA_HOME, download-with-md5, cluster file splitting)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "download", "md5file", "split", "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Return the locally cached file for (module, url); there is no
+    network egress in this environment so a missing cache entry raises with
+    the path to pre-place the file (reference common.py downloads here)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename) and (
+            not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        f"no network egress: place the file for {url} at {filename} "
+        "(datasets fall back to deterministic synthetic data when their "
+        "loader is called without a cached file)")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's output into pickled chunk files of line_count
+    samples (reference common.py:split)."""
+    import pickle
+    if dumper is None:
+        dumper = pickle.dump
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Round-robin chunk files over trainers (reference
+    common.py:cluster_files_reader)."""
+    import glob
+    import pickle
+    if loader is None:
+        loader = pickle.load
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for line in loader(f):
+                        yield line
+    return reader

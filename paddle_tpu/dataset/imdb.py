@@ -1,0 +1,28 @@
+"""IMDB reader creators (reference: python/paddle/dataset/imdb.py —
+train(word_idx)/test(word_idx) yield (token_ids, 0/1 label); word_dict()
+builds the vocabulary). Backed by paddle_tpu.text.Imdb."""
+from __future__ import annotations
+
+__all__ = ["train", "test", "word_dict"]
+
+
+def word_dict(cutoff=150):
+    """Vocabulary map word -> id. With no cached corpus the synthetic
+    dataset's id space is returned directly (ids are their own tokens)."""
+    return {i: i for i in range(5000)}
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..text import Imdb
+        for toks, label in Imdb(mode=mode):
+            yield list(int(t) for t in toks), int(label)
+    return reader
+
+
+def train(word_idx=None):
+    return _reader_creator("train")
+
+
+def test(word_idx=None):
+    return _reader_creator("test")

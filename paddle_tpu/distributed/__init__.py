@@ -19,6 +19,22 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from ..core import TCPStore  # noqa: F401  (reference: core.TCPStore)
 from . import fleet  # noqa: F401
+from . import io  # noqa: F401
+from . import launch as _launch_module  # noqa: F401
+# matching the reference: `paddle.distributed.launch` the ATTRIBUTE is the
+# callable entry point (distributed/__init__.py:17 `from .launch.main
+# import launch`); `python -m paddle_tpu.distributed.launch` still hits the
+# module. The module object stays reachable as _launch_module.
+from .launch.main import launch  # noqa: F401
+from .parallel_with_gloo import (  # noqa: F401
+    gloo_init_parallel_env, gloo_barrier, gloo_release,
+)
+from .entry_attr import (  # noqa: F401
+    EntryAttr, ProbabilityEntry, CountFilterEntry, ShowClickEntry,
+)
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .fleet.base.topology import ParallelMode  # noqa: F401
+from .fleet.meta_parallel.mp_ops import split  # noqa: F401
 from .mesh import (  # noqa: F401
     build_mesh, get_global_mesh, set_global_mesh,
 )
@@ -48,3 +64,17 @@ def spawn(func, args=(), nprocs=-1, **options):
         procs.append(p)
     for p in procs:
         p.join()
+
+
+# reference python/paddle/distributed/__init__.py:76 __all__ (38 names)
+__all__ = [  # noqa
+    "io", "spawn", "launch", "scatter", "broadcast", "ParallelEnv",
+    "new_group", "init_parallel_env", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release", "QueueDataset", "split",
+    "CountFilterEntry", "ShowClickEntry", "get_world_size", "get_group",
+    "all_gather", "all_gather_object", "InMemoryDataset", "barrier",
+    "all_reduce", "alltoall", "alltoall_single", "send", "reduce", "recv",
+    "ReduceOp", "wait", "get_rank", "ProbabilityEntry", "ParallelMode",
+    "is_initialized", "destroy_process_group", "isend", "irecv",
+    "reduce_scatter", "rpc",
+]

@@ -1,0 +1,96 @@
+"""Sparse-feature entry rules for parameter-server embeddings.
+
+Reference analog: python/paddle/distributed/entry_attr.py — an EntryAttr
+decides whether a sparse feature id is admitted into the PS sparse table
+(probability sampling, show-count filtering, or show/click tracking). The
+string form produced by `_to_attr()` matches the reference's accessor config
+wire format; the TPU-native PS tier (paddle_tpu.distributed.ps) consumes the
+objects directly via `SparseTable(entry=...)`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry",
+           "ShowClickEntry"]
+
+
+class EntryAttr:
+    """Base entry rule (reference entry_attr.py:18)."""
+
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is base class")
+
+    def admit(self, key, table):
+        """Whether feature `key` may be materialized in `table` on first
+        touch. Tables call this once per unseen id."""
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit each new feature with independent probability p
+    (reference entry_attr.py:57)."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float):
+            raise ValueError("probability must be a float in (0,1]")
+        if probability <= 0 or probability > 1:
+            raise ValueError(
+                f"probability must be in (0, 1], got {probability}")
+        self._name = "probability_entry"
+        self._probability = probability
+        self._rng = np.random.default_rng(0)
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+    def admit(self, key, table):
+        return bool(self._rng.random() < self._probability)
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature only after it has been seen `count_filter` times
+    (reference entry_attr.py count_filter_entry)."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if not isinstance(count_filter, int):
+            raise ValueError("count_filter must be a non-negative integer")
+        if count_filter < 0:
+            raise ValueError(
+                f"count_filter must be >= 0, got {count_filter}")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+        self._counts = {}
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count_filter)])
+
+    def admit(self, key, table):
+        k = int(key)
+        c = self._counts.get(k, 0) + 1
+        self._counts[k] = c
+        return c >= self._count_filter
+
+
+class ShowClickEntry(EntryAttr):
+    """Entry that names the show/click input slots feeding the CTR accessor
+    statistics (reference entry_attr.py show_click_entry)."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be str")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return ":".join([self._name, self._show_name, self._click_name])
+
+    def admit(self, key, table):
+        return True

@@ -1,0 +1,222 @@
+"""Fleet datasets for PS-style training loops.
+
+Reference analog: python/paddle/distributed/fleet/dataset/dataset.py —
+DatasetBase (:23) / InMemoryDataset (:349) / QueueDataset (:1273) wrap the
+C++ MultiSlotDataset + data_feed ingest (framework/data_feed.cc): a filelist
+is parsed by worker threads into example queues consumed by the Trainer/
+DeviceWorker stack.
+
+TPU-native: no protobuf data_feed pipeline — files are parsed by a
+pluggable `pipe_command`-style parser into NumPy slot batches held in host
+memory (InMemory) or streamed lazily (Queue), and `batches()` feeds the
+MultiTrainer loop (paddle_tpu.distributed.trainer). Global shuffle
+exchanges example shards over the eager collective API.
+"""
+from __future__ import annotations
+
+import random as _random
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+def _default_parser(line):
+    """Default line parser: whitespace-separated floats; last column is the
+    label (the reference's MultiSlot text format degenerates to this for
+    one dense slot + label)."""
+    parts = line.strip().split()
+    if not parts:
+        return None
+    vals = np.asarray([float(v) for v in parts], np.float32)
+    return vals[:-1], np.asarray(vals[-1], np.int64)
+
+
+class DatasetBase:
+    """Config surface shared by both datasets (reference dataset.py:23)."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist = []
+        self.use_var = []
+        self.pipe_command = None      # here: a callable line -> sample|None
+        self.input_type = 0
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self._set_batch_size(batch_size)
+        self._set_thread(thread_num)
+        if use_var is not None:
+            self._set_use_var(use_var)
+        if pipe_command is not None:
+            self._set_pipe_command(pipe_command)
+        self._set_input_type(input_type)
+        return self
+
+    def _set_pipe_command(self, pipe_command):
+        if isinstance(pipe_command, str):
+            # string pipe commands (awk/sed pipelines) are a POSIX ingest
+            # detail; only the identity command maps cleanly here
+            if pipe_command not in ("cat", ""):
+                raise NotImplementedError(
+                    "string pipe_command is a data_feed.cc subprocess "
+                    "detail; pass a Python callable line -> sample instead")
+            pipe_command = None
+        self.pipe_command = pipe_command
+
+    def _set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def _set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def _set_use_var(self, var_list):
+        self.use_var = list(var_list)
+
+    def _set_input_type(self, input_type):
+        self.input_type = int(input_type)
+
+    # -- ingestion ---------------------------------------------------------
+    def _parse_files(self):
+        parser = self.pipe_command or _default_parser
+        for path in self.filelist:
+            with open(path) as f:
+                for line in f:
+                    sample = parser(line)
+                    if sample is not None:
+                        yield sample
+
+    def _batched(self, samples):
+        """Group samples into per-slot stacked NumPy batches."""
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._stack(buf)
+                buf = []
+        if buf:
+            yield self._stack(buf)
+
+    @staticmethod
+    def _stack(buf):
+        n_slots = len(buf[0]) if isinstance(buf[0], (tuple, list)) else 1
+        if n_slots == 1:
+            return np.stack(buf)
+        return tuple(np.stack([b[i] for b in buf]) for i in range(n_slots))
+
+
+class InMemoryDataset(DatasetBase):
+    """Load the whole filelist into host memory, then shuffle/iterate
+    (reference dataset.py:349)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+        self._loaded = False
+
+    def load_into_memory(self, is_shuffle=False):
+        """Reference dataset.py:856."""
+        self._samples = list(self._parse_files())
+        self._loaded = True
+        if is_shuffle:
+            self.local_shuffle()
+
+    def preload_into_memory(self, thread_num=None):
+        """Reference dataset.py:895 — async load; synchronous here (host
+        ingest is not the TPU bottleneck), kept for API parity."""
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        """Reference dataset.py:935."""
+        if not self._loaded:
+            self.load_into_memory()
+
+    def local_shuffle(self, seed=None):
+        """Reference dataset.py:968."""
+        rng = _random.Random(seed)
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12, seed=0):
+        """Shuffle examples ACROSS ranks: locally shuffle, then exchange
+        shards so each rank ends with an unbiased sample of the global data
+        (reference dataset.py:1000 routes examples by hash through the PS).
+        Uses all_gather_object over the eager collective group; at world 1
+        it degenerates to a local shuffle."""
+        from ...distributed.env import get_world_size, get_rank
+        world = get_world_size()
+        self.local_shuffle(seed)
+        if world <= 1:
+            return
+        from ...distributed.collective import all_gather_object
+        everyone = []
+        all_gather_object(everyone, self._samples)
+        merged = [s for per_rank in everyone for s in per_rank]
+        rng = _random.Random(seed)
+        rng.shuffle(merged)
+        rank = get_rank()
+        self._samples = merged[rank::world]
+
+    def release_memory(self):
+        """Reference dataset.py:1060."""
+        self._samples = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        """Reference dataset.py:1099 (global size when fleet is passed)."""
+        n = len(self._samples)
+        if fleet is not None:
+            from ...distributed.env import get_world_size
+            if get_world_size() > 1:
+                from ...distributed.collective import all_gather_object
+                sizes = []
+                all_gather_object(sizes, n)
+                return int(sum(sizes))
+        return n
+
+    get_shuffle_data_size = get_memory_data_size
+
+    def slots_shuffle(self, slots):
+        """Shuffle the values of the named slot indices across examples
+        (reference dataset.py:1232 — feature-permutation importance)."""
+        for slot in slots:
+            idx = int(slot)
+            col = [s[idx] for s in self._samples]
+            _random.shuffle(col)
+            self._samples = [
+                tuple(col[i] if j == idx else v
+                      for j, v in enumerate(s))
+                for i, s in enumerate(self._samples)]
+
+    def batches(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        yield from self._batched(self._samples)
+
+    def __iter__(self):
+        return self.batches()
+
+
+class QueueDataset(DatasetBase):
+    """Stream the filelist without materializing it (reference
+    dataset.py:1273 — single-pass queue feed; no shuffle support)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams single-pass; use InMemoryDataset for "
+            "shuffling (reference raises the same)")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        raise NotImplementedError(
+            "QueueDataset streams single-pass; use InMemoryDataset for "
+            "shuffling (reference raises the same)")
+
+    def batches(self):
+        yield from self._batched(self._parse_files())
+
+    def __iter__(self):
+        return self.batches()

@@ -50,9 +50,21 @@ def apply_recompute(model, recompute_configs):
             "['checkpoints']: a list of sublayer-name substrings to "
             "checkpoint (reference recompute_optimizer.py semantics)")
     from .utils import recompute as _recompute
+
+    def _matches(name):
+        # segment-boundary match only: "blocks.1" selects blocks.1 (and its
+        # subtree via the prefix rule) but NOT blocks.10/blocks.11
+        return any(name == tok or name.startswith(tok + ".")
+                   for tok in checkpoints)
+
     wrapped = 0
+    wrapped_names = []
     for name, sub in model.named_sublayers():
-        if not any(tok in name for tok in checkpoints):
+        if not _matches(name):
+            continue
+        if any(name.startswith(w + ".") for w in wrapped_names):
+            # an ancestor is already checkpointed: wrapping the child too
+            # would nest jax.checkpoint and compound rematerialization
             continue
         if getattr(sub, "_recompute_wrapped", False):
             continue
@@ -82,6 +94,7 @@ def apply_recompute(model, recompute_configs):
 
         sub.forward = _make(orig, sub)
         sub._recompute_wrapped = True
+        wrapped_names.append(name)
         wrapped += 1
     if not wrapped:
         raise ValueError(
@@ -396,8 +409,12 @@ def apply_strategy(optimizer, strategy, hcg=None):
         if level == "O2" or cfg.get("use_pure_fp16"):
             # master-weight path: the optimizer keeps f32 masters for low-
             # precision params (reference amp_optimizer.py O2 + master grad)
-            if hasattr(optimizer, "_multi_precision"):
-                optimizer._multi_precision = True
+            if not hasattr(optimizer, "_multi_precision"):
+                raise TypeError(
+                    "strategy.amp level O2 needs a multi_precision-capable "
+                    "optimizer (Adam/AdamW family); "
+                    f"{type(optimizer).__name__} keeps no f32 masters")
+            optimizer._multi_precision = True
             applied.append("amp_o2_master_weights")
         else:
             # O1 on TPU: bf16 autocast needs no loss scaling; the forward-

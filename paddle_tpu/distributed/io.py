@@ -1,0 +1,45 @@
+"""Distributed persistable I/O.
+
+Reference analog: python/paddle/distributed/io.py — save/load of persistable
+variables from a (possibly distributed) static program, splitting PS-hosted
+parameters from local ones. TPU-native: programs are jax.export artifacts
+with a state side-table (paddle_tpu.static), so persistables are the
+program's parameter/buffer dict; distributed placement is re-derived from
+the mesh on load (reshard-on-load lives in distributed.checkpoint).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var):
+    """A variable is persistable if it outlives a single step — here:
+    anything registered in a program's state table (params + buffers)
+    (reference io.py:189 checks var.persistable minus feed/fetch/rpc ops).
+    """
+    if var is None:
+        return False
+    return bool(getattr(var, "persistable", True))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save every persistable in `main_program` under `dirname`
+    (reference io.py:220; PS-side sparse tables are saved by the PS server
+    itself via ps.save_table — see distributed/ps)."""
+    from ..static import save, default_main_program
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "persistables")
+    save(program, path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Inverse of save_persistables (reference io.py load path)."""
+    from ..static import load, default_main_program
+    program = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables")
+    load(program, path, executor)
+    return program

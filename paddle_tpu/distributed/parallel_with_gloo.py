@@ -1,0 +1,52 @@
+"""CPU-side (control-plane) process group bring-up.
+
+Reference analog: python/paddle/distributed/parallel_with_gloo.py —
+gloo_init_parallel_env / gloo_barrier / gloo_release give PS heterogenous
+jobs a CPU-only rendezvous + barrier without NCCL. TPU-native: the same
+contract over the native TCPStore (csrc/tcp_store.cc) — there is one
+collective backend (XLA) so "gloo" here is purely the host control plane.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+_gloo = {"store": None, "rank": None, "world": None}
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Start the control-plane store. `server_endpoint` is "host:port"; rank
+    0 hosts the server (reference parallel_with_gloo.py:40 starts the KV
+    http server on rank 0)."""
+    from ..core import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    store = TCPStore(host_name=host, port=int(port),
+                     is_master=(rank_id == 0), world_size=rank_num,
+                     timeout=60.0)
+    _gloo.update(store=store, rank=int(rank_id), world=int(rank_num))
+    # all ranks check in before returning, like the reference's init wait
+    store.add("gloo_init", 1)
+    deadline = time.monotonic() + 60.0
+    while store.add("gloo_init", 0) < rank_num:
+        if time.monotonic() > deadline:
+            raise TimeoutError("gloo_init_parallel_env: not all "
+                               f"{rank_num} ranks checked in")
+        time.sleep(0.01)
+
+
+def gloo_barrier():
+    """Block until every rank reaches the barrier
+    (reference parallel_with_gloo.py:137)."""
+    if _gloo["store"] is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo["store"].barrier()
+
+
+def gloo_release():
+    """Tear down the control-plane store
+    (reference parallel_with_gloo.py:195)."""
+    store = _gloo.get("store")
+    if store is not None and hasattr(store, "close"):
+        store.close()
+    _gloo.update(store=None, rank=None, world=None)

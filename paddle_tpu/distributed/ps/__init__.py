@@ -67,14 +67,29 @@ class DenseTable:
 
 class SparseTable:
     """id -> embedding row, materialized on first touch (the reference's
-    memory_sparse_table lazy init)."""
+    memory_sparse_table lazy init).
 
-    def __init__(self, name, dim, initializer="uniform", seed=0):
+    `entry` is an optional paddle.distributed EntryAttr (ProbabilityEntry /
+    CountFilterEntry / ShowClickEntry): an unseen id is only materialized
+    once the rule admits it; un-admitted ids pull zeros and drop pushes —
+    the reference's sparse_embedding entry semantics
+    (distributed/entry_attr.py paired with ps/table accessors)."""
+
+    def __init__(self, name, dim, initializer="uniform", seed=0, entry=None):
         self.name = name
         self.dim = dim
         self.rows = {}
         self._rng = np.random.default_rng(seed)
         self._init = initializer
+        self.entry = entry
+
+    def _admitted(self, key):
+        k = int(key)
+        if k in self.rows:
+            return True
+        if self.entry is not None and not self.entry.admit(k, self):
+            return False
+        return True
 
     def _materialize(self, key):
         if self._init == "zeros":
@@ -87,15 +102,24 @@ class SparseTable:
         for i, key in enumerate(ids):
             k = int(key)
             if k not in self.rows:
+                if not self._admitted(k):
+                    out[i] = 0.0
+                    continue
                 self.rows[k] = self._materialize(k)
             out[i] = self.rows[k]
         return out
 
     def push(self, ids, grads, lr):
-        # duplicate ids accumulate, matching dense embedding-grad semantics
+        # duplicate ids accumulate, matching dense embedding-grad semantics.
+        # un-admitted ids (entry rule not yet satisfied) drop their grads
+        # WITHOUT consulting the rule again — admission counts pulls/shows
+        # only (reference count_filter semantics), and the forward that
+        # produced this grad saw a zero row anyway
         for key, g in zip(ids, grads):
             k = int(key)
             if k not in self.rows:
+                if self.entry is not None:
+                    continue
                 self.rows[k] = self._materialize(k)
             self.rows[k] = self.rows[k] - lr * g
 

@@ -1,0 +1,15 @@
+"""paddle.reader — reader-creator decorators.
+
+Reference analog: python/paddle/reader/decorator.py. A *reader creator* is a
+zero-arg callable returning an iterable of samples; these combinators wrap
+creators into new creators (shuffle/buffer/compose/...). Kept as plain host
+Python: readers feed the host side of the input pipeline and never trace.
+"""
+from .decorator import (  # noqa: F401
+    cache, map_readers, shuffle, chain, compose, buffered, firstn,
+    xmap_readers, multiprocess_reader, ComposeNotAligned,
+)
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]

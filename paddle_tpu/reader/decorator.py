@@ -1,0 +1,222 @@
+"""Reader-creator combinators (reference: python/paddle/reader/decorator.py).
+
+The thread-backed pieces (buffered :301, xmap_readers :408,
+multiprocess_reader :504) keep the reference's queue/end-signal protocol but
+use threads throughout — host-side ingest parallelism on a TPU VM is
+IO-bound, and threads avoid the fork-vs-JAX deadlock (multiprocessing is
+reserved for the DataLoader worker pool, paddle_tpu.io).
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+import threading
+import time
+import queue as _queue
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]
+
+
+def cache(reader):
+    """Cache the reader's full output in memory on first pass
+    (reference decorator.py:47)."""
+    all_data = []
+    filled = []
+
+    def cache_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+    return cache_reader
+
+
+def map_readers(func, *readers):
+    """Zip several readers, mapping func over the per-reader samples
+    (reference decorator.py:87)."""
+    def reader():
+        rs = [r() for r in readers]
+        for elems in zip(*rs):
+            yield func(*elems)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill a buf_size window, emit it shuffled
+    (reference decorator.py:129)."""
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back (reference decorator.py:178)."""
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples; check_alignment (default True)
+    raises ComposeNotAligned when one ends early
+    (reference decorator.py:243)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield sum(map(make_tuple, outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Read ahead into a bounded queue on a worker thread
+    (reference decorator.py:301)."""
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+
+        def read_worker():
+            for d in r:
+                q.put(d)
+            q.put(_End())
+
+        t = threading.Thread(target=read_worker, daemon=True)
+        t.start()
+        e = q.get()
+        while not isinstance(e, _End):
+            yield e
+            e = q.get()
+    return data_reader
+
+
+def firstn(reader, n):
+    """Limit to the first n samples (reference decorator.py:363)."""
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return firstn_reader
+
+
+class XmapEndSignal:
+    pass
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with process_num workers
+    (reference decorator.py:408 — same in/out queue + end-signal protocol,
+    thread workers here)."""
+    end = XmapEndSignal()
+
+    def read_worker(r, in_q):
+        for i in r:
+            in_q.put(i)
+        in_q.put(end)
+
+    def order_read_worker(r, in_q):
+        for i, sample in enumerate(r):
+            in_q.put((i, sample))
+        in_q.put(end)
+
+    def handle_worker(in_q, out_q, fn):
+        sample = in_q.get()
+        while not isinstance(sample, XmapEndSignal):
+            out_q.put(fn(sample))
+            sample = in_q.get()
+        in_q.put(end)
+        out_q.put(end)
+
+    def order_handle_worker(in_q, out_q, fn, out_order):
+        ins = in_q.get()
+        while not isinstance(ins, XmapEndSignal):
+            order_id, sample = ins
+            result = fn(sample)
+            while order_id != out_order[0]:
+                time.sleep(0.001)   # yield the GIL to the draining thread
+            out_q.put(result)
+            out_order[0] += 1
+            ins = in_q.get()
+        in_q.put(end)
+        out_q.put(end)
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        out_order = [0]
+        target = order_read_worker if order else read_worker
+        t = threading.Thread(target=target, args=(reader(), in_q),
+                             daemon=True)
+        t.start()
+        args = (in_q, out_q, mapper, out_order) if order else \
+            (in_q, out_q, mapper)
+        workers = []
+        for _ in range(process_num):
+            w = threading.Thread(
+                target=order_handle_worker if order else handle_worker,
+                args=args, daemon=True)
+            w.start()
+            workers.append(w)
+        finish = 0
+        while finish < process_num:
+            sample = out_q.get()
+            if isinstance(sample, XmapEndSignal):
+                finish += 1
+            else:
+                yield sample
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers concurrently
+    (reference decorator.py:504; thread-backed here — see module note)."""
+    if len(readers) < 1:
+        raise ValueError("multiprocess_reader needs at least one reader")
+
+    def queue_reader():
+        q = _queue.Queue(queue_size)
+
+        def worker(r):
+            for sample in r():
+                q.put(sample)
+            q.put(None)
+
+        for r in readers:
+            threading.Thread(target=worker, args=(r,), daemon=True).start()
+        finish = 0
+        while finish < len(readers):
+            sample = q.get()
+            if sample is None:
+                finish += 1
+            else:
+                yield sample
+    return queue_reader
