@@ -122,14 +122,18 @@ class PipelineLayer(Layer):
             self._num_stages = num_stages
             self._stage_id = 0
 
-        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        # interleave (reference pp_layers.py:208): segmentation is over
+        # S * V model CHUNKS — device s later owns chunks s, s+S, ...
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
+        n_parts = self._num_stages * self._num_virtual
+        seg = SegmentLayers(self._layers_desc, n_parts, seg_method)
         self.segment_parts = seg.do_segment()
 
         # single-controller: materialize ALL stages; stage boundaries drive
         # the schedule and (when meshed) parameter placement over "pipe"
         self._stage_layers = []
         self.shared_layers = {}
-        for stage in range(self._num_stages):
+        for stage in range(n_parts):
             start, end = self.segment_parts[stage], self.segment_parts[stage + 1]
             built = []
             for desc in self._layers_desc[start:end]:
@@ -149,10 +153,11 @@ class PipelineLayer(Layer):
         self.add_sublayer("stages", LayerList(self._stage_layers))
 
     def get_stage_from_index(self, layer_idx):
-        for stage in range(self._num_stages):
-            if self.segment_parts[stage] <= layer_idx < \
-                    self.segment_parts[stage + 1]:
-                return stage
+        # with interleave, chunk c belongs to PHYSICAL stage c % S
+        for chunk in range(len(self._stage_layers)):
+            if self.segment_parts[chunk] <= layer_idx < \
+                    self.segment_parts[chunk + 1]:
+                return chunk % self._num_stages
         return self._num_stages - 1
 
     def get_num_stages(self):
@@ -164,7 +169,7 @@ class PipelineLayer(Layer):
         return x
 
     def forward(self, x):
-        for stage in range(self._num_stages):
+        for stage in range(len(self._stage_layers)):
             x = self.forward_stage(x, stage)
         return x
 

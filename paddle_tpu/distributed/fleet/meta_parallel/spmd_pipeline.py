@@ -269,45 +269,31 @@ def stack_stage_params(blocks, num_stages, mesh, axis="pipe",
     parameter's existing named sharding on the trailing dims (so Megatron
     "model"-axis placements survive stacking).
 
-    stage_sizes (V=1 only): per-stage block counts for HETEROGENEOUS
-    partitions (reference analog: LayerDesc segmentation, pp_layers.py:92
-    SegmentLayers — stages need not be equal). Leaves become
-    [S, max(stage_sizes), ...] padded with copies of each stage's first
-    block (NaN-safe placeholders the masked schedule never selects);
-    returns (stacked, valid_mask[S, per_max])."""
+    stage_sizes: per-CHUNK block counts for HETEROGENEOUS partitions
+    (reference analog: LayerDesc segmentation, pp_layers.py:92 SegmentLayers
+    — stages need not be equal; with interleave the reference segments into
+    S*V chunks and composes with PipelineParallelWithInterleave,
+    pipeline_parallel.py:461). len(stage_sizes) == S (V=1) or S*V (V>1,
+    chunk c = l*S + s holds blocks[offsets[c]:offsets[c+1]]). Leaves become
+    [S, per_max, ...] (or [V, S, per_max, ...]) padded with copies of each
+    chunk's first block (NaN-safe placeholders the masked schedule never
+    selects); returns (stacked, valid_mask[S, per_max] or [V, S, per_max]).
+    """
     S, V = num_stages, num_virtual
+    n_chunks = S * V
     proto_params = blocks[0].parameters()
-    if stage_sizes is not None:
-        if V != 1:
-            raise ValueError("ragged stage_sizes require num_virtual=1")
-        if len(stage_sizes) != S or sum(stage_sizes) != len(blocks):
+    ragged = stage_sizes is not None
+    if ragged:
+        if len(stage_sizes) != n_chunks or sum(stage_sizes) != len(blocks):
             raise ValueError(
-                f"stage_sizes {stage_sizes} must have {S} entries summing "
-                f"to {len(blocks)} blocks")
-        per_max = max(stage_sizes)
-        offsets = np.cumsum([0] + list(stage_sizes))
-        mask = np.zeros((S, per_max), bool)
-        stacked = []
-        for k, pp in enumerate(proto_params):
-            rows = []
-            for s in range(S):
-                vals = [blocks[offsets[s] + j].parameters()[k]._value
-                        for j in range(stage_sizes[s])]
-                mask[s, :stage_sizes[s]] = True
-                vals += [vals[0]] * (per_max - stage_sizes[s])
-                rows.append(jnp.stack(vals))
-            leaf = jnp.stack(rows)
-            spec = P()
-            shd = getattr(pp._value, "sharding", None)
-            if isinstance(shd, NamedSharding):
-                spec = shd.spec
-            full_spec = P(axis, None, *tuple(spec))
-            stacked.append(jax.device_put(leaf,
-                                          NamedSharding(mesh, full_spec)))
-        mask_leaf = jax.device_put(jnp.asarray(mask),
-                                   NamedSharding(mesh, P(axis, None)))
-        return stacked, mask_leaf
-    per = len(blocks) // (S * V)
+                f"stage_sizes {stage_sizes} must have {n_chunks} entries "
+                f"summing to {len(blocks)} blocks")
+    else:
+        # uniform = the degenerate ragged partition (equal chunks, no mask)
+        stage_sizes = [len(blocks) // n_chunks] * n_chunks
+    per_max = max(stage_sizes)
+    offsets = np.cumsum([0] + list(stage_sizes))
+    mask = np.zeros((V, S, per_max), bool)
     stacked = []
     for k, pp in enumerate(proto_params):
         laps = []
@@ -315,10 +301,14 @@ def stack_stage_params(blocks, num_stages, mesh, axis="pipe",
             rows = []
             for s in range(S):
                 c = l * S + s
-                vals = [blocks[c * per + j].parameters()[k]._value
-                        for j in range(per)]
+                vals = [blocks[offsets[c] + j].parameters()[k]._value
+                        for j in range(stage_sizes[c])]
+                mask[l, s, :stage_sizes[c]] = True
+                # padding slots are copies of the chunk's first block:
+                # NaN-safe placeholders the masked schedule never selects
+                vals += [vals[0]] * (per_max - stage_sizes[c])
                 rows.append(jnp.stack(vals))
-            laps.append(jnp.stack(rows))             # [S, per, *shape]
+            laps.append(jnp.stack(rows))             # [S, per_max, *shape]
         leaf = laps[0] if V == 1 else jnp.stack(laps)
         spec = P()
         shd = getattr(pp._value, "sharding", None)
@@ -327,7 +317,13 @@ def stack_stage_params(blocks, num_stages, mesh, axis="pipe",
         lead = (axis, None) if V == 1 else (None, axis, None)
         full_spec = P(*lead, *tuple(spec))
         stacked.append(jax.device_put(leaf, NamedSharding(mesh, full_spec)))
-    return stacked
+    if not ragged:
+        return stacked
+    mask_np = mask[0] if V == 1 else mask
+    mask_spec = P(axis, None) if V == 1 else P(None, axis, None)
+    mask_leaf = jax.device_put(jnp.asarray(mask_np),
+                               NamedSharding(mesh, mask_spec))
+    return stacked, mask_leaf
 
 
 def _acc_sharding(mesh, base_spec, shape, axis="sharding"):
@@ -378,10 +374,6 @@ class PipelineTrainStep:
             flat = list(layers)
         self._stage_sizes = list(stage_sizes) if stage_sizes else None
         if self._stage_sizes is not None:
-            if num_virtual > 1:
-                raise ValueError(
-                    "ragged stage_sizes require num_virtual=1 (the "
-                    "interleaved schedule assumes equal chunks)")
             if any(s <= 0 for s in self._stage_sizes):
                 raise ValueError(f"stage_sizes must be positive, got "
                                  f"{self._stage_sizes}")
@@ -410,19 +402,22 @@ class PipelineTrainStep:
 
     # -- construction -----------------------------------------------------
     def _resolve_stage_sizes(self, flat, start, count):
-        """Per-stage block counts. Priority: explicit stage_sizes → a
-        PipelineLayer's LayerDesc segmentation (reference analog:
-        SegmentLayers, pp_layers.py:92) → uniform."""
-        S = self.num_stages
+        """Per-chunk block counts (S entries for V=1, S*V for interleave —
+        reference composes SegmentLayers uneven parts with
+        PipelineParallelWithInterleave, pp_layers.py:92 +
+        pipeline_parallel.py:461). Priority: explicit stage_sizes → a
+        PipelineLayer's LayerDesc segmentation → uniform."""
+        n_chunks = self.num_stages * self.num_virtual
         if self._stage_sizes is not None:
-            if len(self._stage_sizes) != S:
+            if len(self._stage_sizes) != n_chunks:
                 raise ValueError(
                     f"stage_sizes has {len(self._stage_sizes)} entries for "
-                    f"{S} pipeline stages")
+                    f"{n_chunks} pipeline chunks (stages x virtual)")
             return self._stage_sizes
-        if self._pp_segments is not None and len(self._pp_segments) == S + 1:
+        if self._pp_segments is not None and \
+                len(self._pp_segments) == n_chunks + 1:
             sizes = []
-            for s in range(S):
+            for s in range(n_chunks):
                 a, b = self._pp_segments[s], self._pp_segments[s + 1]
                 sizes.append(max(0, min(b, start + count) - max(a, start)))
             if sum(sizes) == count and all(sz > 0 for sz in sizes):
@@ -452,8 +447,8 @@ class PipelineTrainStep:
         S = self.num_stages
         V = self.num_virtual
         flat = self._flat
-        may_ragged = V == 1 and (self._stage_sizes is not None
-                                 or self._pp_segments is not None)
+        may_ragged = (self._stage_sizes is not None
+                      or self._pp_segments is not None)
         start, count = find_block_run(flat, S * V,
                                       require_multiple=not may_ragged)
         sizes = self._resolve_stage_sizes(flat, start, count) if may_ragged \
@@ -488,7 +483,8 @@ class PipelineTrainStep:
         opt = self.optimizer
 
         # stacked block params [S, per, ...] (or [V, S, per, ...]) over the
-        # pipe axis; ragged partitions add a [S, per_max] validity mask
+        # pipe axis; ragged partitions add a validity mask of shape
+        # [S, per_max] (V=1) or [V, S, per_max] (interleaved)
         if sizes is not None:
             self._stacked, self._block_mask = stack_stage_params(
                 self._blocks, S, self.mesh, self.axis, num_virtual=V,
@@ -732,10 +728,12 @@ class PipelineTrainStep:
         REAL block — ragged padding slots are skipped."""
         S, V, per = self.num_stages, self.num_virtual, self._per_stage
         if self._stage_sizes_eff is not None:
+            # ragged: one entry per chunk c = l*S + s; V=1 leaves index
+            # (s, j), V>1 leaves index (l, s, j)
             off = 0
-            for s, sz in enumerate(self._stage_sizes_eff):
+            for c, sz in enumerate(self._stage_sizes_eff):
                 for j in range(sz):
-                    yield off + j, (s, j)
+                    yield off + j, (c, j) if V == 1 else (c // S, c % S, j)
                 off += sz
         elif V == 1:
             for c in range(S):

@@ -591,3 +591,91 @@ class TestInterleavedPipeline:
                 paddle.optimizer.AdamW(learning_rate=1e-2,
                                        parameters=model.parameters()),
                 num_microbatches=3, num_virtual=2)
+
+
+class TestRaggedInterleaved:
+    """Ragged chunk sizes COMPOSED with interleaved virtual stages —
+    reference composes SegmentLayers uneven partitions (pp_layers.py:92)
+    with PipelineParallelWithInterleave (pipeline_parallel.py:461)."""
+
+    def test_ragged_v2_matches_single_device(self):
+        """pp=2 x V=2 with chunk sizes [1,2,2,1] over a 6-layer GPT matches
+        single-device training step for step."""
+        ref = _train_losses_single(steps=4, lr=1e-2, layers=6)
+        set_global_mesh(build_mesh(dp=4, pp=2, sharding=1, sep=1, mp=1,
+                                   devices=jax.devices()[:8]))
+        paddle.seed(0)
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=6))
+        step = PipelineTrainStep(
+            gpt_pipeline_layers(model), GPTPretrainingCriterion(),
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()),
+            num_microbatches=4, num_virtual=2, stage_sizes=[1, 2, 2, 1])
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        got = [float(step(ids, labels)) for _ in range(4)]
+        assert step._stage_sizes_eff == [1, 2, 2, 1]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+        assert got[-1] < got[0]
+
+    def test_ragged_v2_sync_to_model_skips_padding(self):
+        set_global_mesh(build_mesh(dp=4, pp=2, sharding=1, sep=1, mp=1,
+                                   devices=jax.devices()[:8]))
+        paddle.seed(0)
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=6))
+        step = PipelineTrainStep(
+            gpt_pipeline_layers(model), GPTPretrainingCriterion(),
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()),
+            num_microbatches=4, num_virtual=2, stage_sizes=[2, 1, 1, 2])
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        float(step(ids, ids))
+        step.sync_to_model()
+        for p in model.parameters():
+            assert np.all(np.isfinite(np.asarray(p._value)))
+
+    def test_ragged_v2_wrong_chunk_count_raises(self):
+        set_global_mesh(build_mesh(dp=4, pp=2, sharding=1, sep=1, mp=1,
+                                   devices=jax.devices()[:8]))
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=6))
+        step = PipelineTrainStep(
+            gpt_pipeline_layers(model), GPTPretrainingCriterion(),
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()),
+            num_microbatches=4, num_virtual=2, stage_sizes=[3, 3])
+        ids = jnp.zeros((8, 16), jnp.int32)
+        with pytest.raises(ValueError, match="chunks"):
+            float(step(ids, ids))
+
+    def test_pipeline_layer_segments_drive_ragged_interleave(self):
+        """A PipelineLayer with num_virtual_pipeline_stages=2 segments into
+        S*V chunks; an uneven split flows into the masked interleaved
+        pipeline (reference SegmentLayers + interleave composition)."""
+        from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import \
+            PipelineLayer
+        mesh = build_mesh(dp=4, pp=2, sharding=1, sep=1, mp=1,
+                          devices=jax.devices()[:8])
+        set_global_mesh(mesh)
+        paddle.seed(0)
+        # 9 pipeline items (emb + 7 blocks + head) over 4 chunks ->
+        # uniform segmentation [0,3,5,7,9]: the 7-block run splits ragged
+        # [2,2,2,1] across the S*V interleave chunks
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=7))
+        pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2,
+                           num_virtual_pipeline_stages=2)
+        assert len(pl.segment_parts) == 5          # S*V + 1 chunks
+        step = PipelineTrainStep(
+            pl, GPTPretrainingCriterion(),
+            paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=model.parameters()),
+            mesh=mesh, num_microbatches=4, num_virtual=2)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        l0 = float(step(ids, labels))
+        l1 = float(step(ids, labels))
+        assert step._stage_sizes_eff == [2, 2, 2, 1]
+        ref = _train_losses_single(steps=2, layers=7)
+        np.testing.assert_allclose([l0, l1], ref, rtol=1e-5, atol=1e-5)
