@@ -535,10 +535,39 @@ def _partial_bounds(tensor, nranks, rank_id):
     return per * rank_id, per * (rank_id + 1)
 
 
+_partial_p2p_warned = False
+
+
+def _warn_partial_p2p_path():
+    """Once-per-process: the eager partial_send/recv ride the host-mediated
+    pickle-over-TCPStore control plane. Fine for metadata/handshakes; for
+    actual pipeline ACTIVATION traffic the data plane is the compiled
+    ppermute path (spmd_pipeline / ProcessGroupXLA.p2p), which stays on
+    ICI at full bandwidth."""
+    global _partial_p2p_warned
+    if _partial_p2p_warned:
+        return
+    _partial_p2p_warned = True
+    import warnings
+    warnings.warn(
+        "partial_send/partial_recv use the host-mediated (pickle over "
+        "TCPStore) control-plane transport — fine for small slices and "
+        "handshakes, but pipeline activation traffic should ride the "
+        "compiled ppermute data plane (PipelineTrainStep / "
+        "ProcessGroupXLA.p2p) for ICI bandwidth",
+        category=RuntimeWarning, stacklevel=3)
+
+
 def partial_send(tensor, dst=0, group=None, nranks=1, rank_id=0):
     """Send one 1/nranks flat slice of `tensor` (reference:
     collective/partial_send_op.cc — the pipeline's tensor-slice p2p that
-    lets mp-sharded ranks exchange only the slice they own)."""
+    lets mp-sharded ranks exchange only the slice they own).
+
+    Transport note: this eager API is host-mediated (control plane); the
+    intended data plane for per-step activation slices is the compiled
+    ppermute inside the one-program pipeline (spmd_pipeline.py). A
+    once-per-process RuntimeWarning marks the distinction."""
+    _warn_partial_p2p_path()
     lo, hi = _partial_bounds(tensor, nranks, rank_id)
     flat = jnp.reshape(tensor._value, (-1,))[lo:hi]
     return send(Tensor(flat, stop_gradient=True), dst=dst, group=group)
@@ -546,7 +575,8 @@ def partial_send(tensor, dst=0, group=None, nranks=1, rank_id=0):
 
 def partial_recv(tensor, src=0, group=None, nranks=1, rank_id=0):
     """Receive into one 1/nranks flat slice of `tensor` (reference:
-    collective/partial_recv_op.cc)."""
+    collective/partial_recv_op.cc). Same transport note as partial_send."""
+    _warn_partial_p2p_path()
     lo, hi = _partial_bounds(tensor, nranks, rank_id)
     buf = Tensor(jnp.zeros((hi - lo,), tensor._value.dtype),
                  stop_gradient=True)

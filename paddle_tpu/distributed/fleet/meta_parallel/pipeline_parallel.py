@@ -100,8 +100,10 @@ class PipelineParallel(Layer):
         else:
             x, label = micro_batch, None
         out = x
-        for stage in range(self.num_stages):
-            out = self._layers.forward_stage(out, stage)
+        # iterate CHUNKS, not stages: with interleave (num_virtual > 1) the
+        # PipelineLayer holds S*V chunk groups
+        for chunk in range(len(self._layers._stage_layers)):
+            out = self._layers.forward_stage(out, chunk)
         if self._layers._loss_fn is not None and label is not None:
             return self._layers._loss_fn(out, label)
         return out
@@ -119,15 +121,25 @@ class PipelineParallel(Layer):
                          and len(data) == 2)
         if spmd_eligible:
             self._layers.train()     # trace in train mode (dropout on)
+            V = getattr(self._layers, "_num_virtual", 1)
             num_micro = max(self.accumulate_steps, self._mesh_pipe_degree())
-            step_key = (id(optimizer), num_micro)
+            if V > 1 and num_micro % self._mesh_pipe_degree():
+                # no silent rounding: a rounded count would fail later with
+                # a batch-divisibility error naming a value the user never
+                # set (reference interleave has the same constraint)
+                raise ValueError(
+                    f"interleaved pipeline (num_virtual={V}) needs "
+                    f"accumulate_steps ({self.accumulate_steps}, effective "
+                    f"micro-batches {num_micro}) divisible by the pipe "
+                    f"degree ({self._mesh_pipe_degree()})")
+            step_key = (id(optimizer), num_micro, V)
             if self._spmd_step is None or self._spmd_key != step_key:
                 if self._spmd_step is not None:
                     self._spmd_step.sync_to_model()   # hand off prior state
                 from .spmd_pipeline import PipelineTrainStep
                 self._spmd_step = PipelineTrainStep(
                     self._layers, self._layers._loss_fn, optimizer,
-                    num_microbatches=num_micro)
+                    num_microbatches=num_micro, num_virtual=V)
                 self._spmd_key = step_key
             x, y = data
             loss = self._spmd_step(x, y)

@@ -245,3 +245,20 @@ def test_sparse_table_entry_admission():
     second = t.pull([7])                 # second touch admits
     assert 7 in t.rows
     assert np.abs(second).sum() > 0
+
+
+def test_partial_p2p_warns_once_about_control_plane():
+    """partial_send/recv ride the host-mediated path: a once-per-process
+    RuntimeWarning must point users at the compiled ppermute data plane."""
+    import warnings
+    import paddle_tpu.distributed as d
+    from paddle_tpu.distributed import collective as coll
+    coll._partial_p2p_warned = False      # reset the once-latch
+    t = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        d.partial_send(t, dst=0, nranks=2, rank_id=0)
+        d.partial_send(t, dst=0, nranks=2, rank_id=1)
+    msgs = [x for x in w if issubclass(x.category, RuntimeWarning)
+            and "ppermute" in str(x.message)]
+    assert len(msgs) == 1                 # fired exactly once

@@ -679,3 +679,34 @@ class TestRaggedInterleaved:
         assert step._stage_sizes_eff == [2, 2, 2, 1]
         ref = _train_losses_single(steps=2, layers=7)
         np.testing.assert_allclose([l0, l1], ref, rtol=1e-5, atol=1e-5)
+
+    def test_train_batch_forwards_interleave(self):
+        """An interleave-configured PipelineLayer flows its num_virtual into
+        the SPMD step, and the single-controller fallback runs ALL S*V
+        chunks (head included)."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel, PipelineLayer)
+
+        mesh = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                          devices=jax.devices()[:2])
+        set_global_mesh(mesh)
+        paddle.seed(0)
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=8))
+        crit = GPTPretrainingCriterion()
+        pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2,
+                           loss_fn=crit, num_virtual_pipeline_stages=2)
+        runner = PipelineParallel(pl, hcg=None)
+        runner.accumulate_steps = 2      # rounded up to a multiple of S
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+        l1 = float(runner.train_batch((ids, labels), opt))
+        assert runner._spmd_step is not None
+        assert runner._spmd_step.num_virtual == 2
+        # eval_batch exercises the fallback chunk walk: must produce a LOSS
+        # (i.e. the head chunk ran), not hidden states
+        ev = runner.eval_batch((ids, labels))
+        assert np.isfinite(float(ev))
+        assert np.isfinite(l1)
