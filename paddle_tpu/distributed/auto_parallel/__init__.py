@@ -31,3 +31,4 @@ __all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor",
 from .planner import (  # noqa: F401
     ModelStats, PlanChoice, plan_mesh, gpt_stats,
 )
+from .tuner import TuneReport, tune_mesh, gpt_measure_fn  # noqa: F401

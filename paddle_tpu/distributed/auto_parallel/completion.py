@@ -20,6 +20,18 @@ parameters with Megatron pairing rules, then device_put each decision
     forward);
   - an Embedding weight sharded on the feature dim behaves like a column
     mark for the following Linear;
+  - a FUSED-QKV attention block (4-D qkv_weight [3, H, D, h], reference
+    incubate FusedMultiHeadAttention) marked on the heads dim completes
+    head-parallel: qkv_bias on the same axis, out-projection row-parallel
+    — and an incoming column mark completes the whole block the same way;
+  - a fused FFN block (linear1 [d, ff] + linear2 [ff, d] in one layer)
+    marked column on linear1 completes linear2 row-parallel in place;
+  - a CONV pair: weight [out_c, in_c, kh, kw] marked on the out-channel
+    dim propagates its axis to the bias and completes the NEXT conv
+    in-channel-sharded (the Megatron pairing in channel space);
+  - a MoE EXPERT BANK (stacked 3-D expert weights [E, ...]) marked on the
+    expert dim completes every same-bank param (leading dim E) on that
+    axis; the gate stays replicated (reference moe/moe_layer.py experts);
   - 1-D norm/scale params between a column and row partner stay
     replicated;
   - anything with no annotated neighbor completes as replicated.
@@ -71,6 +83,154 @@ def _apply(p, mesh, spec):
     p._dist_attr = (mesh, list(spec))
 
 
+def _complete_fused_attention(params, specs, mesh, decisions, open_axis):
+    """Fused-QKV attention block: qkv_weight [3, H, D, h] + 2-D out
+    projection in ONE layer (incubate FusedMultiHeadAttention). A mark on
+    the heads dim — or an incoming column axis — completes the block
+    head-parallel with a row-parallel out projection (the Megatron
+    attention placement, reference dist_fused_attention.py)."""
+    qkv = next(((n, p) for n, p in params
+                if p is not None and p._value.ndim == 4
+                and p._value.shape[0] == 3), None)
+    out = next(((n, p) for n, p in params
+                if p is not None and p._value.ndim == 2
+                and p._value.shape[0] == p._value.shape[1]), None)
+    if qkv is None:
+        return open_axis
+    qname, qw = qkv
+    qspec = specs.get(qname)
+    axis = None
+    if qspec is not None:
+        head_axes = _axes_of(qspec[1])
+        if not head_axes:
+            return None                  # user pinned something else: close
+        axis = head_axes[0]
+    elif open_axis is not None:
+        axis = open_axis
+        _apply(qw, mesh, [None, axis, None, None])
+        decisions[qw.name] = [None, axis, None, None]
+    else:
+        return open_axis
+    for n, p in params:
+        if p is None or n == qname or specs.get(n) is not None:
+            continue
+        if p._value.ndim == 3 and p._value.shape[0] == 3:   # qkv_bias
+            _apply(p, mesh, [None, axis, None])
+            decisions[p.name] = [None, axis, None]
+        elif out is not None and n == out[0]:               # row partner
+            _apply(p, mesh, [axis, None])
+            decisions[p.name] = [axis, None]
+    return None                          # pair closed inside the block
+
+
+def _complete_fused_ffn(params, specs, mesh, decisions, open_axis):
+    """Fused FFN block: linear1 [d, ff] + linear2 [ff, d] in one layer
+    (incubate FusedFeedForward). A column mark on linear1 completes
+    linear2 row-parallel in place; an incoming open axis closes on
+    linear1 as its row partner (same as the plain-Linear rule)."""
+    two_d = [(n, p) for n, p in params
+             if p is not None and p._value.ndim == 2]
+    if len(two_d) < 2:
+        return open_axis
+    (n1, w1), (n2, w2) = two_d[0], two_d[1]
+    if w1._value.shape[1] != w2._value.shape[0]:
+        return open_axis
+    ff = w1._value.shape[1]
+    s1 = specs.get(n1)
+    if s1 is not None:
+        out_axes = _axes_of(s1[1])
+        if not out_axes:
+            return None
+        axis = out_axes[0]
+        # linear1's bias is the FIRST ff-sized 1-D param between w1 and w2
+        # in creation order — shape alone is ambiguous when d_model == ff
+        # (ln scales are the same size and must stay replicated)
+        names = [n for n, _ in params]
+        i1, i2 = names.index(n1), names.index(n2)
+        bias1 = next((n for n, p in params[i1 + 1:i2]
+                      if p is not None and p._value.ndim == 1
+                      and p._value.shape[0] == ff
+                      and specs.get(n) is None), None)
+        for n, p in params:
+            if p is None or n == n1 or specs.get(n) is not None:
+                continue
+            if n == bias1:
+                _apply(p, mesh, [axis])          # linear1 bias
+                decisions[p.name] = [axis]
+            elif n == n2:
+                _apply(p, mesh, [axis, None])    # row partner
+                decisions[p.name] = [axis, None]
+        return None
+    if open_axis is not None and specs.get(n1) is None:
+        _apply(w1, mesh, [open_axis, None])      # close as row partner
+        decisions[w1.name] = [open_axis, None]
+        return None
+    return open_axis
+
+
+def _complete_conv(params, specs, mesh, decisions, open_axis,
+                   transposed=False):
+    """Conv pairing in channel space: weight [out_c, in_c, kh, kw] marked
+    on the OUT-channel dim carries its axis (bias follows); the next conv
+    completes IN-channel-sharded (GSPMD places the psum) and closes the
+    pair — the Megatron rule lifted to conv towers. Transposed convs store
+    [in_c, out_c, kh, kw], so the channel dims swap."""
+    wname, w = next((n, p) for n, p in params
+                    if p is not None and p._value.ndim == 4)
+    out_dim, in_dim = (1, 0) if transposed else (0, 1)
+    wspec = specs.get(wname)
+    if wspec is not None:
+        if _axes_of(wspec[out_dim]):             # out-channel mark
+            axis = _axes_of(wspec[out_dim])[0]
+            for n, p in params:
+                if p is None or n == wname or specs.get(n) is not None:
+                    continue
+                if p._value.ndim == 1:
+                    _apply(p, mesh, [axis])
+                    decisions[p.name] = [axis]
+            return axis
+        return None                              # in-channel/pinned: close
+    if open_axis is not None:
+        spec = [None] * 4
+        spec[in_dim] = open_axis
+        _apply(w, mesh, spec)
+        decisions[w.name] = spec
+        return None
+    return open_axis
+
+
+def _complete_expert_bank(params, specs, expert_banks, mesh, decisions,
+                          open_axis):
+    """MoE expert bank: stacked 3-D expert weights [E, in, out]. A mark on
+    the expert dim completes EVERY same-bank param (leading dim E, e.g.
+    w2 [E, ff, d] and the [E, ...] biases) on that axis; the gate (no E
+    leading dim) stays replicated. Reference: incubate moe_layer.py
+    experts + dist_op expert placement."""
+    marked = None
+    for n, p in expert_banks:
+        s = specs.get(n)
+        if s is not None and _axes_of(s[0]):
+            marked = (_axes_of(s[0])[0], p._value.shape[0])
+            break
+    if marked is None:
+        return open_axis
+    axis, n_experts = marked
+    for n, p in params:
+        if p is None or specs.get(n) is not None:
+            continue
+        # gates route INTO the bank and stay replicated even when their
+        # leading dim collides with E (d_model == num_experts); the name
+        # is the only disambiguator, matching the reference's named gate
+        # component (moe/gate/)
+        if "gate" in n.lower():
+            continue
+        if p._value.ndim >= 2 and p._value.shape[0] == n_experts:
+            spec = [axis] + [None] * (p._value.ndim - 1)
+            _apply(p, mesh, spec)
+            decisions[p.name] = spec
+    return open_axis
+
+
 def complete_model_sharding(model, process_mesh=None):
     """Complete missing parameter placements from the model's partial
     shard_tensor annotations. Returns {param_name: spec} for every
@@ -91,10 +251,31 @@ def complete_model_sharding(model, process_mesh=None):
         is_linear = "linear" in kind and any(
             p is not None and p._value.ndim == 2 for _, p in params)
         is_embedding = "embedding" in kind
+        is_conv = "conv" in kind and any(
+            p is not None and p._value.ndim == 4 for _, p in params)
+        has_qkv4 = any(p is not None and p._value.ndim == 4
+                       and p._value.shape[0] == 3 for _, p in params)
+        expert_banks = [(n, p) for n, p in params
+                        if p is not None and p._value.ndim == 3]
         specs = {n: _existing_spec(p) for n, p in params if p is not None}
         annotated = {n: s for n, s in specs.items() if s is not None}
 
-        if is_linear:
+        # fused attention first: its 3-D qkv_bias must not be mistaken for
+        # an expert bank
+        if has_qkv4 and ("attention" in kind or "transformer" in kind):
+            open_axis = _complete_fused_attention(
+                params, specs, mesh, decisions, open_axis)
+        elif "feedforward" in kind or "ffn" in kind:
+            open_axis = _complete_fused_ffn(
+                params, specs, mesh, decisions, open_axis)
+        elif expert_banks:
+            open_axis = _complete_expert_bank(
+                params, specs, expert_banks, mesh, decisions, open_axis)
+        elif is_conv:
+            open_axis = _complete_conv(
+                params, specs, mesh, decisions, open_axis,
+                transposed="transpose" in kind)
+        elif is_linear:
             wname, w = next((n, p) for n, p in params
                             if p is not None and p._value.ndim == 2)
             wspec = specs.get(wname)

@@ -99,11 +99,9 @@ class PipelineParallel(Layer):
             x, label = micro_batch
         else:
             x, label = micro_batch, None
-        out = x
-        # iterate CHUNKS, not stages: with interleave (num_virtual > 1) the
-        # PipelineLayer holds S*V chunk groups
-        for chunk in range(len(self._layers._stage_layers)):
-            out = self._layers.forward_stage(out, chunk)
+        # PipelineLayer.forward owns the chunk traversal (all S*V chunks,
+        # interleave included) — no second walk to keep in sync here
+        out = self._layers(x)
         if self._layers._loss_fn is not None and label is not None:
             return self._layers._loss_fn(out, label)
         return out

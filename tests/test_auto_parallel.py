@@ -440,3 +440,240 @@ class TestCompletionEdgeCases:
         hist = engine.fit(DS(), epochs=2, batch_size=16, verbose=0)
         assert np.isfinite(hist["loss"][-1])
         assert tuple(model[2].weight._value.sharding.spec)[0] == "model"
+
+
+class TestCompletionPatterns:
+    """Completion beyond Linear/Embedding pairs: fused-qkv attention,
+    conv channel pairing, MoE expert banks (round-4 verdict item 5)."""
+
+    def _mesh(self):
+        n = len(jax.devices())
+        return ProcessMesh(np.arange(n).reshape(1, n),
+                           dim_names=["data", "model"])
+
+    def test_fused_qkv_attention_completes_head_parallel(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        paddle.seed(0)
+        pm = self._mesh()
+        attn = FusedMultiHeadAttention(embed_dim=64, num_heads=8)
+        # the ONLY mark: qkv_weight [3, H, D, h] on the heads dim
+        shard_tensor(attn.qkv_weight, pm, [None, "model", None, None])
+        complete_model_sharding(attn, pm)
+        assert tuple(attn.qkv_bias._value.sharding.spec)[1] == "model"
+        # out projection completes ROW-parallel
+        assert tuple(attn.linear_weight._value.sharding.spec)[0] == "model"
+        for p in [attn.linear_bias, attn.ln_scale, attn.ln_bias]:
+            assert all(s is None for s in p._value.sharding.spec)
+
+    def test_fused_ffn_completes_row_partner(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        paddle.seed(0)
+        pm = self._mesh()
+        ffn = FusedFeedForward(d_model=16, dim_feedforward=64)
+        shard_tensor(ffn.linear1_weight, pm, [None, "model"])
+        complete_model_sharding(ffn, pm)
+        assert tuple(ffn.linear1_bias._value.sharding.spec) == ("model",)
+        assert tuple(ffn.linear2_weight._value.sharding.spec)[0] == "model"
+        assert all(s is None
+                   for s in ffn.linear2_bias._value.sharding.spec)
+
+    def test_conv_tower_channel_pairing(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        pm = self._mesh()
+        model = nn.Sequential(nn.Conv2D(3, 16, 3), nn.ReLU(),
+                              nn.Conv2D(16, 8, 3))
+        # mark the FIRST conv out-channel-parallel
+        shard_tensor(model[0].weight, pm, ["model", None, None, None])
+        complete_model_sharding(model, pm)
+        assert tuple(model[0].bias._value.sharding.spec) == ("model",)
+        # next conv completes IN-channel-sharded (dim 1), closing the pair
+        spec2 = tuple(model[2].weight._value.sharding.spec)
+        assert spec2[1] == "model" and spec2[0] is None
+        assert all(s is None for s in model[2].bias._value.sharding.spec)
+
+    def test_conv_tower_forward_matches_replicated(self):
+        """The completed channel-pair placement must be numerically
+        invisible: GSPMD inserts the psum."""
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        model = nn.Sequential(nn.Conv2D(3, 16, 3), nn.ReLU(),
+                              nn.Conv2D(16, 8, 3))
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(2, 3, 12, 12))
+            .astype(np.float32))
+        ref = model(x).numpy()
+        pm = self._mesh()
+        shard_tensor(model[0].weight, pm, ["model", None, None, None])
+        complete_model_sharding(model, pm)
+        np.testing.assert_allclose(model(x).numpy(), ref,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_moe_expert_bank_completes_on_expert_axis(self):
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        paddle.seed(0)
+        pm = self._mesh()
+        moe = MoELayer(d_model=16, d_hidden=32, num_experts=8,
+                       moe_axis="model")
+        # one mark: w1 [E, d, ff] on the expert dim
+        shard_tensor(moe.w1, pm, ["model", None, None])
+        complete_model_sharding(moe, pm)
+        for p in [moe.b1, moe.w2, moe.b2]:
+            assert tuple(p._value.sharding.spec)[0] == "model", p.name
+        # the gate stays replicated
+        assert all(s is None
+                   for s in moe.gate_weight._value.sharding.spec)
+
+
+class TestMeasuringTuner:
+    """Reference analog: auto_parallel/tuner/parallel_tuner.py — the tuner
+    must pick the MEASURED best, not the analytic best. (Also hosts three
+    completion-regression tests appended from review findings.)"""
+
+    def _mesh(self):
+        n = len(jax.devices())
+        return ProcessMesh(np.arange(n).reshape(1, n),
+                           dim_names=["data", "model"])
+
+    def test_measurement_overrides_analytic_rank(self):
+        """When the injected measurements say analytic rank-2 is faster,
+        the tuner chooses it."""
+        from paddle_tpu.distributed.auto_parallel import (gpt_stats,
+                                                          tune_mesh)
+        from paddle_tpu.incubate.models import GPTConfig
+        cfg = GPTConfig(vocab_size=256, hidden_size=64,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        intermediate_size=128, max_position_embeddings=128)
+        stats = gpt_stats(cfg, seq_len=128)
+        ranked_order = []
+
+        def fake_measure(choice):
+            ranked_order.append(choice)
+            # rank-2 (the second candidate trialed) measures fastest
+            return 0.5 if len(ranked_order) == 2 else 1.0
+
+        report = tune_mesh(stats, n_devices=8, batch=32,
+                           measure_fn=fake_measure, top_k=3)
+        assert len(report.candidates) == 3
+        second = report.candidates[1]
+        assert (report.best.dp, report.best.mp, report.best.pp,
+                report.best.sharding) == (second.dp, second.mp,
+                                          second.pp, second.sharding)
+        assert report.measurement_changed_plan
+
+    def test_agreement_keeps_analytic_best(self):
+        from paddle_tpu.distributed.auto_parallel import (gpt_stats,
+                                                          tune_mesh)
+        from paddle_tpu.incubate.models import GPTConfig
+        cfg = GPTConfig(vocab_size=256, hidden_size=64,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        intermediate_size=128, max_position_embeddings=128)
+        stats = gpt_stats(cfg, seq_len=128)
+        costs = iter([0.1, 0.5, 0.9])
+
+        def fake_measure(choice):
+            return next(costs)
+
+        report = tune_mesh(stats, n_devices=8, batch=32,
+                           measure_fn=fake_measure, top_k=3)
+        assert not report.measurement_changed_plan
+
+    def test_rounds_take_min(self):
+        from paddle_tpu.distributed.auto_parallel import (gpt_stats,
+                                                          tune_mesh)
+        from paddle_tpu.incubate.models import GPTConfig
+        cfg = GPTConfig(vocab_size=256, hidden_size=64,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        intermediate_size=128, max_position_embeddings=128)
+        stats = gpt_stats(cfg, seq_len=128)
+        calls = {}
+
+        def fake_measure(choice):
+            k = (choice.dp, choice.mp, choice.pp, choice.sharding)
+            calls[k] = calls.get(k, 0) + 1
+            return 1.0 / calls[k]        # later rounds measure faster
+
+        report = tune_mesh(stats, n_devices=8, batch=32,
+                           measure_fn=fake_measure, top_k=2, rounds=2)
+        assert all(v == 2 for v in calls.values())
+        assert all(t == 0.5 for t in report.measured_s.values())
+
+    def test_real_compile_and_time_top2(self):
+        """End-to-end: the tuner compiles and times the top-2 plans of a
+        tiny GPT on the live virtual mesh and returns a measured winner."""
+        from paddle_tpu.distributed.auto_parallel import (gpt_stats,
+                                                          tune_mesh,
+                                                          gpt_measure_fn)
+        from paddle_tpu.incubate.models import GPTConfig
+        cfg = GPTConfig(vocab_size=128, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=64, max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        use_flash_attention=False)
+        stats = gpt_stats(cfg, seq_len=64)
+        report = tune_mesh(stats, n_devices=8, batch=16,
+                           measure_fn=gpt_measure_fn(cfg, batch=16, seq=64,
+                                                     steps=1),
+                           top_k=2)
+        assert len(report.measured_s) == 2
+        assert all(t > 0 for t in report.measured_s.values())
+        key = (report.best.dp, report.best.mp, report.best.pp,
+               report.best.sharding)
+        assert report.measured_s[key] == min(report.measured_s.values())
+
+    def test_fused_ffn_square_dims_keep_norms_replicated(self):
+        """d_model == dim_feedforward: ln params share linear1_bias's shape
+        but must stay replicated (review regression)."""
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        paddle.seed(0)
+        pm = self._mesh()
+        ffn = FusedFeedForward(d_model=64, dim_feedforward=64)
+        shard_tensor(ffn.linear1_weight, pm, [None, "model"])
+        complete_model_sharding(ffn, pm)
+        assert tuple(ffn.linear1_bias._value.sharding.spec) == ("model",)
+        assert tuple(ffn.linear2_weight._value.sharding.spec)[0] == "model"
+        for n, p in ffn.named_parameters():
+            if "ln" in n or n.endswith("linear2_bias"):
+                assert all(s is None for s in p._value.sharding.spec), n
+
+    def test_moe_gate_replicated_when_dmodel_equals_experts(self):
+        """d_model == num_experts: the gate's leading dim collides with E
+        but must stay replicated (review regression)."""
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        paddle.seed(0)
+        pm = self._mesh()
+        moe = MoELayer(d_model=8, d_hidden=32, num_experts=8,
+                       moe_axis="model")
+        shard_tensor(moe.w1, pm, ["model", None, None])
+        complete_model_sharding(moe, pm)
+        assert all(s is None
+                   for s in moe.gate_weight._value.sharding.spec)
+        assert tuple(moe.w2._value.sharding.spec)[0] == "model"
+
+    def test_conv_transpose_channel_dims_swapped(self):
+        """Conv2DTranspose stores [in_c, out_c, kh, kw]: an out-channel
+        mark is dim 1 and the pairing must respect it."""
+        from paddle_tpu.distributed.auto_parallel import \
+            complete_model_sharding
+        paddle.seed(0)
+        pm = self._mesh()
+        model = nn.Sequential(nn.Conv2DTranspose(3, 16, 3), nn.ReLU(),
+                              nn.Conv2D(16, 8, 3))
+        shard_tensor(model[0].weight, pm, [None, "model", None, None])
+        complete_model_sharding(model, pm)
+        assert tuple(model[0].bias._value.sharding.spec) == ("model",)
+        spec2 = tuple(model[2].weight._value.sharding.spec)
+        assert spec2[1] == "model" and spec2[0] is None
