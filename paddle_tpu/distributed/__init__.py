@@ -17,6 +17,8 @@ from .collective import (  # noqa: F401
     partial_recv, P2POp, batch_isend_irecv,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import communication  # noqa: F401
+from .communication import stream  # noqa: F401
 from ..core import TCPStore  # noqa: F401  (reference: core.TCPStore)
 from . import fleet  # noqa: F401
 from . import io  # noqa: F401

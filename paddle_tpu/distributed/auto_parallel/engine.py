@@ -95,6 +95,37 @@ class Engine:
                 shard_optimizer_states(self._optimizer)
         return self._train_step
 
+    def tune(self, stats, batch, measure_fn, n_devices=None):
+        """Measure-and-pick the mesh factorization (reference analog:
+        Engine._tune -> tuner/parallel_tuner.py when strategy.auto_mode is
+        'full'). Trials the planner's top plans with `measure_fn` (see
+        tuner.gpt_measure_fn), stores the TuneReport, and — when
+        strategy.tuning.run_after_tuning — installs the winning plan as
+        this Engine's ProcessMesh so the next fit() trains on it (pp>1
+        winners keep the pipe axis for PipelineTrainStep consumers)."""
+        from .tuner import tune_mesh
+        from .process_mesh import ProcessMesh
+        cfg = self._strategy.tuning
+        n = n_devices or len(jax.devices())
+        report = tune_mesh(stats, n_devices=n, batch=batch,
+                           measure_fn=measure_fn,
+                           top_k=getattr(cfg, "top_k", 3),
+                           rounds=getattr(cfg, "rounds", 1))
+        self._tune_report = report
+        if getattr(cfg, "run_after_tuning", True):
+            b = report.best
+            data = b.dp * b.sharding
+            if b.pp > 1:
+                shape = (data, b.pp, b.mp)
+                names = ["data", "pipe", "model"]
+            else:
+                shape = (data, b.mp)
+                names = ["data", "model"]
+            self._process_mesh = ProcessMesh(
+                np.arange(n).reshape(shape), dim_names=names)
+            self._train_step = None          # retrace on the new mesh
+        return report
+
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
             log_freq=10, verbose=1, shuffle=True, collate_fn=None):
         from ...io import DataLoader

@@ -40,6 +40,8 @@ class Strategy:
         self.pipeline = _Config(enable=False, schedule_mode="1F1B",
                                 micro_batch_size=1, accumulate_steps=1)
         self.fused_passes = _Config(enable=True, fused_opt=True)
+        self.tuning = _Config(enable=False, top_k=3, rounds=1,
+                              run_after_tuning=True, verbose=0)
         self.dataset = _Config(batch_dim=None)
         if config:
             for section, values in config.items():

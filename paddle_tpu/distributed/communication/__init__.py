@@ -1,0 +1,22 @@
+"""paddle.distributed.communication — per-primitive communication package.
+
+Reference analog: python/paddle/distributed/communication/ (one module per
+primitive + the `stream` variants). The eager implementations live in
+paddle_tpu.distributed.collective; this package re-exports them under the
+reference layout so `paddle.distributed.communication.stream.all_reduce`
+resolves.
+"""
+from ..collective import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, alltoall,
+    alltoall_single, broadcast, reduce, reduce_scatter, scatter, send, recv,
+    isend, irecv, batch_isend_irecv, P2POp, barrier, wait, get_group,
+    destroy_process_group,
+)
+from ..env import is_initialized  # noqa: F401
+from . import stream  # noqa: F401
+
+__all__ = ["stream", "ReduceOp", "all_reduce", "all_gather",
+           "all_gather_object", "alltoall", "alltoall_single", "broadcast",
+           "reduce", "reduce_scatter", "scatter", "send", "recv", "isend",
+           "irecv", "batch_isend_irecv", "P2POp", "barrier", "wait",
+           "get_group", "destroy_process_group", "is_initialized"]

@@ -1,0 +1,72 @@
+"""Fleet UtilBase: rank-aware helper toolbox.
+
+Reference analog: python/paddle/distributed/fleet/base/util_factory.py —
+all_reduce/barrier/all_gather over the role's comm world, file sharding for
+data-parallel input, print_on_rank. Backed here by the eager collective API
+(ProcessGroupXLA / host control plane), a no-op at world 1.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    # -- collectives (reference util_factory.py all_reduce :87) -------------
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import paddle_tpu as paddle
+        from ...collective import all_reduce, ReduceOp
+        from ...env import get_world_size
+        ops = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+               "min": ReduceOp.MIN}
+        if mode not in ops:
+            raise ValueError(f"unknown all_reduce mode {mode!r}")
+        arr = np.asarray(input)
+        if get_world_size() <= 1:
+            return arr
+        t = paddle.to_tensor(arr)
+        all_reduce(t, op=ops[mode])
+        return np.asarray(t._value)
+
+    def barrier(self, comm_world="worker"):
+        from ...collective import barrier
+        from ...env import get_world_size
+        if get_world_size() > 1:
+            barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ...collective import all_gather_object
+        from ...env import get_world_size
+        if get_world_size() <= 1:
+            return [input]
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    # -- data sharding (reference util_factory.py get_file_shard :230) ------
+    def get_file_shard(self, files):
+        """Split `files` contiguously over workers; earlier workers take
+        the remainder (exactly the reference's blocking rule)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        rm = self.role_maker
+        trainer_id = rm._worker_index() if rm else 0
+        trainers = rm._worker_num() if rm else 1
+        blocks = len(files) // trainers
+        remainder = len(files) % trainers
+        begin = trainer_id * blocks + min(trainer_id, remainder)
+        end = begin + blocks + (1 if trainer_id < remainder else 0)
+        return files[begin:end]
+
+    def print_on_rank(self, message, rank_id):
+        rm = self.role_maker
+        me = rm._worker_index() if rm else 0
+        if me == rank_id:
+            print(message, flush=True)

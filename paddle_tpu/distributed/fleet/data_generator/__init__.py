@@ -1,0 +1,15 @@
+"""User-defined data generators for the fleet dataset pipeline.
+
+Reference analog: python/paddle/distributed/fleet/data_generator/
+data_generator.py — a user subclasses MultiSlot(String)DataGenerator,
+implements generate_sample(line) returning an iterator of
+[(slot_name, [values...]), ...], and the generator renders the MultiSlot
+text protocol ("ids_num id1 id2 ..." per slot) consumed by the dataset
+ingest (here: fleet.dataset InMemoryDataset/QueueDataset parsers).
+"""
+from .data_generator import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]

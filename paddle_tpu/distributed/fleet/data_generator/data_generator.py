@@ -1,0 +1,156 @@
+"""DataGenerator protocol (reference data_generator.py).
+
+generate_sample(line) -> iterator of samples, each
+    [(slot_name, [value, ...]), ...]
+_gen_str renders one sample to the MultiSlot wire line; run_from_stdin /
+run_from_memory drive lines through the pipeline exactly like the
+reference's pipe_command subprocess mode.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """User hook: return a callable/iterator yielding samples of shape
+        [(slot_name, [values...]), ...] (reference :153)."""
+        raise NotImplementedError(
+            "please rewrite this function to return a list or tuple: "
+            "[('words', [1926, 8, 17]), ('label', [1])]")
+
+    def generate_batch(self, samples):
+        """User hook: batch-level postprocessing (default passthrough)."""
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "Please inherit MultiSlotDataGenerator or "
+            "MultiSlotStringDataGenerator")
+
+    def run_from_stdin(self):
+        """Reference :95 — the pipe_command mode: read raw lines on stdin,
+        emit MultiSlot lines on stdout."""
+        batch_samples = []
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    batch_iter = self.generate_batch(batch_samples)
+                    for sample in batch_iter():
+                        sys.stdout.write(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            batch_iter = self.generate_batch(batch_samples)
+            for sample in batch_iter():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_memory(self, lines):
+        """In-process variant: render `lines` to MultiSlot text lines
+        (feeds fleet.dataset directly without a subprocess)."""
+        out = []
+        batch_samples = []
+        for line in lines:
+            for user_parsed_line in self.generate_sample(line)():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    for sample in self.generate_batch(batch_samples)():
+                        out.append(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            for sample in self.generate_batch(batch_samples)():
+                out.append(self._gen_str(sample))
+        return out
+
+
+def _check_slots(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of process() must be in list or tuple type "
+            "Example: [('words', [1926, 8, 17]), ('label', [1])]")
+    return line
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots: each rendered as 'ids_num id1 id2 ...'
+    (reference :137)."""
+
+    def _gen_str(self, line):
+        line = _check_slots(line)
+        output = ""
+        if self._proto_info is None:
+            self._proto_info = []
+            for item in line:
+                name, elements = item
+                if not isinstance(name, str):
+                    raise ValueError("name of slot must be str")
+                if not isinstance(elements, list):
+                    raise ValueError("elements of each slot must be list")
+                if not elements:
+                    raise ValueError("the elements of a slot cannot be empty")
+                kind = "uint64" if all(
+                    isinstance(e, int) for e in elements) else "float"
+                self._proto_info.append((name, kind))
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for e in elements:
+                    output += " " + str(e)
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the complete field set of two given line are "
+                    f"inconsistent: {len(line)} vs {len(self._proto_info)}")
+            for i, item in enumerate(line):
+                name, elements = item
+                if name != self._proto_info[i][0]:
+                    raise ValueError(
+                        "the field name of two given line are not match: "
+                        f"{name} vs {self._proto_info[i][0]}")
+                if output:
+                    output += " "
+                output += str(len(elements))
+                for e in elements:
+                    output += " " + str(e)
+        return output + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String slots: no type bookkeeping, values emitted verbatim
+    (reference :240)."""
+
+    def _gen_str(self, line):
+        line = _check_slots(line)
+        output = ""
+        for item in line:
+            name, elements = item
+            if not isinstance(name, str):
+                raise ValueError("name of slot must be str")
+            if not isinstance(elements, list):
+                raise ValueError("elements of each slot must be list")
+            if output:
+                output += " "
+            output += str(len(elements))
+            for e in elements:
+                output += " " + str(e)
+        return output + "\n"

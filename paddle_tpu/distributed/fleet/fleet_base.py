@@ -1,27 +1,46 @@
-"""Fleet facade functions. Reference analog: fleet/fleet.py:98 (class Fleet:
+"""Fleet facade. Reference analog: fleet/fleet.py:98 (class Fleet:
 init :166, _init_hybrid_parallel_env :382, distributed_model via
-fleet/model.py:30, distributed_optimizer via fleet/optimizer.py)."""
+fleet/model.py:30, distributed_optimizer via fleet/optimizer.py; the
+module binds a singleton's methods at import, fleet/__init__.py:52)."""
 from __future__ import annotations
 
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
                             ParallelMode)
+from .base.role_maker import (Role, RoleMakerBase, UserDefinedRoleMaker,
+                              PaddleCloudRoleMaker)
+from .base.util_factory import UtilBase
 from ..env import init_parallel_env, get_rank, get_world_size
 
-__all__ = ["init", "is_first_worker", "worker_index", "worker_num",
-           "is_worker", "distributed_model", "distributed_optimizer",
+__all__ = ["Fleet", "init", "is_first_worker", "worker_index", "worker_num",
+           "is_worker", "is_server", "worker_endpoints", "server_num",
+           "server_index", "server_endpoints", "barrier_worker",
+           "init_worker", "init_server", "run_server", "stop_worker",
+           "distributed_model", "distributed_optimizer",
            "get_hybrid_communicate_group", "_get_fleet"]
 
 
-class _Fleet:
+class Fleet:
+    """Reference fleet/fleet.py:98. One instance per process; the module-
+    level functions below bind the singleton's methods, exactly like the
+    reference's `fleet = Fleet(); init = fleet.init; ...`."""
+
     def __init__(self):
         self.strategy = None
         self.hcg = None
         self.is_collective = False
+        self._role_maker = None
+        self._util = UtilBase()
+        self._user_optimizer = None
+        self._ps_server = None
+        self._ps_client = None
 
     def init(self, role_maker=None, is_collective=False, strategy=None):
         self.is_collective = is_collective
         self.strategy = strategy or DistributedStrategy()
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._util._set_role_maker(self._role_maker)
         init_parallel_env()
         hybrid = self.strategy.hybrid_configs
         dp = hybrid.get("dp_degree", -1)
@@ -41,32 +60,192 @@ class _Fleet:
         self.hcg = HybridCommunicateGroup(topo)
         return self
 
+    # -- identity (reference fleet.py is_first_worker :290 ff) --------------
+    def is_first_worker(self):
+        return self.worker_index() == 0
 
-_fleet = _Fleet()
+    def worker_index(self):
+        # a LIVE multi-process world (jax.distributed, possibly initialized
+        # by the user before fleet.init with no PADDLE_* env) outranks the
+        # env-derived role maker — rank-0-only guards must see real ranks
+        if get_world_size() > 1:
+            return get_rank()
+        if self._role_maker is not None:
+            return self._role_maker._worker_index()
+        return get_rank()
+
+    def worker_num(self):
+        live = get_world_size()
+        if live > 1:
+            return live
+        if self._role_maker is not None and \
+                self._role_maker._worker_endpoints:
+            return self._role_maker._worker_num()
+        return live
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker._is_worker()
+
+    def is_server(self):
+        return self._role_maker is not None and \
+            self._role_maker._is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker._get_trainer_endpoints() \
+            if self._role_maker else []
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker._server_num() if self._role_maker else 0
+
+    def server_index(self):
+        return self._role_maker._server_index() if self._role_maker else -1
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker._get_pserver_endpoints() \
+            if self._role_maker else []
+        return ",".join(eps) if to_string else eps
+
+    @property
+    def util(self):
+        return self._util
+
+    def barrier_worker(self):
+        self._util.barrier()
+
+    # -- PS lifecycle (reference fleet.py init_worker :670 ff, backed by
+    # the rpc-based PS tier in distributed/ps) -----------------------------
+    def init_worker(self, scopes=None):
+        if self._ps_client is None:
+            if self.server_num() > 0:
+                # real PS job: servers reachable over rpc (the launcher
+                # ran rpc.init_rpc with the endpoint list)
+                from ..ps import PSClient
+                self._ps_client = PSClient()
+            else:
+                # single-node PS mode: tables live in-process
+                from ..ps import LocalPSClient
+                self._ps_client = LocalPSClient()
+        return self._ps_client
+
+    def init_server(self, *args, **kwargs):
+        from ..ps import PSServer
+        if self._ps_server is None:
+            self._ps_server = PSServer()
+        return self._ps_server
+
+    def run_server(self):
+        if self._ps_server is None:
+            self.init_server()
+        # the rpc PSServer serves from construction; block-until-shutdown
+        # is the launcher's job (reference run_server blocks in brpc)
+        return self._ps_server
+
+    def stop_worker(self):
+        client = self._ps_client
+        if client is not None and hasattr(client, "shutdown"):
+            client.shutdown()
+        self._ps_client = None
+
+    def shrink(self, threshold=0.0):
+        """Shrink all CTR sparse tables (reference fleet.py shrink —
+        day-level table eviction)."""
+        if self._ps_client is not None and hasattr(self._ps_client,
+                                                   "shrink"):
+            return self._ps_client.shrink(threshold)
+        return 0
+
+    # -- model/optimizer state passthroughs (reference fleet.py state_dict
+    # :520 ff delegate to the user optimizer captured by
+    # distributed_optimizer) ------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def _require_opt(self):
+        if self._user_optimizer is None:
+            raise RuntimeError(
+                "call fleet.distributed_optimizer(optimizer) first")
+        return self._user_optimizer
+
+    def state_dict(self):
+        return self._require_opt().state_dict()
+
+    def set_state_dict(self, state):
+        return self._require_opt().set_state_dict(state)
+
+    def get_lr(self):
+        return self._require_opt().get_lr()
+
+    def set_lr(self, value):
+        return self._require_opt().set_lr(value)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._require_opt().minimize(loss, startup_program,
+                                            parameters, no_grad_set)
+
+    # -- persistence (reference fleet.py save_inference_model :800) ---------
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True, mode=0):
+        """TPU-native: the artifact is a jax.export of the LAYER — pass the
+        model as `target_vars` or `main_program` (reference passes pruned
+        program vars; here the Layer carries the program)."""
+        from ...static import save_inference_model as _sim
+        import os
+        layer = None
+        for cand in (target_vars, main_program):
+            if hasattr(cand, "state_dict"):
+                layer = cand
+                break
+        if layer is None:
+            raise TypeError(
+                "fleet.save_inference_model on TPU needs the model Layer: "
+                "pass it as target_vars (or main_program); string var "
+                "names alone cannot rebuild the exported program")
+        return _sim(os.path.join(dirname, "model"),
+                    feeded_var_names or [], layer, executor=executor)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          mode=0):
+        from ..io import save_persistables as _sp
+        return _sp(executor, dirname, main_program)
+
+
+_fleet = Fleet()
 
 
 def _get_fleet():
     return _fleet
 
 
-def init(role_maker=None, is_collective=False, strategy=None):
-    return _fleet.init(role_maker, is_collective, strategy)
-
-
-def is_first_worker():
-    return get_rank() == 0
-
-
-def worker_index():
-    return get_rank()
-
-
-def worker_num():
-    return get_world_size()
-
-
-def is_worker():
-    return True
+# singleton bindings — the reference pattern (fleet/__init__.py:52
+# `fleet = Fleet(); init = fleet.init; ...`): one definition, no wrapper
+# boilerplate to keep signature-synchronized. `_fleet` is never reassigned.
+init = _fleet.init
+is_first_worker = _fleet.is_first_worker
+worker_index = _fleet.worker_index
+worker_num = _fleet.worker_num
+is_worker = _fleet.is_worker
+is_server = _fleet.is_server
+worker_endpoints = _fleet.worker_endpoints
+server_num = _fleet.server_num
+server_index = _fleet.server_index
+server_endpoints = _fleet.server_endpoints
+barrier_worker = _fleet.barrier_worker
+init_worker = _fleet.init_worker
+init_server = _fleet.init_server
+run_server = _fleet.run_server
+stop_worker = _fleet.stop_worker
+shrink = _fleet.shrink
+state_dict = _fleet.state_dict
+set_state_dict = _fleet.set_state_dict
+get_lr = _fleet.get_lr
+set_lr = _fleet.set_lr
+minimize = _fleet.minimize
+save_inference_model = _fleet.save_inference_model
+save_persistables = _fleet.save_persistables
+util = _fleet.util
 
 
 def get_hybrid_communicate_group():
@@ -124,6 +303,7 @@ def distributed_optimizer(optimizer, strategy=None):
     if strategy is not None:
         optimizer = apply_strategy(optimizer, strategy, hcg=hcg)
     if hcg is None:
+        _fleet._user_optimizer = optimizer
         return optimizer
     from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
     from .meta_optimizers import _OptWrapper
@@ -134,5 +314,8 @@ def distributed_optimizer(optimizer, strategy=None):
         while isinstance(inner._inner, _OptWrapper):
             inner = inner._inner
         inner._inner = HybridParallelOptimizer(inner._inner, hcg, strategy)
+        _fleet._user_optimizer = optimizer
         return optimizer
-    return HybridParallelOptimizer(optimizer, hcg, strategy)
+    out = HybridParallelOptimizer(optimizer, hcg, strategy)
+    _fleet._user_optimizer = out
+    return out

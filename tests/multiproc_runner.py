@@ -123,6 +123,55 @@ def main():
     assert [o["rank"] for o in objs] == list(range(world))
     assert all(objs[r]["tag"] == "x" * (r + 1) for r in range(world))
 
+    # --- LocalSGD over a REAL dp axis ----------------------------------------
+    # each rank trains on DIFFERENT data for k_steps, then the averaging
+    # step must leave every rank with IDENTICAL parameters (reference
+    # localsgd_optimizer.py semantics)
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        LocalSGDOptimizer, DGCMomentum)
+    paddle.seed(0)                       # same init on every rank
+    m = nn.Linear(4, 2)
+    opt = LocalSGDOptimizer(
+        paddle.optimizer.SGD(learning_rate=1e-2,
+                             parameters=m.parameters()), k_steps=3)
+    rng = np.random.default_rng(100 + rank)     # different data per rank
+    for i in range(3):                   # step 3 triggers the averaging
+        x_ = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+        y_ = paddle.to_tensor(rng.normal(size=(8, 2)).astype(np.float32))
+        loss = ((m(x_) - y_) * (m(x_) - y_)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    mine = np.asarray(m.weight._value)
+    gathered = []
+    dist.all_gather_object(gathered, mine)
+    for other in gathered:
+        np.testing.assert_allclose(other, mine, rtol=1e-6, atol=1e-7)
+
+    # --- DGC over a REAL dp axis ---------------------------------------------
+    # identical data + identical init => the compressed all-reduced grads
+    # are identical, so params must track exactly across ranks
+    paddle.seed(1)
+    m2 = nn.Linear(4, 2)
+    opt2 = DGCMomentum(
+        paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                  parameters=m2.parameters()),
+        sparsity=(0.5,))
+    rng2 = np.random.default_rng(7)      # SAME data on every rank
+    for i in range(3):
+        x_ = paddle.to_tensor(rng2.normal(size=(8, 4)).astype(np.float32))
+        y_ = paddle.to_tensor(rng2.normal(size=(8, 2)).astype(np.float32))
+        loss = ((m2(x_) - y_) * (m2(x_) - y_)).mean()
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+    mine2 = np.asarray(m2.weight._value)
+    gathered2 = []
+    dist.all_gather_object(gathered2, mine2)
+    for other in gathered2:
+        np.testing.assert_allclose(other, mine2, rtol=1e-6, atol=1e-7)
+
     # --- barrier + store round-trip -----------------------------------------
     dist.barrier()
     store = dist.env.get_store()

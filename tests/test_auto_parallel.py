@@ -677,3 +677,35 @@ class TestMeasuringTuner:
         assert tuple(model[0].bias._value.sharding.spec) == ("model",)
         spec2 = tuple(model[2].weight._value.sharding.spec)
         assert spec2[1] == "model" and spec2[0] is None
+
+    def test_engine_tune_installs_winning_mesh(self):
+        """Engine.tune trials plans and installs the measured winner's
+        mesh for the next fit (reference Engine._tune analog)."""
+        from paddle_tpu.distributed.auto_parallel import (Engine, Strategy,
+                                                          gpt_stats)
+        from paddle_tpu.incubate.models import GPTConfig
+        cfg = GPTConfig(vocab_size=256, hidden_size=64,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        intermediate_size=128, max_position_embeddings=128)
+        stats = gpt_stats(cfg, seq_len=128)
+        st = Strategy()
+        st.tuning.enable = True
+        engine = Engine(model=nn.Linear(4, 4), loss=nn.MSELoss(),
+                        strategy=st)
+        calls = []
+
+        def fake_measure(choice):
+            calls.append(choice)
+            return 0.1 if len(calls) == 3 else 1.0   # 3rd candidate wins
+
+        report = engine.tune(stats, batch=32, measure_fn=fake_measure,
+                             n_devices=8)
+        assert len(calls) == 3
+        b = report.best
+        third = report.candidates[2]
+        assert (b.dp, b.mp, b.pp, b.sharding) == \
+            (third.dp, third.mp, third.pp, third.sharding)
+        pm = engine._process_mesh
+        assert pm is not None
+        assert int(np.prod(pm.shape)) == 8
+        assert "model" in pm.dim_names
