@@ -133,14 +133,25 @@ class _OptWrapper:
         return self._inner.set_state_dict(state)
 
 
+def unwrap_optimizer(opt):
+    """The innermost REAL optimizer under any _OptWrapper /
+    HybridParallelOptimizer chain. Attribute WRITES must target this object
+    — __getattr__ passthrough makes reads transparent but a write on a
+    wrapper lands in the wrapper's __dict__ and the inner optimizer never
+    sees it."""
+    inner = opt
+    while True:
+        if isinstance(inner, _OptWrapper):
+            inner = inner._inner
+        elif hasattr(inner, "_inner_opt"):      # HybridParallelOptimizer
+            inner = inner._inner_opt
+        else:
+            return inner
+
+
 def _base_params(opt):
     """The trainable parameter list of the innermost optimizer."""
-    inner = opt
-    while hasattr(inner, "_inner"):
-        inner = inner._inner
-    if hasattr(inner, "_inner_opt"):            # HybridParallelOptimizer
-        inner = inner._inner_opt
-    return inner._parameter_list
+    return unwrap_optimizer(opt)._parameter_list
 
 
 class GradientMergeOptimizer(_OptWrapper):

@@ -1,0 +1,250 @@
+"""paddle.distributed.passes — the pass framework surface.
+
+Reference analog: python/paddle/distributed/passes/ (pass_base.py:131
+new_pass, :311 PassManager; ~12k LoC of program-rewrite passes:
+auto_parallel_{amp,fp16,recompute,sharding,gradient_merge}, fuse_all_reduce,
+...).
+
+TPU-first: there is no ProgramDesc to rewrite — XLA owns fusion and comm
+scheduling, and the framework-level transformations the reference expresses
+as passes are FUNCTIONAL here (fleet.meta_optimizers: amp O2, recompute,
+sharding, gradient merge; the compiler: fuse_all_reduce and every fusion
+pass). This module keeps the reference's registration/apply API so
+pass-driven user code runs: each registered pass delegates to the
+functional transform; compiler-owned passes are explicit no-ops that record
+themselves as applied.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase",
+           "register_pass"]
+
+
+class PassContext:
+    """Reference pass_base.py:19 — carries attrs between pass applications."""
+
+    def __init__(self):
+        self._applied_passes = []
+        self.attrs = {}
+
+    @property
+    def applied_passes(self):
+        return tuple(self._applied_passes)
+
+
+class PassBase:
+    _REGISTERED_PASSES = {}
+
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def _check_self(self):
+        return True
+
+    def _check_conflict(self, other_pass):
+        return True
+
+    def apply(self, main_programs, startup_programs, context=None):
+        context = context or PassContext()
+        self._apply_impl(main_programs, startup_programs, context)
+        context._applied_passes.append(self)
+        return context
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def wrap(cls):
+        cls.name = name
+        PassBase._REGISTERED_PASSES[name] = cls
+        return cls
+    return wrap
+
+
+def new_pass(name, pass_attrs=None):
+    """Reference pass_base.py:131."""
+    pass_class = PassBase._REGISTERED_PASSES.get(name)
+    if pass_class is None:
+        raise ValueError(
+            f"Pass {name} is not registered; known: "
+            f"{sorted(PassBase._REGISTERED_PASSES)}")
+    pass_obj = pass_class()
+    for k, v in (pass_attrs or {}).items():
+        pass_obj.set_attr(k, v)
+    return pass_obj
+
+
+class PassManager:
+    """Reference pass_base.py:311 — ordered application with a shared
+    context. auto_solve_conflict=True drops a later pass that conflicts
+    with an earlier one (the reference's _solve_pass_conflict); False
+    raises instead."""
+
+    def __init__(self, passes, context=None, auto_solve_conflict=True):
+        self._context = context or PassContext()
+        kept = []
+        for p in passes:
+            if not p._check_self():
+                raise ValueError(
+                    f"pass {p.name!r} rejected its own attributes "
+                    f"({p._attrs})")
+            clash = next((q for q in kept
+                          if not p._check_conflict(q)
+                          or not q._check_conflict(p)), None)
+            if clash is not None:
+                if auto_solve_conflict:
+                    continue             # drop the later pass
+                raise ValueError(
+                    f"pass {p.name!r} conflicts with {clash.name!r}")
+            kept.append(p)
+        self._passes = kept
+
+    def apply(self, main_programs=None, startup_programs=None):
+        context = self._context
+        for p in self._passes:
+            context = p.apply(main_programs, startup_programs, context)
+        self._context = context
+        return context
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    @property
+    def passes(self):
+        return tuple(self._passes)
+
+
+# ---------------------------------------------------------------------------
+# registered passes: functional delegates + compiler-owned no-ops
+# ---------------------------------------------------------------------------
+
+class _ModelOptPass(PassBase):
+    """Base for passes whose TPU-native form transforms the model/optimizer
+    captured in pass attrs (the reference rewrites the program instead)."""
+
+    def _model(self):
+        m = self.get_attr("model")
+        if m is None:
+            raise ValueError(
+                f"pass {self.name!r} needs set_attr('model', layer) — the "
+                "TPU-native pass transforms the Layer, not a ProgramDesc")
+        return m
+
+
+@register_pass("auto_parallel_recompute")
+class _RecomputePass(_ModelOptPass):
+    """Delegates to meta_optimizers.apply_recompute (reference:
+    passes/auto_parallel_recompute.py)."""
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        checkpoints = self.get_attr("checkpoints") or []
+        if not checkpoints:
+            raise ValueError(
+                "pass 'auto_parallel_recompute' needs "
+                "set_attr('checkpoints', [...]) — sublayer-name substrings "
+                "to checkpoint")
+        from ..fleet.meta_optimizers import apply_recompute
+        apply_recompute(self._model(), {"checkpoints": checkpoints})
+
+
+@register_pass("auto_parallel_amp")
+class _AMPPass(_ModelOptPass):
+    """bf16 O2 cast of the model + master weights on the optimizer
+    (reference: passes/auto_parallel_amp.py loss-scaling rewrite — not
+    needed for bf16)."""
+
+    _default_dtype = "bfloat16"
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        from ...amp import decorate
+        decorate(models=self._model(), level="O2",
+                 dtype=self.get_attr("dtype", self._default_dtype))
+        opt = self.get_attr("optimizer")
+        if opt is not None:
+            # write on the INNERMOST optimizer: a wrapper's __getattr__
+            # makes reads transparent but a write would land on the wrapper
+            from ..fleet.meta_optimizers import unwrap_optimizer
+            base = unwrap_optimizer(opt)
+            if not hasattr(base, "_multi_precision"):
+                raise TypeError(
+                    "auto_parallel_amp needs a multi_precision-capable "
+                    f"optimizer; {type(base).__name__} keeps no f32 masters")
+            base._multi_precision = True
+
+
+@register_pass("auto_parallel_fp16")
+class _FP16Pass(_AMPPass):
+    """Reference passes/auto_parallel_fp16.py: the pure-fp16 variant of
+    the AMP pass (bf16 is still the TPU default dtype unless overridden)."""
+
+    _default_dtype = "float16"
+
+
+@register_pass("auto_parallel_sharding")
+class _ShardingPass(PassBase):
+    """ZeRO stage-1 optimizer-state sharding (reference:
+    passes/auto_parallel_sharding.py)."""
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        opt = self.get_attr("optimizer")
+        if opt is None:
+            raise ValueError(
+                "pass 'auto_parallel_sharding' needs "
+                "set_attr('optimizer', opt)")
+        # shard the INNERMOST optimizer: shard_optimizer_states wraps
+        # _add_accumulator, which the inner object calls on itself
+        from ..fleet.meta_optimizers import unwrap_optimizer
+        from ..fleet.sharding_opt import shard_optimizer_states
+        shard_optimizer_states(unwrap_optimizer(opt))
+
+
+@register_pass("auto_parallel_gradient_merge_pass")
+class _GradientMergePass(PassBase):
+    """Wraps the optimizer in GradientMergeOptimizer; the wrapped object is
+    placed in context.attrs['optimizer'] (a functional pass cannot rewrite
+    the caller's binding)."""
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        opt = self.get_attr("optimizer")
+        if opt is None:
+            raise ValueError(
+                "pass 'auto_parallel_gradient_merge_pass' needs "
+                "set_attr('optimizer', opt)")
+        from ..fleet.meta_optimizers import GradientMergeOptimizer
+        context.attrs["optimizer"] = GradientMergeOptimizer(
+            opt, k_steps=self.get_attr("k_steps", 1),
+            avg=self.get_attr("avg", True))
+
+
+@register_pass("fuse_all_reduce")
+class _FuseAllReducePass(PassBase):
+    """Compiler-owned: XLA fuses gradient all-reduces along the backward
+    dependency frontier (the reference pass coalesces them manually,
+    passes/fuse_all_reduce.py). Applying it records a no-op."""
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        context.attrs.setdefault("compiler_owned", []).append(self.name)
+
+
+@register_pass("fuse_optimizer")
+class _FuseOptimizerPass(PassBase):
+    """Compiler-owned: the jitted optimizer update is already one fused
+    executable (jit/train_step + optimizer._apply_optimize)."""
+
+    def _apply_impl(self, main_programs, startup_programs, context):
+        context.attrs.setdefault("compiler_owned", []).append(self.name)
