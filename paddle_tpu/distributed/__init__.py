@@ -45,6 +45,10 @@ from . import checkpoint  # noqa: F401
 from . import sharding  # noqa: F401
 from . import rpc  # noqa: F401
 from . import passes  # noqa: F401
+from . import utils  # noqa: F401
+from . import models  # noqa: F401
+from . import metric  # noqa: F401
+from . import cloud_utils  # noqa: F401
 from . import trainer  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,

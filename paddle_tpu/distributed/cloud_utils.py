@@ -1,0 +1,66 @@
+"""paddle.distributed.cloud_utils — cloud-environment cluster discovery.
+
+Reference analog: python/paddle/distributed/cloud_utils.py (:26
+get_cloud_cluster, :119 _get_trainers_num) — parses the PaddleCloud env
+contract (PADDLE_TRAINERS / PADDLE_TRAINERS_NUM / POD_IP / TRAINER_PORTS)
+into the cluster topology the launcher drives. Node-level facts only here;
+device placement is the Mesh's job on TPU.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_cloud_cluster", "get_trainers_num"]
+
+
+class Pod:
+    def __init__(self, ip, rank, ports):
+        self.ip = ip
+        self.rank = rank
+        self.ports = list(ports)
+
+    def __repr__(self):
+        return f"Pod(ip={self.ip}, rank={self.rank}, ports={self.ports})"
+
+
+class Cluster:
+    def __init__(self, pods):
+        self.pods = list(pods)
+
+    def trainers_endpoints(self):
+        return [f"{p.ip}:{port}" for p in self.pods for port in p.ports]
+
+    def world_size(self):
+        return sum(len(p.ports) for p in self.pods)
+
+    def __repr__(self):
+        return f"Cluster({self.pods})"
+
+
+def get_trainers_num():
+    """Reference cloud_utils.py:119."""
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
+                      selected_devices=None):
+    """Build the Cluster/Pod view from the cloud env (reference
+    cloud_utils.py:26). Falls back to a single local pod outside a cloud
+    job."""
+    node_ips = os.getenv("PADDLE_TRAINERS") or args_node_ips or "127.0.0.1"
+    if isinstance(node_ips, str):
+        node_ips = [ip for ip in node_ips.replace(" ", ",").split(",") if ip]
+    node_ip = os.getenv("POD_IP") or args_node_ip or node_ips[0]
+    ports_env = os.getenv("TRAINER_PORTS", "")
+    ports = [int(p) for p in ports_env.split(",") if p] or \
+        [int(args_port) + i for i in range(len(selected_devices or [0]))]
+    pods = []
+    for rank, ip in enumerate(node_ips):
+        pods.append(Pod(ip, rank, ports))
+    cluster = Cluster(pods)
+    if node_ip not in node_ips:
+        # a silent rank-0 fallback would have two pods own the same shard
+        raise ValueError(
+            f"this node's ip {node_ip!r} is not in the trainer list "
+            f"{node_ips} (PADDLE_TRAINERS/POD_IP mismatch)")
+    return cluster, cluster.pods[node_ips.index(node_ip)]

@@ -1,0 +1,11 @@
+"""paddle.distributed.metric — PS-training metric aggregation.
+
+Reference analog: python/paddle/distributed/metric/metrics.py —
+init_metric (:25) registers named metric slots on the PS table,
+print_metric (:152) / print_auc (:183) pull and render the global values.
+TPU-native: metric state is a host-side registry aggregated over the eager
+collective plane (all_gather), AUC backed by paddle.metric.Auc.
+"""
+from .metrics import init_metric, print_metric, print_auc  # noqa: F401
+
+__all__ = []

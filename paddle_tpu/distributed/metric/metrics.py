@@ -1,0 +1,77 @@
+"""Distributed metric registry (reference metrics.py).
+
+init_metric registers named metrics; update_metric feeds predictions;
+print_metric/print_auc aggregate across ranks and render. The reference
+keys metric slots into the PS; here the registry is in-process and the
+cross-rank reduction is an all_gather of the raw statistic tensors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["init_metric", "update_metric", "print_metric", "print_auc",
+           "get_metric"]
+
+_METRICS = {}
+
+
+def init_metric(metric_ptr=None, metric_yaml_path=None, name="auc",
+                method="bucket", bucket_size=1000000, **kwargs):
+    """Register a metric slot (reference metrics.py:25 — yaml-driven there;
+    name/method args here). Returns the registry usable as metric_ptr."""
+    from ...metric import Auc
+    if method not in ("bucket", "auc"):
+        raise ValueError(f"unsupported metric method {method!r}")
+    _METRICS[name] = Auc(num_thresholds=min(int(bucket_size), 4095))
+    return _METRICS
+
+
+def update_metric(name, preds, labels):
+    """Feed a batch of (positive-class probability, label)."""
+    m = _METRICS[name]
+    p = np.asarray(preds, np.float32).reshape(-1, 1)
+    both = np.concatenate([1.0 - p, p], axis=1)
+    m.update(both, np.asarray(labels).reshape(-1, 1))
+    return m
+
+
+def get_metric(name="auc"):
+    return _METRICS[name]
+
+
+def _global_stats(m):
+    """Sum the AUC histogram statistics across ranks."""
+    from ..env import get_world_size
+    from ..collective import all_gather_object
+    stats = [np.asarray(m._stat_pos), np.asarray(m._stat_neg)]
+    if get_world_size() > 1:
+        gathered = []
+        all_gather_object(gathered, stats)
+        stats = [sum(s[0] for s in gathered), sum(s[1] for s in gathered)]
+    return stats
+
+
+def _auc_from_stats(stat_pos, stat_neg):
+    tot_pos = np.cumsum(stat_pos[::-1])[::-1]
+    tot_neg = np.cumsum(stat_neg[::-1])[::-1]
+    area = 0.0
+    for i in range(len(stat_pos) - 1):
+        area += (tot_neg[i] - tot_neg[i + 1]) * \
+            (tot_pos[i] + tot_pos[i + 1]) / 2.0
+    denom = tot_pos[0] * tot_neg[0]
+    return float(area / denom) if denom > 0 else 0.0
+
+
+def print_metric(metric_ptr, name):
+    """Render the named metric's GLOBAL value (reference metrics.py:152)."""
+    m = (metric_ptr or _METRICS)[name]
+    pos, neg = _global_stats(m)
+    value = _auc_from_stats(pos, neg)
+    msg = f"{name}: {value:.6f}"
+    print(msg, flush=True)
+    return value
+
+
+def print_auc(metric_ptr=None, is_day=False, phase="all", name="auc"):
+    """Reference metrics.py:183."""
+    return print_metric(metric_ptr, name)

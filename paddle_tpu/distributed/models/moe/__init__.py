@@ -1,0 +1,14 @@
+"""paddle.distributed.models.moe — re-export of the MoE stack + gate utils.
+
+Reference analog: python/paddle/distributed/models/moe/ (268 LoC re-export
+of the incubate MoE utilities, SURVEY.md appendix).
+"""
+from ....incubate.distributed.models.moe import (  # noqa: F401
+    MoELayer,
+)
+from .utils import (  # noqa: F401
+    _number_count, _assign_pos, _random_routing, _limit_by_capacity,
+    _prune_gate_by_capacity,
+)
+
+__all__ = ["MoELayer"]

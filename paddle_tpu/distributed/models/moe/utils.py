@@ -1,0 +1,91 @@
+"""MoE gate utility ops.
+
+Reference analog: python/paddle/distributed/models/moe/utils.py — thin
+wrappers over the CUDA ops number_count / assign_pos / random_routing /
+limit_by_capacity / prune_gate_by_capacity. TPU-first: plain jnp
+(histogram / stable argsort / where), all static-shape and jittable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor
+from ....ops._helpers import ensure_tensor
+
+__all__ = ["_number_count", "_assign_pos", "_random_routing",
+           "_limit_by_capacity", "_prune_gate_by_capacity"]
+
+
+def _number_count(numbers, upper_range):
+    """Histogram of gate indices over [0, upper_range)
+    (reference utils.py:22 number_count op)."""
+    v = ensure_tensor(numbers)._value.reshape(-1)
+    # out-of-range ids (e.g. -1 pruned) land in the overflow bin and drop
+    valid = jnp.bincount(jnp.where((v >= 0) & (v < upper_range),
+                                   v, upper_range),
+                         length=upper_range + 1)[:upper_range]
+    return Tensor(valid.astype(ensure_tensor(numbers)._value.dtype))
+
+
+def _assign_pos(x, cum_count):
+    """Token positions grouped by expert: out[k] is the index (into x) of
+    the k-th token when tokens are ordered expert-by-expert (reference
+    utils.py:63 assign_pos op). cum_count is the inclusive cumulative
+    expert count."""
+    gate = ensure_tensor(x)._value.reshape(-1)
+    cum = ensure_tensor(cum_count)._value.reshape(-1)
+    total = int(cum[-1]) if cum.size else 0
+    # stable sort by expert id reproduces the op's intra-expert order
+    order = jnp.argsort(gate, stable=True)
+    return Tensor(order[:total].astype(jnp.int64))
+
+
+def _random_routing(topk_idx, topk_value, prob, topk=2):
+    """Drop the last choice where topk * value < prob (reference
+    utils.py:115: out[i][topk-1] = -1 when 2*value[i][1] < prob[i])."""
+    if topk != 2:
+        raise RuntimeError("only topk=2 is supported now")
+    idx = ensure_tensor(topk_idx)._value
+    val = ensure_tensor(topk_value)._value
+    p = ensure_tensor(prob)._value
+    drop = topk * val[:, topk - 1] < p
+    new_last = jnp.where(drop, -1, idx[:, topk - 1])
+    return Tensor(idx.at[:, topk - 1].set(new_last))
+
+
+def _limit_by_capacity(expert_count, capacity, n_worker):
+    """Clip per-(worker, expert) counts so each expert receives at most
+    `capacity` tokens ACROSS workers, first-come-first-served by worker
+    rank (reference utils.py:140 limit_by_capacity op)."""
+    ec = ensure_tensor(expert_count)._value.reshape(-1)
+    cap = ensure_tensor(capacity)._value.reshape(-1)
+    n_expert = ec.shape[0] // n_worker
+    grid = ec.reshape(n_worker, n_expert)
+
+    def per_expert(counts, c):
+        # walk workers in rank order, granting up to the remaining budget
+        def body(rem, cnt):
+            grant = jnp.minimum(cnt, rem)
+            return rem - grant, grant
+        _, grants = jax.lax.scan(body, c, counts)
+        return grants
+
+    out = jax.vmap(per_expert, in_axes=(1, 0), out_axes=1)(grid, cap)
+    return Tensor(out.reshape(-1).astype(ec.dtype))
+
+
+def _prune_gate_by_capacity(gate_idx, expert_count, n_expert, n_worker):
+    """Set gate ids that exceed their expert's remaining capacity to -1,
+    in token order (reference utils.py:186 prune_gate_by_capacity op).
+    expert_count here is the LIMITED per-expert budget."""
+    gate = ensure_tensor(gate_idx)._value.reshape(-1)
+    budget = ensure_tensor(expert_count)._value.reshape(-1)
+
+    def body(rem, g):
+        ok = (g >= 0) & (rem[g] > 0)
+        rem = rem.at[jnp.clip(g, 0)].add(jnp.where(ok, -1, 0))
+        return rem, jnp.where(ok, g, -1)
+
+    _, out = jax.lax.scan(body, budget, gate)
+    return Tensor(out.astype(gate.dtype))

@@ -1,0 +1,9 @@
+"""paddle.distributed.utils — MoE token-exchange ops + log helpers.
+
+Reference analog: python/paddle/distributed/utils/{moe_utils.py (:21
+global_scatter, :147 global_gather), log_utils.py, launch_utils.py}.
+"""
+from .moe_utils import global_scatter, global_gather  # noqa: F401
+from .log_utils import get_logger  # noqa: F401
+
+__all__ = ["global_scatter", "global_gather", "get_logger"]

@@ -1,0 +1,18 @@
+"""Reference analog: distributed/utils/log_utils.py get_logger."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        log_handler = logging.StreamHandler()
+        log_format = logging.Formatter(
+            "%(levelname)s %(asctime)s %(filename)s:%(lineno)d] %(message)s")
+        log_handler.setFormatter(log_format)
+        logger.addHandler(log_handler)
+    return logger

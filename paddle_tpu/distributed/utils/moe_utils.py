@@ -1,0 +1,97 @@
+"""MoE token exchange: global_scatter / global_gather.
+
+Reference analog: distributed/utils/moe_utils.py:21,:147 over the
+collective ops fluid/operators/collective/global_scatter_op.* — expert-
+parallel MoE moves VARIABLE token counts between cards: chunk i of the
+flattened (card, expert) grid [world * n_expert] goes from this card to
+expert (i % n_expert) of card (i // n_expert).
+
+TPU-first note: the PERFORMANCE dispatch path is the static-capacity
+all-to-all inside the compiled MoE layer (incubate moe_layer.py — fixed
+[tokens, experts, capacity] buckets ride XLA's all_to_all over ICI). These
+eager functions keep the reference's dynamic-count API for user code and
+tests; cross-process they ride the host-mediated object plane (a
+once-per-process perf warning marks the distinction, like
+partial_send/recv).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+from ...ops._helpers import ensure_tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+_warned = False
+
+
+def _warn_once():
+    global _warned
+    if _warned:
+        return
+    _warned = True
+    import warnings
+    warnings.warn(
+        "global_scatter/global_gather use the eager host-mediated "
+        "transport for dynamic token counts; the performance dispatch is "
+        "the static-capacity all_to_all inside the compiled MoE layer "
+        "(paddle_tpu.incubate.distributed.models.moe.MoELayer)",
+        category=RuntimeWarning, stacklevel=3)
+
+
+def _counts(t):
+    return [int(v) for v in np.asarray(ensure_tensor(t)._value).reshape(-1)]
+
+
+def _exchange(x, send_counts, recv_counts, group):
+    """Common body: split x by send_counts, exchange chunk lists over the
+    GROUP, and reassemble the received rows in (card, expert) grid order —
+    my chunk for grid slot (me, e) is what card src stored at its slot
+    (me, e), symmetric for scatter and gather."""
+    from ..collective import all_gather_object
+    from ..env import get_rank, get_world_size
+    _warn_once()
+    xv = np.asarray(ensure_tensor(x)._value)
+    rank = get_rank(group)
+    world = get_world_size(group)
+    n_grid = len(send_counts)
+    n_expert = max(n_grid // max(world, 1), 1)
+
+    offsets = np.cumsum([0] + send_counts)
+    chunks = [xv[offsets[i]:offsets[i + 1]] for i in range(n_grid)]
+    if world <= 1:
+        got = np.concatenate(chunks, 0) if chunks else xv[:0]
+    else:
+        everyone = []
+        all_gather_object(everyone, chunks, group=group)
+        out = []
+        for j in range(n_grid):
+            src_card, expert = divmod(j, n_expert)
+            out.append(everyone[src_card][rank * n_expert + expert])
+        got = np.concatenate(out, 0) if out else xv[:0]
+    expect = sum(recv_counts)
+    if got.shape[0] != expect:
+        raise ValueError(
+            f"declared receive counts sum to {expect} rows but "
+            f"{got.shape[0]} arrived — local_count/global_count are "
+            "inconsistent across ranks")
+    return Tensor(np.ascontiguousarray(got))
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Send local_count[i] rows of `x` to expert (i % n_expert) of card
+    (i // n_expert); receive global_count[i] rows likewise
+    (reference moe_utils.py:21)."""
+    return _exchange(x, _counts(local_count), _counts(global_count), group)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter: return each received row to the card it
+    came from (reference moe_utils.py:147). Here `global_count` describes
+    the rows currently held (the scatter's receive layout) and
+    `local_count` the rows to get back."""
+    return _exchange(x, _counts(global_count), _counts(local_count),
+                     group)

@@ -189,3 +189,56 @@ def case(pred_fn_pairs, default=None, name=None):
                for f in fns + [default]]
     out = jax.lax.switch(idx.astype(jnp.int32), wrapped, None)
     return _wrap_out(out)
+
+
+_SPARSE_TABLES = {}
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None, name=None):
+    """PS-backed sparse embedding lookup (reference:
+    fluid/contrib/layers/nn.py:1072 sparse_embedding over the PS sparse
+    table; pairs with paddle.distributed entry rules —
+    distributed/entry_attr.py).
+
+    TPU-native: rows live in a host-side ps.SparseTable materialized on
+    first touch and gated by `entry` admission (ProbabilityEntry /
+    CountFilterEntry / ShowClickEntry); the lookup result is a dense
+    Tensor. Training updates flow through the PS push path
+    (distributed.ps / DownpourSGD trainer), not autograd — exactly the
+    reference's split between dense program and sparse table."""
+    import numpy as np
+    from ..distributed.ps import SparseTable
+    from ..framework.core import Tensor
+
+    x = ensure_tensor(input)
+    key = name or getattr(param_attr, "name", None)
+    if not key:
+        # an auto-generated key would be fresh EVERY call: the table (and
+        # every PS push into it) would be lost between steps
+        raise ValueError(
+            "sparse_embedding needs a stable identity: pass name=... or "
+            "param_attr=ParamAttr(name=...) so lookups across steps hit "
+            "the same PS table")
+    table = _SPARSE_TABLES.get(key)
+    if table is None:
+        table = SparseTable(key, int(size[1]), entry=entry)
+        _SPARSE_TABLES[key] = table
+    elif table.dim != int(size[1]):
+        raise ValueError(
+            f"sparse_embedding {key!r} already exists with dim "
+            f"{table.dim}; got size={list(size)}")
+    ids = np.asarray(x._value).reshape(-1).astype(np.int64)
+    if padding_idx is not None:
+        rows = np.zeros((ids.size, int(size[1])), np.float32)
+        mask = ids != padding_idx
+        if mask.any():
+            rows[mask] = table.pull(ids[mask])
+    else:
+        rows = table.pull(ids)
+    out_shape = tuple(x._value.shape) + (int(size[1]),)
+    return Tensor(jnp.asarray(rows.reshape(out_shape), dtype))
+
+
+__all__.append("sparse_embedding")

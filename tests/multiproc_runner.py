@@ -172,6 +172,29 @@ def main():
     for other in gathered2:
         np.testing.assert_allclose(other, mine2, rtol=1e-6, atol=1e-7)
 
+    # --- global_scatter / global_gather (MoE token exchange) -----------------
+    # 1 expert per card: rank r sends `r+1` tokens to every card; the
+    # gather must return exactly the original tokens
+    if world <= 4:
+        import warnings as _w
+        from paddle_tpu.distributed.utils import (global_scatter,
+                                                  global_gather)
+        n_send = world * (rank + 1)
+        x_moe = t(np.arange(n_send * 2, dtype=np.float32)
+                  .reshape(n_send, 2) + 100 * rank)
+        local_count = t(np.asarray([rank + 1] * world, np.int64))
+        # rank r receives (c+1) tokens from each card c
+        global_count = t(np.asarray([c + 1 for c in range(world)],
+                                    np.int64))
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            recv = global_scatter(x_moe, local_count, global_count)
+            assert recv._value.shape[0] == sum(
+                c + 1 for c in range(world)), recv._value.shape
+            back = global_gather(recv, local_count, global_count)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(x_moe._value))
+
     # --- barrier + store round-trip -----------------------------------------
     dist.barrier()
     store = dist.env.get_store()

@@ -501,3 +501,116 @@ def test_pass_manager_conflict_hooks():
     assert pm.names == ["fuse_all_reduce"]
     with pytest.raises(ValueError, match="conflicts"):
         PassManager([a, b], auto_solve_conflict=False)
+
+
+# ----------------------------------------- secondary distributed modules
+
+def test_moe_gate_utils():
+    from paddle_tpu.distributed.models.moe import (
+        _number_count, _assign_pos, _random_routing, _limit_by_capacity,
+        _prune_gate_by_capacity)
+    # number_count: reference docstring example
+    numbers = paddle.to_tensor(np.asarray([[0, 2], [0, 2]], np.int32))
+    nc = _number_count(numbers, 6)
+    np.testing.assert_array_equal(np.asarray(nc._value), [2, 0, 2, 0, 0, 0])
+    # assign_pos: tokens ordered expert-by-expert, stable within expert
+    gate = paddle.to_tensor(np.asarray([1, 0, 1, 0], np.int64))
+    cum = paddle.to_tensor(np.asarray([2, 4], np.int64))
+    pos = _assign_pos(gate, cum)
+    np.testing.assert_array_equal(np.asarray(pos._value), [1, 3, 0, 2])
+    # random_routing: 2*value < prob drops the 2nd choice
+    idx = paddle.to_tensor(np.asarray([[0, 1], [2, 3]], np.int64))
+    val = paddle.to_tensor(np.asarray([[0.9, 0.05], [0.8, 0.4]],
+                                      np.float32))
+    prob = paddle.to_tensor(np.asarray([0.5, 0.5], np.float32))
+    out = _random_routing(idx, val, prob)
+    np.testing.assert_array_equal(np.asarray(out._value),
+                                  [[0, -1], [2, 3]])
+    # limit_by_capacity: worker 0 served first
+    ec = paddle.to_tensor(np.asarray([3, 1, 4, 2], np.int64))  # 2 workers
+    cap = paddle.to_tensor(np.asarray([4, 2], np.int64))       # x 2 experts
+    lim = _limit_by_capacity(ec, cap, n_worker=2)
+    np.testing.assert_array_equal(np.asarray(lim._value), [3, 1, 1, 1])
+    # prune_gate: budget [1,1] kills the second token per expert
+    g = paddle.to_tensor(np.asarray([0, 0, 1, 1], np.int64))
+    budget = paddle.to_tensor(np.asarray([1, 1], np.int64))
+    pruned = _prune_gate_by_capacity(g, budget, 2, 1)
+    np.testing.assert_array_equal(np.asarray(pruned._value),
+                                  [0, -1, 1, -1])
+
+
+def test_global_scatter_gather_world1_roundtrip():
+    import warnings
+    from paddle_tpu.distributed.utils import global_scatter, global_gather
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    local = paddle.to_tensor(np.asarray([2, 2], np.int64))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        y = global_scatter(x, local, local)
+        np.testing.assert_allclose(np.asarray(y._value),
+                                   np.asarray(x._value))
+        back = global_gather(y, local, local)
+    np.testing.assert_allclose(np.asarray(back._value),
+                               np.asarray(x._value))
+
+
+def test_distributed_metric_auc():
+    from paddle_tpu.distributed.metric import init_metric, print_auc
+    from paddle_tpu.distributed.metric.metrics import update_metric
+    ptr = init_metric(name="auc", bucket_size=4095)
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 512)
+    # informative predictions -> AUC well above 0.5
+    preds = np.clip(labels * 0.6 + rng.random(512) * 0.4, 0, 1)
+    update_metric("auc", preds, labels)
+    auc = print_auc(ptr)
+    assert 0.7 < auc <= 1.0
+
+
+def test_cloud_utils_cluster(monkeypatch):
+    from paddle_tpu.distributed import cloud_utils
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("TRAINER_PORTS", "6170,6171")
+    cluster, pod = cloud_utils.get_cloud_cluster()
+    assert cluster.world_size() == 4
+    assert pod.ip == "10.0.0.2" and pod.rank == 1
+    assert cluster.trainers_endpoints()[0] == "10.0.0.1:6170"
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    assert cloud_utils.get_trainers_num() == 3
+
+
+def test_static_sparse_embedding_with_entry():
+    import paddle_tpu.static.nn as snn
+    from paddle_tpu.distributed.entry_attr import CountFilterEntry
+    ids = paddle.to_tensor(np.asarray([[7, 9]], np.int64))
+    e1 = snn.sparse_embedding(ids, size=[100, 8], name="se_test",
+                              entry=CountFilterEntry(2))
+    assert np.abs(np.asarray(e1._value)).sum() == 0.0   # gated
+    e2 = snn.sparse_embedding(ids, size=[100, 8], name="se_test")
+    assert tuple(e2.shape) == (1, 2, 8)
+    assert np.abs(np.asarray(e2._value)).sum() > 0       # admitted
+    # padding_idx rows stay zero
+    ids3 = paddle.to_tensor(np.asarray([[0, 7]], np.int64))
+    e3 = snn.sparse_embedding(ids3, size=[100, 8], name="se_test",
+                              padding_idx=0)
+    assert np.abs(np.asarray(e3._value)[0, 0]).sum() == 0.0
+
+
+def test_sparse_embedding_identity_and_dim_guards():
+    import paddle_tpu.static.nn as snn
+    ids = paddle.to_tensor(np.asarray([[1]], np.int64))
+    with pytest.raises(ValueError, match="stable identity"):
+        snn.sparse_embedding(ids, size=[10, 4])
+    snn.sparse_embedding(ids, size=[10, 4], name="se_dim_guard")
+    with pytest.raises(ValueError, match="already exists"):
+        snn.sparse_embedding(ids, size=[10, 8], name="se_dim_guard")
+
+
+def test_cloud_utils_unknown_pod_ip_raises(monkeypatch):
+    from paddle_tpu.distributed import cloud_utils
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.setenv("POD_IP", "192.168.1.9")
+    monkeypatch.setenv("TRAINER_PORTS", "6170")
+    with pytest.raises(ValueError, match="not in the trainer list"):
+        cloud_utils.get_cloud_cluster()
