@@ -50,7 +50,15 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
     node_ips = os.getenv("PADDLE_TRAINERS") or args_node_ips or "127.0.0.1"
     if isinstance(node_ips, str):
         node_ips = [ip for ip in node_ips.replace(" ", ",").split(",") if ip]
-    node_ip = os.getenv("POD_IP") or args_node_ip or node_ips[0]
+    node_ip = os.getenv("POD_IP") or args_node_ip
+    if node_ip is None:
+        if len(node_ips) > 1:
+            # a node_ips[0] fallback would give EVERY node rank 0 — the
+            # same duplicate-shard hazard the mismatch guard below catches
+            raise ValueError(
+                "multi-node trainer list needs POD_IP (or args_node_ip) "
+                "to identify this node's rank")
+        node_ip = node_ips[0]
     ports_env = os.getenv("TRAINER_PORTS", "")
     ports = [int(p) for p in ports_env.split(",") if p] or \
         [int(args_port) + i for i in range(len(selected_devices or [0]))]

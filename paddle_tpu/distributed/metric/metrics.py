@@ -51,22 +51,17 @@ def _global_stats(m):
     return stats
 
 
-def _auc_from_stats(stat_pos, stat_neg):
-    tot_pos = np.cumsum(stat_pos[::-1])[::-1]
-    tot_neg = np.cumsum(stat_neg[::-1])[::-1]
-    area = 0.0
-    for i in range(len(stat_pos) - 1):
-        area += (tot_neg[i] - tot_neg[i + 1]) * \
-            (tot_pos[i] + tot_pos[i + 1]) / 2.0
-    denom = tot_pos[0] * tot_neg[0]
-    return float(area / denom) if denom > 0 else 0.0
-
-
 def print_metric(metric_ptr, name):
-    """Render the named metric's GLOBAL value (reference metrics.py:152)."""
+    """Render the named metric's GLOBAL value (reference metrics.py:152).
+    The summed cross-rank histograms go through Auc.accumulate itself, so
+    the global value matches the local metric's math exactly."""
+    from ...metric import Auc
     m = (metric_ptr or _METRICS)[name]
     pos, neg = _global_stats(m)
-    value = _auc_from_stats(pos, neg)
+    agg = Auc(num_thresholds=len(pos) - 1)
+    agg._stat_pos = np.asarray(pos, np.float64)
+    agg._stat_neg = np.asarray(neg, np.float64)
+    value = float(agg.accumulate())
     msg = f"{name}: {value:.6f}"
     print(msg, flush=True)
     return value

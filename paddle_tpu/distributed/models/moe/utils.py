@@ -36,8 +36,11 @@ def _assign_pos(x, cum_count):
     gate = ensure_tensor(x)._value.reshape(-1)
     cum = ensure_tensor(cum_count)._value.reshape(-1)
     total = int(cum[-1]) if cum.size else 0
-    # stable sort by expert id reproduces the op's intra-expert order
-    order = jnp.argsort(gate, stable=True)
+    # stable sort by expert id reproduces the op's intra-expert order;
+    # pruned ids (-1) sort LAST (past every real expert) so order[:total]
+    # holds only dispatched tokens, like the reference op skipping negatives
+    big = gate.shape[0] + jnp.max(jnp.abs(gate)) + 1
+    order = jnp.argsort(jnp.where(gate < 0, big, gate), stable=True)
     return Tensor(order[:total].astype(jnp.int64))
 
 

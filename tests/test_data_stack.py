@@ -614,3 +614,36 @@ def test_cloud_utils_unknown_pod_ip_raises(monkeypatch):
     monkeypatch.setenv("TRAINER_PORTS", "6170")
     with pytest.raises(ValueError, match="not in the trainer list"):
         cloud_utils.get_cloud_cluster()
+
+
+def test_assign_pos_skips_pruned_ids():
+    """Pruned (-1) gate ids must not be dispatched (review regression)."""
+    from paddle_tpu.distributed.models.moe import _assign_pos
+    gate = paddle.to_tensor(np.asarray([-1, 0, -1, 1], np.int64))
+    cum = paddle.to_tensor(np.asarray([1, 2], np.int64))
+    pos = _assign_pos(gate, cum)
+    np.testing.assert_array_equal(np.asarray(pos._value), [1, 3])
+
+
+def test_metric_top_bucket_mass_counts():
+    """Predictions in the top histogram bucket must contribute to the
+    global AUC exactly as to the local one (review regression)."""
+    from paddle_tpu.distributed.metric import init_metric, print_auc
+    from paddle_tpu.distributed.metric.metrics import (update_metric,
+                                                       get_metric)
+    ptr = init_metric(name="auc_top")
+    labels = np.asarray([0, 1, 0, 1])
+    update_metric("auc_top", np.ones(4, np.float32), labels)  # all ties
+    local = float(get_metric("auc_top").accumulate())
+    glob = print_auc(ptr, name="auc_top")
+    np.testing.assert_allclose(glob, local)
+    assert abs(glob - 0.5) < 1e-6
+
+
+def test_cloud_utils_multinode_needs_pod_ip(monkeypatch):
+    from paddle_tpu.distributed import cloud_utils
+    monkeypatch.setenv("PADDLE_TRAINERS", "10.0.0.1,10.0.0.2")
+    monkeypatch.delenv("POD_IP", raising=False)
+    monkeypatch.setenv("TRAINER_PORTS", "6170")
+    with pytest.raises(ValueError, match="POD_IP"):
+        cloud_utils.get_cloud_cluster()

@@ -217,3 +217,46 @@ class TestDetectionOps:
         assert dec.shape == [3, 16, 20]
         got = dec.numpy().transpose(1, 2, 0).astype(int)
         assert np.abs(got - arr.astype(int)).mean() < 8
+
+
+class TestFlowersVOC:
+    def test_flowers_dataset(self):
+        from paddle_tpu.vision.datasets import Flowers
+        ds = Flowers(mode="train")
+        img, lab = ds[0]
+        assert img.shape == (3, 64, 64) and 0 <= int(lab) < 102
+        assert len(Flowers(mode="test")) > 0
+
+    def test_voc2012_segmentation_pairs(self):
+        from paddle_tpu.vision.datasets import VOC2012
+        ds = VOC2012(mode="train")
+        img, mask = ds[0]
+        assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+        assert 0 <= mask.max() < 21
+        # loader-compatible
+        import paddle_tpu as paddle
+        batch = next(iter(paddle.io.DataLoader(ds, batch_size=4)))
+        assert batch[0].shape[0] == 4 and batch[1].shape == [4, 64, 64]
+
+    def test_profiler_enums_and_protobuf_export(self, tmp_path):
+        import pickle
+        import paddle_tpu.profiler as profiler
+        p = profiler.Profiler(
+            on_trace_ready=profiler.export_protobuf(str(tmp_path)))
+        with p:
+            with profiler.RecordEvent("work"):
+                sum(range(1000))
+        files = list(tmp_path.glob("*.pb"))
+        assert files
+        events = pickle.loads(files[0].read_bytes())
+        assert any(e["name"] == "work" for e in events)
+        p.summary(sorted_by=profiler.SortedKeys.CPUAvg)
+
+    def test_require_version(self):
+        import paddle_tpu as paddle
+        paddle.utils.require_version("0.0.1", "99.0")
+        import pytest as _pytest
+        with _pytest.raises(Exception):
+            paddle.utils.require_version("99.0")
+        with _pytest.raises(TypeError):
+            paddle.utils.require_version(1)
