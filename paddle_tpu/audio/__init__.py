@@ -6,5 +6,10 @@ from .features import (  # noqa: F401
     Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC,
 )
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+from . import datasets  # noqa: F401
+from . import backends  # noqa: F401
+from .backends.backend import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "datasets", "backends", "load", "info",
+           "save", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,23 @@
+"""Audio IO backends (reference: python/paddle/audio/backends — wave_backend
+default, soundfile optional). WAV via the stdlib `wave` module."""
+from .wave_backend import load, info, save, AudioInfo  # noqa: F401
+from . import wave_backend  # noqa: F401
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave_backend is bundled (soundfile is an "
+            "optional dependency in the reference too)")
+
+
+__all__ = ["load", "info", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]

@@ -1,0 +1,1 @@
+from .wave_backend import load, info, save, AudioInfo  # noqa: F401

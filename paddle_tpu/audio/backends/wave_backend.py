@@ -1,0 +1,73 @@
+"""WAV file IO (reference: audio/backends/wave_backend.py over the stdlib
+wave module — 16-bit PCM)."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["AudioInfo", "load", "info", "save"]
+
+
+class AudioInfo:
+    """Reference backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath, format=None):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True, format=None):
+    """Returns (waveform Tensor, sample_rate): [C, T] when channels_first
+    (reference wave_backend.load)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(int(frame_offset))
+        n = f.getnframes() - int(frame_offset) if num_frames < 0 \
+            else int(num_frames)
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16).astype(np.float32)
+        if normalize:
+            data = data / 32768.0
+    elif width == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128)
+        if normalize:
+            data = data / 128.0
+    else:
+        raise ValueError(f"unsupported sample width {width} bytes")
+    data = data.reshape(-1, nch)
+    wav = data.T if channels_first else data
+    return Tensor(wav), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    if bits_per_sample != 16:
+        raise ValueError("wave_backend saves 16-bit PCM only (reference "
+                         "limitation)")
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src,
+                     np.float32)
+    if channels_first:
+        arr = arr.T                      # -> [T, C]
+    pcm = np.clip(arr * 32768.0, -32768, 32767).astype("<i2")
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim == 2 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())

@@ -1,0 +1,77 @@
+"""Audio datasets (reference: python/paddle/audio/datasets — TESS emotional
+speech, ESC50 environmental sounds). No-egress synthetic fallback: class-
+correlated sine mixtures with the real label spaces."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _SyntheticAudio(Dataset):
+    N_TRAIN = 128
+    N_TEST = 32
+    SR = 16000
+    DUR = 0.25
+
+    def __init__(self, mode="train", feat_type="raw", seed_offset=0,
+                 **feat_kwargs):
+        self.mode = mode
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        rng = np.random.default_rng(
+            (0 if mode in ("train", "dev") else 1) + seed_offset)
+        n = self.N_TRAIN if mode in ("train", "dev") else self.N_TEST
+        t = np.arange(int(self.SR * self.DUR)) / self.SR
+        self.labels = rng.integers(0, self.N_CLASSES, n).astype(np.int64)
+        base = 200.0
+        self.waves = np.stack([
+            np.sin(2 * np.pi * (base + 50.0 * lab) * t)
+            + 0.05 * rng.standard_normal(t.shape)
+            for lab in self.labels]).astype(np.float32)
+
+    def _features(self, wav):
+        if self.feat_type == "raw":
+            return wav
+        from .. import features as F
+        from ...framework.core import Tensor
+        import jax.numpy as jnp
+        x = Tensor(jnp.asarray(wav[None]))
+        if self.feat_type == "spectrogram":
+            return np.asarray(F.Spectrogram(**self.feat_kwargs)(x)._value)[0]
+        if self.feat_type == "melspectrogram":
+            return np.asarray(
+                F.MelSpectrogram(sr=self.SR, **self.feat_kwargs)(x)._value)[0]
+        if self.feat_type == "mfcc":
+            return np.asarray(F.MFCC(sr=self.SR, **self.feat_kwargs)(x)._value)[0]
+        raise ValueError(f"unknown feat_type {self.feat_type!r}")
+
+    def __getitem__(self, idx):
+        return self._features(self.waves[idx]), self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class TESS(_SyntheticAudio):
+    """Toronto emotional speech set: 7 emotions
+    (reference audio/datasets/tess.py)."""
+    N_CLASSES = 7
+
+    def __init__(self, mode="train", n_folds=1, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        super().__init__(mode=mode, feat_type=feat_type, seed_offset=50,
+                         **kwargs)
+
+
+class ESC50(_SyntheticAudio):
+    """ESC-50 environmental sounds: 50 classes
+    (reference audio/datasets/esc50.py)."""
+    N_CLASSES = 50
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        super().__init__(mode=mode, feat_type=feat_type, seed_offset=60,
+                         **kwargs)

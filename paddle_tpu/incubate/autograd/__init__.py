@@ -8,6 +8,7 @@ are the primitive transforms. Everything XLA-compiles.
 """
 from __future__ import annotations
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -67,3 +68,117 @@ def grad(outputs, inputs, grad_outputs=None):
     res = _eager_grad(outputs, inputs, grad_outputs=grad_outputs,
                       create_graph=True, allow_unused=True)
     return res if len(res) > 1 else res[0]
+
+
+class Jacobian:
+    """Lazy Jacobian matrix view (reference:
+    incubate/autograd/functional.py Jacobian — computed on first index).
+    J has shape [M, N] (or [B, M, N] with is_batched) and supports
+    numpy-style slicing."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            xs_l = self._xs if isinstance(self._xs, (list, tuple)) \
+                else [self._xs]
+            j = jacobian(self._func, self._xs)
+            blocks = [j] if isinstance(j, Tensor) else list(j)
+            mats = []
+            for blk, x in zip(blocks, xs_l):
+                v = blk._value
+                if self._is_batched:
+                    # [B, M, B, N] diag -> [B, M, N]
+                    b = v.shape[0]
+                    v = jnp.stack([v[i, :, i, :] for i in range(b)])
+                else:
+                    # flatten to [M, Ni] with Ni = this input's size
+                    ni = int(np.prod(x._value.shape))
+                    v = v.reshape(-1, ni)
+                mats.append(v)
+            # multiple inputs: hstack the column blocks (reference
+            # functional.py Jacobian over concat'd xs)
+            self._mat = mats[0] if len(mats) == 1 else \
+                jnp.concatenate(mats, axis=-1)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+
+class Hessian:
+    """Lazy Hessian matrix view (reference Hessian — symmetric [N, N])."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            if isinstance(self._xs, (list, tuple)):
+                # multi-input: assemble the full [N, N] from the nested
+                # block structure h[i][j] (reference concatenates blocks)
+                h = hessian(self._func, list(self._xs))
+                sizes = [int(np.prod(x._value.shape)) for x in self._xs]
+                rows = []
+                for i, hi in enumerate(h):
+                    row = [jnp.reshape(
+                        hij._value if isinstance(hij, Tensor) else hij,
+                        (sizes[i], sizes[j]))
+                        for j, hij in enumerate(hi)]
+                    rows.append(jnp.concatenate(row, axis=1))
+                self._mat = jnp.concatenate(rows, axis=0)
+            else:
+                h = hessian(self._func, self._xs)
+                v = h._value if isinstance(h, Tensor) else h
+                if self._is_batched:
+                    # [B, N, B, N] per-batch diag -> [B, N, N]
+                    b = self._xs._value.shape[0]
+                    n = int(np.prod(self._xs._value.shape[1:]))
+                    v = v.reshape(b, n, b, n)
+                    self._mat = jnp.stack([v[i, :, i, :] for i in range(b)])
+                else:
+                    n = int(np.prod(self._xs._value.shape))
+                    self._mat = v.reshape(n, n)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._materialize().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """Switch AD to primitive-op mode (reference primapi: lowers the
+    program to prim ops). Here AD is ALWAYS primitive — replay_pure +
+    jax.jvp/vjp over jaxpr primitives — so this records intent only."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled
+
+
+__all__ += ["Jacobian", "Hessian", "enable_prim", "disable_prim",
+            "prim_enabled"]

@@ -11,6 +11,6 @@ compiler does the epilogue fusion the reference hand-wrote.
 """
 from .fused_transformer import (  # noqa: F401
     FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
-    FusedMultiTransformer, FusedBiasDropoutResidualLayerNorm,
+    FusedMultiTransformer, FusedBiasDropoutResidualLayerNorm, FusedLinear,
 )
 from . import functional  # noqa: F401

@@ -223,3 +223,32 @@ class FusedMultiTransformer(Layer):
         for layer in self.layers:
             out = layer(out, src_mask=attn_mask)
         return out
+
+
+class FusedLinear(Layer):
+    """Linear with fused gemm epilogue (reference:
+    incubate/nn/layer/fused_linear.py:19 over fused_gemm_epilogue_op.cc /
+    cublasLt). TPU-first: XLA fuses the bias add (and any following
+    activation) into the matmul epilogue on its own — one Linear under jit
+    IS the fused op."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose_weight = transpose_weight
+        # transpose_weight STORES the parameter as [out, in] and the gemm
+        # reads it transposed (reference fused_linear.py semantics)
+        w_shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = materialize_parameter(
+            w_shape, weight_attr, self._dtype,
+            default_initializer=I.XavierNormal())
+        self.bias = materialize_parameter(
+            [out_features], bias_attr, self._dtype, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input):
+        from ...ops import manipulation as manip
+        w = manip.transpose(self.weight, [1, 0]) if self._transpose_weight \
+            else self.weight
+        return F.linear(input, w, self.bias)

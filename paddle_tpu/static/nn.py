@@ -242,3 +242,10 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
 
 
 __all__.append("sparse_embedding")
+
+
+from .nn_ext import *  # noqa: F401,F403,E402
+from .nn_ext import __all__ as _ext_all  # noqa: E402
+__all__ += [n for n in _ext_all if n not in __all__]
+__all__.append("py_func")
+from . import py_func  # noqa: F401,E402

@@ -1,0 +1,731 @@
+"""static.nn builders beyond the core set — layer delegates, normalizers,
+and the sequence_* family.
+
+Reference analog: python/paddle/static/nn/__init__.py (41 exports over
+fluid layers). TPU-first representation notes:
+
+  - LoD does not exist: a "sequence batch" is a PADDED dense tensor
+    [B, T, ...] plus an optional `lengths` argument ([B] int). Every
+    sequence_* op takes that form; ops whose reference output is ragged
+    (sequence_unpad) return the flattened valid rows.
+  - parameters live in the same call-site layer scope as fc/embedding
+    (static/nn.py:_get_layer — the startup-program analog), so repeated
+    calls with one `name` reuse weights.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops._helpers import ensure_tensor
+from ..ops.dispatch import call_op
+from ..utils import unique_name
+
+__all__ = [
+    "bilinear_tensor_product", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "crf_decoding", "data_norm", "deform_conv2d",
+    "group_norm", "instance_norm", "layer_norm", "multi_box_head", "nce",
+    "prelu", "row_conv", "spectral_norm", "sequence_conv",
+    "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_reverse", "StaticRNN",
+]
+
+
+def _scope(name, factory):
+    from .nn import _get_layer
+    return _get_layer(name, factory)
+
+
+def _v(x):
+    return ensure_tensor(x)._value
+
+
+def _t(v):
+    return Tensor(v, stop_gradient=True)
+
+
+# ------------------------------------------------------- layer delegates
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ..nn.layer.common import Bilinear
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    layer = _scope(name, lambda: Bilinear(
+        xt.shape[-1], yt.shape[-1], size, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    out = layer(xt, yt)
+    if act == "relu":
+        import paddle_tpu.nn.functional as F
+        out = F.relu(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from ..nn.layer.conv import Conv2DTranspose
+    x = ensure_tensor(input)
+    layer = _scope(name, lambda: Conv2DTranspose(
+        x.shape[1], num_filters, filter_size or 3, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format))
+    return layer(x)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from ..nn.layer.conv import Conv3D
+    x = ensure_tensor(input)
+    layer = _scope(name, lambda: Conv3D(
+        x.shape[1], num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format))
+    return layer(x)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from ..nn.layer.conv import Conv3DTranspose
+    x = ensure_tensor(input)
+    layer = _scope(name, lambda: Conv3DTranspose(
+        x.shape[1], num_filters, filter_size or 3, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format))
+    return layer(x)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn.initializer_util import materialize_parameter
+    from ..vision.ops import deform_conv2d as _dc
+    x = ensure_tensor(input)
+    k = filter_size if isinstance(filter_size, (tuple, list)) else \
+        (filter_size, filter_size)
+
+    class _DeformParams:
+        def __init__(self):
+            self.weight = materialize_parameter(
+                [num_filters, x.shape[1] // groups, k[0], k[1]], param_attr,
+                "float32")
+            self.bias = materialize_parameter(
+                [num_filters], bias_attr, "float32", is_bias=True) \
+                if bias_attr is not False else None
+
+    p = _scope(name, _DeformParams)
+    return _dc(x, offset, p.weight, bias=p.bias, stride=stride,
+               padding=padding, dilation=dilation,
+               deformable_groups=deformable_groups, groups=groups,
+               mask=mask)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn.layer.norm import GroupNorm
+    x = ensure_tensor(input)
+    layer = _scope(name, lambda: GroupNorm(
+        groups, x.shape[1], epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return layer(x)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn.layer.norm import InstanceNorm2D
+    x = ensure_tensor(input)
+    layer = _scope(name, lambda: InstanceNorm2D(
+        x.shape[1], epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return layer(x)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn.layer.norm import LayerNorm
+    x = ensure_tensor(input)
+    norm_shape = list(x.shape[begin_norm_axis:])
+    layer = _scope(name, lambda: LayerNorm(
+        norm_shape, epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False))
+    return layer(x)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    from ..nn.layer.activation import PReLU
+    xt = ensure_tensor(x)
+    num = 1 if mode == "all" else (
+        xt.shape[1] if mode == "channel" else int(np.prod(xt.shape[1:])))
+    layer = _scope(name, lambda: PReLU(num_parameters=num,
+                                       weight_attr=param_attr,
+                                       data_format=data_format))
+    return layer(xt)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 name=None):
+    """Viterbi decode against a learned transition parameter (reference:
+    fluid crf_decoding over linear_chain_crf's transition). input:
+    [B, T, N] emissions."""
+    from ..nn.initializer_util import materialize_parameter
+    from ..text import viterbi_decode
+    x = ensure_tensor(input)
+    n_tags = x.shape[-1]
+
+    class _Transition:
+        def __init__(self):
+            self.weight = materialize_parameter(
+                [n_tags + 2, n_tags], param_attr, "float32")
+
+    trans = _scope(name or "crf_decoding", _Transition)
+    lens = length if length is not None else _t(
+        jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+    # the learned table's first two rows are start/stop in the reference;
+    # the square body drives the pairwise transitions
+    body = Tensor(trans.weight._value[2:, :])
+    _, path = viterbi_decode(x, body, lens, include_bos_eos_tag=False)
+    return path
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_0=0.9999999, enable_scale_and_shift=False):
+    """Normalization by ACCUMULATED batch statistics (reference: fluid
+    data_norm op — PS-CTR feature normalization keeping batch_size/
+    batch_sum/batch_square_sum accumulators, no learned scale)."""
+    from ..nn.initializer_util import materialize_parameter
+    x = ensure_tensor(input)
+    d = x.shape[-1]
+
+    from ..nn import initializer as I
+
+    class _Stats:
+        def __init__(self):
+            self.batch_size = materialize_parameter(
+                [d], None, "float32", default_initializer=I.Constant(1e4))
+            self.batch_sum = materialize_parameter(
+                [d], None, "float32", default_initializer=I.Constant(0.0))
+            self.batch_square_sum = materialize_parameter(
+                [d], None, "float32", default_initializer=I.Constant(1e4))
+            for p in (self.batch_size, self.batch_sum,
+                      self.batch_square_sum):
+                p.stop_gradient = True
+
+    s = _scope(name or "data_norm", _Stats)
+    mean = s.batch_sum._value / s.batch_size._value
+    scale = jnp.sqrt(s.batch_size._value / s.batch_square_sum._value)
+    out_t = call_op("data_norm",
+                    lambda v: (v - mean) * scale, (x,))
+    # accumulate this batch into the stats (the op's saved outputs)
+    n = float(np.prod(x.shape[:-1]))
+    s.batch_size._value = s.batch_size._value + n
+    s.batch_sum._value = s.batch_sum._value + x._value.reshape(-1, d).sum(0)
+    s.batch_square_sum._value = s.batch_square_sum._value + \
+        (x._value.reshape(-1, d) ** 2).sum(0)
+    return out_t
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference: fluid nce op).
+    input [B, D], label [B, 1] or [B]; returns [B, 1] per-example loss."""
+    from ..nn.initializer_util import materialize_parameter
+    x = ensure_tensor(input)
+    y = ensure_tensor(label)
+    d = x.shape[-1]
+    k = int(num_neg_samples or 10)
+
+    class _NCE:
+        def __init__(self):
+            self.weight = materialize_parameter(
+                [num_total_classes, d], param_attr, "float32")
+            self.bias = materialize_parameter(
+                [num_total_classes], bias_attr, "float32", is_bias=True)
+
+    p = _scope(name or "nce", _NCE)
+    yv = y._value.reshape(-1).astype(jnp.int32)
+    rng = np.random.default_rng(seed)
+    neg = jnp.asarray(
+        rng.integers(0, num_total_classes, (x.shape[0], k)), jnp.int32)
+
+    def fn(xv, wv, bv):
+        pos_logit = jnp.einsum("bd,bd->b", xv, wv[yv]) + bv[yv]
+        neg_logit = jnp.einsum("bd,bkd->bk", xv, wv[neg]) + bv[neg]
+        loss = -jax.nn.log_sigmoid(pos_logit) \
+            - jax.nn.log_sigmoid(-neg_logit).sum(-1)
+        return loss[:, None]
+    return call_op("nce", fn, (x, p.weight, p.bias))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead row convolution (reference: fluid row_conv op —
+    DeepSpeech2's streaming-friendly temporal filter). input [B, T, D]."""
+    from ..nn.initializer_util import materialize_parameter
+    x = ensure_tensor(input)
+    d = x.shape[-1]
+    w = future_context_size + 1
+
+    class _RowConv:
+        def __init__(self):
+            self.weight = materialize_parameter([w, d], param_attr,
+                                                "float32")
+
+    p = _scope(name or "row_conv", _RowConv)
+
+    def fn(v, wv):
+        pad = jnp.pad(v, ((0, 0), (0, future_context_size), (0, 0)))
+        return sum(pad[:, i:i + v.shape[1], :] * wv[i] for i in range(w))
+    return call_op("row_conv", fn, (x, p.weight))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized view of `weight` (reference: fluid
+    spectral_norm op — power iteration on the unrolled matrix)."""
+    wt = ensure_tensor(weight)
+    nd = wt._value.ndim
+    perm = [dim] + [i for i in range(nd) if i != dim]
+
+    def fn(w):
+        mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+        u = jax.random.normal(jax.random.PRNGKey(0), (mat.shape[0],))
+        v = None
+        for _ in range(max(int(power_iters), 1)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+    return call_op("spectral_norm", fn, (wt,))
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference: fluid multi_box_head): per feature
+    map, conv heads predict box offsets and class scores against generated
+    prior boxes. Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    from ..nn.layer.conv import Conv2D
+    if min_sizes is None:
+        # reference ratio schedule
+        num_layer = len(inputs)
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(num_layer - 2, 1))
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+    locs, confs, priors, vars_ = [], [], [], []
+    img_h, img_w = ensure_tensor(image).shape[2:4]
+    for i, feat in enumerate(inputs):
+        f = ensure_tensor(feat)
+        ar = aspect_ratios[i] if i < len(aspect_ratios) else [1.0]
+        # build the per-cell size list FIRST: the conv heads' channel
+        # count must equal the number of priors actually generated
+        sizes = []
+        mn = min_sizes[i] / base_size
+        sizes.append((mn, mn))
+        if i < len(max_sizes) and max_sizes[i]:
+            mx = (mn * max_sizes[i] / base_size) ** 0.5
+            sizes.append((mx, mx))
+        for a in ar:
+            if a == 1.0:
+                continue
+            sizes.append((mn * a ** 0.5, mn / a ** 0.5))
+            if flip:
+                sizes.append((mn / a ** 0.5, mn * a ** 0.5))
+        n_prior = len(sizes)
+        loc_conv = _scope(f"{name or 'mbox'}_loc_{i}", lambda f=f, n=n_prior:
+                          Conv2D(f.shape[1], n * 4, kernel_size,
+                                 stride=stride, padding=pad))
+        conf_conv = _scope(f"{name or 'mbox'}_conf_{i}",
+                           lambda f=f, n=n_prior:
+                           Conv2D(f.shape[1], n * num_classes, kernel_size,
+                                  stride=stride, padding=pad))
+        loc = loc_conv(f)._value
+        conf = conf_conv(f)._value
+        b, _, fh, fw = loc.shape
+        locs.append(loc.transpose(0, 2, 3, 1).reshape(b, -1, 4))
+        confs.append(conf.transpose(0, 2, 3, 1)
+                     .reshape(b, -1, num_classes))
+        # prior boxes: centered grid, one box per size per cell
+        ys, xs = jnp.meshgrid(
+            (jnp.arange(fh) + offset) / fh,
+            (jnp.arange(fw) + offset) / fw, indexing="ij")
+        for (sw, sh) in sizes:
+            box = jnp.stack([xs - sw / 2, ys - sh / 2,
+                             xs + sw / 2, ys + sh / 2], -1).reshape(-1, 4)
+            if clip:
+                box = jnp.clip(box, 0.0, 1.0)
+            priors.append(box)
+            vars_.append(jnp.broadcast_to(
+                jnp.asarray(variance, jnp.float32), box.shape))
+    mbox_locs = jnp.concatenate(locs, 1)
+    mbox_confs = jnp.concatenate(confs, 1)
+    boxes = jnp.concatenate(priors, 0)
+    variances = jnp.concatenate(vars_, 0)
+    return _t(mbox_locs), _t(mbox_confs), _t(boxes), _t(variances)
+
+
+# ------------------------------------------------------- sequence family
+
+def _len_mask(v, lengths):
+    if lengths is None:
+        return None
+    lv = ensure_tensor(lengths)._value.reshape(-1)
+    return jnp.arange(v.shape[1])[None, :] < lv[:, None]
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Temporal conv over padded [B, T, D] sequences (reference: fluid
+    sequence_conv over LoD rows)."""
+    from ..nn.initializer_util import materialize_parameter
+    x = ensure_tensor(input)
+    d = x.shape[-1]
+
+    class _SeqConv:
+        def __init__(self):
+            self.weight = materialize_parameter(
+                [filter_size * d, num_filters], param_attr, "float32")
+            self.bias = materialize_parameter(
+                [num_filters], bias_attr, "float32", is_bias=True) \
+                if bias_attr is not False else None
+
+    p = _scope(name or "sequence_conv", _SeqConv)
+    start = padding_start if padding_start is not None else \
+        -((filter_size - 1) // 2)
+    lo = max(-start, 0)
+    hi = max(filter_size - 1 + start, 0)
+
+    def fn(v, w, *rest):
+        pad = jnp.pad(v, ((0, 0), (lo, hi), (0, 0)))
+        windows = jnp.concatenate(
+            [pad[:, i:i + v.shape[1], :] for i in range(filter_size)], -1)
+        out = windows @ w
+        if rest:
+            out = out + rest[0]
+        return out
+
+    ins = (x, p.weight) + ((p.bias,) if p.bias is not None else ())
+    return call_op("sequence_conv", fn, ins)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, lengths=None):
+    x = ensure_tensor(input)
+    mask = _len_mask(x._value, lengths)
+
+    def fn(v):
+        vv = v
+        if mask is not None:
+            vv = jnp.where(mask[..., None] if v.ndim == 3 else mask,
+                           vv, -1e30)
+        out = jax.nn.softmax(vv, axis=1)
+        if mask is not None:
+            out = jnp.where(mask[..., None] if v.ndim == 3 else mask,
+                            out, 0.0)
+        return out
+    return call_op("sequence_softmax", fn, (x,))
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  lengths=None):
+    x = ensure_tensor(input)
+    mask = _len_mask(x._value, lengths)
+    lv = None if lengths is None else \
+        ensure_tensor(lengths)._value.reshape(-1).astype(jnp.int32)
+    pt = pool_type.lower()
+    if pt not in ("sum", "average", "sqrt", "max", "first", "last"):
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    def fn(v):
+        m3 = None if mask is None else mask[..., None]
+        if pt in ("sum", "average", "sqrt"):
+            vv = v if m3 is None else jnp.where(m3, v, 0.0)
+            s = vv.sum(1)
+            if pt == "sum":
+                return s
+            n = jnp.maximum(mask.sum(1), 1)[..., None] \
+                if mask is not None else float(v.shape[1])
+            return s / (jnp.sqrt(n) if pt == "sqrt" else n)
+        if pt == "max":
+            vv = v if m3 is None else jnp.where(m3, v, -jnp.inf)
+            return vv.max(1)
+        if pt == "first":
+            return v[:, 0]
+        if lv is None:
+            return v[:, -1]
+        return jnp.take_along_axis(
+            v, jnp.maximum(lv - 1, 0)[:, None, None], 1)[:, 0]
+    return call_op("sequence_pool", fn, (x,))
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths=lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths=lengths)
+
+
+def sequence_concat(input, name=None):
+    ts = [ensure_tensor(t) for t in input]
+
+    def fn(*vals):
+        return jnp.concatenate(vals, axis=1)
+    return call_op("sequence_concat", fn, tuple(ts))
+
+
+def sequence_slice(input, offset, length, name=None):
+    x = ensure_tensor(input)
+    off = ensure_tensor(offset)._value.reshape(-1).astype(jnp.int32)
+    ln = ensure_tensor(length)._value.reshape(-1).astype(jnp.int32)
+    out_len = int(ln[0])
+    if not bool(jnp.all(ln == out_len)):
+        raise ValueError(
+            "sequence_slice on the padded representation needs equal "
+            "lengths per batch row (ragged output has no dense tensor)")
+    def fn(v):
+        return jnp.stack([
+            jax.lax.dynamic_slice_in_dim(v[b], off[b], out_len, 0)
+            for b in range(v.shape[0])])
+    return call_op("sequence_slice", fn, (x,))
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Tile each of x's rows to y's time length (reference expands rows by
+    y's LoD; padded analog: repeat along a new/existing time dim)."""
+    xt = ensure_tensor(x)
+    t = ensure_tensor(y).shape[1]
+
+    def fn(xv):
+        if xv.ndim == 2:
+            return jnp.repeat(xv[:, None, :], t, axis=1)
+        return jnp.broadcast_to(xv[:, :1, :],
+                                (xv.shape[0], t, xv.shape[2]))
+    return call_op("sequence_expand", fn, (xt,))
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, lengths=None):
+    """Pad/trim the time dim to maxlen; returns (padded, lengths)
+    (reference returns Length as second output)."""
+    xt = ensure_tensor(x)
+    pv = float(ensure_tensor(pad_value)._value) \
+        if not isinstance(pad_value, (int, float)) else float(pad_value)
+    t = xt.shape[1]
+    target = int(maxlen or t)
+
+    def fn(v):
+        if target > t:
+            return jnp.pad(
+                v, ((0, 0), (0, target - t)) + ((0, 0),) * (v.ndim - 2),
+                constant_values=pv)
+        return v[:, :target]
+
+    padded = call_op("sequence_pad", fn, (xt,))
+    if lengths is None:
+        lens = jnp.full((xt.shape[0],), min(t, target), jnp.int64)
+    else:
+        lens = jnp.minimum(ensure_tensor(lengths)._value.reshape(-1), target)
+    return padded, _t(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """Drop padding: returns the concatenated valid rows [sum(len), ...]
+    (the reference's LoD output flattened — the only dense form)."""
+    xt = ensure_tensor(x)
+    lv = np.asarray(ensure_tensor(length)._value).reshape(-1).astype(int)
+    rows = [np.asarray(xt._value[b, :lv[b]]) for b in range(xt.shape[0])]
+    return _t(jnp.asarray(np.concatenate(rows, 0)))
+
+
+def sequence_reshape(input, new_dim):
+    x = ensure_tensor(input)
+    v = x._value
+    total = v.shape[1] * v.shape[2]
+    if total % new_dim:
+        raise ValueError(f"cannot reshape feature {v.shape[1]}x{v.shape[2]} "
+                         f"to rows of {new_dim}")
+    return call_op("sequence_reshape", lambda vv: vv.reshape(
+        vv.shape[0], total // new_dim, new_dim), (x,))
+
+
+def sequence_scatter(input, index, updates, name=None):
+    x = ensure_tensor(input)
+    idx = ensure_tensor(index)._value.astype(jnp.int32)
+    upd = ensure_tensor(updates)
+    b = jnp.arange(x.shape[0])[:, None]
+
+    def fn(v, u):
+        return v.at[b, idx].add(u)
+    return call_op("sequence_scatter", fn, (x, upd))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    x = ensure_tensor(input)
+    v = x._value
+    pad = jnp.pad(v, ((0, 0), (0, win_size - 1)),
+                  constant_values=int(pad_value))
+    wins = jnp.stack([pad[:, i:i + v.shape[1]] for i in range(win_size)],
+                     -1)
+    return _t(wins)
+
+
+def sequence_reverse(x, name=None, lengths=None):
+    xt = ensure_tensor(x)
+    lv = None if lengths is None else \
+        ensure_tensor(lengths)._value.reshape(-1).astype(jnp.int32)
+
+    def fn(v):
+        if lv is None:
+            return v[:, ::-1]
+        idx = jnp.arange(v.shape[1])[None, :]
+        src = jnp.where(idx < lv[:, None], lv[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(
+            v, src[..., None] if v.ndim == 3 else src, 1)
+    return call_op("sequence_reverse", fn, (xt,))
+
+
+class StaticRNN:
+    """Step-builder RNN (reference: fluid StaticRNN — step_input/memory/
+    update_memory/output record ops into a block re-executed per step).
+
+    TPU-first: the user's step block runs eagerly ONCE (on the t=0 slice),
+    wiring the autograd tape from the step cursors to the outputs; __call__
+    replays that tape as a PURE function (framework.autograd.replay_pure —
+    the same machinery as double-grad) and drives it with ONE lax.scan over
+    the time dim. Parameters touched inside the block are discovered from
+    the tape and threaded as explicit scan inputs, so gradients flow to
+    them exactly as in the reference."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._memories = []
+        self._outputs = []
+
+    def step(self):
+        class _Ctx:
+            def __enter__(ctx):
+                return ctx
+
+            def __exit__(ctx, *exc):
+                return False
+        return _Ctx()
+
+    def step_input(self, x):
+        xt = ensure_tensor(x)
+        cursor = Tensor(xt._value[:, 0], stop_gradient=False)
+        self._inputs.append({"value": xt, "cursor": cursor})
+        return cursor
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            if batch_ref is None:
+                raise ValueError("memory() needs init or batch_ref")
+            b = ensure_tensor(batch_ref).shape[0]
+            init = Tensor(jnp.full((b,) + tuple(shape or ()),
+                                   float(init_value), jnp.float32),
+                          stop_gradient=True)
+        init = ensure_tensor(init)
+        cursor = Tensor(init._value, stop_gradient=False)
+        self._memories.append({"init": init, "cursor": cursor,
+                               "update": None})
+        return cursor
+
+    def update_memory(self, mem, new):
+        for slot in self._memories:
+            if slot["cursor"] is mem:
+                slot["update"] = ensure_tensor(new)
+                return
+        raise ValueError("update_memory: unknown memory tensor")
+
+    def output(self, *outputs):
+        self._outputs.extend(ensure_tensor(o) for o in outputs)
+
+    def _leaf_params(self, roots, exclude_ids):
+        """Parameters the step block touched: AccumulationNode leaves of
+        the recorded graph, minus the step cursors."""
+        from ..framework.autograd import AccumulationNode
+        seen, leaves = set(), []
+        stack = [t._grad_node for t in roots if t._grad_node is not None]
+        while stack:
+            node = stack.pop()
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, AccumulationNode):
+                t = node.tensor_ref()
+                if t is not None and id(t) not in exclude_ids:
+                    leaves.append(t)
+                continue
+            for edge in getattr(node, "edges", ()):
+                if edge is not None:
+                    stack.append(edge[0])
+        return leaves
+
+    def __call__(self):
+        """Scan the recorded step over the time dim; returns the stacked
+        outputs [B, T, ...] (one Tensor per output slot)."""
+        from ..framework.autograd import replay_pure
+        from ..ops.dispatch import call_op_multi
+        if not self._inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        if not self._outputs:
+            raise ValueError("StaticRNN needs at least one output()")
+        cursors = [s["cursor"] for s in self._inputs]
+        mems = [s["cursor"] for s in self._memories]
+        updates = [s["update"] if s["update"] is not None else s["cursor"]
+                   for s in self._memories]
+        roots = list(self._outputs) + list(updates)
+        exclude = {id(c) for c in cursors + mems}
+        params = self._leaf_params(roots, exclude)
+        F = replay_pure(roots, cursors + mems + params)
+        n_out, n_in, n_mem = len(self._outputs), len(cursors), len(mems)
+
+        def scan_fn(*vals):
+            seqs = vals[:n_in]
+            mem0 = vals[n_in:n_in + n_mem]
+            pvals = vals[n_in + n_mem:]
+
+            def body(carry, xs):
+                res = F(*xs, *carry, *pvals)
+                outs = res[:n_out]
+                new_mems = res[n_out:]
+                return tuple(new_mems), tuple(outs)
+
+            xs_tm = tuple(jnp.swapaxes(s, 0, 1) for s in seqs)  # [T, B, ..]
+            _, ys = jax.lax.scan(body, tuple(mem0), xs_tm)
+            return tuple(jnp.swapaxes(y, 0, 1) for y in ys)
+
+        full_inputs = [s["value"] for s in self._inputs] + \
+            [s["init"] for s in self._memories] + params
+        outs = call_op_multi("static_rnn", scan_fn, tuple(full_inputs),
+                     num_outputs=n_out)
+        return outs[0] if len(outs) == 1 else list(outs)

@@ -169,3 +169,180 @@ def test_mel_and_mfcc_shapes():
     assert np.isfinite(logmel.numpy()).all()
     mfcc = audio.MFCC(sr=16000, n_mfcc=20, n_fft=512)(x)
     assert mfcc.numpy().shape[:2] == (2, 20)
+
+
+class TestAudioBackendsDatasets:
+    def test_wav_save_load_info_roundtrip(self, tmp_path):
+        import paddle_tpu as paddle
+        path = str(tmp_path / "t.wav")
+        t = np.linspace(0, 1, 1600, dtype=np.float32)
+        wav = paddle.to_tensor(np.stack([np.sin(2 * np.pi * 440 * t)]))
+        paddle.audio.save(path, wav, 16000)
+        meta = paddle.audio.info(path)
+        assert meta.sample_rate == 16000 and meta.num_channels == 1
+        assert meta.num_samples == 1600 and meta.bits_per_sample == 16
+        back, sr = paddle.audio.load(path)
+        assert sr == 16000 and back.shape == [1, 1600]
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(wav._value), atol=1e-3)
+
+    def test_load_offset_and_channels_last(self, tmp_path):
+        import paddle_tpu as paddle
+        path = str(tmp_path / "st.wav")
+        wav = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(2, 800))
+            .astype(np.float32) * 0.1)
+        paddle.audio.save(path, wav, 8000)
+        seg, sr = paddle.audio.load(path, frame_offset=100, num_frames=200,
+                                    channels_first=False)
+        assert seg.shape == [200, 2]
+
+    def test_tess_esc50(self):
+        import paddle_tpu as paddle
+        ds = paddle.audio.datasets.TESS(mode="train")
+        wav, lab = ds[0]
+        assert wav.ndim == 1 and 0 <= int(lab) < 7
+        ds2 = paddle.audio.datasets.ESC50(mode="test",
+                                          feat_type="melspectrogram",
+                                          n_fft=256)
+        feat, lab2 = ds2[0]
+        assert feat.ndim == 2 and 0 <= int(lab2) < 50
+
+    def test_backend_registry(self):
+        import paddle_tpu as paddle
+        assert paddle.audio.backends.list_available_backends() == \
+            ["wave_backend"]
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError):
+            paddle.audio.backends.set_backend("soundfile")
+
+
+class TestIncubateAutogradMatrix:
+    def test_jacobian_matrix_view(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.autograd import Jacobian
+        x = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+
+        def f(v):
+            return (v * v)
+
+        J = Jacobian(f, x)
+        assert J.shape == [3, 3]
+        np.testing.assert_allclose(np.asarray(J[:, :]._value),
+                                   np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+    def test_hessian_matrix_view(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.autograd import Hessian
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+
+        def f(v):
+            return (v * v).sum()
+
+        H = Hessian(f, x)
+        assert H.shape == [2, 2]
+        np.testing.assert_allclose(np.asarray(H[:, :]._value),
+                                   2 * np.eye(2), rtol=1e-6)
+
+    def test_prim_toggles(self):
+        from paddle_tpu.incubate import autograd as ia
+        ia.enable_prim()
+        assert ia.prim_enabled()
+        ia.disable_prim()
+        assert not ia.prim_enabled()
+
+
+class TestFusedLinear:
+    def test_matches_linear(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import FusedLinear
+        paddle.seed(0)
+        fl = FusedLinear(8, 4)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32))
+        out = fl(x)
+        ref = np.asarray(x._value) @ np.asarray(fl.weight._value) + \
+            np.asarray(fl.bias._value)
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5)
+
+
+class TestAutogradMatrixRegressions:
+    def test_jacobian_multi_input_hstacks(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.autograd import Jacobian
+        x1 = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        x2 = paddle.to_tensor(np.asarray([3.0], np.float32))
+        x1.stop_gradient = False
+        x2.stop_gradient = False
+
+        def f(a, b):
+            return a * a + b.sum()
+
+        J = Jacobian(f, [x1, x2])
+        assert J.shape == [2, 3]
+        np.testing.assert_allclose(np.asarray(J[:, :]._value),
+                                   [[2, 0, 1], [0, 4, 1]], rtol=1e-6)
+
+    def test_hessian_multi_input_blocks(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.autograd import Hessian
+        x1 = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        x2 = paddle.to_tensor(np.asarray([3.0], np.float32))
+        x1.stop_gradient = False
+        x2.stop_gradient = False
+
+        def f(a, b):
+            return (a * a).sum() + 3.0 * (b * b).sum()
+
+        H = Hessian(f, [x1, x2])
+        assert H.shape == [3, 3]
+        np.testing.assert_allclose(np.asarray(H[:, :]._value),
+                                   np.diag([2.0, 2.0, 6.0]), rtol=1e-6)
+
+    def test_hessian_batched(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.autograd import Hessian
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32))
+        x.stop_gradient = False
+
+        def f(v):
+            return (v * v).sum()
+
+        H = Hessian(f, x, is_batched=True)
+        assert H.shape == [4, 3, 3]
+        for b in range(4):
+            np.testing.assert_allclose(np.asarray(H[b]._value),
+                                       2 * np.eye(3), rtol=1e-6)
+
+    def test_fused_linear_transpose_weight(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn import FusedLinear
+        paddle.seed(0)
+        fl = FusedLinear(8, 4, transpose_weight=True)
+        assert list(fl.weight.shape) == [4, 8]   # stored transposed
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32))
+        out = fl(x)
+        ref = np.asarray(x._value) @ np.asarray(fl.weight._value).T + \
+            np.asarray(fl.bias._value)
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5)
+
+
+def test_multi_box_head_priors_align_with_heads():
+    """locs/confs per-image count must equal the generated prior count,
+    including ar=1.0 entries (review regression)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.static.nn as snn
+    paddle.seed(0)
+    feats = [paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(1, 8, 4, 4))
+        .astype(np.float32))]
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    locs, confs, boxes, vars_ = snn.multi_box_head(
+        feats, img, base_size=64, num_classes=3,
+        aspect_ratios=[[1.0, 2.0]], name="mbox_align")
+    assert locs.shape[1] == boxes.shape[0]
+    assert confs.shape[1] == boxes.shape[0]
