@@ -1,0 +1,104 @@
+"""paddle.device.cuda — CUDA device API surface (reference:
+python/paddle/device/cuda). There is no CUDA on a TPU host: queries report
+zero devices, stream/event objects are inert (XLA owns streams), and
+allocation probes return 0 — feature-detecting user code takes its
+CPU/other-device path naturally instead of crashing on import."""
+from __future__ import annotations
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "empty_cache", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "stream_guard", "get_device_properties", "get_device_name",
+           "get_device_capability"]
+
+
+def device_count():
+    return 0
+
+
+def synchronize(device=None):
+    return None
+
+
+def empty_cache():
+    return None
+
+
+def max_memory_allocated(device=None):
+    return 0
+
+
+def max_memory_reserved(device=None):
+    return 0
+
+
+def memory_allocated(device=None):
+    return 0
+
+
+def memory_reserved(device=None):
+    return 0
+
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        return None
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        return None
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        return None
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _no_cuda(what):
+    raise RuntimeError(
+        f"{what}: no CUDA device on a TPU host (device_count() == 0)")
+
+
+def get_device_properties(device=None):
+    _no_cuda("get_device_properties")
+
+
+def get_device_name(device=None):
+    _no_cuda("get_device_name")
+
+
+def get_device_capability(device=None):
+    _no_cuda("get_device_capability")

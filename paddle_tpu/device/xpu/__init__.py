@@ -1,0 +1,7 @@
+"""paddle.device.xpu shim (reference: python/paddle/device/xpu) — no
+Kunlun XPU on a TPU host."""
+__all__ = ["synchronize"]
+
+
+def synchronize(device=None):
+    return None

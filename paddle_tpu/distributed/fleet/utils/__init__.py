@@ -15,7 +15,10 @@ from ....framework import random as _random
 from ....framework.autograd import set_grad_enabled
 from ....ops.dispatch import call_op
 
-__all__ = ["recompute"]
+from .fs import LocalFS, HDFSClient  # noqa: F401
+from .ps_util import DistributedInfer  # noqa: F401
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
 
 
 def recompute(function, *args, **kwargs):

@@ -1,0 +1,31 @@
+"""DistributedInfer (reference: fleet/utils/ps_util.py:23) — run inference
+against the PS-hosted sparse tables: pull the sparse rows the batch needs,
+run the dense program locally. The TPU-native pair is distributed/ps +
+static.nn.sparse_embedding's table registry."""
+from __future__ import annotations
+
+__all__ = ["DistributedInfer"]
+
+
+class DistributedInfer:
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+        self._initialized = False
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        """Pull the current table state down for inference (reference
+        pulls dense params from the PS). Loads persistables from `dirname`
+        when given."""
+        if dirname and self._main is not None:
+            from ...io import load_persistables
+            load_persistables(exe, dirname, self._main)
+        self._initialized = True
+
+    def get_dist_infer_program(self):
+        """Reference rewrites distributed lookup ops into local ones; the
+        TPU-native program IS local (sparse_embedding pulls from the
+        in-process/rpc table directly), so the main program passes
+        through."""
+        return self._main

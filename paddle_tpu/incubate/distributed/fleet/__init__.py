@@ -1,0 +1,47 @@
+"""incubate.distributed.fleet — recompute entry points (reference:
+python/paddle/incubate/distributed/fleet/__init__.py)."""
+from __future__ import annotations
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential in `segments` chunks (reference
+    incubate/distributed/fleet/recompute_sequential.py). ctx: dict with
+    "segments" (default 1)."""
+    from ....distributed.fleet.utils import recompute
+    segments = int((ctx or {}).get("segments", 1))
+    if hasattr(functions, "sublayers"):
+        layers = [l for l in functions] if hasattr(functions, "__iter__") \
+            else list(functions.sublayers(include_self=False))
+    else:
+        layers = list(functions)
+    def run_layers(chunk, *xs):
+        # first layer receives the args as given; later layers chain the
+        # (single or tuple) output exactly like nn.Sequential
+        out = chunk[0](*xs)
+        for l in chunk[1:]:
+            out = l(*out) if isinstance(out, tuple) else l(out)
+        return out
+
+    if segments <= 1 or len(layers) <= 1:
+        return recompute(lambda *xs: run_layers(layers, *xs), *args,
+                         **kwargs)
+    per = max(len(layers) // segments, 1)
+    out = args
+    for s in range(0, len(layers), per):
+        chunk = layers[s:s + per]
+        cur = out if isinstance(out, tuple) else (out,)
+        out = recompute(lambda *xs, c=chunk: run_layers(c, *xs), *cur,
+                        **kwargs)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Recompute in hybrid-parallel context (reference recompute_hybrid.py:
+    mp-aware RNG + optional offload). The mesh-global RNG tracker already
+    keys dropout per (step, stage), so this reduces to recompute; the
+    "offload" knob is accepted (XLA remat already avoids storing)."""
+    from ....distributed.fleet.utils import recompute
+    kwargs.pop("offload", None)
+    return recompute(function, *args, **kwargs)

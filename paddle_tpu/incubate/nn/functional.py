@@ -13,6 +13,7 @@ from ...framework.core import Tensor
 from ...ops._helpers import ensure_tensor, call_op
 from ...kernels import fused_ln as _fused_ln
 from ...kernels import cross_entropy as _fused_ce
+from ...ops.math import matmul as _matmul
 
 __all__ = ["fused_bias_dropout_residual_layer_norm",
            "fused_softmax_cross_entropy", "fused_linear"]
@@ -112,3 +113,158 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
         wm = w.T if transpose_weight else w
         return a @ wm + b
     return call_op("fused_linear", fn, (x, weight, ensure_tensor(bias)))
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (reference: functional/fused_matmul_bias.py
+    over fused_gemm_epilogue_op.cc/cublasLt). XLA fuses the epilogue."""
+    from ...ops import math as pmath
+    out = pmath.matmul(ensure_tensor(x), ensure_tensor(y),
+                       transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + ensure_tensor(bias)
+    return out
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-05, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-05, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True, name=None):
+    """Functional fused attention (reference: incubate/nn/functional/
+    fused_transformer.py fused_multi_head_attention over
+    fused_attention_op.cu). qkv_weight [3, H, D, E]; the attention core is
+    the flash/XLA path of F.scaled_dot_product_attention."""
+    import paddle_tpu.nn.functional as F
+    from ...ops import manipulation as manip
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use the compiled decode "
+            "path (incubate.models.GPTDecodeStep / model.generate()) — the "
+            "static-KV serving cache lives there on TPU")
+    xt = ensure_tensor(x)
+    qkvw = ensure_tensor(qkv_weight)
+    n_heads, head_dim = qkvw.shape[1], qkvw.shape[2]
+    embed = qkvw.shape[3]
+    residual = xt
+    if pre_layer_norm:
+        xt = F.layer_norm(xt, [embed], weight=pre_ln_scale,
+                          bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    # [B, N, E] @ [E, 3*H*D]
+    wmat = manip.reshape(manip.transpose(qkvw, [3, 0, 1, 2]),
+                         [embed, 3 * n_heads * head_dim])
+    qkv = _matmul(xt, wmat)
+    if qkv_bias is not None:
+        qkv = qkv + manip.reshape(ensure_tensor(qkv_bias),
+                                  [3 * n_heads * head_dim])
+    b, n = xt.shape[0], xt.shape[1]
+    qkv = manip.reshape(qkv, [b, n, 3, n_heads, head_dim])
+    q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), 2)
+    k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), 2)
+    v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), 2)
+    ctx = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    ctx = manip.reshape(ctx, [b, n, n_heads * head_dim])
+    out = _matmul(ctx, ensure_tensor(linear_weight))
+    if linear_bias is not None:
+        out = out + ensure_tensor(linear_bias)
+    if dropout_rate and training:
+        out = F.dropout(out, p=dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [embed], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """Functional fused FFN (reference fused_feedforward over
+    fused_feedforward_op.cu): residual + dropout(act(x@W1+b1)@W2+b2) with
+    pre/post LN."""
+    import paddle_tpu.nn.functional as F
+    xt = ensure_tensor(x)
+    d = xt.shape[-1]
+    residual = xt
+    if pre_layer_norm:
+        xt = F.layer_norm(xt, [d], weight=ln1_scale, bias=ln1_bias,
+                          epsilon=ln1_epsilon)
+    h = _matmul(xt, ensure_tensor(linear1_weight))
+    if linear1_bias is not None:
+        h = h + ensure_tensor(linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate and training:
+        h = F.dropout(h, p=dropout1_rate, training=training)
+    h = _matmul(h, ensure_tensor(linear2_weight))
+    if linear2_bias is not None:
+        h = h + ensure_tensor(linear2_bias)
+    if dropout2_rate and training:
+        h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [d], weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-05, cache_kvs=None, pre_caches=None, time_step=None,
+        attn_mask=None, dropout_rate=0.0, activation="gelu",
+        training=False, mode="upscale_in_train", trans_qkvw=True,
+        ring_id=-1, name=None):
+    """Stacked fused transformer blocks (reference fused_multi_transformer
+    over fused_multi_transformer_op.cu — the serving path). Applies L
+    blocks of fused attention + FFN; cache_kvs, when given, are updated
+    per block ([2, B, H, T, D] each, reference layout)."""
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer cache_kvs/time_step: use the compiled "
+            "decode path (incubate.models.GPTDecodeStep / generate()) for "
+            "serving caches on TPU")
+    out = ensure_tensor(x)
+    n_layers = len(qkv_weights)
+    if not trans_qkvw:
+        # reference layout [E, 3, H, D] -> the [3, H, D, E] this path uses
+        from ...ops import manipulation as _manip
+        qkv_weights = [_manip.transpose(ensure_tensor(w), [1, 2, 3, 0])
+                       for w in qkv_weights]
+    for i in range(n_layers):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            pre_ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            ln1_epsilon=epsilon, pre_layer_norm=pre_layer_norm,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, training=training)
+    return out
+
+
+__all__ += ["fused_matmul_bias", "fused_multi_head_attention",
+            "fused_feedforward", "fused_multi_transformer"]

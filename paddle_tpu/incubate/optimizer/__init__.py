@@ -131,3 +131,6 @@ class ModelAverage:
             if id(p) in self._backup:
                 p._value = self._backup[id(p)]
         self._backup = {}
+
+
+from . import functional  # noqa: F401,E402

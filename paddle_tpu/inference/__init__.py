@@ -15,7 +15,10 @@ import numpy as np
 from ..framework.core import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType", "get_version"]
+           "PlaceType", "get_version", "DataType", "PredictorPool",
+           "get_num_bytes_of_data_type", "convert_to_mixed_precision",
+           "get_trt_compile_version", "get_trt_runtime_version",
+           "_get_phi_kernel_name"]
 
 
 class PrecisionType:
@@ -187,3 +190,70 @@ def create_predictor(config):
 def get_version():
     from .. import __version__
     return __version__
+
+
+class DataType:
+    """Reference paddle_infer.DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+                DataType.BFLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype):
+    """Reference inference API helper."""
+    return _DTYPE_BYTES[dtype]
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU (XLA owns inference compilation)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """Fluid op name -> phi kernel name (reference pybind helper). The op
+    registry here is already phi-style, so names pass through."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Reference convert_to_mixed_precision: rewrite a saved model to
+    mixed precision. TPU-native saved artifacts are StableHLO exports whose
+    precision is chosen AT EXPORT (bf16 weights + jit) — re-export the
+    layer with model.bfloat16() instead of rewriting the artifact."""
+    raise NotImplementedError(
+        "TPU inference artifacts fix precision at export: call "
+        "model.bfloat16() before jit.save / save_inference_model instead "
+        "of converting the saved file")
+
+
+class PredictorPool:
+    """Pool of Predictors sharing one config (reference
+    paddle_infer.PredictorPool — serving worker pools)."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [create_predictor(config)
+                            for _ in range(max(int(size), 1))]
+
+    def retrive(self, idx):
+        return self._predictors[idx]
+
+    retrieve = retrive          # reference spells it "retrive"
+
+    def size(self):
+        return len(self._predictors)

@@ -3,6 +3,7 @@ from .layer_base import Layer  # noqa: F401
 from .initializer_util import ParamAttr  # noqa: F401
 from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 
 from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
 from .layer.common import *  # noqa: F401,F403

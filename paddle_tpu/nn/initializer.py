@@ -180,3 +180,48 @@ class Dirac(Initializer):
                 idx = (g * (out_c // self.groups) + i, i, *centers)
                 out[idx] = 1.0
         return jnp.asarray(out, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference: nn/initializer/Bilinear over bilinear_init): weight
+    [C_out, C_in, kH, kW] gets the separable triangle kernel."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got {shape}")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            c = f - 1 if k % 2 == 1 else f - 0.5
+            return 1 - np.abs(np.arange(k) - c) / f
+
+        kernel = np.outer(tri(kh), tri(kw)).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            w[i, i % shape[1]] = kernel
+        return jnp.asarray(w, dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set the DEFAULT initializers used when a parameter has no explicit
+    one (reference: nn/initializer/set_global_initializer — applies to
+    parameters created afterwards; pass None to reset)."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_initializer(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init
+
+
+__all__ += ["Bilinear", "set_global_initializer"]

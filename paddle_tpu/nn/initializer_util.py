@@ -47,7 +47,11 @@ def materialize_parameter(shape, attr=None, dtype="float32", is_bias=False,
     if attr is False:
         return None
     attr = ParamAttr._to_attr(attr)
-    init = attr.initializer or default_initializer
+    # precedence: explicit attr > set_global_initializer (it overrides the
+    # LAYER's default too — reference semantics: applies wherever the user
+    # did not pass an initializer) > layer default > built-in
+    init = attr.initializer or I._global_initializer(is_bias) \
+        or default_initializer
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
     shape = [int(s) for s in shape]

@@ -1,0 +1,160 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils — weight_norm /
+spectral_norm reparameterizations + parameter/vector converters).
+
+TPU-first reparameterization: instead of op-hooks on a mutable program,
+the wrapped layer's forward recomputes the effective weight from the
+reparam parameters each call — one extra fused normalize per step that XLA
+folds into the matmul's producer chain."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, Parameter
+from ...ops.dispatch import call_op
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt((v * v).sum(axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.name` as g * v/||v|| (reference
+    nn/utils/weight_norm_hook.py). Registers `name`_g / `name`_v and
+    recomputes the weight in a wrapped forward."""
+    w = getattr(layer, name)
+    v0 = w._value
+    if dim is not None and dim < 0:
+        dim = v0.ndim + dim             # dim=-1 means the LAST axis
+    if dim is None:                      # None = whole-tensor norm
+        g0 = jnp.sqrt((v0 * v0).sum())
+    else:
+        g0 = _norm_except(v0, dim).reshape(-1)
+    g = Parameter(g0)
+    g.stop_gradient = False
+    v = Parameter(v0)
+    v.stop_gradient = False
+    setattr(layer, name + "_g", g)
+    setattr(layer, name + "_v", v)
+
+    orig_forward = layer.forward
+
+    def _effective_weight():
+        def fn(gv, vv):
+            if dim is None:
+                nrm = jnp.sqrt((vv * vv).sum())
+                return vv * (gv / nrm)
+            nrm = _norm_except(vv, dim)
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv / nrm * gv.reshape(shape)
+        return call_op("weight_norm", fn, (g, v))
+
+    def forward(*args, **kwargs):
+        eff = _effective_weight()
+        saved = getattr(layer, name)
+        try:
+            # swap the effective weight in: Parameter identity preserved
+            saved_val = saved._value
+            saved_node = saved._grad_node
+            saved_idx = saved._out_index
+            saved._value = eff._value
+            saved._grad_node = eff._grad_node
+            saved._out_index = eff._out_index
+            return orig_forward(*args, **kwargs)
+        finally:
+            saved._value = saved_val
+            saved._grad_node = saved_node
+            saved._out_index = saved_idx
+
+    layer.forward = forward
+    layer._weight_norm_info = (name, dim, orig_forward)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Bake the current effective weight back and restore the plain
+    forward (reference remove_weight_norm)."""
+    info = getattr(layer, "_weight_norm_info", None)
+    if info is None:
+        raise ValueError("layer has no weight_norm applied")
+    pname, dim, orig_forward = info
+    g = getattr(layer, pname + "_g")._value
+    v = getattr(layer, pname + "_v")._value
+    if dim is None:
+        eff = v * (g / jnp.sqrt((v * v).sum()))
+    else:
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        eff = v / _norm_except(v, dim) * g.reshape(shape)
+    getattr(layer, pname)._value = eff
+    layer.forward = orig_forward
+    delattr(layer, pname + "_g")
+    delattr(layer, pname + "_v")
+    del layer._weight_norm_info
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization of `layer.name` (reference
+    nn/utils/spectral_norm_hook.py): the forward divides the weight by its
+    leading singular value, estimated by persistent power iteration."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    v0 = w._value
+    perm = [dim] + [i for i in range(v0.ndim) if i != dim]
+    mat0 = jnp.transpose(v0, perm).reshape(v0.shape[dim], -1)
+    rng = np.random.default_rng(0)
+    layer._sn_u = jnp.asarray(rng.normal(size=(mat0.shape[0],)),
+                              jnp.float32)
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        saved = getattr(layer, name)
+        saved_val = saved._value
+        mat = jnp.transpose(saved_val, perm).reshape(saved_val.shape[dim],
+                                                     -1)
+        u = layer._sn_u
+        for _ in range(max(int(n_power_iterations), 1)):
+            vv = mat.T @ u
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            u = mat @ vv
+            u = u / (jnp.linalg.norm(u) + eps)
+        layer._sn_u = u                    # persistent estimate
+        sigma = u @ mat @ vv
+        try:
+            saved._value = saved_val / sigma
+            return orig_forward(*args, **kwargs)
+        finally:
+            saved._value = saved_val
+
+    layer.forward = forward
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one 1-D Tensor (reference
+    nn/utils/transform_parameters.py)."""
+    vals = [jnp.ravel(p._value) for p in parameters]
+    return Tensor(jnp.concatenate(vals) if vals
+                  else jnp.zeros((0,), jnp.float32))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Inverse of parameters_to_vector: writes slices back in order."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape)) if p._value.ndim else 1
+        p._value = v[off:off + n].reshape(p._value.shape) \
+            .astype(p._value.dtype)
+        off += n
+    if off != v.shape[0]:
+        raise ValueError(
+            f"vector has {v.shape[0]} elements but parameters take {off}")

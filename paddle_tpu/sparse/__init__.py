@@ -318,3 +318,5 @@ __all__ += ["SparseCsrTensor", "sin", "tan", "asin", "atan", "sinh", "tanh",
             "cast", "neg", "deg2rad", "rad2deg", "expm1", "mv",
             "masked_matmul", "addmm", "subtract", "transpose", "divide",
             "coalesce", "reshape"]
+
+from . import nn  # noqa: F401,E402

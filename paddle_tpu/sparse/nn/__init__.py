@@ -1,0 +1,134 @@
+"""paddle.sparse.nn — layers over sparse tensors (reference:
+python/paddle/sparse/nn: activations, BatchNorm/SyncBatchNorm on values,
+Conv3D/SubmConv3D/MaxPool3D via the functional forms)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...nn.layer_base import Layer
+from ...nn.initializer_util import materialize_parameter
+from ...nn import initializer as I
+from .. import SparseCooTensor
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the VALUES of a sparse tensor (reference
+    sparse/nn/layer/norm.py BatchNorm — channels-last values [nnz, C])."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self.weight = materialize_parameter(
+            [num_features], weight_attr, self._dtype,
+            default_initializer=I.Constant(1.0))
+        self.bias = materialize_parameter(
+            [num_features], bias_attr, self._dtype, is_bias=True)
+        self._mean = jnp.zeros((num_features,), jnp.float32)
+        self._variance = jnp.ones((num_features,), jnp.float32)
+
+    def forward(self, x):
+        vals = x.values if isinstance(x, SparseCooTensor) else x
+        v = vals._value
+        if self.training:
+            mean = v.mean(0)
+            var = v.var(0)
+            m = self._momentum
+            self._mean = m * self._mean + (1 - m) * mean
+            self._variance = m * self._variance + (1 - m) * var
+        else:
+            mean, var = self._mean, self._variance
+        from ...framework.core import Tensor
+        out = (v - mean) / jnp.sqrt(var + self._epsilon) \
+            * self.weight._value + self.bias._value
+        out_t = Tensor(out)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices, out_t, x.shape,
+                                   coalesced=x.coalesced)
+        return out_t
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm (reference sparse SyncBatchNorm): under a
+    jitted SPMD program XLA's batch statistics are already global per
+    sharded batch; the eager single-controller form equals BatchNorm."""
+
+
+class Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 3
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self.weight = materialize_parameter(
+            list(k) + [in_channels // groups, out_channels], weight_attr,
+            self._dtype, default_initializer=I.XavierNormal())
+        self.bias = materialize_parameter(
+            [out_channels], bias_attr, self._dtype, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, bias=self.bias,
+                        stride=self._stride, padding=self._padding,
+                        dilation=self._dilation, groups=self._groups)
+
+
+class SubmConv3D(Conv3D):
+    def forward(self, x):
+        return F.subm_conv3d(x, self.weight, bias=self.bias,
+                             stride=1, padding=self._padding,
+                             dilation=self._dilation, groups=self._groups)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._k = kernel_size
+        self._stride = stride
+        self._padding = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._k, stride=self._stride,
+                            padding=self._padding)

@@ -1,0 +1,177 @@
+"""paddle.sparse.nn.functional (reference: python/paddle/sparse/nn/
+functional — conv3d/subm_conv3d/max_pool3d/activations/attention over the
+phi sparse kernels).
+
+TPU-first: activations apply to the VALUES (zero-preserving, pattern
+unchanged); the spatial ops (conv3d / subm_conv3d / max_pool3d) densify,
+run the MXU-tiled dense op, and re-sparsify. On TPU that IS the fast path
+for the occupancies sparse conv targets — the MXU wants dense tiles, and
+gather/scatter spconv has no systolic mapping (pallas_guide.md).
+subm_conv3d masks the output back to the input's active sites (submanifold
+semantics, reference subm_conv3d docs)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework.core import Tensor
+from ....ops._helpers import ensure_tensor
+from .... import sparse as _sp
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "relu", "relu6",
+           "leaky_relu", "softmax", "attention"]
+
+
+def _values_op(x, fn):
+    if isinstance(x, _sp.SparseCooTensor):
+        return _sp.SparseCooTensor(x.indices, fn(x.values), x.shape,
+                                   coalesced=x.coalesced)
+    if isinstance(x, _sp.SparseCsrTensor):
+        return _sp.SparseCsrTensor(x.crows, x.cols, fn(x.values), x.shape)
+    return fn(ensure_tensor(x))
+
+
+def relu(x, name=None):
+    import paddle_tpu.nn.functional as F
+    return _values_op(x, F.relu)
+
+
+def relu6(x, name=None):
+    import paddle_tpu.nn.functional as F
+    return _values_op(x, F.relu6)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    import paddle_tpu.nn.functional as F
+    return _values_op(x, lambda v: F.leaky_relu(v, negative_slope))
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax: normalizes over the stored values per row, treating
+    absent entries as -inf (reference sparse softmax semantics)."""
+    if isinstance(x, _sp.SparseCsrTensor):
+        import numpy as np
+        crows = np.asarray(x.crows._value)
+        vals = x.values._value
+        out = []
+        for r in range(len(crows) - 1):
+            seg = vals[int(crows[r]):int(crows[r + 1])]
+            if seg.shape[0]:
+                e = jnp.exp(seg - seg.max())
+                out.append(e / e.sum())
+        new_vals = jnp.concatenate(out) if out else vals
+        return _sp.SparseCsrTensor(x.crows, x.cols, Tensor(new_vals),
+                                   x.shape)
+    import paddle_tpu.nn.functional as F
+    return _values_op(x, lambda v: F.softmax(v, axis=axis))
+
+
+def _dense_to_coo(dense, sparse_ndim):
+    """Re-sparsify: active site = any nonzero along the trailing dense
+    (channel) dims."""
+    import numpy as np
+    v = np.asarray(dense._value)
+    reduce_axes = tuple(range(sparse_ndim, v.ndim))
+    active = np.abs(v).sum(axis=reduce_axes) != 0 if reduce_axes else \
+        v != 0
+    idx = np.stack(np.nonzero(active))
+    vals = dense._value[tuple(jnp.asarray(idx[i])
+                              for i in range(idx.shape[0]))]
+    return _sp.SparseCooTensor(Tensor(jnp.asarray(idx)), Tensor(vals),
+                               list(v.shape), coalesced=True)
+
+
+def _dense_path(x, dense_fn, mask_to_input_sites=False):
+    """densify -> dense op -> re-sparsify (active site = nonzero)."""
+    dense = x.to_dense() if isinstance(x, _sp.SparseCooTensor) else \
+        ensure_tensor(x)
+    out = dense_fn(dense)
+    if not isinstance(x, _sp.SparseCooTensor):
+        return out
+    if mask_to_input_sites:
+        # submanifold: output active only where the input was active
+        site = jnp.zeros(tuple(x.shape[:-1]) + (1,), out._value.dtype)
+        idx = x.indices._value
+        site = site.at[tuple(idx[i] for i in range(idx.shape[0] - 1))
+                       + (0,)].set(1.0)
+        out = Tensor(out._value * site)
+    return _dense_to_coo(out, len(x.shape) - 1)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d: x is a 5-D NDHWC SparseCooTensor, weight
+    [kd, kh, kw, in_c, out_c] (reference sparse conv3d layout)."""
+    import paddle_tpu.nn.functional as F
+    from ....ops import manipulation as manip
+    w = ensure_tensor(weight)
+
+    def run(dense):
+        # NDHWC -> NCDHW for the dense kernel, weight -> [out, in, kd, kh, kw]
+        xd = manip.transpose(dense, [0, 4, 1, 2, 3])
+        wd = manip.transpose(w, [4, 3, 0, 1, 2])
+        out = F.conv3d(xd, wd, bias=bias, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups)
+        return manip.transpose(out, [0, 2, 3, 4, 1])
+
+    return _dense_path(x, run)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv3d: the output pattern equals the input
+    pattern (reference subm_conv3d). Requires stride 1 (like the
+    reference's practical use)."""
+    import paddle_tpu.nn.functional as F
+    from ....ops import manipulation as manip
+    w = ensure_tensor(weight)
+    k = w.shape[0:3]
+    same_pad = [(kk - 1) // 2 for kk in k]
+
+    def run(dense):
+        xd = manip.transpose(dense, [0, 4, 1, 2, 3])
+        wd = manip.transpose(w, [4, 3, 0, 1, 2])
+        out = F.conv3d(xd, wd, bias=bias, stride=1, padding=same_pad,
+                       dilation=dilation, groups=groups)
+        return manip.transpose(out, [0, 2, 3, 4, 1])
+
+    return _dense_path(x, run, mask_to_input_sites=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    import paddle_tpu.nn.functional as F
+    from ....ops import manipulation as manip
+
+    def run(dense):
+        xd = manip.transpose(dense, [0, 4, 1, 2, 3])
+        out = F.max_pool3d(xd, kernel_size, stride=stride, padding=padding)
+        return manip.transpose(out, [0, 2, 3, 4, 1])
+
+    return _dense_path(x, run)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention (reference sparse/nn/functional/
+    transformer.py attention over CSR masks): scores restricted to the
+    CSR sparse_mask's pattern."""
+    import math
+    import numpy as np
+    q = ensure_tensor(query)._value
+    k = ensure_tensor(key)._value
+    v = ensure_tensor(value)._value
+    scores = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(q.shape[-1])
+    crows = np.asarray(sparse_mask.crows._value).reshape(-1)
+    cols = np.asarray(sparse_mask.cols._value).reshape(-1)
+    n = q.shape[2]
+    # mask: allowed (row, col) pairs from the CSR pattern (shared across
+    # batch*heads, reference requires the mask's batch dims to match)
+    per_row = np.diff(crows[:n + 1])
+    rows = np.repeat(np.arange(n), per_row)
+    allow = np.zeros((n, scores.shape[-1]), bool)
+    allow[rows, cols[:rows.size]] = True
+    masked = jnp.where(jnp.asarray(allow), scores, -1e30)
+    import jax
+    probs = jax.nn.softmax(masked, axis=-1)
+    out = jnp.einsum("bhnm,bhmd->bhnd", probs, v)
+    return Tensor(out)

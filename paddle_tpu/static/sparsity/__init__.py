@@ -1,0 +1,24 @@
+"""paddle.static.sparsity — ASP (2:4 structured sparsity) static surface.
+
+Reference analog: python/paddle/static/sparsity/__init__.py re-exporting
+incubate/asp. The implementations live in paddle_tpu.incubate.asp."""
+from ...incubate.asp import (  # noqa: F401
+    calculate_density, decorate, prune_model, set_excluded_layers,
+    reset_excluded_layers,
+)
+
+_SUPPORTED_LAYERS = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a custom layer type for ASP pruning (reference
+    asp/supported_layer_list.py add_supported_layer)."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _SUPPORTED_LAYERS[name] = pruning_func
+    return name
+
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer"]

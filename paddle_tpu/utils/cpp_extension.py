@@ -111,3 +111,45 @@ def load(name, sources, functions=None, extra_cflags=None, verbose=False):
     """
     lib_path = _compile(name, sources, extra_cflags)
     return CppExtension(lib_path, functions or [name])
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    """Reference cpp_extension.py CUDAExtension — no CUDA toolchain on a
+    TPU host; C++ extensions go through CppExtension/setup."""
+    raise RuntimeError(
+        "CUDAExtension needs nvcc; this is a TPU host — use "
+        "CppExtension(sources) for C++ ops (XLA/Pallas own device code)")
+
+
+def get_build_directory(verbose=False):
+    """Reference cpp_extension/extension_utils.py get_build_directory
+    (PADDLE_EXTENSION_DIR override honored)."""
+    import os
+    root = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def setup(**attr):
+    """Reference cpp_extension.py:78 setup — build the ext_modules with the
+    host C++ toolchain via setuptools; on this image the JIT `load` path
+    (ctypes) is the supported route, so setup() compiles each extension's
+    sources through the same pipeline and records the artifacts."""
+    name = attr.get("name", "paddle_tpu_ext")
+    exts = attr.get("ext_modules") or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    built = []
+    for ext in exts:
+        sources = getattr(ext, "sources", None) or (
+            ext.get("sources") if isinstance(ext, dict) else None)
+        if not sources:
+            continue
+        mod = load(name=getattr(ext, "name", name), sources=sources,
+                   extra_cflags=attr.get("extra_compile_args"))
+        built.append(mod)
+    return built
+
+
+__all__ += ["CUDAExtension", "setup", "get_build_directory"]

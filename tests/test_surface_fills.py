@@ -1,0 +1,250 @@
+"""Parity fills: sparse.nn, nn.utils, incubate functional forms,
+functional BFGS/L-BFGS, static.sparsity, fleet.utils FS, inference pool,
+device.cuda shim (reference modules cited per test)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSparseNN:
+    def _coo(self):
+        import paddle_tpu.sparse as sp
+        idx = np.asarray([[0, 0, 1], [0, 2, 1]])
+        vals = np.asarray([[1.0, -2.0], [3.0, -4.0], [-5.0, 6.0]],
+                          np.float32)
+        return sp.sparse_coo_tensor(idx, vals, shape=[2, 3, 2])
+
+    def test_activations_preserve_pattern(self):
+        import paddle_tpu.sparse.nn as spnn
+        x = self._coo()
+        y = spnn.ReLU()(x)
+        assert y.nnz() == x.nnz()
+        np.testing.assert_allclose(np.asarray(y.values._value),
+                                   [[1, 0], [3, 0], [0, 6]])
+        z = spnn.LeakyReLU(0.1)(x)
+        np.testing.assert_allclose(np.asarray(z.values._value)[0],
+                                   [1.0, -0.2])
+
+    def test_batch_norm_on_values(self):
+        import paddle_tpu.sparse.nn as spnn
+        bn = spnn.BatchNorm(2)
+        out = bn(self._coo())
+        v = np.asarray(out.values._value)
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-5)
+
+    def test_conv3d_matches_dense(self):
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn.functional as spf
+        rng = np.random.default_rng(0)
+        dense = np.zeros((1, 4, 4, 4, 3), np.float32)
+        sites = [(0, 1, 1, 1), (0, 2, 3, 0)]
+        for s in sites:
+            dense[s[0], s[1], s[2], s[3]] = rng.normal(size=3)
+        idx = np.asarray(list(zip(*[(s + (c,)) for s in sites
+                                    for c in range(3)])))
+        vals = np.asarray([dense[s][c] for s in sites for c in range(3)],
+                          np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, shape=[1, 4, 4, 4, 3])
+        w = paddle.to_tensor(rng.normal(size=(3, 3, 3, 3, 5))
+                             .astype(np.float32))
+        out = spf.conv3d(x, w, padding=1)
+        # parity with the dense path
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.ops.manipulation as manip
+        xd = manip.transpose(paddle.to_tensor(dense), [0, 4, 1, 2, 3])
+        wd = manip.transpose(w, [4, 3, 0, 1, 2])
+        ref = manip.transpose(F.conv3d(xd, wd, padding=1),
+                              [0, 2, 3, 4, 1])
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   np.asarray(ref._value),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv_keeps_input_pattern(self):
+        import paddle_tpu.sparse as sp
+        import paddle_tpu.sparse.nn as spnn
+        rng = np.random.default_rng(0)
+        sites = [(0, 1, 2, 1), (0, 3, 0, 2)]
+        idx = np.asarray(list(zip(*[(s + (c,)) for s in sites
+                                    for c in range(3)])))
+        vals = rng.normal(size=(len(sites) * 3,)).astype(np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, shape=[1, 4, 4, 4, 3])
+        conv = spnn.SubmConv3D(3, 4, 3, padding=1)
+        y = conv(x)
+        got = np.abs(np.asarray(y.to_dense()._value)).sum(-1) != 0
+        want = np.abs(np.asarray(x.to_dense()._value)).sum(-1) != 0
+        assert (got & ~want).sum() == 0    # no new active sites
+
+
+class TestNNUtils:
+    def test_weight_norm_roundtrip(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+        paddle.seed(0)
+        l = nn.Linear(4, 3)
+        w0 = np.asarray(l.weight._value).copy()
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .normal(size=(2, 4)).astype(np.float32))
+        ref = np.asarray(l(x)._value)
+        weight_norm(l, dim=0)
+        assert hasattr(l, "weight_g") and hasattr(l, "weight_v")
+        np.testing.assert_allclose(np.asarray(l(x)._value), ref, rtol=1e-5)
+        # gradients flow to g and v
+        out = l(x)
+        ((out * out).mean()).backward()
+        assert l.weight_g.grad is not None and l.weight_v.grad is not None
+        remove_weight_norm(l)
+        np.testing.assert_allclose(np.asarray(l(x)._value), ref, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(l.weight._value), w0,
+                                   rtol=1e-5)
+
+    def test_spectral_norm_bounds_sigma(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.utils import spectral_norm
+        paddle.seed(0)
+        l = nn.Linear(6, 8)
+        l.weight._value = l.weight._value * 10.0
+        spectral_norm(l, n_power_iterations=5)
+        x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+        out = np.asarray(l(x)._value) - np.asarray(l.bias._value)
+        s = np.linalg.svd(out, compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=0.2)
+
+    def test_parameters_vector_roundtrip(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.utils import (parameters_to_vector,
+                                         vector_to_parameters)
+        paddle.seed(0)
+        m = nn.Linear(3, 2)
+        vec = parameters_to_vector(m.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        vector_to_parameters(vec * 0 + 1.0, m.parameters())
+        for p in m.parameters():
+            np.testing.assert_allclose(np.asarray(p._value), 1.0)
+
+
+class TestFunctionalOptimizers:
+    def test_bfgs_converges_on_rosenbrock(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+
+        def rosen(x):
+            v = x._value
+            return paddle.to_tensor(
+                (100 * (v[1] - v[0] ** 2) ** 2 + (1 - v[0]) ** 2))
+
+        ok, calls, pos, val, grad, H = minimize_bfgs(
+            rosen, paddle.to_tensor(np.zeros(2, np.float32)),
+            max_iters=200)
+        np.testing.assert_allclose(np.asarray(pos._value), [1.0, 1.0],
+                                   atol=1e-2)
+        assert calls > 0 and H.shape == [2, 2]
+
+    def test_lbfgs_matches_bfgs_on_quadratic(self):
+        from paddle_tpu.incubate.optimizer.functional import (
+            minimize_bfgs, minimize_lbfgs)
+
+        def quad(x):
+            v = x._value
+            t = v - jnp.asarray([3.0, -1.0, 2.0, 0.5])
+            return paddle.to_tensor((t * t).sum())
+
+        x0 = paddle.to_tensor(np.zeros(4, np.float32))
+        _, _, p1, _, _, _ = minimize_bfgs(quad, x0)
+        _, _, p2, _, _ = minimize_lbfgs(quad, x0, history_size=3)
+        np.testing.assert_allclose(np.asarray(p1._value),
+                                   np.asarray(p2._value), atol=1e-4)
+
+
+class TestMiscShims:
+    def test_static_sparsity_reexports(self):
+        import paddle_tpu.static.sparsity as sparsity
+        assert callable(sparsity.calculate_density)
+        assert sparsity.add_supported_layer("my_layer") == "my_layer"
+
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = str(tmp_path / "a" / "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert files == ["x.txt"]
+        fs.mv(f, str(tmp_path / "y.txt"))
+        assert fs.is_exist(str(tmp_path / "y.txt"))
+        assert not fs.need_upload_download()
+
+    def test_hdfs_client_without_hadoop_raises(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        c = HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(RuntimeError, match="hadoop"):
+            c.is_exist("/x")
+
+    def test_inference_extras(self):
+        import paddle_tpu.inference as infer
+        assert infer.get_num_bytes_of_data_type(infer.DataType.FLOAT32) == 4
+        assert infer.get_trt_compile_version() == (0, 0, 0)
+        assert infer._get_phi_kernel_name("matmul_v2") == "matmul_v2"
+        with pytest.raises(NotImplementedError):
+            infer.convert_to_mixed_precision("a", "b", "c", "d")
+
+    def test_device_cuda_shim(self):
+        import paddle_tpu.device.cuda as cuda
+        assert cuda.device_count() == 0
+        cuda.synchronize()
+        s = cuda.Stream()
+        e = s.record_event()
+        assert e.query()
+        with cuda.stream_guard(s):
+            pass
+        with pytest.raises(RuntimeError):
+            cuda.get_device_name()
+
+    def test_bilinear_initializer_and_global(self):
+        import paddle_tpu.nn.initializer as I
+        w = I.Bilinear()((2, 2, 4, 4), jnp.float32)
+        # center of the triangle kernel is the max
+        assert float(w[0, 0, 1, 1]) == np.asarray(w[0, 0]).max()
+        import paddle_tpu.nn as nn
+        I.set_global_initializer(I.Constant(0.5), I.Constant(0.1))
+        try:
+            l = nn.Linear(2, 2)
+            np.testing.assert_allclose(np.asarray(l.weight._value), 0.5)
+            np.testing.assert_allclose(np.asarray(l.bias._value), 0.1)
+        finally:
+            I.set_global_initializer(None)
+        l2 = nn.Linear(2, 2)
+        assert not np.allclose(np.asarray(l2.weight._value), 0.5)
+
+    def test_recompute_sequential_matches_plain(self):
+        from paddle_tpu.incubate.distributed.fleet import (
+            recompute_sequential)
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .normal(size=(3, 4)).astype(np.float32))
+        ref = m(x)
+        got = recompute_sequential({"segments": 2}, list(m), x)
+        np.testing.assert_allclose(np.asarray(got._value),
+                                   np.asarray(ref._value), rtol=1e-5)
+
+    def test_fused_functional_forms(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_feedforward, fused_matmul_bias)
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.normal(size=(2, 5, 8)).astype(np.float32))
+        w1 = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+        w2 = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+        out = fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                dropout2_rate=0.0, training=False)
+        assert out.shape == [2, 5, 8]
+        mm = fused_matmul_bias(
+            paddle.to_tensor(rng.normal(size=(3, 4)).astype(np.float32)),
+            paddle.to_tensor(rng.normal(size=(4, 2)).astype(np.float32)),
+            paddle.to_tensor(np.ones(2, np.float32)))
+        assert mm.shape == [3, 2]
