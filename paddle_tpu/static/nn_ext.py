@@ -191,7 +191,7 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
             self.weight = materialize_parameter(
                 [n_tags + 2, n_tags], param_attr, "float32")
 
-    trans = _scope(name or "crf_decoding", _Transition)
+    trans = _scope(name, _Transition)
     lens = length if length is not None else _t(
         jnp.full((x.shape[0],), x.shape[1], jnp.int64))
     # the learned table's first two rows are start/stop in the reference;
@@ -227,7 +227,7 @@ def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
                       self.batch_square_sum):
                 p.stop_gradient = True
 
-    s = _scope(name or "data_norm", _Stats)
+    s = _scope(name, _Stats)
     mean = s.batch_sum._value / s.batch_size._value
     scale = jnp.sqrt(s.batch_size._value / s.batch_square_sum._value)
     out_t = call_op("data_norm",
@@ -257,21 +257,26 @@ def nce(input, label, num_total_classes, sample_weight=None,
             self.weight = materialize_parameter(
                 [num_total_classes, d], param_attr, "float32")
             self.bias = materialize_parameter(
-                [num_total_classes], bias_attr, "float32", is_bias=True)
+                [num_total_classes], bias_attr, "float32", is_bias=True) \
+                if bias_attr is not False else None
 
-    p = _scope(name or "nce", _NCE)
+    p = _scope(name, _NCE)
     yv = y._value.reshape(-1).astype(jnp.int32)
     rng = np.random.default_rng(seed)
     neg = jnp.asarray(
         rng.integers(0, num_total_classes, (x.shape[0], k)), jnp.int32)
 
-    def fn(xv, wv, bv):
-        pos_logit = jnp.einsum("bd,bd->b", xv, wv[yv]) + bv[yv]
-        neg_logit = jnp.einsum("bd,bkd->bk", xv, wv[neg]) + bv[neg]
+    def fn(xv, wv, *rest):
+        pos_logit = jnp.einsum("bd,bd->b", xv, wv[yv])
+        neg_logit = jnp.einsum("bd,bkd->bk", xv, wv[neg])
+        if rest:
+            pos_logit = pos_logit + rest[0][yv]
+            neg_logit = neg_logit + rest[0][neg]
         loss = -jax.nn.log_sigmoid(pos_logit) \
             - jax.nn.log_sigmoid(-neg_logit).sum(-1)
         return loss[:, None]
-    return call_op("nce", fn, (x, p.weight, p.bias))
+    ins = (x, p.weight) + ((p.bias,) if p.bias is not None else ())
+    return call_op("nce", fn, ins)
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None,
@@ -288,12 +293,15 @@ def row_conv(input, future_context_size, param_attr=None, act=None,
             self.weight = materialize_parameter([w, d], param_attr,
                                                 "float32")
 
-    p = _scope(name or "row_conv", _RowConv)
+    p = _scope(name, _RowConv)
 
     def fn(v, wv):
         pad = jnp.pad(v, ((0, 0), (0, future_context_size), (0, 0)))
         return sum(pad[:, i:i + v.shape[1], :] * wv[i] for i in range(w))
     return call_op("row_conv", fn, (x, p.weight))
+
+
+_SN_STATE = {}
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
@@ -302,17 +310,29 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     wt = ensure_tensor(weight)
     nd = wt._value.ndim
     perm = [dim] + [i for i in range(nd) if i != dim]
+    # persistent power-iteration state (the reference op's weight_u var):
+    # keyed by name (or the weight's identity) so sigma REFINES across
+    # steps instead of restarting from the same random vector
+    key = name or id(weight)
+    u0 = _SN_STATE.get(key)
+    if u0 is None:
+        u0 = jax.random.normal(jax.random.PRNGKey(0),
+                               (wt._value.shape[dim],))
+    mat_now = jnp.transpose(wt._value, perm).reshape(
+        wt._value.shape[dim], -1)
+    u_now = u0
+    for _ in range(max(int(power_iters), 1)):
+        v_now = mat_now.T @ u_now
+        v_now = v_now / (jnp.linalg.norm(v_now) + eps)
+        u_now = mat_now @ v_now
+        u_now = u_now / (jnp.linalg.norm(u_now) + eps)
+    _SN_STATE[key] = u_now
 
     def fn(w):
         mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
-        u = jax.random.normal(jax.random.PRNGKey(0), (mat.shape[0],))
-        v = None
-        for _ in range(max(int(power_iters), 1)):
-            v = mat.T @ u
-            v = v / (jnp.linalg.norm(v) + eps)
-            u = mat @ v
-            u = u / (jnp.linalg.norm(u) + eps)
-        sigma = u @ mat @ v
+        v = mat.T @ u_now
+        v = v / (jnp.linalg.norm(v) + eps)
+        sigma = u_now @ mat @ v
         return w / sigma
     return call_op("spectral_norm", fn, (wt,))
 
@@ -414,7 +434,7 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                 [num_filters], bias_attr, "float32", is_bias=True) \
                 if bias_attr is not False else None
 
-    p = _scope(name or "sequence_conv", _SeqConv)
+    p = _scope(name, _SeqConv)
     start = padding_start if padding_start is not None else \
         -((filter_size - 1) // 2)
     lo = max(-start, 0)
@@ -520,11 +540,16 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     xt = ensure_tensor(x)
     t = ensure_tensor(y).shape[1]
 
+    if len(xt.shape) == 3 and t % xt.shape[1] != 0:
+        raise ValueError(
+            f"sequence_expand: y's length {t} must be a multiple of x's "
+            f"length {xt.shape[1]} in the padded representation (the "
+            "reference repeats whole sub-sequences per LoD)")
+
     def fn(xv):
         if xv.ndim == 2:
             return jnp.repeat(xv[:, None, :], t, axis=1)
-        return jnp.broadcast_to(xv[:, :1, :],
-                                (xv.shape[0], t, xv.shape[2]))
+        return jnp.tile(xv, (1, t // xv.shape[1], 1))
     return call_op("sequence_expand", fn, (xt,))
 
 

@@ -1,0 +1,217 @@
+"""Cross-framework numeric oracle: paddle_tpu ops vs torch CPU.
+
+Reference analog: the OpTest methodology (unittests/op_test.py:333) checks
+ops against NumPy references; for ops whose semantics are easy to get
+subtly wrong (conv transpose padding, norm statistics, loss reductions,
+attention masking), an independent full-framework oracle is stronger than
+a hand-written NumPy model. torch (CPU) ships in the image and its op
+semantics match the reference's (both follow the same conventions).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def _close(a, b, rtol=2e-4, atol=2e-5):
+    np.testing.assert_allclose(_np(a), b.detach().numpy(), rtol=rtol,
+                               atol=atol)
+
+
+RNG = np.random.default_rng(0)
+
+
+def _pair(shape):
+    x = RNG.normal(size=shape).astype(np.float32)
+    return paddle.to_tensor(x), torch.tensor(x)
+
+
+class TestConvOracle:
+    def test_conv2d_strided_padded(self):
+        x, tx = _pair((2, 3, 11, 11))
+        w, tw = _pair((5, 3, 3, 3))
+        b, tb = _pair((5,))
+        got = F.conv2d(x, w, b, stride=2, padding=1)
+        ref = torch.nn.functional.conv2d(tx, tw, tb, stride=2, padding=1)
+        _close(got, ref)
+
+    def test_conv2d_dilated_grouped(self):
+        x, tx = _pair((1, 4, 13, 13))
+        w, tw = _pair((8, 2, 3, 3))
+        got = F.conv2d(x, w, stride=1, padding=2, dilation=2, groups=2)
+        ref = torch.nn.functional.conv2d(tx, tw, padding=2, dilation=2,
+                                         groups=2)
+        _close(got, ref)
+
+    def test_conv2d_transpose(self):
+        x, tx = _pair((2, 4, 7, 7))
+        w, tw = _pair((4, 6, 3, 3))
+        got = F.conv2d_transpose(x, w, stride=2, padding=1,
+                                 output_padding=1)
+        ref = torch.nn.functional.conv_transpose2d(tx, tw, stride=2,
+                                                   padding=1,
+                                                   output_padding=1)
+        _close(got, ref)
+
+    def test_conv3d(self):
+        x, tx = _pair((1, 2, 5, 6, 7))
+        w, tw = _pair((4, 2, 3, 3, 3))
+        got = F.conv3d(x, w, padding=1)
+        ref = torch.nn.functional.conv3d(tx, tw, padding=1)
+        _close(got, ref)
+
+    def test_avg_and_max_pool2d(self):
+        x, tx = _pair((2, 3, 10, 10))
+        _close(F.max_pool2d(x, 3, stride=2, padding=1),
+               torch.nn.functional.max_pool2d(tx, 3, stride=2, padding=1))
+        _close(F.avg_pool2d(x, 2, stride=2),
+               torch.nn.functional.avg_pool2d(tx, 2, stride=2))
+
+
+class TestNormOracle:
+    def test_layer_norm(self):
+        x, tx = _pair((4, 6, 8))
+        w, tw = _pair((8,))
+        b, tb = _pair((8,))
+        got = F.layer_norm(x, [8], weight=w, bias=b, epsilon=1e-5)
+        ref = torch.nn.functional.layer_norm(tx, [8], tw, tb, eps=1e-5)
+        _close(got, ref)
+
+    def test_group_norm(self):
+        x, tx = _pair((2, 8, 5, 5))
+        w, tw = _pair((8,))
+        b, tb = _pair((8,))
+        got = F.group_norm(x, 4, weight=w, bias=b, epsilon=1e-5)
+        ref = torch.nn.functional.group_norm(tx, 4, tw, tb, eps=1e-5)
+        _close(got, ref)
+
+    def test_instance_norm(self):
+        x, tx = _pair((2, 3, 6, 6))
+        got = F.instance_norm(x, eps=1e-5)
+        ref = torch.nn.functional.instance_norm(tx, eps=1e-5)
+        _close(got, ref)
+
+    def test_batch_norm_eval(self):
+        x, tx = _pair((4, 5, 3, 3))
+        rm, trm = _pair((5,))
+        rv = np.abs(RNG.normal(size=5)).astype(np.float32) + 0.5
+        w, tw = _pair((5,))
+        b, tb = _pair((5,))
+        got = F.batch_norm(x, paddle.to_tensor(rm._value),
+                           paddle.to_tensor(rv), weight=w, bias=b,
+                           training=False, epsilon=1e-5)
+        ref = torch.nn.functional.batch_norm(
+            tx, trm, torch.tensor(rv), tw, tb, training=False, eps=1e-5)
+        _close(got, ref)
+
+
+class TestActivationLossOracle:
+    def test_activations(self):
+        x, tx = _pair((3, 17))
+        _close(F.gelu(x), torch.nn.functional.gelu(tx), rtol=1e-3)
+        _close(F.silu(x), torch.nn.functional.silu(tx))
+        _close(F.elu(x, 0.7), torch.nn.functional.elu(tx, 0.7))
+        _close(F.hardswish(x), torch.nn.functional.hardswish(tx))
+        _close(F.log_softmax(x, axis=-1),
+               torch.nn.functional.log_softmax(tx, dim=-1))
+
+    def test_cross_entropy_variants(self):
+        logits, tlogits = _pair((6, 10))
+        labels = RNG.integers(0, 10, 6)
+        got = F.cross_entropy(logits, paddle.to_tensor(labels))
+        ref = torch.nn.functional.cross_entropy(tlogits,
+                                                torch.tensor(labels))
+        _close(got, ref)
+        # soft labels
+        soft = np.abs(RNG.normal(size=(6, 10))).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        got2 = F.cross_entropy(logits, paddle.to_tensor(soft),
+                               soft_label=True)
+        ref2 = torch.nn.functional.cross_entropy(tlogits,
+                                                 torch.tensor(soft))
+        _close(got2, ref2)
+
+    def test_nll_kl_smoothl1(self):
+        x, tx = _pair((5, 7))
+        y, ty = _pair((5, 7))
+        _close(F.smooth_l1_loss(x, y),
+               torch.nn.functional.smooth_l1_loss(tx, ty))
+        logp = F.log_softmax(x, axis=-1)
+        tlogp = torch.nn.functional.log_softmax(tx, dim=-1)
+        tgt = np.abs(RNG.normal(size=(5, 7))).astype(np.float32)
+        tgt /= tgt.sum(-1, keepdims=True)
+        got = F.kl_div(logp, paddle.to_tensor(tgt), reduction="batchmean")
+        ref = torch.nn.functional.kl_div(tlogp, torch.tensor(tgt),
+                                         reduction="batchmean")
+        _close(got, ref)
+
+    def test_embedding_padding_idx(self):
+        w, tw = _pair((20, 6))
+        ids = RNG.integers(0, 20, (3, 4))
+        got = F.embedding(paddle.to_tensor(ids), w, padding_idx=2)
+        ref = torch.nn.functional.embedding(torch.tensor(ids), tw,
+                                            padding_idx=2)
+        _close(got, ref)
+
+
+class TestAttentionOracle:
+    def test_sdpa_causal(self):
+        q, tq = _pair((2, 8, 4, 16))     # paddle layout [B, N, H, D]
+        k, tk = _pair((2, 8, 4, 16))
+        v, tv = _pair((2, 8, 4, 16))
+        got = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             dropout_p=0.0)
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            tq.permute(0, 2, 1, 3), tk.permute(0, 2, 1, 3),
+            tv.permute(0, 2, 1, 3), is_causal=True).permute(0, 2, 1, 3)
+        _close(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_sdpa_boolean_mask(self):
+        q, tq = _pair((1, 5, 2, 8))
+        k, tk = _pair((1, 5, 2, 8))
+        v, tv = _pair((1, 5, 2, 8))
+        mask = RNG.random((1, 2, 5, 5)) > 0.3
+        mask[..., 0] = True              # keep rows attendable
+        got = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=paddle.to_tensor(mask), dropout_p=0.0)
+        ref = torch.nn.functional.scaled_dot_product_attention(
+            tq.permute(0, 2, 1, 3), tk.permute(0, 2, 1, 3),
+            tv.permute(0, 2, 1, 3),
+            attn_mask=torch.tensor(mask)).permute(0, 2, 1, 3)
+        _close(got, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestGradOracle:
+    def test_conv_backward_matches(self):
+        xv = RNG.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        wv = RNG.normal(size=(4, 3, 3, 3)).astype(np.float32)
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        w = paddle.to_tensor(wv)
+        w.stop_gradient = False
+        loss = (F.conv2d(x, w, padding=1) ** 2).mean()
+        loss.backward()
+        tx = torch.tensor(xv, requires_grad=True)
+        tw = torch.tensor(wv, requires_grad=True)
+        tloss = (torch.nn.functional.conv2d(tx, tw, padding=1) ** 2).mean()
+        tloss.backward()
+        _close(x.grad, tx.grad, rtol=1e-3, atol=1e-5)
+        _close(w.grad, tw.grad, rtol=1e-3, atol=1e-5)
+
+    def test_layer_norm_backward_matches(self):
+        xv = RNG.normal(size=(4, 10)).astype(np.float32)
+        x = paddle.to_tensor(xv)
+        x.stop_gradient = False
+        loss = (F.layer_norm(x, [10]) ** 3).mean()
+        loss.backward()
+        tx = torch.tensor(xv, requires_grad=True)
+        tloss = (torch.nn.functional.layer_norm(tx, [10]) ** 3).mean()
+        tloss.backward()
+        _close(x.grad, tx.grad, rtol=1e-3, atol=1e-5)
