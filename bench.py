@@ -125,6 +125,11 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
                                             GPTPretrainingCriterion)
     from paddle_tpu.jit import TrainStep
 
+    # each config runs in its own subprocess, but reset anyway so the
+    # record's dispatch_cache block covers exactly this run (retries incl.)
+    from paddle_tpu.profiler import reset_dispatch_cache_stats
+    reset_dispatch_cache_stats()
+
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     n_params = model.num_params()
@@ -159,6 +164,10 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     platform = jax.devices()[0].platform
     tdir = _trace(trace_tag, platform, lambda: float(step(x, y)))
 
+    # eager-dispatch cache telemetry (hits/misses/evictions/retraces):
+    # future BENCH rounds diff this block to catch retrace regressions
+    from paddle_tpu.profiler import dispatch_cache_stats
+
     return {
         "metric": metric,
         "value": round(tokens_per_sec, 1),
@@ -167,7 +176,8 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
         "platform": platform,
         "extra": {"mfu": round(mfu, 4), "loss": round(final, 3),
                   "batch": batch, "seq": seq, "params": n_params,
-                  "platform": platform, "trace": tdir},
+                  "platform": platform, "trace": tdir,
+                  "dispatch_cache": dispatch_cache_stats()},
     }
 
 

@@ -116,9 +116,11 @@ class GradNode:
         cur = self.pending.get(out_index)
         self.pending[out_index] = g if cur is None else cur + g
 
-    def collect_input_grads(self):
+    def collect_input_grads(self, final=False):
         """Run hooks, zero-fill missing output grads, call vjp; returns tuple of
-        grads aligned with self.edges."""
+        grads aligned with self.edges. `final=True` (this node will be
+        released right after — no retained graph) lets a dispatch-cached
+        pullback donate its residual buffers to the backward executable."""
         outs = []
         for j, (shape, dt) in enumerate(self.out_avals):
             g = self.pending.get(j)
@@ -132,7 +134,10 @@ class GradNode:
             outs.append(g)
         self.pending = {}
         arg = tuple(outs) if len(outs) > 1 else outs[0]
-        grads = self.vjp_fn(arg)
+        if final and getattr(self.vjp_fn, "_supports_donate", False):
+            grads = self.vjp_fn(arg, donate=True)
+        else:
+            grads = self.vjp_fn(arg)
         if not isinstance(grads, tuple):
             grads = (grads,)
         return grads
@@ -283,7 +288,7 @@ def run_backward(root_node: GradNode, root_index: int, seed_grad,
         if isinstance(node, AccumulationNode):
             node.accumulate()
             continue
-        grads = node.collect_input_grads()
+        grads = node.collect_input_grads(final=not retain_graph)
         if not retain_graph:
             node.release()
         for edge, g in zip(node.edges, grads):

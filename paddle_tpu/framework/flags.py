@@ -53,6 +53,37 @@ define_flag("FLAGS_embedding_deterministic", 0, "determinism hint")
 define_flag("FLAGS_max_inplace_grad_add", 0, "compat no-op")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op (XLA GC)")
 
+# Compiled eager dispatch (ops/dispatch.py). The cache key is
+# (op name, fn token, input (shape, dtype, weak_type) avals, diff mask,
+# AMP-state token, registry override token); values are jitted forward /
+# forward+vjp executables, so a repeated eager op sequence stops re-tracing
+# after its first iteration. Telemetry — hits, misses, bypasses, retraces,
+# evictions, cumulative dispatch wall time — is read with
+# paddle_tpu.profiler.dispatch_cache_stats() and lands in bench.py's
+# headline record as the `dispatch_cache` block in `extra`.
+define_flag("FLAGS_eager_op_cache", True,
+            "per-op executable cache in eager dispatch: repeated ops reuse "
+            "compiled forward and VJP executables instead of re-tracing. "
+            "Un-keyable calls (fns closing over arrays/Tensors, tracer "
+            "inputs, jit-incompatible ops) bypass the cache, so numerics "
+            "never change — only whether jax re-traces")
+define_flag("FLAGS_eager_op_cache_size", 512,
+            "LRU capacity (entries) of the eager op executable cache; the "
+            "least-recently-used entry is evicted past this size. Bounds "
+            "forward entries only — backward applier traces (keyed by vjp "
+            "residual treedef) live for the process unless "
+            "ops.dispatch.clear_dispatch_cache() is called")
+define_flag("FLAGS_eager_op_cache_donate", False,
+            "EXPERIMENTAL: donate VJP residual buffers to the cached "
+            "backward executable on the final (non-retained) backward. Off "
+            "by default because residuals commonly alias buffers that are "
+            "still live — op inputs/outputs the caller holds (weights!), "
+            "or the same buffer saved as a residual by a sibling node that "
+            "has not fired yet in the same backward pass — and donation "
+            "invalidates them. Only safe when the graph is a chain whose "
+            "intermediates are not referenced after backward; donation is "
+            "a warn-and-skip no-op on CPU")
+
 
 class _FlagsView:
     def __getattr__(self, name):

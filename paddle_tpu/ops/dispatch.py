@@ -3,51 +3,96 @@
 Reference analog: the generated `<op>_ad_func` forwards
 (eager/auto_code_generator/generator/eager_gen.py:1217) — AMP cast, kernel
 call, GradNode creation + Edge wiring. TPU-first: the "kernel" is a jax
-callable; when grad is required the VJP is captured at forward time via
-`jax.vjp`, so residuals are device arrays and backward is XLA-compiled.
+callable; when grad is required the VJP is captured at forward time, so
+residuals are device arrays and backward is XLA-compiled.
+
+Compiled eager dispatch (the `<op>_ad_func` fast-path analog). The reference
+beat per-op dispatch overhead with the PHI kernel library plus codegen'd C++
+forwards; here the same cost is beaten with a per-op executable cache:
+
+  key   = (op name, fn token, input (shape, dtype, weak_type) avals,
+           diff mask, AMP-state token, registry override token)
+  value = a jitted forward (no-grad path), or a jitted forward+vjp pair
+          (grad path) whose vjp comes back as a `jax.tree_util.Partial`
+          pytree — residual buffers as leaves — applied through one shared
+          jitted applier, so backward reuses a compiled executable too
+          instead of re-tracing `jax.vjp` on every differentiable call.
+
+The fn token keys the implementation by VALUE: code object + closure cell
+contents, accepted only for types whose hash is value-based (scalars,
+dtypes, nested tuples/functions). Anything else — arrays, Tensors in
+closures, tracer inputs, jit-incompatible ops — bypasses the cache and
+takes the original eager path, so caching can never change numerics, only
+whether jax re-traces. Registry override (de)activation bumps a per-op
+generation counter (ops/registry.py) that is part of the key, so stale
+entries become unreachable and age out of the LRU. Flags:
+framework/flags.py FLAGS_eager_op_cache / _size / _donate; telemetry:
+paddle_tpu.profiler.dispatch_cache_stats().
 """
 from __future__ import annotations
 
+import enum
+import functools
+import threading
+import time
+import types
+from collections import OrderedDict
 from typing import Callable, Sequence
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework.autograd import pack_saved_values as _pack_saved, GradNode, is_grad_enabled
 from ..framework.flags import _FLAGS
+from ..profiler.dispatch import STATS as _STATS
 
-__all__ = ["call_op", "call_op_multi"]
+__all__ = ["call_op", "call_op_multi", "clear_dispatch_cache",
+           "dispatch_cache_info"]
 
 
 def _values(tensors):
     return tuple(t._value for t in tensors)
 
 
-def _debug_checks(name, out_vals):
+def _scan_nan_inf(name, out_vals):
     """FLAGS_check_nan_inf: scan op outputs for non-finite values, raising
     (level 0) or warning (level >= 1) with the op name — the eager analog of
-    framework/details/nan_inf_utils.h:29 CheckOpHasNanOrInf.
-    FLAGS_benchmark: block until the op's result is ready so per-op wall
-    times are honest (platform/flags.cc FLAGS_benchmark sync semantics)."""
+    framework/details/nan_inf_utils.h:29 CheckOpHasNanOrInf. Forces a device
+    sync per inexact output (the reduction must materialize)."""
+    from jax.errors import TracerBoolConversionError
+    for v in out_vals:
+        if not jnp.issubdtype(v.dtype, jnp.inexact):
+            continue
+        try:
+            finite = bool(jnp.all(jnp.isfinite(v)))
+        except TracerBoolConversionError:
+            continue   # inside a jit trace: the fused TrainStep checks
+        if not finite:
+            msg = f"Operator '{name}' output contains NaN/Inf"
+            if int(_FLAGS.get("FLAGS_check_nan_inf_level", 0)) == 0:
+                raise FloatingPointError(msg)
+            import warnings
+            warnings.warn(msg)
+
+
+def _sync_outputs(out_vals):
+    """FLAGS_benchmark: block until the op's results are ready so per-op wall
+    times are honest (platform/flags.cc FLAGS_benchmark sync semantics).
+    Pure wait — no reduction, no transfer."""
+    for v in out_vals:
+        jax.block_until_ready(v)
+
+
+def _debug_checks(name, out_vals):
+    """Split debug paths: the NaN scan (device-syncing reduction) and the
+    benchmark sync (pure wait) are independent helpers, so benchmark mode
+    never pays the NaN reduction."""
     if _FLAGS.get("FLAGS_check_nan_inf"):
-        from jax.errors import TracerBoolConversionError
-        for v in out_vals:
-            if not jnp.issubdtype(v.dtype, jnp.inexact):
-                continue
-            try:
-                finite = bool(jnp.all(jnp.isfinite(v)))
-            except TracerBoolConversionError:
-                continue   # inside a jit trace: the fused TrainStep checks
-            if not finite:
-                msg = f"Operator '{name}' output contains NaN/Inf"
-                if int(_FLAGS.get("FLAGS_check_nan_inf_level", 0)) == 0:
-                    raise FloatingPointError(msg)
-                import warnings
-                warnings.warn(msg)
+        _scan_nan_inf(name, out_vals)
     elif _FLAGS.get("FLAGS_benchmark"):
-        for v in out_vals:
-            jax.block_until_ready(v)
+        _sync_outputs(out_vals)
 
 
 def _differentiable(t):
@@ -75,75 +120,333 @@ def _make_edges(tensors):
     return edges
 
 
-def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Tensor:
-    """Dispatch a single-output op. `fn` maps jax values -> jax value; all
-    non-tensor arguments must already be closed over in `fn`."""
-    from .registry import _active_override
-    override = _active_override(name)
-    if override is not None:
-        fn = override
-    inputs = _amp_transform(name, inputs)
-    vals = _values(inputs)
-    debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
-    if not _requires_grad(inputs):
-        out_val = fn(*vals)
-        if debug:
-            _debug_checks(name, (out_val,))
-        return Tensor(out_val, stop_gradient=True)
+# ---------------------------------------------------------------------------
+# cache keying: hash op implementations by VALUE, or refuse
+# ---------------------------------------------------------------------------
 
-    diff_mask = [_differentiable(t) for t in inputs]
-    if all(diff_mask):
-        out_val, vjp_fn = jax.vjp(fn, *vals)
-        wrapped_vjp = vjp_fn
-    else:
-        # only differentiate w.r.t. non-stop-gradient inputs; close over the rest
-        diff_idx = [i for i, d in enumerate(diff_mask) if d]
+_UNKEYABLE = object()
 
-        def partial_fn(*diff_vals):
+# Types whose hash/equality is value-based and whose value cannot change
+# under the key's feet. Anything outside this set (arrays, Tensors — whose
+# __hash__ is id() but whose _value mutates in-place, arbitrary objects)
+# makes the fn un-keyable: baking such a cell into a cached trace would go
+# stale silently.
+_SAFE_SCALARS = (int, float, bool, complex, str, bytes, type(None), type,
+                 np.dtype, np.generic)
+
+# callables without a __code__ object that are still safely identity-keyed:
+# stateless module-level singletons (jnp.add is a jnp.ufunc; jnp.exp /
+# jax.nn.* are PjitFunction wrappers; python builtins)
+_SAFE_CALLABLE_TYPES = (types.BuiltinFunctionType, np.ufunc, jnp.ufunc,
+                        type(jax.jit(lambda: None)))
+
+
+def _token_of(v, depth):
+    if depth > 4:
+        return _UNKEYABLE
+    if isinstance(v, _SAFE_SCALARS) or isinstance(v, enum.Enum):
+        return v
+    if isinstance(v, (tuple, list)):
+        items = tuple(_token_of(i, depth + 1) for i in v)
+        if any(i is _UNKEYABLE for i in items):
+            return _UNKEYABLE
+        return (type(v).__name__, items)
+    if isinstance(v, dict):
+        try:
+            keys = sorted(v)
+        except TypeError:
+            return _UNKEYABLE
+        items = tuple((k, _token_of(v[k], depth + 1)) for k in keys)
+        if any(t is _UNKEYABLE for _, t in items):
+            return _UNKEYABLE
+        return ("dict", items)
+    if callable(v):
+        return _fn_token(v, depth + 1)
+    return _UNKEYABLE
+
+
+def _fn_token(fn, depth=0):
+    """Value-identity for an op implementation: code object plus closure
+    cell / default tokens. Returns _UNKEYABLE when the fn cannot be keyed
+    safely (→ the call bypasses the cache)."""
+    if depth > 4:
+        return _UNKEYABLE
+    if isinstance(fn, functools.partial):
+        inner = _fn_token(fn.func, depth + 1)
+        args = _token_of(tuple(fn.args), depth + 1)
+        kw = _token_of(fn.keywords or {}, depth + 1)
+        if _UNKEYABLE in (inner, args, kw):
+            return _UNKEYABLE
+        return ("partial", inner, args, kw)
+    bound_self = getattr(fn, "__self__", None)
+    if bound_self is not None:
+        # bound method: the code object is shared across instances, so the
+        # receiver must be part of the token — which for arbitrary
+        # (mutable) objects it can't be → bypass
+        stok = _token_of(bound_self, depth + 1)
+        inner = _fn_token(getattr(fn, "__func__", None) or fn.__call__,
+                          depth + 1) if stok is not _UNKEYABLE else _UNKEYABLE
+        if _UNKEYABLE in (stok, inner):
+            return _UNKEYABLE
+        return ("bound", stok, inner)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # no python code object: accept only known-stateless singleton
+        # types (jnp ufuncs, jitted wrappers, builtins) whose behavior
+        # cannot mutate under an identity key; arbitrary callable objects
+        # may carry mutable state (e.g. a Layer's weights) → bypass
+        if isinstance(fn, _SAFE_CALLABLE_TYPES):
+            return fn
+        return _UNKEYABLE
+    cells = []
+    for cell in (fn.__closure__ or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:           # empty cell
+            return _UNKEYABLE
+        t = _token_of(v, depth + 1)
+        if t is _UNKEYABLE:
+            return _UNKEYABLE
+        cells.append(t)
+    dflt = _token_of(fn.__defaults__ or (), depth + 1)
+    kwdflt = _token_of(getattr(fn, "__kwdefaults__", None) or {}, depth + 1)
+    if _UNKEYABLE in (dflt, kwdflt):
+        return _UNKEYABLE
+    gtok = _globals_token(fn, code, depth)
+    if gtok is None:
+        return _UNKEYABLE
+    return (code, tuple(cells), dflt, kwdflt, gtok)
+
+
+_code_names_cache: dict = {}
+
+
+def _all_code_names(code):
+    """Sorted co_names of `code` and of every nested code object (inner
+    defs / lambdas in co_consts), so globals read by an inner function
+    still make it into the key. Code objects are immutable, so the walk is
+    memoized per code object (the dict stays small: one row per distinct
+    op-fn definition site)."""
+    names = _code_names_cache.get(code)
+    if names is None:
+        def walk(c, out, depth):
+            out.update(c.co_names)
+            if depth <= 4:
+                for const in c.co_consts:
+                    if isinstance(const, types.CodeType):
+                        walk(const, out, depth + 1)
+        acc: set = set()
+        walk(code, acc, 0)
+        names = _code_names_cache[code] = tuple(sorted(acc))
+    return names
+
+
+def _globals_token(fn, code, depth):
+    """Token for the module globals an op fn references (co_names of the fn
+    AND its nested code objects, ∩ __globals__): a fn can read mutable
+    module state the closure scan never sees, and baking it into a cached
+    trace would go stale. Scalars are keyed by value (a changed global →
+    new key); modules and module-level functions/classes are stable
+    singletons keyed by identity — state read INDIRECTLY through such a
+    helper's own globals is frozen at trace time, the same contract as
+    jax.jit (recursing into helpers would cascade into dispatch internals
+    and mark every op unkeyable); any other global — arrays, Tensors,
+    stateful objects — returns None → the call bypasses the cache."""
+    g = getattr(fn, "__globals__", None)
+    if g is None:
+        return ()
+    toks = []
+    for nm in _all_code_names(code):
+        if nm not in g:
+            continue                 # builtin or pure attribute name
+        v = g[nm]
+        if isinstance(v, types.ModuleType):
+            continue
+        if isinstance(v, (types.FunctionType, type)) \
+                or isinstance(v, _SAFE_CALLABLE_TYPES):
+            toks.append((nm, v))     # stable module-level def: identity
+            continue
+        t = _token_of(v, depth + 1)
+        if t is _UNKEYABLE:
+            return None
+        toks.append((nm, t))
+    return tuple(toks)
+
+
+def _amp_token(name):
+    from ..amp.auto_cast import current_amp_state
+    st = current_amp_state()
+    if st is None or not st.enabled:
+        return None
+    return (st.level, st.dtype, name in st.white, name in st.black)
+
+
+def _make_key(name, fn, vals, diff_mask, reg_token):
+    """The cache key, or None when this call must bypass the cache."""
+    ftok = _fn_token(fn)
+    if ftok is _UNKEYABLE:
+        return None
+    for v in vals:
+        # inside an outer trace (TrainStep/to_static) the op is absorbed
+        # into the enclosing jaxpr; caching per-trace executables would
+        # only pollute the LRU and risk nested-jit edge cases
+        if isinstance(v, jax.core.Tracer):
+            return None
+    avals = tuple((v.shape, v.dtype, getattr(v, "weak_type", False))
+                  for v in vals)
+    return (name, ftok, avals, diff_mask, _amp_token(name), reg_token)
+
+
+# ---------------------------------------------------------------------------
+# the executable cache (LRU, FLAGS_eager_op_cache_size entries)
+# ---------------------------------------------------------------------------
+
+_BYPASS = object()        # negative-cache: this key is known un-jittable
+
+_cache: OrderedDict = OrderedDict()
+_cache_lock = threading.Lock()
+
+
+def _cache_get(key):
+    with _cache_lock:
+        exe = _cache.get(key)
+        if exe is not None:
+            _cache.move_to_end(key)
+        return exe
+
+
+def _cache_put(key, exe):
+    cap = int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 1)
+    with _cache_lock:
+        _cache[key] = exe
+        _cache.move_to_end(key)
+        while len(_cache) > max(cap, 1):
+            _cache.popitem(last=False)
+            _STATS.evictions += 1
+
+
+def clear_dispatch_cache():
+    """Drop every cached executable (test hook / manual invalidation),
+    including the shared backward appliers' jit caches — the LRU only
+    bounds forward entries; backward traces live in the appliers keyed by
+    the vjp Partial treedef and are released here."""
+    with _cache_lock:
+        _cache.clear()
+    for applier in (_vjp_applier, _vjp_applier_donate):
+        try:
+            applier.clear_cache()
+        except Exception:
+            pass
+
+
+def dispatch_cache_info():
+    """Entry count + capacity + live keys of the executable cache."""
+    with _cache_lock:
+        keys = list(_cache)
+    return {"entries": len(keys),
+            "capacity": int(_FLAGS.get("FLAGS_eager_op_cache_size", 512)),
+            "keys": keys}
+
+
+def _build_fwd(fn):
+    def traced(*vals):
+        _STATS.retraces += 1      # side effect: runs only while tracing
+        return fn(*vals)
+    return jax.jit(traced)
+
+
+def _build_fwd_vjp(fn, diff_idx):
+    """Jitted (out, vjp) pair. jax.vjp's pullback is a jax.tree_util.Partial
+    — a pytree with the residual buffers as leaves — so it crosses the jit
+    boundary; the compiled forward then emits fresh residuals every call
+    with zero re-tracing, and the shared `_vjp_applier` runs the backward
+    as one cached executable keyed on the Partial's (stable) treedef."""
+    def traced(*vals):
+        _STATS.retraces += 1
+        if len(diff_idx) == len(vals):
+            return jax.vjp(fn, *vals)
+
+        def pf(*dv):
             full = list(vals)
-            for i, v in zip(diff_idx, diff_vals):
+            for i, v in zip(diff_idx, dv):
                 full[i] = v
             return fn(*full)
-
-        out_val, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
-
-        def wrapped_vjp(g, _vjp=vjp_fn, _idx=diff_idx, _n=len(inputs)):
-            partial = _vjp(g)
-            full = [None] * _n
-            for i, pg in zip(_idx, partial):
-                full[i] = pg
-            return tuple(full)
-
-    if debug:
-        _debug_checks(name, (out_val,))
-    node = GradNode(name, wrapped_vjp, _make_edges(inputs),
-                    ((out_val.shape, out_val.dtype),))
-    node.fwd_fn = fn
-    node.in_vals, node.unpack_hook = _pack_saved(vals, node.edges)
-    out = Tensor(out_val, stop_gradient=False)
-    out._grad_node = node
-    out._out_index = 0
-    return out
+        return jax.vjp(pf, *(vals[i] for i in diff_idx))
+    return jax.jit(traced)
 
 
-def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
-                  num_outputs: int) -> list:
-    """Dispatch an op whose fn returns a tuple of `num_outputs` jax values."""
-    from .registry import _active_override
-    override = _active_override(name)
-    if override is not None:
-        fn = override
-    inputs = _amp_transform(name, inputs)
-    vals = _values(inputs)
-    debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
-    if not _requires_grad(inputs):
-        out_vals = fn(*vals)
-        if debug:
-            _debug_checks(name, out_vals)
-        return [Tensor(v, stop_gradient=True) for v in out_vals]
+def _apply_vjp(vjp_fn, g):
+    _STATS.retraces += 1
+    return vjp_fn(g)
 
-    diff_mask = [_differentiable(t) for t in inputs]
-    diff_idx = [i for i, d in enumerate(diff_mask) if d]
+
+_vjp_applier = jax.jit(_apply_vjp)
+# donating variant: hands the residual buffers to XLA on the final backward
+# (gated by FLAGS_eager_op_cache_donate — see the flag's docstring for the
+# aliasing hazard; donation is a warn-and-skip no-op on CPU)
+_vjp_applier_donate = jax.jit(_apply_vjp, donate_argnums=(0,))
+
+
+def _cached_call(key, name, fn, diff_idx, vals):
+    """Run the op through the executable cache. Returns (ok, result);
+    ok=False → the caller must take the uncached path (also the landing
+    spot for keys negative-cached after a failed trace, so jit-incompatible
+    ops fail over exactly once)."""
+    exe = _cache_get(key)
+    if exe is _BYPASS:
+        _STATS.bypass(name)
+        return False, None
+    if exe is not None:
+        _STATS.hit(name)
+        try:
+            return True, exe(*vals)
+        except jax.errors.JaxRuntimeError:
+            # same transient-fault contract as the miss path: fall back to
+            # the eager call this once, keep the executable for next time
+            return False, None
+    _STATS.miss(name)
+    exe = _build_fwd(fn) if diff_idx is None else _build_fwd_vjp(fn, diff_idx)
+    try:
+        res = exe(*vals)
+    except jax.errors.JaxRuntimeError:
+        # transient execution fault (OOM, device reset): do NOT negative-
+        # cache a jittable key — let the next call try again
+        return False, None
+    except Exception:
+        # un-jittable (value-dependent python control flow, dynamic output
+        # shape, ...) or a genuine user error: either way the eager path
+        # owns this call — and raises the uncached error message
+        _cache_put(key, _BYPASS)
+        return False, None
+    _cache_put(key, exe)
+    return True, res
+
+
+def _make_cached_vjp(vjp_partial, diff_idx, n_in, multi):
+    """Engine-facing pullback over the cached backward executable. The
+    `donate` kwarg (passed by GradNode.collect_input_grads on the final,
+    non-retained backward) routes through the donating applier."""
+    def wrapped(g, donate=False):
+        if multi and not isinstance(g, tuple):
+            # the engine passes a bare cotangent when the op has exactly
+            # one output; the vjp of a tuple-returning fn wants a tuple
+            g = (g,)
+        if donate and _FLAGS.get("FLAGS_eager_op_cache_donate"):
+            partial = _vjp_applier_donate(vjp_partial, g)
+        else:
+            partial = _vjp_applier(vjp_partial, g)
+        full = [None] * n_in
+        for i, pg in zip(diff_idx, partial):
+            full[i] = pg
+        return tuple(full)
+    wrapped._supports_donate = True
+    return wrapped
+
+
+def _slow_vjp(fn, vals, diff_idx, n_in, multi):
+    """The original uncached path: eager jax.vjp at every call."""
+    if not multi and len(diff_idx) == n_in:
+        return jax.vjp(fn, *vals)
 
     def partial_fn(*diff_vals):
         full = list(vals)
@@ -151,29 +454,111 @@ def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
             full[i] = v
         return fn(*full)
 
-    out_vals, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
-    if debug:
-        _debug_checks(name, out_vals)
+    out, vjp_fn = jax.vjp(partial_fn, *(vals[i] for i in diff_idx))
 
-    def wrapped_vjp(gs, _vjp=vjp_fn, _idx=diff_idx, _n=len(inputs)):
-        if not isinstance(gs, tuple):
-            # the engine passes a bare cotangent when the op has exactly one
-            # output; jax.vjp of a tuple-returning fn wants a tuple
-            gs = (gs,)
-        partial = _vjp(gs)
+    def wrapped(g, _vjp=vjp_fn, _idx=diff_idx, _n=n_in):
+        if multi and not isinstance(g, tuple):
+            g = (g,)
+        partial = _vjp(g)
         full = [None] * _n
         for i, pg in zip(_idx, partial):
             full[i] = pg
         return tuple(full)
+    return out, wrapped
 
-    node = GradNode(name, wrapped_vjp, _make_edges(inputs),
-                    tuple((v.shape, v.dtype) for v in out_vals))
+
+# ---------------------------------------------------------------------------
+# the funnel
+# ---------------------------------------------------------------------------
+
+def _prologue(name, fn, inputs):
+    """Shared call_op/call_op_multi preamble: registry override resolution,
+    AMP input casts, raw value extraction, and the registry part of the
+    cache key — in one place so the cache logic exists exactly once."""
+    from .registry import _dispatch_state
+    override, active, generation = _dispatch_state(name)
+    if override is not None:
+        fn = override
+    inputs = _amp_transform(name, inputs)
+    return fn, inputs, _values(inputs), (active, generation)
+
+
+def _dispatch(name, fn, inputs, num_outputs):
+    multi = num_outputs is not None
+    fn, inputs, vals, reg_token = _prologue(name, fn, inputs)
+    debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
+    cache_on = bool(_FLAGS.get("FLAGS_eager_op_cache"))
+
+    if not _requires_grad(inputs):
+        key = _make_key(name, fn, vals, None, reg_token) if cache_on else None
+        ok = False
+        if key is not None:
+            ok, out_vals = _cached_call(key, name, fn, None, vals)
+        elif cache_on:
+            _STATS.bypass(name)
+        if not ok:
+            out_vals = fn(*vals)
+        if multi:
+            if debug:
+                _debug_checks(name, out_vals)
+            return [Tensor(v, stop_gradient=True) for v in out_vals]
+        if debug:
+            _debug_checks(name, (out_vals,))
+        return Tensor(out_vals, stop_gradient=True)
+
+    diff_mask = tuple(_differentiable(t) for t in inputs)
+    diff_idx = tuple(i for i, d in enumerate(diff_mask) if d)
+    n_in = len(inputs)
+
+    key = _make_key(name, fn, vals, diff_mask, reg_token) if cache_on else None
+    ok = False
+    if key is not None:
+        ok, res = _cached_call(key, name, fn, diff_idx, vals)
+    elif cache_on:
+        _STATS.bypass(name)
+    if ok:
+        out_vals, vjp_partial = res
+        wrapped_vjp = _make_cached_vjp(vjp_partial, diff_idx, n_in, multi)
+    else:
+        out_vals, wrapped_vjp = _slow_vjp(fn, vals, diff_idx, n_in, multi)
+
+    if debug:
+        _debug_checks(name, out_vals if multi else (out_vals,))
+    out_avals = tuple((v.shape, v.dtype) for v in out_vals) if multi \
+        else ((out_vals.shape, out_vals.dtype),)
+    node = GradNode(name, wrapped_vjp, _make_edges(inputs), out_avals)
     node.fwd_fn = fn
     node.in_vals, node.unpack_hook = _pack_saved(vals, node.edges)
-    outs = []
-    for j, v in enumerate(out_vals):
-        t = Tensor(v, stop_gradient=False)
-        t._grad_node = node
-        t._out_index = j
-        outs.append(t)
-    return outs
+    if multi:
+        outs = []
+        for j, v in enumerate(out_vals):
+            t = Tensor(v, stop_gradient=False)
+            t._grad_node = node
+            t._out_index = j
+            outs.append(t)
+        return outs
+    out = Tensor(out_vals, stop_gradient=False)
+    out._grad_node = node
+    out._out_index = 0
+    return out
+
+
+def _timed_dispatch(name, fn, inputs, num_outputs):
+    t0 = time.perf_counter_ns()
+    try:
+        return _dispatch(name, fn, inputs, num_outputs)
+    finally:
+        _STATS.calls += 1
+        _STATS.dispatch_time_ns += time.perf_counter_ns() - t0
+
+
+def call_op(name: str, fn: Callable, inputs: Sequence[Tensor], **_ignored) -> Tensor:
+    """Dispatch a single-output op. `fn` maps jax values -> jax value; all
+    non-tensor arguments must already be closed over in `fn`."""
+    return _timed_dispatch(name, fn, inputs, None)
+
+
+def call_op_multi(name: str, fn: Callable, inputs: Sequence[Tensor],
+                  num_outputs: int) -> list:
+    """Dispatch an op whose fn returns a tuple of `num_outputs` jax values."""
+    return _timed_dispatch(name, fn, inputs, num_outputs)

@@ -36,6 +36,12 @@ class OpDef:
     args: tuple = ()                    # entry-point signature (arg names)
     overrides: dict = field(default_factory=dict)  # impl_name -> callable
     active: Optional[str] = None        # activated override, if any
+    # bumped whenever the overrides table changes (a kernel registered or
+    # re-registered under an existing name); together with the active impl
+    # name it forms the registry token in the eager executable-cache key
+    # (ops/dispatch.py), so entries compiled against a superseded kernel
+    # become unreachable immediately
+    generation: int = 0
 
 
 _REGISTRY: dict[str, OpDef] = {}
@@ -111,6 +117,7 @@ def override_kernel(name: str, impl_name: str, fn: Callable,
     if od is None:
         od = _REGISTRY.setdefault(name, OpDef(name=name, category="custom"))
     od.overrides[impl_name] = fn
+    od.generation += 1
     if activate:
         od.active = impl_name
     return fn
@@ -129,6 +136,9 @@ class use_kernel:
                 f"{list(od.overrides)}")
         self._od = od
         self._prev = od.active
+        # no generation bump: the active impl NAME is part of the dispatch
+        # cache token, so (de)activation re-keys by itself — and restoring
+        # the previous impl re-matches its still-valid cached executables
         od.active = impl_name
 
     def __enter__(self):
@@ -140,8 +150,19 @@ class use_kernel:
 
 
 def _active_override(name: str):
-    """Dispatch hook: the activated override callable for `name`, or None."""
+    """The activated override callable for `name`, or None (thin view over
+    _dispatch_state so the two can never drift)."""
+    return _dispatch_state(name)[0]
+
+
+def _dispatch_state(name: str):
+    """Dispatch hook: (override_callable_or_None, active_impl_name,
+    generation). The (name, generation) pair is the registry token in the
+    eager executable-cache key — activation changes the name, re-registering
+    the same impl name bumps the generation, and either way stale cache
+    entries stop matching."""
     od = _REGISTRY.get(name)
-    if od is not None and od.active is not None:
-        return od.overrides.get(od.active)
-    return None
+    if od is None:
+        return None, None, 0
+    fn = od.overrides.get(od.active) if od.active is not None else None
+    return fn, od.active, od.generation

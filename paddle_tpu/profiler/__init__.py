@@ -16,9 +16,14 @@ import threading
 import time
 from enum import Enum
 
+from .dispatch import (DispatchStats, dispatch_cache_stats,
+                       reset_dispatch_cache_stats)
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
-           "load_profiler_result", "benchmark", "SortedKeys", "SummaryView"]
+           "load_profiler_result", "benchmark", "SortedKeys", "SummaryView",
+           "DispatchStats", "dispatch_cache_stats",
+           "reset_dispatch_cache_stats"]
 
 
 class SortedKeys(Enum):
