@@ -126,9 +126,12 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     from paddle_tpu.jit import TrainStep
 
     # each config runs in its own subprocess, but reset anyway so the
-    # record's dispatch_cache block covers exactly this run (retries incl.)
-    from paddle_tpu.profiler import reset_dispatch_cache_stats
+    # record's dispatch_cache / chain_fusion blocks cover exactly this run
+    # (retries incl.)
+    from paddle_tpu.profiler import (reset_dispatch_cache_stats,
+                                     reset_chain_fusion_stats)
     reset_dispatch_cache_stats()
+    reset_chain_fusion_stats()
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -164,9 +167,10 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     platform = jax.devices()[0].platform
     tdir = _trace(trace_tag, platform, lambda: float(step(x, y)))
 
-    # eager-dispatch cache telemetry (hits/misses/evictions/retraces):
-    # future BENCH rounds diff this block to catch retrace regressions
-    from paddle_tpu.profiler import dispatch_cache_stats
+    # eager-dispatch cache + chain-fusion telemetry (hits/misses/retraces,
+    # fused replays/splits/launches saved): future BENCH rounds diff these
+    # blocks to catch retrace and fusion regressions
+    from paddle_tpu.profiler import dispatch_cache_stats, chain_fusion_stats
 
     return {
         "metric": metric,
@@ -177,7 +181,8 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
         "extra": {"mfu": round(mfu, 4), "loss": round(final, 3),
                   "batch": batch, "seq": seq, "params": n_params,
                   "platform": platform, "trace": tdir,
-                  "dispatch_cache": dispatch_cache_stats()},
+                  "dispatch_cache": dispatch_cache_stats(),
+                  "chain_fusion": chain_fusion_stats()},
     }
 
 

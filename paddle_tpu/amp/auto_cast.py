@@ -103,13 +103,20 @@ def amp_cast_inputs(op_name: str, tensors):
             return tensors
     out = []
     changed = False
+    from ..ops._helpers import jnp_dtype
     for t in tensors:
-        v = t._value
-        if jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != target:
+        # dtype from chain metadata when the input is a deferred fusion
+        # placeholder (ops/fusion.py): a no-cast decision must not force a
+        # pending chain to materialize
+        dt = jnp_dtype(t)
+        if jnp.issubdtype(dt, jnp.floating) and dt != target:
             # cast the raw value and alias the producer's grad node: the
             # downstream op's VJP then emits grads in compute dtype, which
             # accumulate into the original tensor (standard AMP behavior)
+            # (reading _value here forces a pending placeholder — the cast
+            # is a real escape, the chain splits, numerics stay identical)
             from ..framework.core import Tensor
+            v = t._value
             casted = Tensor(v.astype(target), stop_gradient=t.stop_gradient)
             casted._grad_node = t._grad_node
             casted._out_index = t._out_index

@@ -85,7 +85,7 @@ class ProcessGroupXLA:
         if fn is not None:
             return fn
         mesh = self.mesh
-        from jax.experimental.shard_map import shard_map
+        from ..framework.jax_compat import shard_map
 
         red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
                ReduceOp.MIN: jax.lax.pmin,

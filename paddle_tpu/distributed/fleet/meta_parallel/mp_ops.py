@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ....framework.core import Tensor
+from ....framework.jax_compat import axis_size
 from ....ops._helpers import ensure_tensor, call_op
 
 __all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce", "split",
@@ -23,12 +24,20 @@ MODEL_AXIS = "model"
 
 
 def in_spmd_axis(axis_name=MODEL_AXIS):
-    """True when called inside a shard_map/pmap trace binding `axis_name`."""
+    """True when called inside a shard_map/pmap trace binding `axis_name`
+    with more than one shard. A bound size-1 axis carries no sharding —
+    collectives over it are identities — so it does not count: this keeps
+    dispatch decisions (ring attention, mp collectives) correct under the
+    jax_compat all-manual shard_map emulation, which binds EVERY mesh axis
+    including degenerate ones."""
     try:
         jax.lax.axis_index(axis_name)
-        return True
     except (NameError, KeyError, TypeError, Exception):
         return False
+    try:
+        return axis_size(axis_name) > 1
+    except Exception:
+        return True
 
 
 def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
@@ -93,7 +102,7 @@ def _c_split(tensor, group=None):
         return t
 
     def fn(v):
-        n = jax.lax.axis_size(MODEL_AXIS)
+        n = axis_size(MODEL_AXIS)
         idx = jax.lax.axis_index(MODEL_AXIS)
         chunk = v.shape[-1] // n
         return jax.lax.dynamic_slice_in_dim(v, idx * chunk, chunk,

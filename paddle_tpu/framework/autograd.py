@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
-    "GradNode", "AccumulationNode", "run_backward", "grad",
+    "GradNode", "FusedChainNode", "AccumulationNode", "run_backward", "grad",
     "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
 ]
 
@@ -125,7 +125,7 @@ class GradNode:
         for j, (shape, dt) in enumerate(self.out_avals):
             g = self.pending.get(j)
             if g is None:
-                g = jnp.zeros(shape, dt)
+                g = _zero_cotangent(shape, dt)
             else:
                 for hook in self.out_hooks.get(j, ()):
                     newg = hook(g)
@@ -154,6 +154,76 @@ class GradNode:
 
 
 _RELEASED = object()
+
+# Zero-cotangent buffers for outputs nothing fed a grad into — hot for
+# FusedChainNode, whose flat output tuple includes every chain intermediate
+# (a linear chain zero-fills all but the last slot on every backward).
+# Zeros are immutable and never donated (appliers donate residuals, not
+# cotangents), so one device buffer per (shape, dtype) is safe to share.
+# Only buffers ≤ _COTANGENT_CACHE_MAX_BYTES are kept: the win is the saved
+# eager dispatch, which small shapes dominate — pinning activation-sized
+# device buffers for the process lifetime would trade transient allocation
+# for persistent memory pressure.
+_COTANGENT_CACHE_MAX_BYTES = 1 << 20
+
+
+def _fill_cotangent(cache, fill, shape, dt):
+    key = (tuple(shape), dt)
+    z = cache.get(key)
+    if z is None:
+        z = fill(shape, dt)
+        if z.nbytes <= _COTANGENT_CACHE_MAX_BYTES:
+            if len(cache) >= 256:
+                cache.clear()
+            cache[key] = z
+    return z
+
+
+_zero_cache: dict = {}
+
+
+def _zero_cotangent(shape, dt):
+    return _fill_cotangent(_zero_cache, jnp.zeros, shape, dt)
+
+
+# same contract for the default backward seed (∂loss/∂loss = 1): an eager
+# jnp.ones is a full uncompiled dispatch (~30% of a small fused train step
+# on CPU) paid on every .backward()/grad() call
+_ones_cache: dict = {}
+
+
+def _one_cotangent(shape, dt):
+    return _fill_cotangent(_ones_cache, jnp.ones, shape, dt)
+
+
+class FusedChainNode(GradNode):
+    """One tape node owning the outputs of MULTIPLE logical forward ops — the
+    grad node a fused op-chain executable records (ops/fusion.py).
+
+    Where a normal GradNode owns one op invocation's outputs, a fused node's
+    `out_avals` concatenates every constituent op's outputs in chain order,
+    and `edges` point at the chain's EXTERNAL inputs only (one edge per
+    external slot; chain-internal dataflow lives inside the fused vjp).
+    `out_index` on a tensor produced mid-chain addresses its slot in the
+    flattened output tuple, so downstream consumers, output hooks, and
+    partial backward through a side output all work exactly as they do on a
+    multi-output GradNode — the engine never needs to know the outputs came
+    from different logical ops. `owners[j] = (op position in chain, local
+    out index)` keeps the logical attribution for diagnostics and telemetry.
+    """
+
+    __slots__ = ("op_names", "owners")
+
+    def __init__(self, op_names, vjp_fn, edges, out_avals, owners):
+        super().__init__("fused_chain(" + "→".join(op_names) + ")",
+                         vjp_fn, edges, out_avals)
+        self.op_names = tuple(op_names)
+        self.owners = tuple(owners)
+
+    def output_owner(self, out_index):
+        """(op name, local output index) of a flattened chain output."""
+        pos, local = self.owners[out_index]
+        return self.op_names[pos], local
 
 # ---------------------------------------------------------------------------
 # saved-tensors hooks (reference: python/paddle/autograd
@@ -503,7 +573,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for out, gout in zip(outputs, grad_outputs):
             if out._grad_node is None:
                 continue
-            seed = (jnp.ones(out.shape, out._value.dtype)
+            seed = (_one_cotangent(out._value.shape, out._value.dtype)
                     if gout is None else jnp.asarray(gout._value if isinstance(gout, Tensor) else gout))
             run_backward(out._grad_node, out._out_index, seed,
                          retain_graph=retain)

@@ -149,7 +149,8 @@ class Tensor:
                 "Tensor.backward() called on a tensor with stop_gradient=True "
                 "and no grad graph")
         if grad_tensor is None:
-            seed = jnp.ones(self._value.shape, self._value.dtype)
+            from .autograd import _one_cotangent
+            seed = _one_cotangent(self._value.shape, self._value.dtype)
         else:
             seed = grad_tensor._value if isinstance(grad_tensor, Tensor) \
                 else jnp.asarray(grad_tensor)

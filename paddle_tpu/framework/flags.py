@@ -69,10 +69,12 @@ define_flag("FLAGS_eager_op_cache", True,
             "never change — only whether jax re-traces")
 define_flag("FLAGS_eager_op_cache_size", 512,
             "LRU capacity (entries) of the eager op executable cache; the "
-            "least-recently-used entry is evicted past this size. Bounds "
-            "forward entries only — backward applier traces (keyed by vjp "
-            "residual treedef) live for the process unless "
-            "ops.dispatch.clear_dispatch_cache() is called")
+            "least-recently-used entry is evicted past this size. 0 disables "
+            "caching entirely (keyable calls take the uncached path and are "
+            "counted as bypasses in telemetry). Bounds forward entries only "
+            "— backward applier traces (keyed by vjp residual treedef) live "
+            "for the process unless ops.dispatch.clear_dispatch_cache() is "
+            "called")
 define_flag("FLAGS_eager_op_cache_donate", False,
             "EXPERIMENTAL: donate VJP residual buffers to the cached "
             "backward executable on the final (non-retained) backward. Off "
@@ -83,6 +85,38 @@ define_flag("FLAGS_eager_op_cache_donate", False,
             "invalidates them. Only safe when the graph is a chain whose "
             "intermediates are not referenced after backward; donation is "
             "a warn-and-skip no-op on CPU")
+
+# Eager chain fusion (ops/fusion.py), the layer above the per-op cache:
+# repeated op *sequences* (matmul→add→gelu, ...) are detected from the
+# dispatch stream and compiled into ONE fused executable per chain — one
+# XLA launch instead of N, one fused GradNode instead of N tape nodes.
+# Replay is speculative: ops matching a hot chain are deferred and the
+# fused executable fires when the chain completes; any mid-chain mismatch
+# or an intermediate escaping the chain (a `.numpy()`, an unrelated op, a
+# mutated stop_gradient) splits the chain back onto the per-op cached
+# path with identical numerics. Telemetry:
+# paddle_tpu.profiler.chain_fusion_stats(); bench.py embeds it as the
+# `chain_fusion` block.
+define_flag("FLAGS_eager_chain_fusion", True,
+            "fuse repeated eager op sequences into single compiled chain "
+            "executables on top of the per-op cache. Chains are keyed by "
+            "the constituent per-op cache keys plus the dataflow wiring "
+            "between them, so every invalidation rule of the per-op cache "
+            "(registry generation bump, AMP state, clear_dispatch_cache) "
+            "applies to chains too. Falls back to per-op dispatch with "
+            "bitwise-identical results whenever a chain breaks")
+define_flag("FLAGS_eager_chain_fusion_min_count", 25,
+            "hotness threshold: a candidate op sequence must repeat this "
+            "many times before a fused chain executable is compiled for "
+            "it. Compiling a chain costs O(seconds); a replay saves "
+            "O(100us) — the default only fuses loops long enough to "
+            "amortize the compile (any real training loop crosses it in "
+            "the first second). Lower it in micro-benchmarks that want "
+            "fusion to settle during a short warmup")
+define_flag("FLAGS_eager_chain_cache_size", 128,
+            "LRU capacity (chains) of the fused-chain executable cache; "
+            "least-recently-replayed chains are evicted past this size. "
+            "0 disables chain fusion (same semantics as the flag off)")
 
 
 class _FlagsView:

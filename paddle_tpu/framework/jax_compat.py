@@ -1,0 +1,119 @@
+"""Version-compat shims over the installed jax.
+
+The repo targets the modern jax surface (`jax.shard_map` with an
+`axis_names` kwarg, `jax.lax.axis_size`, `jax.lax.pcast`), but must also run
+on jax 0.4.x where `shard_map` only exists under `jax.experimental` with a
+different signature and no partial-manual support. Everything that needs one
+of these symbols goes through this module; `install()` additionally patches
+the missing attributes onto the `jax` module itself so test/user code
+written against the modern spelling keeps working.
+
+Fallback semantics on old jax (jax.experimental.shard_map):
+
+  - `axis_names={...}` (partial-manual) is emulated by mapping ALL mesh axes
+    manually: in/out specs that never mention the extra axes leave data
+    replicated across them, so each device computes the same values it would
+    have under partial-auto — numerically identical, possibly redundant
+    compute across the unnamed axes (they are size-1 or small in every
+    in-repo mesh).
+  - replication checking (`check_vma`/`check_rep`) is disabled: 0.4.x's
+    rep-checker predates `pcast` and rejects legal programs the modern
+    checker accepts (e.g. psum-produced values returned through a
+    `P(axis, ...)` out_spec).
+  - `jax.lax.axis_size(name)` is `lax.psum(1, name)`, which constant-folds
+    to a python int inside a manual-mapping trace.
+  - `jax.lax.pcast(x, axis, to=...)` is the identity: with rep-checking
+    disabled there is no varying/replicated type to cast between.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "axis_size", "pcast", "export_key_form", "install"]
+
+_NATIVE_SHARD_MAP = getattr(jax, "shard_map", None)
+if _NATIVE_SHARD_MAP is None:
+    from jax.experimental.shard_map import shard_map as _EXPERIMENTAL_SHARD_MAP
+else:
+    _EXPERIMENTAL_SHARD_MAP = None
+
+# natives resolved ONCE, before install() can alias the shims onto jax —
+# a late getattr would find our own patch and recurse
+_NATIVE_AXIS_SIZE = getattr(lax, "axis_size", None)
+_NATIVE_PCAST = getattr(lax, "pcast", None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None, check_rep=None):
+    """`jax.shard_map` resolved against the installed jax.
+
+    `axis_names` restricts manual mapping to a subset of mesh axes (modern
+    jax); on 0.4.x it is emulated as documented in the module docstring.
+    `check_vma`/`check_rep` are accepted from either API generation and
+    forwarded when the installed jax supports them.
+    """
+    if _NATIVE_SHARD_MAP is not None:
+        kwargs = {}
+        if axis_names:
+            kwargs["axis_names"] = set(axis_names)
+        check = check_vma if check_vma is not None else check_rep
+        if check is not None:
+            kwargs["check_vma"] = check
+        return _NATIVE_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)
+    return _EXPERIMENTAL_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_rep=False)
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size` — size of a mapped mesh axis, as a python int
+    inside shard_map/pmap traces."""
+    if _NATIVE_AXIS_SIZE is not None:
+        return _NATIVE_AXIS_SIZE(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast(x, axis_name, *, to):
+    """`jax.lax.pcast` — varying/replicated cast. Identity on jax versions
+    without VMA tracking (the fallback shard_map runs with rep-checking
+    off, so there is nothing to cast)."""
+    if _NATIVE_PCAST is not None:
+        return _NATIVE_PCAST(x, axis_name, to=to)
+    return x
+
+
+_EXPORT_KEY_FORM = None
+
+
+def export_key_form():
+    """How a PRNG key must be threaded through `jax.export` so the artifact
+    SERIALIZES on this jax: "typed" when the export serializer knows the
+    typed key dtypes (`key<fry>`), "legacy" (raw uint32[2] `PRNGKey`)
+    otherwise — 0.4.x's serializer has no dtype kind for typed keys, so a
+    typed-key export traces fine but `Exported.serialize()` raises
+    KeyError(key<fry>). Every `jax.random` op accepts both forms."""
+    global _EXPORT_KEY_FORM
+    if _EXPORT_KEY_FORM is None:
+        try:
+            from jax._src.export import serialization as _ser
+            _EXPORT_KEY_FORM = "typed" if jax.random.key(0).dtype \
+                in _ser._dtype_to_dtype_kind else "legacy"
+        except Exception:
+            _EXPORT_KEY_FORM = "legacy"
+    return _EXPORT_KEY_FORM
+
+
+def install():
+    """Patch the modern spellings onto the jax module when missing, so code
+    outside this repo (tests, notebooks) written against current jax runs
+    unchanged. Idempotent; never overwrites a real implementation."""
+    if getattr(jax, "shard_map", None) is None:
+        jax.shard_map = shard_map
+    if getattr(lax, "axis_size", None) is None:
+        lax.axis_size = axis_size
+    if getattr(lax, "pcast", None) is None:
+        lax.pcast = pcast
+
+
+install()

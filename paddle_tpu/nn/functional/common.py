@@ -44,7 +44,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
     if p == 1.0:
         return unary("dropout", lambda v: jnp.zeros_like(v), x)
     key = get_rng_key()
-    shape = list(x._value.shape)
+    shape = list(x.shape)     # aval-answerable: never forces a fused chain
     if axis is not None:
         axes = axis if isinstance(axis, (list, tuple)) else [axis]
         mask_shape = [s if i in axes else 1 for i, s in enumerate(shape)]
@@ -64,7 +64,7 @@ def _dropout_nd(x, p, training, data_format, spatial_dims, name=None):
     x = ensure_tensor(x)
     if not training or p == 0.0:
         return x.clone()
-    shape = list(x._value.shape)
+    shape = list(x.shape)     # aval-answerable: never forces a fused chain
     if data_format.endswith("C"):  # NHWC / NDHWC: channel last
         mask_shape = [shape[0]] + [1] * spatial_dims + [shape[-1]]
     else:

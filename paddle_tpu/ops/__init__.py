@@ -24,7 +24,7 @@ from .registry import (  # noqa: F401
     all_ops, get_op, register_op, override_kernel, use_kernel, infer_meta,
     describe,
 )
-from ._helpers import ensure_tensor
+from ._helpers import ensure_tensor, jnp_dtype
 
 
 # ---------------------------------------------------------------------------
@@ -52,13 +52,13 @@ def _patch_operators():
     T.__neg__ = lambda self: math.neg(self)
     T.__abs__ = lambda self: math.abs(self)
     T.__invert__ = lambda self: logic.logical_not(self) \
-        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_not(self)
+        if jnp_dtype(self) == jnp.bool_.dtype else logic.bitwise_not(self)
     T.__and__ = lambda self, other: logic.logical_and(self, other) \
-        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_and(self, other)
+        if jnp_dtype(self) == jnp.bool_.dtype else logic.bitwise_and(self, other)
     T.__or__ = lambda self, other: logic.logical_or(self, other) \
-        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_or(self, other)
+        if jnp_dtype(self) == jnp.bool_.dtype else logic.bitwise_or(self, other)
     T.__xor__ = lambda self, other: logic.logical_xor(self, other) \
-        if self._value.dtype == jnp.bool_.dtype else logic.bitwise_xor(self, other)
+        if jnp_dtype(self) == jnp.bool_.dtype else logic.bitwise_xor(self, other)
     T.__eq__ = lambda self, other: logic.equal(self, other)
     T.__ne__ = lambda self, other: logic.not_equal(self, other)
     T.__lt__ = lambda self, other: logic.less_than(self, other)

@@ -11,7 +11,16 @@ from ..framework.dtype import get_default_dtype, to_jax_dtype
 from .dispatch import call_op, call_op_multi
 
 __all__ = ["ensure_tensor", "unary", "binary", "nary", "scalar_or_value",
-           "call_op", "call_op_multi", "axis_tuple"]
+           "call_op", "call_op_multi", "axis_tuple", "jnp_dtype"]
+
+
+def jnp_dtype(t):
+    """jnp dtype of a Tensor, answered from chain metadata when `t` is a
+    deferred fusion placeholder (ops/fusion.py) — pre-dispatch dtype peeks
+    in op wrappers must not force a pending chain to materialize. (Shape
+    peeks use Tensor.shape/ndim, which are already aval-answerable.)"""
+    av = getattr(t, "_fusion_aval", None)
+    return av[1] if av is not None else t._value.dtype
 
 
 def ensure_tensor(x, dtype=None):

@@ -95,7 +95,27 @@ def _debug_checks(name, out_vals):
         _sync_outputs(out_vals)
 
 
+def _input_aval(t):
+    """(shape, dtype, weak_type) of a dispatch input. Answered from chain
+    metadata for a deferred fusion placeholder (ops/fusion.py) so keying
+    never forces a pending chain to materialize; None means the input is a
+    tracer and the call must bypass the cache."""
+    av = getattr(t, "_fusion_aval", None)
+    if av is not None:
+        return av
+    v = t._value
+    if isinstance(v, jax.core.Tracer):
+        # inside an outer trace (TrainStep/to_static) the op is absorbed
+        # into the enclosing jaxpr; caching per-trace executables would
+        # only pollute the LRU and risk nested-jit edge cases
+        return None
+    return (v.shape, v.dtype, getattr(v, "weak_type", False))
+
+
 def _differentiable(t):
+    av = getattr(t, "_fusion_aval", None)
+    if av is not None:
+        return (not t.stop_gradient) and jnp.issubdtype(av[1], jnp.inexact)
     return (not t.stop_gradient) and jnp.issubdtype(t._value.dtype, jnp.inexact)
 
 
@@ -281,20 +301,21 @@ def _amp_token(name):
     return (st.level, st.dtype, name in st.white, name in st.black)
 
 
-def _make_key(name, fn, vals, diff_mask, reg_token):
-    """The cache key, or None when this call must bypass the cache."""
+def _make_key(name, fn, inputs, diff_mask, reg_token):
+    """The cache key, or None when this call must bypass the cache. Takes
+    the input TENSORS (not raw values) so avals of deferred fusion
+    placeholders come from chain metadata instead of forcing a
+    materialization."""
     ftok = _fn_token(fn)
     if ftok is _UNKEYABLE:
         return None
-    for v in vals:
-        # inside an outer trace (TrainStep/to_static) the op is absorbed
-        # into the enclosing jaxpr; caching per-trace executables would
-        # only pollute the LRU and risk nested-jit edge cases
-        if isinstance(v, jax.core.Tracer):
+    avals = []
+    for t in inputs:
+        av = _input_aval(t)
+        if av is None:          # tracer input
             return None
-    avals = tuple((v.shape, v.dtype, getattr(v, "weak_type", False))
-                  for v in vals)
-    return (name, ftok, avals, diff_mask, _amp_token(name), reg_token)
+        avals.append(av)
+    return (name, ftok, tuple(avals), diff_mask, _amp_token(name), reg_token)
 
 
 # ---------------------------------------------------------------------------
@@ -316,11 +337,15 @@ def _cache_get(key):
 
 
 def _cache_put(key, exe):
-    cap = int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 1)
+    cap = int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 0)
+    if cap <= 0:
+        # size 0 disables caching (dispatch already bypasses before keying;
+        # this guards a mid-call flag flip)
+        return
     with _cache_lock:
         _cache[key] = exe
         _cache.move_to_end(key)
-        while len(_cache) > max(cap, 1):
+        while len(_cache) > cap:
             _cache.popitem(last=False)
             _STATS.evictions += 1
 
@@ -329,7 +354,9 @@ def clear_dispatch_cache():
     """Drop every cached executable (test hook / manual invalidation),
     including the shared backward appliers' jit caches — the LRU only
     bounds forward entries; backward traces live in the appliers keyed by
-    the vjp Partial treedef and are released here."""
+    the vjp Partial treedef and are released here. Fused chain executables
+    (ops/fusion.py) obey the same invalidation: registered chains,
+    detection state, and the chain backward appliers are cleared too."""
     with _cache_lock:
         _cache.clear()
     for applier in (_vjp_applier, _vjp_applier_donate):
@@ -337,6 +364,8 @@ def clear_dispatch_cache():
             applier.clear_cache()
         except Exception:
             pass
+    if _fusion_mod is not None:
+        _fusion_mod.clear_chain_cache()
 
 
 def dispatch_cache_info():
@@ -471,51 +500,93 @@ def _slow_vjp(fn, vals, diff_idx, n_in, multi):
 # the funnel
 # ---------------------------------------------------------------------------
 
+# ops/fusion.py, resolved on first dispatch (lazy: fusion imports
+# framework.core/autograd, and importing it at module top would order the
+# package init around the funnel instead of the other way around)
+_fusion_mod = None
+
+
+def _fusion():
+    global _fusion_mod
+    if _fusion_mod is None:
+        from . import fusion
+        _fusion_mod = fusion
+    return _fusion_mod
+
+
 def _prologue(name, fn, inputs):
     """Shared call_op/call_op_multi preamble: registry override resolution,
-    AMP input casts, raw value extraction, and the registry part of the
-    cache key — in one place so the cache logic exists exactly once."""
+    AMP input casts, and the registry part of the cache key — in one place
+    so the cache logic exists exactly once. Raw value extraction is the
+    caller's job AFTER the fusion step: reading `_value` here would force
+    deferred chain placeholders that the fusion layer can keep symbolic."""
     from .registry import _dispatch_state
     override, active, generation = _dispatch_state(name)
     if override is not None:
         fn = override
     inputs = _amp_transform(name, inputs)
-    return fn, inputs, _values(inputs), (active, generation)
+    return fn, inputs, (active, generation)
 
 
 def _dispatch(name, fn, inputs, num_outputs):
     multi = num_outputs is not None
-    fn, inputs, vals, reg_token = _prologue(name, fn, inputs)
+    fn, inputs, reg_token = _prologue(name, fn, inputs)
     debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
     cache_on = bool(_FLAGS.get("FLAGS_eager_op_cache"))
+    if cache_on and int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 0) <= 0:
+        # size 0 disables caching entirely — keyable or not, every call
+        # takes the uncached path and is counted as a bypass
+        cache_on = False
+        _STATS.bypass(name)
 
-    if not _requires_grad(inputs):
-        key = _make_key(name, fn, vals, None, reg_token) if cache_on else None
+    grad_on = _requires_grad(inputs)
+    diff_mask = tuple(_differentiable(t) for t in inputs) if grad_on else None
+
+    key = _make_key(name, fn, inputs, diff_mask, reg_token) if cache_on \
+        else None
+    if cache_on and key is None:
+        _STATS.bypass(name)
+
+    fus = _fusion()
+    if debug:
+        # debug modes need materialized outputs op-by-op: resolve any
+        # pending chain and keep fusion out of the way for this call
+        fus.MANAGER.flush()
+        fus.MANAGER.reset()
+    else:
+        res = fus.MANAGER.step(name, fn, inputs, num_outputs, key, diff_mask)
+        if res is not fus.MISS:
+            return res
+
+    t0 = time.perf_counter_ns()
+    vals = _values(inputs)
+
+    if not grad_on:
         ok = False
         if key is not None:
             ok, out_vals = _cached_call(key, name, fn, None, vals)
-        elif cache_on:
-            _STATS.bypass(name)
         if not ok:
             out_vals = fn(*vals)
         if multi:
             if debug:
                 _debug_checks(name, out_vals)
-            return [Tensor(v, stop_gradient=True) for v in out_vals]
+            outs = [Tensor(v, stop_gradient=True) for v in out_vals]
+            _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
+                             key, None, outs, t0)
+            return outs
         if debug:
             _debug_checks(name, (out_vals,))
-        return Tensor(out_vals, stop_gradient=True)
+        out = Tensor(out_vals, stop_gradient=True)
+        _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
+                         key, None, (out,), t0)
+        return out
 
-    diff_mask = tuple(_differentiable(t) for t in inputs)
     diff_idx = tuple(i for i, d in enumerate(diff_mask) if d)
     n_in = len(inputs)
 
-    key = _make_key(name, fn, vals, diff_mask, reg_token) if cache_on else None
     ok = False
     if key is not None:
         ok, res = _cached_call(key, name, fn, diff_idx, vals)
-    elif cache_on:
-        _STATS.bypass(name)
     if ok:
         out_vals, vjp_partial = res
         wrapped_vjp = _make_cached_vjp(vjp_partial, diff_idx, n_in, multi)
@@ -536,11 +607,29 @@ def _dispatch(name, fn, inputs, num_outputs):
             t._grad_node = node
             t._out_index = j
             outs.append(t)
+        _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
+                         key, diff_mask, outs, t0)
         return outs
     out = Tensor(out_vals, stop_gradient=False)
     out._grad_node = node
     out._out_index = 0
+    _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
+                     key, diff_mask, (out,), t0)
     return out
+
+
+def _record_dispatch(fus, cached_ok, debug, name, fn, inputs, num_outputs,
+                     key, diff_mask, outs, t0):
+    """Feed the chain detector after the per-op path ran. Only dispatches
+    that went through the executable cache are chain material; an uncached
+    or un-keyable call breaks the stream (debug calls already reset it)."""
+    if debug or key is None:
+        return
+    if cached_ok:
+        fus.MANAGER.record(name, fn, inputs, num_outputs, key, diff_mask,
+                           outs, time.perf_counter_ns() - t0)
+    else:
+        fus.MANAGER.reset()
 
 
 def _timed_dispatch(name, fn, inputs, num_outputs):

@@ -17,7 +17,7 @@ from ..framework.core import Tensor
 from ..framework.dtype import to_jax_dtype, get_default_dtype
 from .registry import register_op
 from ._helpers import ensure_tensor, unary, binary, nary, call_op, axis_tuple, \
-    scalar_or_value
+    scalar_or_value, jnp_dtype
 
 __all__ = [
     # binary elementwise
@@ -373,7 +373,7 @@ def _reduce(name, jfn, x, axis=None, keepdim=False, dtype=None):
 @register_op("sum", "reduction", ref="phi/kernels/reduce_sum_kernel.h")
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     x = ensure_tensor(x)
-    if dtype is None and x._value.dtype in (jnp.int32.dtype, jnp.bool_.dtype):
+    if dtype is None and jnp_dtype(x) in (jnp.int32.dtype, jnp.bool_.dtype):
         dtype = "int64"
     return _reduce("sum", jnp.sum, x, axis, keepdim, dtype)
 

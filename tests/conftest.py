@@ -21,6 +21,14 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", "tests must run on CPU"
 assert jax.device_count() == 8, "tests expect an 8-device virtual CPU mesh"
 
+# Persistent XLA compilation cache: the distributed suites (pipeline /
+# hybrid / auto-parallel over the 8-device mesh) are dominated by large
+# SPMD compiles that are identical run-to-run. Caching them keeps tier-1
+# wall time inside its budget on re-runs; only compiles ≥0.1 s are written
+# so trivial eager micro-test compiles don't churn the cache.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 import numpy as np
 import pytest
 

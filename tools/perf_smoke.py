@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Fast perf guard for the compiled eager dispatch stack (PR 1 + PR 2).
+
+Runs a tiny eager matmul→add→gelu→sum fwd+bwd loop on CPU and fails
+(exit 1) when the dispatch telemetry shows either optimization silently
+regressed:
+
+  * post-warmup retraces — the per-op executable cache (ops/dispatch.py)
+    must stop tracing after the first few iterations; any later trace means
+    cache keying broke (a PR 1 regression);
+  * zero chain-fusion replay rate with fusion enabled — the hot sequence
+    must be detected and replayed as one fused executable (ops/fusion.py);
+    a 0% replay rate means detection or replay broke (a PR 2 regression).
+
+Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
+in tests/test_chain_fusion.py — this CLI is the same guard for CI scripts
+and manual bisection:
+
+    JAX_PLATFORMS=cpu python tools/perf_smoke.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable from a source checkout without an install
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+WARMUP = 12
+MEASURE = 40
+
+
+def main() -> int:
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache
+    from paddle_tpu.profiler import chain_fusion_stats, dispatch_cache_stats
+
+    set_flags({"FLAGS_eager_op_cache": True,
+               "FLAGS_eager_chain_fusion": True,
+               # fuse within the short warmup (the default threshold is
+               # sized for training loops, not a 52-iteration smoke)
+               "FLAGS_eager_chain_fusion_min_count": 4})
+    clear_dispatch_cache()
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 32)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((32, 32)).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(rng.standard_normal(32).astype(np.float32),
+                         stop_gradient=False)
+
+    def step():
+        y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+        loss = y.sum()
+        loss.backward()
+        w.clear_grad()
+        b.clear_grad()
+
+    for _ in range(WARMUP):
+        step()
+    d0 = dispatch_cache_stats()
+    c0 = chain_fusion_stats()
+    for _ in range(MEASURE):
+        step()
+    d1 = dispatch_cache_stats()
+    c1 = chain_fusion_stats()
+
+    failures = []
+    retraces = (d1["retraces"] - d0["retraces"]) \
+        + (c1["retraces"] - c0["retraces"])
+    if retraces:
+        failures.append(
+            f"{retraces} post-warmup retrace(s): the executable cache is "
+            "re-tracing a hot loop (PR 1 regression)")
+    attempts = (c1["fused_replays"] - c0["fused_replays"]) \
+        + (c1["fallback_splits"] - c0["fallback_splits"])
+    replays = c1["fused_replays"] - c0["fused_replays"]
+    if replays == 0:
+        failures.append(
+            "chain-fusion replay rate is zero with fusion enabled "
+            f"(attempts={attempts}, detected={c1['chains_detected']}): the "
+            "hot sequence is not being fused (PR 2 regression)")
+
+    print(f"perf_smoke: post-warmup retraces={retraces}, "
+          f"fused replays={replays}/{MEASURE} iterations, "
+          f"launches_saved={c1['launches_saved'] - c0['launches_saved']}")
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        return 1
+    print("perf_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
