@@ -129,9 +129,11 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     # record's dispatch_cache / chain_fusion blocks cover exactly this run
     # (retries incl.)
     from paddle_tpu.profiler import (reset_dispatch_cache_stats,
-                                     reset_chain_fusion_stats)
+                                     reset_chain_fusion_stats,
+                                     reset_step_fusion_stats)
     reset_dispatch_cache_stats()
     reset_chain_fusion_stats()
+    reset_step_fusion_stats()
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -167,10 +169,13 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     platform = jax.devices()[0].platform
     tdir = _trace(trace_tag, platform, lambda: float(step(x, y)))
 
-    # eager-dispatch cache + chain-fusion telemetry (hits/misses/retraces,
-    # fused replays/splits/launches saved): future BENCH rounds diff these
-    # blocks to catch retrace and fusion regressions
-    from paddle_tpu.profiler import dispatch_cache_stats, chain_fusion_stats
+    # eager-dispatch cache + chain-fusion + whole-step-fusion telemetry
+    # (hits/misses/retraces, fused replays/splits/launches saved): future
+    # BENCH rounds diff these blocks to catch retrace and fusion
+    # regressions (step_fusion stays zero on the explicit TrainStep path —
+    # nonzero values here would mean eager leaked into the compiled loop)
+    from paddle_tpu.profiler import (dispatch_cache_stats,
+                                     chain_fusion_stats, step_fusion_stats)
 
     return {
         "metric": metric,
@@ -182,7 +187,8 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
                   "batch": batch, "seq": seq, "params": n_params,
                   "platform": platform, "trace": tdir,
                   "dispatch_cache": dispatch_cache_stats(),
-                  "chain_fusion": chain_fusion_stats()},
+                  "chain_fusion": chain_fusion_stats(),
+                  "step_fusion": step_fusion_stats()},
     }
 
 

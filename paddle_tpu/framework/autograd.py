@@ -18,8 +18,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
-    "GradNode", "FusedChainNode", "AccumulationNode", "run_backward", "grad",
-    "no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled",
+    "GradNode", "FusedChainNode", "FusedStepNode", "AccumulationNode",
+    "run_backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled",
 ]
 
 _state = threading.local()
@@ -224,6 +225,36 @@ class FusedChainNode(GradNode):
         """(op name, local output index) of a flattened chain output."""
         pos, local = self.owners[out_index]
         return self.op_names[pos], local
+
+
+class FusedStepNode(GradNode):
+    """Tape node recorded on the ROOT output (the loss) of a fused
+    whole-step replay (ops/step_fusion.py auto-TrainStep).
+
+    A fused step consumes its own backward: the gradients were computed
+    inside the whole-step executable and the parameters are already
+    updated, so this node exists only to make the root tensor LOOK like a
+    backward-consumed output — `is_leaf` is False, diagnostics name the
+    fused step — and to turn a second `.backward()` into a clear error
+    instead of a silent no-op (the unfused tape errors there too: the
+    graph is released after a non-retained backward)."""
+
+    __slots__ = ("step_label",)
+
+    def __init__(self, step_label, out_aval):
+        super().__init__(f"fused_step({step_label})", self._consumed,
+                         (), (out_aval,))
+        self.step_label = step_label
+        self.fwd_fn = _RELEASED   # replay sees "spent", like any released op
+
+    @staticmethod
+    def _consumed(_g, donate=False):
+        raise RuntimeError(
+            "this tensor was produced by a fused whole-step replay "
+            "(auto-TrainStep): its backward already ran inside the fused "
+            "executable and the graph is consumed. Re-run with "
+            "FLAGS_eager_step_fusion=False (or retain_graph semantics) if "
+            "a second backward is required")
 
 # ---------------------------------------------------------------------------
 # saved-tensors hooks (reference: python/paddle/autograd

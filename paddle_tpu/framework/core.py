@@ -144,6 +144,12 @@ class Tensor:
         return self._grad_node
 
     def backward(self, grad_tensor=None, retain_graph=False):
+        # whole-step fusion (ops/step_fusion.py) may consume this backward
+        # as part of a fused train-step replay — before anything touches
+        # _grad_node, which would force a pending placeholder
+        from ..ops.step_fusion import STEP as _step_fusion
+        if _step_fusion.on_backward(self, grad_tensor, retain_graph):
+            return
         if self.stop_gradient and self._grad_node is None:
             raise RuntimeError(
                 "Tensor.backward() called on a tensor with stop_gradient=True "

@@ -117,6 +117,56 @@ define_flag("FLAGS_eager_chain_cache_size", 128,
             "LRU capacity (chains) of the fused-chain executable cache; "
             "least-recently-replayed chains are evicted past this size. "
             "0 disables chain fusion (same semantics as the flag off)")
+define_flag("FLAGS_eager_chain_stitching", True,
+            "stitch adjacent hot chains whose boundary wiring matches into "
+            "one longer chain: when chain B replays on the very next "
+            "dispatch after chain A fired and B's external inputs wire to "
+            "A's outputs, A+B is registered as a single chain — so "
+            "sequences longer than the rolling detection window (whole "
+            "transformer blocks) fuse into one launch without growing "
+            "detection cost. Stitched chains obey every chain-fusion "
+            "invalidation and fallback rule")
+
+# Whole-step eager fusion (ops/step_fusion.py), the layer above chain
+# fusion: a stable per-step cycle — forward ops, `loss.backward()`,
+# optimizer `step()`/`clear_grad()` — repeated identically for
+# FLAGS_eager_step_fusion_min_count iterations is promoted to ONE fused
+# executable (forward + backward + grad clip/regularization + optimizer
+# update) with donated optimizer-slot buffers: the auto-TrainStep. Replay
+# is speculative and transactional exactly like chain fusion — any
+# cycle-shape mismatch, a mid-step value peek, a changed optimizer/param
+# set, or an execution fault splits back to chain/per-op dispatch with
+# bitwise-identical numerics. The LR-schedule value and the optimizer step
+# count are hoisted to scalar arguments, so schedulers never split.
+# Telemetry: paddle_tpu.profiler.step_fusion_stats(); bench.py embeds it
+# as the `step_fusion` block.
+define_flag("FLAGS_eager_step_fusion", True,
+            "promote a stable eager fwd+bwd+optimizer cycle to one fused "
+            "whole-step executable (auto-TrainStep). Falls back to "
+            "chain/per-op dispatch with identical numerics whenever the "
+            "cycle diverges; requires the per-op cache "
+            "(FLAGS_eager_op_cache with a nonzero cache size) to key the "
+            "cycle's ops")
+define_flag("FLAGS_eager_step_fusion_min_count", 40,
+            "cycle-stability threshold: the per-step op/backward/optimizer "
+            "cycle must repeat identically this many consecutive times "
+            "before the whole-step executable is compiled. Whole-step "
+            "compiles cost O(seconds) and the observation pass is cheap, "
+            "so the default only promotes genuinely steady training loops; "
+            "lower it in micro-benchmarks with a short warmup")
+define_flag("FLAGS_eager_step_fusion_cache_size", 8,
+            "LRU capacity (promoted step programs) kept per thread so a "
+            "loop that temporarily diverges and re-stabilizes reuses its "
+            "compiled whole-step executable instead of recompiling. 0 "
+            "disables step fusion")
+define_flag("FLAGS_eager_step_fusion_donate_params", False,
+            "EXPERIMENTAL: donate parameter buffers (in addition to the "
+            "optimizer-slot buffers, which are always donated exactly as "
+            "the eager optimizer's own fused update donates them) to the "
+            "whole-step executable. Off by default for the same aliasing "
+            "hazard as jit.TrainStep's donate='all': user-held aliases of "
+            "p._value (detach() shares storage) would be invalidated. "
+            "Donation is a warn-and-skip no-op on CPU")
 
 
 class _FlagsView:

@@ -27,11 +27,16 @@ class _GlobalGenerator:
         self._lock = threading.Lock()
         self._key = None
         self.initial_seed = seed_val
+        # whether the user explicitly seeded (paddle.seed): consumers that
+        # want "deterministic iff seeded" semantics (DataLoader worker
+        # seeding) check this instead of guessing from the value
+        self.seeded = False
 
     def manual_seed(self, seed_val: int):
         with self._lock:
             self._key = jax.random.key(int(seed_val))
             self.initial_seed = int(seed_val)
+            self.seeded = True
         return self
 
     def next_key(self):

@@ -16,9 +16,11 @@ which XLA pipelines.
 """
 from __future__ import annotations
 
+import itertools
 import multiprocessing
 import queue
 import threading
+from time import monotonic as _monotonic
 
 import numpy as np
 
@@ -68,9 +70,12 @@ def _worker_loop(dataset, task_q, result_q, worker_id, worker_init_fn,
     (batch_idx, payload) — numpy only."""
     global _worker_info
     # per-worker distinct seed (reference: base_seed + worker_id), so
-    # random augmentations differ across workers
-    _worker_info = WorkerInfo(worker_id, num_workers, dataset,
-                              seed=base_seed + worker_id)
+    # random augmentations differ across workers but are reproducible for
+    # a given worker index (base_seed derives from the framework seed, not
+    # time/pid)
+    seed = (base_seed + worker_id) % (2 ** 31)
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed=seed)
+    np.random.seed(seed)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -87,6 +92,9 @@ def _worker_loop(dataset, task_q, result_q, worker_id, worker_init_fn,
 
 
 _WORKER_CTX = None
+# monotonic epoch counter feeding per-producer base seeds (deterministic,
+# unlike SeedSequence entropy)
+_epoch_counter = itertools.count()
 
 
 def _worker_context():
@@ -124,7 +132,18 @@ class _MultiprocessProducer:
         self._timeout = timeout
         self._depth = max(1, num_workers * max(prefetch_factor, 1))
         self._workers = []
-        base_seed = int(np.random.SeedSequence().entropy % (2 ** 31))
+        # deterministic per-worker seeding: a SEEDED program (paddle.seed)
+        # derives the base seed from the framework seed plus an epoch
+        # counter — NOT from time/pid entropy — so worker k's augmentation
+        # stream is reproducible run-to-run; an unseeded program keeps
+        # per-run entropy (independent hyper-parameter workers must not
+        # all see the same "random" augmentations)
+        from ..framework.random import default_generator
+        if default_generator.seeded:
+            base_seed = (int(default_generator.initial_seed) * 1000003
+                         + next(_epoch_counter) * 10007) % (2 ** 31)
+        else:
+            base_seed = int(np.random.SeedSequence().entropy % (2 ** 31))
         for w in range(num_workers):
             p = ctx.Process(target=_worker_loop,
                             args=(dataset, self._task_q, self._result_q, w,
@@ -180,6 +199,18 @@ class _MultiprocessProducer:
             self.close()
 
     def close(self):
+        # graceful first: sentinels let a worker still inside startup run
+        # its worker_init_fn and exit cleanly (terminate() could kill it
+        # BEFORE init ran — the old worker_init flake); stragglers are
+        # terminated after a bounded join
+        for _ in self._workers:
+            try:
+                self._task_q.put(None)
+            except Exception:
+                break
+        deadline = _monotonic() + 5.0
+        for p in self._workers:
+            p.join(timeout=max(0.1, deadline - _monotonic()))
         for p in self._workers:
             if p.is_alive():
                 p.terminate()

@@ -20,7 +20,30 @@ from ..framework.core import Tensor
 from ..framework import random as _random
 from ..framework.autograd import set_grad_enabled
 
-__all__ = ["TrainStep"]
+__all__ = ["TrainStep", "bake_decay_flags", "donation_argnums"]
+
+
+def bake_decay_flags(opt, params):
+    """Prime the optimizer's per-param weight-decay flag list for a traced
+    update: AdamW/Lamb/Lars `_single_update` implementations consume
+    `_current_decay_flags` in parameter order at trace time, so any builder
+    that jit-compiles `_single_update` over a parameter list (TrainStep and
+    the eager auto-TrainStep in ops/step_fusion.py) must bake them first."""
+    if hasattr(opt, "_decay_skip"):
+        opt._current_decay_flags = [p.name not in opt._decay_skip
+                                    for p in params]
+    elif hasattr(opt, "_decay_flags"):
+        opt._current_decay_flags = [opt._decay_flags.get(p.name, True)
+                                    for p in params]
+
+
+def donation_argnums(donate_params, params_pos, accs_pos):
+    """Donation spec shared by TrainStep and the eager auto-TrainStep:
+    optimizer-slot (accumulator) buffers are always donated — exactly what
+    the eager optimizer's own fused update does — while parameter buffers
+    are only donated on request, because user-held aliases of `p._value`
+    (detach() shares storage) would be invalidated."""
+    return (params_pos, accs_pos) if donate_params else (accs_pos,)
 
 
 class TrainStep:
@@ -72,12 +95,7 @@ class TrainStep:
                     b._value = v
 
         # bake per-param decay flags for AdamW/Lamb before tracing
-        if hasattr(opt, "_decay_skip"):
-            opt._current_decay_flags = [p.name not in opt._decay_skip
-                                        for p in params]
-        elif hasattr(opt, "_decay_flags"):
-            opt._current_decay_flags = [opt._decay_flags.get(p.name, True)
-                                        for p in params]
+        bake_decay_flags(opt, params)
 
         def step(pvals, accs, bvals, args, lr, step_count, key):
             (loss, new_b), grads = jax.value_and_grad(
@@ -96,8 +114,12 @@ class TrainStep:
         # user-held aliases of p._value (detach() shares storage). Pass
         # donate="all" for maximum-memory-efficiency training loops that
         # never alias parameters.
-        donate = (0, 1, 2) if self._donate == "all" else \
-            ((1,) if self._donate else ())
+        if self._donate == "all":
+            donate = donation_argnums(True, 0, 1) + (2,)
+        elif self._donate:
+            donate = donation_argnums(False, 0, 1)
+        else:
+            donate = ()
         self._jitted = jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *args):

@@ -19,6 +19,17 @@ from ...ops.registry import register_op
 __all__ = ["scaled_dot_product_attention"]
 
 
+class _ShapeMeta:
+    """Shape/ndim view for kernel eligibility checks that must not force a
+    deferred fusion placeholder's buffer."""
+
+    __slots__ = ("ndim", "shape")
+
+    def __init__(self, ndim, shape):
+        self.ndim = ndim
+        self.shape = shape
+
+
 def _plain_attention(q, k, v, mask, is_causal, scale, dropout_p=0.0,
                      dropout_key=None):
     # q,k,v: [B, N, H, D] (paddle layout: batch, seq, heads, head_dim)
@@ -90,9 +101,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     eff_dropout = dropout_p if training else 0.0
     from ...kernels import flash_attention as fa
+    # eligibility only needs shapes: answer from tensor meta (aval-safe on
+    # deferred fusion placeholders) instead of forcing q/k/v buffers
+    _shape_of = lambda t: _ShapeMeta(t.ndim, tuple(t.shape))
     if use_flash_attention is not False and \
-            fa.is_eligible(q._value, k._value, v._value, mask_v, eff_dropout,
-                           is_causal=is_causal):
+            fa.is_eligible(_shape_of(q), _shape_of(k), _shape_of(v), mask_v,
+                           eff_dropout, is_causal=is_causal):
         def fn(qq, kk, vv):
             return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
                                            scale=scale)

@@ -105,15 +105,17 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     x = ensure_tensor(x)
     weight = ensure_tensor(weight)
-    idx = x._value
 
-    def fn(w):
+    # the ids are a dispatch INPUT (not a closure capture): closing over
+    # the per-batch array would make every lookup un-keyable, bypassing
+    # the per-op executable cache and poisoning chain/step fusion cycles
+    def fn(idx, w):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None and padding_idx >= 0:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
-    return unary("embedding", fn, weight)
+    return binary("embedding", fn, x, weight)
 
 
 @register_op("one_hot", "nn", differentiable=False)

@@ -48,12 +48,16 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             (input, label, ensure_tensor(weight))
         return call_op("cross_entropy", _apply_reduction(fn, reduction), args)
 
-    lab_v = label._value
-    if lab_v.ndim == input.ndim and lab_v.shape[axis] == 1:
-        lab_v = jnp.squeeze(lab_v, axis)
+    # shape-only peek (aval-safe: must not force a deferred placeholder)
+    if label.ndim == input.ndim and label.shape[axis] == 1:
+        from ...ops.manipulation import squeeze as _squeeze
+        label = _squeeze(label, axis)
 
-    def fn(logits, *w):
-        lab_idx = jnp.clip(lab_v, 0, n_classes - 1).astype(jnp.int32)
+    # labels are a dispatch INPUT (not a closure capture): closing over the
+    # per-batch array would make every loss un-keyable, bypassing the
+    # per-op cache and poisoning chain/step fusion cycles
+    def fn(logits, raw_lab, *w):
+        lab_idx = jnp.clip(raw_lab, 0, n_classes - 1).astype(jnp.int32)
         from ...kernels import cross_entropy as fused_ce
         if (not w and label_smoothing == 0.0 and use_softmax
                 and logits.ndim == 2 and axis in (-1, 1)
@@ -61,7 +65,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 and fused_ce.is_eligible(logits, lab_idx)):
             # vocab-blocked Pallas kernel: no [rows, V] log-softmax in HBM
             nll = fused_ce.fused_softmax_cross_entropy(logits, lab_idx)
-            return fused_ce.masked_reduce(nll, lab_v, ignore_index, reduction)
+            return fused_ce.masked_reduce(nll, raw_lab, ignore_index,
+                                          reduction)
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
             else jnp.log(jnp.clip(logits, 1e-30, None))
         picked = jnp.take_along_axis(
@@ -72,7 +77,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             nll = -(1.0 - label_smoothing) * picked - label_smoothing * smooth
         else:
             nll = -picked
-        valid = (lab_v != ignore_index)
+        valid = (raw_lab != ignore_index)
         nll = jnp.where(valid, nll, 0.0)
         if w:
             cw = jnp.take(w[0], lab_idx, axis=0)
@@ -87,7 +92,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             return jnp.sum(nll)
         return nll
 
-    args = (input,) if weight is None else (input, ensure_tensor(weight))
+    args = (input, label) if weight is None else \
+        (input, label, ensure_tensor(weight))
     return call_op("cross_entropy", fn, args)
 
 

@@ -166,6 +166,15 @@ def _token_of(v, depth):
         return _UNKEYABLE
     if isinstance(v, _SAFE_SCALARS) or isinstance(v, enum.Enum):
         return v
+    if isinstance(v, slice):
+        # slice objects are unhashable (3.10) but value-like: token their
+        # (start, stop, step) so indexing ops (ops/manipulation.py slice /
+        # strided_slice close over jnp.s_ tuples) stay cacheable
+        parts = tuple(_token_of(p, depth + 1)
+                      for p in (v.start, v.stop, v.step))
+        if any(p is _UNKEYABLE for p in parts):
+            return _UNKEYABLE
+        return ("slice",) + parts
     if isinstance(v, (tuple, list)):
         items = tuple(_token_of(i, depth + 1) for i in v)
         if any(i is _UNKEYABLE for i in items):
@@ -185,12 +194,30 @@ def _token_of(v, depth):
     return _UNKEYABLE
 
 
+def _stable_library_fn(fn):
+    """Module-level functions of the jax/numpy libraries are stable
+    singletons: their behavior cannot change under an identity key, so they
+    token by identity instead of a deep code/closure/globals scan — the
+    same contract _globals_token applies to module-level defs. (Without
+    this, a closure cell holding e.g. `lax.max` — pooling reducers — walks
+    into jax internals and marks the whole op un-keyable.)"""
+    import sys
+    mod = getattr(fn, "__module__", None) or ""
+    if not (mod in ("jax", "numpy") or mod.startswith(("jax.", "numpy."))):
+        return False
+    m = sys.modules.get(mod)
+    return m is not None and \
+        getattr(m, getattr(fn, "__qualname__", ""), None) is fn
+
+
 def _fn_token(fn, depth=0):
     """Value-identity for an op implementation: code object plus closure
     cell / default tokens. Returns _UNKEYABLE when the fn cannot be keyed
     safely (→ the call bypasses the cache)."""
     if depth > 4:
         return _UNKEYABLE
+    if isinstance(fn, types.FunctionType) and _stable_library_fn(fn):
+        return fn
     if isinstance(fn, functools.partial):
         inner = _fn_token(fn.func, depth + 1)
         args = _token_of(tuple(fn.args), depth + 1)
@@ -366,6 +393,8 @@ def clear_dispatch_cache():
             pass
     if _fusion_mod is not None:
         _fusion_mod.clear_chain_cache()
+    if _step_fusion_mod is not None:
+        _step_fusion_mod.clear_step_cache()
 
 
 def dispatch_cache_info():
@@ -500,10 +529,12 @@ def _slow_vjp(fn, vals, diff_idx, n_in, multi):
 # the funnel
 # ---------------------------------------------------------------------------
 
-# ops/fusion.py, resolved on first dispatch (lazy: fusion imports
-# framework.core/autograd, and importing it at module top would order the
-# package init around the funnel instead of the other way around)
+# ops/fusion.py + ops/step_fusion.py, resolved on first dispatch (lazy:
+# both import framework.core/autograd, and importing them at module top
+# would order the package init around the funnel instead of the other way
+# around)
 _fusion_mod = None
+_step_fusion_mod = None
 
 
 def _fusion():
@@ -512,6 +543,14 @@ def _fusion():
         from . import fusion
         _fusion_mod = fusion
     return _fusion_mod
+
+
+def _step_fusion():
+    global _step_fusion_mod
+    if _step_fusion_mod is None:
+        from . import step_fusion
+        _step_fusion_mod = step_fusion
+    return _step_fusion_mod
 
 
 def _prologue(name, fn, inputs):
@@ -548,14 +587,26 @@ def _dispatch(name, fn, inputs, num_outputs):
         _STATS.bypass(name)
 
     fus = _fusion()
+    sf = _step_fusion()
     if debug:
         # debug modes need materialized outputs op-by-op: resolve any
-        # pending chain and keep fusion out of the way for this call
+        # pending replay and keep both fusion layers out of the way
+        sf.STEP.interrupt()
         fus.MANAGER.flush()
         fus.MANAGER.reset()
     else:
+        # whole-step replay gets first crack: while it is matching, the
+        # chain layer is quiescent (the fused step IS the chain)
+        res = sf.STEP.step(name, fn, inputs, num_outputs, key, diff_mask)
+        if res is not sf.MISS:
+            return res
         res = fus.MANAGER.step(name, fn, inputs, num_outputs, key, diff_mask)
         if res is not fus.MISS:
+            # chain-deferred ops still feed the step-cycle recorder: the
+            # placeholders carry avals, so nothing materializes
+            sf.STEP.record(name, fn, inputs, num_outputs, key, diff_mask,
+                           tuple(res) if num_outputs is not None else (res,),
+                           cached_ok=True)
             return res
 
     t0 = time.perf_counter_ns()
@@ -620,10 +671,16 @@ def _dispatch(name, fn, inputs, num_outputs):
 
 def _record_dispatch(fus, cached_ok, debug, name, fn, inputs, num_outputs,
                      key, diff_mask, outs, t0):
-    """Feed the chain detector after the per-op path ran. Only dispatches
-    that went through the executable cache are chain material; an uncached
-    or un-keyable call breaks the stream (debug calls already reset it)."""
-    if debug or key is None:
+    """Feed the chain detector and the step-cycle recorder after the
+    per-op path ran. Only dispatches that went through the executable
+    cache are fusion material; an uncached or un-keyable call breaks the
+    chain stream and poisons the step cycle (debug calls already reset
+    both)."""
+    if debug:
+        return
+    _step_fusion().STEP.record(name, fn, inputs, num_outputs, key,
+                               diff_mask, tuple(outs), cached_ok=cached_ok)
+    if key is None:
         return
     if cached_ok:
         fus.MANAGER.record(name, fn, inputs, num_outputs, key, diff_mask,

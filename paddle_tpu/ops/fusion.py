@@ -66,6 +66,11 @@ _MAX_COUNTS = 2048
 _MAX_INTERN = 4096
 # consecutive failed replays before a chain is deactivated
 _MAX_FAIL_STREAK = 8
+# stitched-chain length cap: two adjacent hot chains are stitched into one
+# longer chain (and stitched chains stitch again), so whole transformer
+# blocks fuse without growing the _WINDOW detection cost; past this many ops
+# the XLA compile time stops amortizing
+_STITCH_MAX_OPS = 96
 
 # slot descriptors of the base Tensor: lets _DeferredTensor shadow `_value`
 # / `_grad_node` / `_out_index` with escape-detecting properties while still
@@ -102,9 +107,12 @@ class _DeferredTensor(Tensor):
 
     # -- escape detection ---------------------------------------------------
     def _force(self):
+        # the pending's OWNER resolves it: the chain manager for chain
+        # replays, the step-fusion manager (ops/step_fusion.py) for
+        # whole-step replays — placeholders are shared between the layers
         pending = self._pending_chain
         if pending is not None:
-            MANAGER.resolve_pending(pending, escape=True)
+            pending.owner.resolve_pending(pending, escape=True)
 
     @property
     def _value(self):
@@ -346,6 +354,73 @@ def _make_chain_vjp(vjp_partial, diff_idx, n_ext):
     return wrapped
 
 
+def replay_ops_per_op(ops, ext_vals, ext_edges, placeholders, upto,
+                      skip_materialized=False):
+    """Materialize the first `upto` deferred ops through the per-op cached
+    dispatch path, filling their placeholders with values and real
+    GradNodes — the transactional-fallback core shared by chain splits and
+    step-fusion splits/recomputes (ops/step_fusion.py). Results are
+    bitwise-identical to what unfused dispatch would have produced.
+
+    `skip_materialized` leaves placeholders that already hold a value AND a
+    grad node untouched (post-fire lazy recompute must not overwrite the
+    fused root's value or node)."""
+    from .dispatch import _cached_call, _slow_vjp, _make_cached_vjp
+    for i in range(upto):
+        op = ops[i]
+        in_vals = []
+        in_edges = []
+        for k, src in enumerate(op.arg_srcs):
+            if src[0] == "e":
+                in_vals.append(ext_vals[src[1]])
+                in_edges.append(ext_edges[src[1]])
+            else:
+                prev = placeholders[src[1]][src[2]]
+                in_vals.append(_VALUE_SLOT.__get__(prev))
+                if op.diff_mask is not None and op.diff_mask[k]:
+                    in_edges.append((_NODE_SLOT.__get__(prev),
+                                     _IDX_SLOT.__get__(prev)))
+                else:
+                    in_edges.append(None)
+        in_vals = tuple(in_vals)
+        multi = op.num_outputs is not None
+        if op.diff_mask is None:
+            ok, out_vals = _cached_call(op.key, op.name, op.fn,
+                                        None, in_vals)
+            if not ok:
+                out_vals = op.fn(*in_vals)
+            outs_flat = out_vals if multi else (out_vals,)
+            node = None
+        else:
+            diff_idx = tuple(k for k, d in enumerate(op.diff_mask) if d)
+            ok, res = _cached_call(op.key, op.name, op.fn, diff_idx,
+                                   in_vals)
+            if ok:
+                out_vals, vjp_partial = res
+                wrapped = _make_cached_vjp(vjp_partial, diff_idx,
+                                           len(in_vals), multi)
+            else:
+                out_vals, wrapped = _slow_vjp(op.fn, in_vals, diff_idx,
+                                              len(in_vals), multi)
+            outs_flat = out_vals if multi else (out_vals,)
+            node = GradNode(op.name, wrapped, in_edges,
+                            tuple((v.shape, v.dtype) for v in outs_flat))
+            node.fwd_fn = op.fn
+            node.in_vals, node.unpack_hook = _pack_saved(in_vals, in_edges)
+        for j, t in enumerate(placeholders[i]):
+            if skip_materialized \
+                    and _VALUE_SLOT.__get__(t) is not _PENDING \
+                    and _NODE_SLOT.__get__(t) is not None:
+                t._pending_chain = None
+                continue
+            if _VALUE_SLOT.__get__(t) is _PENDING:
+                _VALUE_SLOT.__set__(t, outs_flat[j])
+            if node is not None:
+                _NODE_SLOT.__set__(t, node)
+                _IDX_SLOT.__set__(t, j)
+            t._pending_chain = None
+
+
 class _PendingChain:
     """Replay in flight: ops deferred so far and their placeholders.
 
@@ -356,7 +431,8 @@ class _PendingChain:
     one."""
 
     __slots__ = ("chain", "pos", "ext_vals", "ext_edges", "placeholders",
-                 "t0", "done", "lock")
+                 "t0", "done", "lock", "owner", "prev_fire", "gap",
+                 "gap_outs", "boundary")
 
     def __init__(self, chain):
         self.chain = chain
@@ -367,6 +443,15 @@ class _PendingChain:
         self.t0 = time.perf_counter_ns()
         self.done = False
         self.lock = threading.RLock()   # reentrant: _fire's fault path splits
+        self.owner = MANAGER
+        # stitching state: the preceding fired chain replay plus the per-op
+        # records dispatched between it and this replay (set when nothing
+        # else intervened), and per ext slot the ("A", i, j) / ("G", g, j)
+        # coordinate in that fired chain / gap the input came from
+        self.prev_fire = None
+        self.gap = ()
+        self.gap_outs = {}
+        self.boundary = []
 
 
 class _Recorded:
@@ -374,7 +459,7 @@ class _Recorded:
 
     __slots__ = ("key_id", "name", "key", "fn", "wiring_abs", "diff_mask",
                  "num_outputs", "out_avals", "out_stop_grads", "outs",
-                 "abs_pos", "dur_ns")
+                 "ins", "abs_pos", "dur_ns")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -388,6 +473,9 @@ class _TLS(threading.local):
         self.pending = None
         self.counter = 0       # abs position of the next recorded dispatch
         self.busy = False
+        self.serial = 0        # every keyable dispatch this thread has seen
+        self.last_fire = None  # (pending, serial of its last deferred op)
+        self.stitch_gap = []   # per-op records dispatched since last_fire
 
 
 class _FusionManager:
@@ -408,6 +496,10 @@ class _FusionManager:
     def enabled():
         return bool(_FLAGS.get("FLAGS_eager_chain_fusion")) \
             and int(_FLAGS.get("FLAGS_eager_chain_cache_size", 128) or 0) > 0
+
+    @staticmethod
+    def stitching_enabled():
+        return bool(_FLAGS.get("FLAGS_eager_chain_stitching", True))
 
     # -- key interning -----------------------------------------------------
     def _intern_key(self, key):
@@ -440,17 +532,23 @@ class _FusionManager:
             # un-keyable op: chains cannot cross it
             self.flush()
             self._reset_window(st)
+            st.last_fire = None
+            st.stitch_gap = []
             return MISS
         kid = self._intern_key(key)
+        st.serial += 1
 
-        # resolve placeholders owned by OTHER threads' pending chains before
-        # taking our own pending lock: _defer reads ext inputs' values, and
-        # forcing a foreign placeholder while holding our lock while that
-        # thread forces one of ours would be an ABBA deadlock. Pre-forcing
-        # is the same escape split, just ordered lock-free.
+        # resolve placeholders owned by OTHER threads' pending chains (or by
+        # a fired step-fusion replay) before taking our own pending lock:
+        # _defer reads ext inputs' values, and forcing a foreign placeholder
+        # while holding our lock while that thread forces one of ours would
+        # be an ABBA deadlock. Pre-forcing is the same escape split, just
+        # ordered lock-free. The stitching boundary chain (last_fire) is
+        # exempt: its placeholders are already materialized.
         for t in inputs:
             if _is_pending(t) and t._pending_chain is not st.pending:
-                self.resolve_pending(t._pending_chain, escape=True)
+                t._pending_chain.owner.resolve_pending(t._pending_chain,
+                                                       escape=True)
 
         if st.pending is not None:
             pending = st.pending
@@ -471,6 +569,18 @@ class _FusionManager:
         chain = self._lookup_start(kid, key)
         if chain is not None:
             pending = st.pending = _PendingChain(chain)
+            if st.last_fire is not None and self.stitching_enabled() \
+                    and st.last_fire[1] + len(st.stitch_gap) + 1 \
+                    == st.serial:
+                # this replay follows a fire with only recorded per-op
+                # dispatches (the gap) in between: candidate for stitching
+                # fire + gap + this chain into one longer chain
+                pending.prev_fire = st.last_fire[0]
+                pending.gap = tuple(st.stitch_gap)
+                pending.gap_outs = {
+                    id(t): (g, j)
+                    for g, rec in enumerate(pending.gap)
+                    for j, t in enumerate(rec.outs)}
             return self._defer(st, pending, chain.ops[0], inputs,
                                num_outputs)
         return MISS
@@ -495,7 +605,17 @@ class _FusionManager:
             wiring_abs=wiring_abs, diff_mask=diff_mask,
             num_outputs=num_outputs, out_avals=out_avals,
             out_stop_grads=tuple(t.stop_gradient for t in outs),
-            outs=tuple(outs), abs_pos=abs_pos, dur_ns=dur_ns)
+            outs=tuple(outs), ins=tuple(inputs), abs_pos=abs_pos,
+            dur_ns=dur_ns)
+        if st.last_fire is not None:
+            # per-op dispatches between two chain replays are stitch
+            # material: they join the two chains as internal ops of the
+            # stitched result. A gap longer than the window stops being a
+            # plausible single hot sequence — drop the anchor.
+            st.stitch_gap.append(rec)
+            if len(st.stitch_gap) > _WINDOW:
+                st.last_fire = None
+                st.stitch_gap = []
         st.window.append(rec)
         for j, t in enumerate(outs):
             st.produced[id(t)] = (abs_pos, j)
@@ -508,8 +628,12 @@ class _FusionManager:
 
     def reset(self):
         """An un-keyable / un-jittable op broke the stream: drop the window
-        (chains cannot span it)."""
-        self._reset_window(self._tls)
+        (chains cannot span it) and the stitch anchor (the broken stream
+        does not bump the serial, so adjacency could otherwise lie)."""
+        st = self._tls
+        self._reset_window(st)
+        st.last_fire = None
+        st.stitch_gap = []
 
     def flush(self):
         """Resolve any pending chain on this thread (split if incomplete)."""
@@ -565,12 +689,18 @@ class _FusionManager:
                      rec.num_outputs, rec.out_avals, rec.out_stop_grads)
             for rec, (_kid, wiring) in zip(recs, sig)]
         chain = Chain(sig, ops, sum(r.dur_ns for r in recs))
+        if self._insert_chain(sig, chain):
+            CHAIN_STATS.detected(chain.label)
+
+    def _insert_chain(self, sig, chain):
+        """Registry insertion + LRU eviction, shared by window detection and
+        stitching. Returns False when `sig` is already registered."""
         with self._lock:
             if sig in self._chains:
-                return
+                return False
             self._chains[sig] = chain
             self._chains.move_to_end(sig)
-            chain.head_kid = self._intern.get(ops[0].key)
+            chain.head_kid = self._intern.get(chain.ops[0].key)
             self._heads.setdefault(chain.head_kid, []).append(chain)
             cap = int(_FLAGS.get("FLAGS_eager_chain_cache_size", 128) or 0)
             while len(self._chains) > max(cap, 1):
@@ -592,7 +722,88 @@ class _FusionManager:
                     _, old = self._chains.popitem(last=False)
                 self._drop_head(old)
                 CHAIN_STATS.evictions += 1
-        CHAIN_STATS.detected(chain.label)
+        return True
+
+    def _register_stitched(self, prev_pending, pending):
+        """Window stitching: a fired chain, the per-op dispatches that
+        followed it (the gap), and the chain that replayed right after
+        become ONE longer chain when their boundary wiring connects.
+
+        `pending.boundary[slot]` maps each ext slot of the second chain to
+        its source — ("A", i, j) = previous chain output, ("G", g, j) = gap
+        op output, None = genuinely external — and each gap record's inputs
+        are resolved the same way at stitch time. The stitched chain keeps
+        the first chain's ops 0..nA-1, appends the gap ops rebased by nA and
+        the second chain's ops rebased by nA+nG, rewiring every boundary
+        edge as an internal `("prev", i, j)`. It is registered like any
+        detected chain — `_lookup_start` prefers the longest viable chain
+        from a head key, so the next iteration replays the whole stitched
+        sequence in one launch (and stitching composes: stitched chains
+        stitch again, so whole transformer blocks converge to a single
+        launch without growing the rolling-window detection cost). A
+        stitched replay counts launches-saved once for the whole sequence;
+        the constituent chains stop replaying, so telemetry never
+        double-counts."""
+        a, b = prev_pending.chain, pending.chain
+        gap = pending.gap
+        n_a, n_g = len(a.ops), len(gap)
+        if a.dead or b.dead \
+                or n_a + n_g + len(b.ops) > _STITCH_MAX_OPS:
+            return
+        # every op of the stitched result must be reachable as one dataflow:
+        # require at least one edge from the gap or the second chain back
+        # into the fired chain, else the two replays are unrelated streams
+        touches_a = any(c is not None and c[0] == "A"
+                        for c in pending.boundary)
+        ops = []
+        for op in a.ops:
+            ops.append(_ChainOp(op.name, op.key, op.fn, op.wiring,
+                                op.diff_mask, op.num_outputs, op.out_avals,
+                                op.out_stop_grads))
+        abs_to_g = {rec.abs_pos: g for g, rec in enumerate(gap)}
+        for g, rec in enumerate(gap):
+            wiring = []
+            for k, w in enumerate(rec.wiring_abs):
+                if w[0] == "prev" and w[1] in abs_to_g:
+                    wiring.append(("prev", n_a + abs_to_g[w[1]], w[2]))
+                    continue
+                coord = self._fired_coord(prev_pending, rec.ins[k])
+                if coord is not None:
+                    wiring.append(("prev", coord[0], coord[1]))
+                    touches_a = True
+                else:
+                    wiring.append(("ext",))
+            ops.append(_ChainOp(rec.name, rec.key, rec.fn, tuple(wiring),
+                                rec.diff_mask, rec.num_outputs,
+                                rec.out_avals, rec.out_stop_grads))
+        if not touches_a:
+            return
+        base_b = n_a + n_g
+        boundary = pending.boundary
+        slot = 0
+        for op in b.ops:
+            wiring = []
+            for w in op.wiring:
+                if w[0] == "prev":
+                    wiring.append(("prev", w[1] + base_b, w[2]))
+                else:
+                    coord = boundary[slot]
+                    slot += 1
+                    if coord is None:
+                        wiring.append(("ext",))
+                    elif coord[0] == "A":
+                        wiring.append(("prev", coord[1], coord[2]))
+                    else:
+                        wiring.append(("prev", n_a + coord[1], coord[2]))
+            ops.append(_ChainOp(op.name, op.key, op.fn, tuple(wiring),
+                                op.diff_mask, op.num_outputs, op.out_avals,
+                                op.out_stop_grads))
+        sig = tuple((self._intern_key(op.key), op.wiring) for op in ops)
+        chain = Chain(sig, ops,
+                      a.baseline_ns + b.baseline_ns
+                      + sum(r.dur_ns for r in gap))
+        if self._insert_chain(sig, chain):
+            CHAIN_STATS.stitched(chain.label)
 
     def _drop_head(self, chain):
         lst = self._heads.get(chain.head_kid)
@@ -641,6 +852,8 @@ class _FusionManager:
         for k, t in enumerate(inputs):
             if op.wiring[k][0] != "ext":
                 continue
+            if pending.prev_fire is not None:
+                pending.boundary.append(self._boundary_coord(pending, t))
             pending.ext_vals.append(t._value)
             if op.diff_mask is not None and op.diff_mask[k]:
                 node = t._grad_node if t._grad_node is not None \
@@ -674,6 +887,35 @@ class _FusionManager:
                 self._split(pending, escape=escape)
         if st.pending is pending:
             st.pending = None
+
+    @staticmethod
+    def _fired_coord(prev, t):
+        """(op, out) coordinate of `t` in the fired replay `prev`, or None.
+        Identity-checked: a materialized placeholder keeps its _chain_coord,
+        and membership in the pending's placeholder table proves
+        ownership."""
+        if not isinstance(t, _DeferredTensor):
+            return None
+        coord = t._chain_coord
+        try:
+            if prev.placeholders[coord[0]][coord[1]] is t:
+                return coord
+        except (IndexError, AttributeError, TypeError):
+            pass
+        return None
+
+    @classmethod
+    def _boundary_coord(cls, pending, t):
+        """Where an ext input of a stitch-candidate replay came from:
+        ("A", i, j) = output of the fired previous chain, ("G", g, j) =
+        output of gap op g, None = genuinely external."""
+        coord = cls._fired_coord(pending.prev_fire, t)
+        if coord is not None:
+            return ("A",) + coord
+        gcoord = pending.gap_outs.get(id(t))
+        if gcoord is not None:
+            return ("G",) + gcoord
+        return None
 
     @staticmethod
     def _materialize(flat_idx, t, value, node):
@@ -737,6 +979,16 @@ class _FusionManager:
             elapsed = time.perf_counter_ns() - pending.t0
             CHAIN_STATS.replay(chain.label, len(chain.ops),
                                chain.baseline_ns - elapsed)
+            if pending.prev_fire is not None \
+                    and any(c is not None for c in pending.boundary):
+                self._register_stitched(pending.prev_fire, pending)
+            # drop the back-links before becoming the new stitch anchor —
+            # otherwise fired pendings form an ever-growing linked list
+            pending.prev_fire = None
+            pending.gap = ()
+            pending.gap_outs = {}
+            st.last_fire = (pending, st.serial)
+            st.stitch_gap = []
             # the detection window predates the fused regime and record()
             # no longer feeds it while ops defer: dropping it releases the
             # last pre-fusion dispatches' output buffers it pins (chains
@@ -754,7 +1006,6 @@ class _FusionManager:
         hold pending.lock (owner via step/flush, escapees via
         resolve_pending); the guard below makes a second resolution a
         no-op."""
-        from .dispatch import _cached_call, _slow_vjp, _make_cached_vjp
         st = self._tls
         chain = pending.chain
         if pending.done:
@@ -762,60 +1013,13 @@ class _FusionManager:
         owner = st.pending is pending   # escapes run on a foreign thread
         st.busy = True
         try:
-            ext = pending.ext_vals
-            for i in range(pending.pos):
-                op = chain.ops[i]
-                in_vals = []
-                in_edges = []
-                for k, src in enumerate(op.arg_srcs):
-                    if src[0] == "e":
-                        in_vals.append(ext[src[1]])
-                        in_edges.append(pending.ext_edges[src[1]])
-                    else:
-                        prev = pending.placeholders[src[1]][src[2]]
-                        in_vals.append(_VALUE_SLOT.__get__(prev))
-                        if op.diff_mask is not None and op.diff_mask[k]:
-                            in_edges.append((_NODE_SLOT.__get__(prev),
-                                             _IDX_SLOT.__get__(prev)))
-                        else:
-                            in_edges.append(None)
-                in_vals = tuple(in_vals)
-                multi = op.num_outputs is not None
-                if op.diff_mask is None:
-                    ok, out_vals = _cached_call(op.key, op.name, op.fn,
-                                                None, in_vals)
-                    if not ok:
-                        out_vals = op.fn(*in_vals)
-                    outs_flat = out_vals if multi else (out_vals,)
-                    node = None
-                else:
-                    diff_idx = tuple(k for k, d in enumerate(op.diff_mask)
-                                     if d)
-                    ok, res = _cached_call(op.key, op.name, op.fn, diff_idx,
-                                           in_vals)
-                    if ok:
-                        out_vals, vjp_partial = res
-                        wrapped = _make_cached_vjp(vjp_partial, diff_idx,
-                                                   len(in_vals), multi)
-                    else:
-                        out_vals, wrapped = _slow_vjp(op.fn, in_vals,
-                                                      diff_idx,
-                                                      len(in_vals), multi)
-                    outs_flat = out_vals if multi else (out_vals,)
-                    node = GradNode(op.name, wrapped, in_edges,
-                                    tuple((v.shape, v.dtype)
-                                          for v in outs_flat))
-                    node.fwd_fn = op.fn
-                    node.in_vals, node.unpack_hook = _pack_saved(
-                        in_vals, in_edges)
-                for j, t in enumerate(pending.placeholders[i]):
-                    if _VALUE_SLOT.__get__(t) is _PENDING:
-                        _VALUE_SLOT.__set__(t, outs_flat[j])
-                    if node is not None:
-                        _NODE_SLOT.__set__(t, node)
-                        _IDX_SLOT.__set__(t, j)
-                    t._pending_chain = None
+            replay_ops_per_op(chain.ops, pending.ext_vals,
+                              pending.ext_edges, pending.placeholders,
+                              pending.pos)
             pending.done = True
+            pending.prev_fire = None
+            pending.gap = ()
+            pending.gap_outs = {}
             chain.fail_streak += 1
             if chain.fail_streak >= _MAX_FAIL_STREAK and not chain.dead:
                 chain.dead = True
@@ -828,8 +1032,10 @@ class _FusionManager:
         if owner:
             # only the owner's detection window saw this chain's stream; a
             # foreign escaping thread must not wipe its own unrelated
-            # detection progress
+            # detection progress (nor its stitch anchor)
             self._reset_window(st)
+            st.last_fire = None
+            st.stitch_gap = []
 
     # -- maintenance --------------------------------------------------------
     def clear(self):
@@ -837,6 +1043,9 @@ class _FusionManager:
         st = self._tls
         self._reset_window(st)
         st.counter = 0
+        st.serial = 0
+        st.last_fire = None
+        st.stitch_gap = []
         with self._lock:
             self._counts.clear()
             self._chains.clear()

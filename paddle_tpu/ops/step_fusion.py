@@ -1,0 +1,1039 @@
+"""Whole-step eager fusion: auto-TrainStep promotion.
+
+The layer above chain fusion (ops/fusion.py). Chain fusion collapses hot
+forward op *sequences* into single launches, but every chain stops at a
+tape read: `loss.backward()` forces the pending chain, and the backward
+walk plus the optimizer update still launch per-node. `jit.TrainStep`
+proves the fast path is ONE executable for the whole step — this module
+gets eager loops there automatically, without the user rewriting their
+loop.
+
+How it works:
+
+  OBSERVE   Every dispatched op, `Tensor.backward()` call, and optimizer
+            `step()`/`clear_grad()` call is recorded into the current
+            *cycle* (one training iteration, delimited by `opt.step()`
+            entries). A cycle's signature is the ordered tuple of per-op
+            cache keys + dataflow wiring + the backward/optimizer events —
+            the same keying discipline as chain fusion scaled to a step,
+            so every per-op invalidation rule (registry generation, AMP
+            state, avals, diff masks) applies for free.
+
+  PROMOTE   After FLAGS_eager_step_fusion_min_count consecutive identical
+            cycles, the cycle is compiled into one fused executable:
+            forward (rebuilt as a pure function from the recorded ops, the
+            re-trace contract of framework/autograd.replay_pure), backward
+            (jax.vjp w.r.t. the parameter slots), grad regularization +
+            clipping (the optimizer's own clip/regularizer objects traced
+            over shims), and the optimizer update (`_single_update`, with
+            decay flags baked by jit/train_step.bake_decay_flags).
+            Optimizer-slot buffers are donated exactly as the eager
+            optimizer's fused update donates them; parameter donation is
+            opt-in (FLAGS_eager_step_fusion_donate_params), sharing
+            jit/train_step.donation_argnums.
+
+  REPLAY    Speculative and transactional, like chain replay: each
+            dispatch is matched against the promoted program and deferred
+            as a `_DeferredTensor`; `loss.backward()` is consumed as an
+            event (p.grad becomes a pending placeholder); `opt.step()`
+            fires the ONE fused launch, updates parameters/slots in place,
+            and fills the loss + grad placeholders from the fused outputs.
+            The LR-schedule value and the step count are hoisted to scalar
+            arguments, so schedulers never split. ANY divergence — an op
+            or event mismatch, a mid-step value peek (a `loss.numpy()`
+            between backward and step; after the step it is served from
+            the fused outputs), a changed optimizer/clip/param set, an
+            in-place param mutation, an RNG-key advance (random ops re-key
+            every call), an execution fault — SPLITS: the deferred prefix
+            replays through the chain/per-op cached path and, if the
+            backward event was already consumed, the real tape backward
+            runs, so numerics are bitwise-identical to unfused dispatch in
+            every outcome. Steps that keep failing to replay are
+            deactivated.
+
+Telemetry: profiler/step_fusion.py, surfaced by
+`paddle_tpu.profiler.step_fusion_stats()` and embedded in bench.py
+headline records as the `step_fusion` block.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import autograd as _autograd
+from ..framework.autograd import FusedStepNode, run_backward
+from ..framework.flags import _FLAGS
+from ..profiler.step_fusion import STEP_STATS
+from .fusion import (MANAGER as _CHAIN_MANAGER, Chain, _ChainOp,
+                     _DeferredTensor, _PENDING, _VALUE_SLOT, _NODE_SLOT,
+                     _IDX_SLOT, _is_pending, replay_ops_per_op)
+
+__all__ = ["STEP", "MISS", "clear_step_cache", "step_cache_info"]
+
+MISS = object()
+
+# consecutive failed replays before a promoted step is deactivated
+_MAX_FAIL_STREAK = 4
+# recording cap per cycle: a cycle longer than this cannot promote (the
+# compile would not amortize) and recording details stop to bound memory
+_MAX_CYCLE_OPS = 2048
+
+_UNBUILDABLE = object()     # library sentinel: this sig cannot promote
+
+
+def _out_aval(t):
+    """(shape, dtype, weak_type) without forcing a pending placeholder."""
+    av = getattr(t, "_fusion_aval", None)
+    if av is not None:
+        return av
+    v = t._value
+    return (v.shape, v.dtype, getattr(v, "weak_type", False))
+
+
+def _snapshot_obj(obj):
+    """Value snapshot of a clip/regularizer object's scalar attributes:
+    these are baked into the traced step as constants, so a mutation must
+    un-verify the promoted program."""
+    if obj is None:
+        return None
+    attrs = tuple(sorted(
+        (k, v) for k, v in vars(obj).items()
+        if isinstance(v, (int, float, bool, str))))
+    return (type(obj).__name__, attrs)
+
+
+class _OpRec:
+    """One dispatch recorded into the current observation cycle. `ins` and
+    `outs` hold strong refs for the cycle's lifetime: the produced-map is
+    keyed by id(), so every recorded tensor must stay alive or a freed
+    id's reuse would mis-wire a later fresh input as ("prev", i, j)."""
+
+    __slots__ = ("name", "key", "fn", "wiring", "diff_mask", "num_outputs",
+                 "out_avals", "out_stop_grads", "ins", "outs")
+
+    def __init__(self, name, key, fn, wiring, diff_mask, num_outputs,
+                 out_avals, out_stop_grads, ins, outs):
+        self.name = name
+        self.key = key
+        self.fn = fn
+        self.wiring = wiring
+        self.diff_mask = diff_mask
+        self.num_outputs = num_outputs
+        self.out_avals = out_avals
+        self.out_stop_grads = out_stop_grads
+        self.ins = ins
+        self.outs = outs
+
+
+class _Cycle:
+    """Observation state for one training iteration."""
+
+    __slots__ = ("entries", "ops", "produced", "dirty", "t0", "n_backward")
+
+    def __init__(self):
+        self.entries = []
+        self.ops = []
+        self.produced = {}     # id(tensor) -> (op index, out index)
+        self.dirty = False
+        self.t0 = time.perf_counter_ns()
+        self.n_backward = 0
+
+    def poison(self):
+        """The cycle cannot promote: drop every recorded detail NOW so a
+        dirty (or boundary-less, e.g. pure-inference) stream pins no
+        tensors — after this, record() is a cheap early return until the
+        next optimizer-step boundary."""
+        self.dirty = True
+        self.entries.clear()
+        self.ops.clear()
+        self.produced.clear()
+
+
+class _ParamShim:
+    """Minimal stand-in for a Parameter inside the traced grad transform:
+    the optimizer's clip/regularizer objects only read `_value`,
+    `need_clip`, `name`, and `regularizer`."""
+
+    __slots__ = ("_value", "name", "need_clip", "regularizer")
+
+
+class _StepProgram:
+    """A promoted cycle: the forward chain, the event schedule, the
+    optimizer binding, and (lazily) the one fused executable."""
+
+    __slots__ = ("sig", "chain", "entries", "root_coord", "root_flat",
+                 "param_refs", "param_names", "param_regs", "need_clip",
+                 "param_slots", "ext_order", "opt_ref", "clip_ref",
+                 "clip_snapshot", "reg_ref", "reg_snapshot", "extra_key",
+                 "acc_names", "label", "n_launches", "baseline_ns",
+                 "fail_streak", "dead", "_exe", "_shims", "donate_params")
+
+    def __init__(self):
+        self.fail_streak = 0
+        self.dead = False
+        self._exe = None
+        self._shims = None
+
+    def release_heavy(self):
+        """A deactivated program stays in the library as a tombstone (so
+        the same cycle is not re-promoted just to fail again) but must not
+        pin its compiled executable or trace shims. The op templates
+        (chain) stay: already-fired pendings still lazily recompute
+        through them."""
+        self._exe = None
+        self._shims = None
+
+    # -- the fused executable ----------------------------------------------
+    def _grad_transform(self, pvals, grads):
+        """Regularization + grad clip exactly as Optimizer.step applies
+        them, traced over param shims so the user's own clip/regularizer
+        objects run unmodified."""
+        reg = self.reg_ref
+        clip = self.clip_ref
+        if reg is None and clip is None:
+            return grads
+        shims = self._shims
+        pgs = []
+        for shim, pv, gv in zip(shims, pvals, grads):
+            shim._value = pv
+            g = Tensor(gv, stop_gradient=True)
+            if reg is not None:
+                g = reg.apply(shim, g)
+            pgs.append((shim, g))
+        if clip is not None:
+            pgs = clip(pgs)
+        return [g._value for _, g in pgs]
+
+    def exe(self):
+        if self._exe is not None:
+            return self._exe
+        from ..jit.train_step import donation_argnums
+        chain = self.chain
+        pure = chain.pure_fn
+        root = self.root_flat
+        seed_shape, seed_dtype = chain.flat_avals[root][:2]
+        param_slots = tuple(sorted(self.param_slots.items()))
+        ext_order = self.ext_order
+        n_ext = chain.n_ext
+        # the closure holds the WEAKREF, not the optimizer: jit retains the
+        # traced fn for the program's lifetime, and a strong capture would
+        # pin the optimizer (and through _parameter_list the whole model)
+        # even after the user discards both. The deref only runs at trace
+        # time, when the firing hook has the optimizer live in hand.
+        opt_ref = self.opt_ref
+        acc_names = self.acc_names
+        if self._shims is None:
+            shims = []
+            for nm, nc, pr in zip(self.param_names, self.need_clip,
+                                  self.param_regs):
+                s = _ParamShim()
+                s.name = nm
+                s.need_clip = nc
+                s.regularizer = pr
+                shims.append(s)
+            self._shims = shims
+
+        def step_fn(pvals, ext, accs, lr, step_count):
+            STEP_STATS.retraces += 1   # side effect: runs only while tracing
+            full = [None] * n_ext
+            for pos, slot in enumerate(ext_order):
+                full[slot] = ext[pos]
+
+            def fwd(pv):
+                env = list(full)
+                for slot, k in param_slots:
+                    env[slot] = pv[k]
+                return pure(*env)[root]
+
+            root_val, vjp = jax.vjp(fwd, list(pvals))
+            (grads,) = vjp(jnp.ones(seed_shape, seed_dtype))
+            upd = self._grad_transform(pvals, grads)
+            opt = opt_ref()   # trace-time only; firing keeps it alive
+            new_p, new_accs = [], []
+            for pv, gv, ac in zip(pvals, upd, accs):
+                acc_dict = dict(zip(acc_names, ac))
+                np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
+                                              step_count)
+                new_p.append(np_)
+                new_accs.append([na_.get(n) for n in acc_names])
+            return root_val, grads, new_p, new_accs
+
+        self._exe = jax.jit(
+            step_fn,
+            donate_argnums=donation_argnums(self.donate_params, 0, 2))
+        return self._exe
+
+
+class _PendingStep:
+    """A speculative whole-step replay in flight."""
+
+    __slots__ = ("program", "owner", "entry_pos", "op_pos", "ext_vals",
+                 "ext_edges", "placeholders", "params", "grad_phs",
+                 "backward_done", "fired", "done", "lock", "t0")
+
+    def __init__(self, program, params, owner):
+        self.program = program
+        self.owner = owner
+        self.entry_pos = 0
+        self.op_pos = 0
+        self.ext_vals = []
+        self.ext_edges = []
+        self.placeholders = []
+        self.params = params
+        self.grad_phs = None
+        self.backward_done = False
+        self.fired = False
+        self.done = False
+        self.lock = threading.RLock()
+        self.t0 = time.perf_counter_ns()
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.recording = None      # _Cycle or None
+        self.prev_sig = None
+        self.streak = 0
+        self.library = OrderedDict()   # sig -> _StepProgram | _UNBUILDABLE
+        self.active = None         # armed program
+        self.replay_arm = False    # next cycle's first entry may start replay
+        self.pending = None
+        self.busy = False
+
+
+class _StepFusionManager:
+    """Cycle recorder + promotion + whole-step replay. All state is
+    per-thread (a training loop is one thread); cross-thread escapes of
+    pending placeholders resolve through the shared owner protocol of
+    ops/fusion.py."""
+
+    def __init__(self):
+        self._tls = _TLS()
+
+    # -- config ------------------------------------------------------------
+    @staticmethod
+    def enabled():
+        return bool(_FLAGS.get("FLAGS_eager_step_fusion")) \
+            and int(_FLAGS.get("FLAGS_eager_step_fusion_cache_size", 8)
+                    or 0) > 0 \
+            and bool(_FLAGS.get("FLAGS_eager_op_cache")) \
+            and int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 0) > 0
+
+    # -- dispatch hooks ----------------------------------------------------
+    def step(self, name, fn, inputs, num_outputs, key, diff_mask):
+        """First crack at every non-debug dispatch (before chain fusion).
+        Returns deferred placeholders while a whole-step replay is
+        matching, else MISS (the dispatcher proceeds and later feeds
+        record())."""
+        st = self._tls
+        if st.busy:
+            return MISS
+        if not self.enabled():
+            if st.pending is not None or st.recording is not None \
+                    or st.active is not None:
+                self._disable(st)
+            return MISS
+        arm = st.replay_arm
+        st.replay_arm = False
+        if key is None:
+            # un-jittable/un-keyable op: the cycle cannot promote
+            self._mark_dirty(st)
+            pending = st.pending
+            if pending is not None and not pending.fired:
+                with pending.lock:
+                    if not pending.done:
+                        self._split(pending, escape=False)
+                st.pending = None
+            return MISS
+
+        pending = st.pending
+        if pending is not None or (arm and st.active is not None):
+            # replay matching is about to read input state: genuinely
+            # foreign pendings (another thread's chain, a fired step) must
+            # be resolved lock-free first, mirroring chain fusion. This
+            # thread's own in-flight CHAIN pending is NOT foreign — the
+            # chain manager handles it in its own step() — and while step
+            # fusion merely observes, no pre-forcing happens at all.
+            own_chain = _CHAIN_MANAGER._tls.pending
+            for t in inputs:
+                if _is_pending(t) and t._pending_chain is not st.pending \
+                        and t._pending_chain is not own_chain:
+                    t._pending_chain.owner.resolve_pending(
+                        t._pending_chain, escape=True)
+        if pending is not None and not pending.fired:
+            program = pending.program
+            with pending.lock:
+                if pending.done:
+                    st.pending = None
+                else:
+                    entry = program.entries[pending.entry_pos]
+                    if entry[0] == "op" and self._op_matches(
+                            program, pending, key, inputs, diff_mask,
+                            num_outputs):
+                        return self._defer(st, pending, inputs, num_outputs)
+                    self._split(pending, escape=False)
+            return MISS
+        if arm and st.active is not None:
+            program = st.active
+            if program.entries and program.entries[0][0] == "op":
+                pending = self._start_pending(st, program)
+                if pending is not None:
+                    with pending.lock:
+                        if self._op_matches(program, pending, key, inputs,
+                                            diff_mask, num_outputs):
+                            return self._defer(st, pending, inputs,
+                                               num_outputs)
+                        self._split(pending, escape=False)
+        return MISS
+
+    def record(self, name, fn, inputs, num_outputs, key, diff_mask, outs,
+               cached_ok):
+        """Feed the cycle recorder after a dispatch ran (per-op cached,
+        per-op uncached, or deferred into a chain replay)."""
+        st = self._tls
+        if st.busy or not self.enabled():
+            return
+        cyc = st.recording
+        if cyc is None:
+            cyc = st.recording = _Cycle()
+        if cyc.dirty:
+            return
+        if key is None or not cached_ok or len(cyc.ops) >= _MAX_CYCLE_OPS:
+            cyc.poison()
+            return
+        wiring = tuple(
+            ("prev",) + cyc.produced[id(t)] if id(t) in cyc.produced
+            else ("ext",)
+            for t in inputs)
+        try:
+            out_avals = tuple(_out_aval(t) for t in outs)
+        except Exception:
+            cyc.poison()
+            return
+        cyc.entries.append(("op", key, wiring, diff_mask, num_outputs))
+        cyc.ops.append(_OpRec(
+            name, key, fn, wiring, diff_mask, num_outputs, out_avals,
+            tuple(t.stop_gradient for t in outs), tuple(inputs),
+            tuple(outs)))
+        i = len(cyc.ops) - 1
+        for j, t in enumerate(outs):
+            cyc.produced[id(t)] = (i, j)
+
+    def interrupt(self):
+        """Debug mode (NaN scan / benchmark sync) needs per-op results:
+        resolve any pending replay and poison the cycle."""
+        st = self._tls
+        if st.busy:
+            return
+        if st.pending is not None and not st.pending.fired:
+            with st.pending.lock:
+                if not st.pending.done:
+                    self._split(st.pending, escape=False)
+            st.pending = None
+        self._mark_dirty(st)
+
+    # -- backward / optimizer hooks ----------------------------------------
+    def on_backward(self, tensor, grad_tensor, retain_graph):
+        """Called at the top of Tensor.backward. Returns True when the
+        backward was consumed by a pending whole-step replay (the caller
+        must return immediately)."""
+        st = self._tls
+        if st.busy or not self.enabled():
+            return False
+        st.replay_arm = False
+        pending = st.pending
+        if pending is not None and not pending.fired:
+            program = pending.program
+            with pending.lock:
+                if pending.done:
+                    st.pending = None
+                    return False
+                entry = program.entries[pending.entry_pos]
+                if entry[0] == "bwd" and grad_tensor is None \
+                        and not retain_graph \
+                        and not _autograd._saved_tensor_hooks \
+                        and self._is_root(pending, tensor) \
+                        and all(p.grad is None and not p._hooks
+                                for p in pending.params):
+                    pending.entry_pos += 1
+                    pending.backward_done = True
+                    self._install_grad_placeholders(pending)
+                    return True
+                self._split(pending, escape=False)
+            return False
+        # observation
+        cyc = st.recording
+        if cyc is None:
+            cyc = st.recording = _Cycle()
+        if cyc.dirty:
+            return False
+        cyc.n_backward += 1
+        coord = cyc.produced.get(id(tensor))
+        if coord is None or grad_tensor is not None or retain_graph \
+                or _autograd._saved_tensor_hooks or cyc.n_backward > 1:
+            cyc.poison()
+            return False
+        cyc.entries.append(("bwd", coord))
+        return False
+
+    def on_clear_grad(self, opt):
+        """Called at the top of Optimizer.clear_grad; the caller always
+        proceeds to clear the grads."""
+        st = self._tls
+        if st.busy or not self.enabled():
+            return
+        arm = st.replay_arm
+        st.replay_arm = False
+        pending = st.pending
+        if pending is not None and not pending.fired:
+            program = pending.program
+            with pending.lock:
+                if pending.done:
+                    st.pending = None
+                else:
+                    entry = program.entries[pending.entry_pos]
+                    if entry[0] == "cg" and opt is program.opt_ref():
+                        pending.entry_pos += 1
+                    else:
+                        self._split(pending, escape=False)
+            return
+        if arm and st.active is not None:
+            program = st.active
+            if program.entries and program.entries[0][0] == "cg" \
+                    and opt is program.opt_ref():
+                pending = self._start_pending(st, program)
+                if pending is not None:
+                    pending.entry_pos = 1
+                    return
+        cyc = st.recording
+        if cyc is None:
+            cyc = st.recording = _Cycle()
+        if not cyc.dirty:
+            cyc.entries.append(("cg", id(opt)))
+
+    def on_optimizer_step(self, opt):
+        """Called at the top of Optimizer.step. Returns True when the
+        fused executable performed the whole update (the caller must
+        return immediately); always delimits the observation cycle."""
+        st = self._tls
+        if st.busy or not self.enabled():
+            return False
+        st.replay_arm = False
+        pending = st.pending
+        if pending is not None and not pending.fired:
+            program = pending.program
+            with pending.lock:
+                if pending.done:
+                    st.pending = None
+                else:
+                    entry = program.entries[pending.entry_pos]
+                    if entry[0] == "step" \
+                            and pending.entry_pos \
+                            == len(program.entries) - 1 \
+                            and pending.backward_done \
+                            and pending.op_pos == len(program.chain.ops) \
+                            and self._verify_fire(program, pending, opt):
+                        if self._fire(st, pending, opt):
+                            self._after_boundary(st)
+                            return True
+                    if not pending.done:
+                        self._split(pending, escape=False)
+            st.pending = None
+            self._boundary(st, opt, dirty=True)
+            return False
+        self._boundary(st, opt, dirty=False)
+        return False
+
+    # -- replay internals --------------------------------------------------
+    @staticmethod
+    def _is_root(pending, tensor):
+        i, j = pending.program.root_coord
+        try:
+            return pending.placeholders[i][j] is tensor
+        except IndexError:
+            return False
+
+    def _start_pending(self, st, program):
+        if program.dead:
+            st.active = None
+            return None
+        params = [r() for r in program.param_refs]
+        if any(p is None for p in params):
+            program.dead = True
+            st.active = None
+            return None
+        # the chain layer must not be mid-replay under a step replay
+        _CHAIN_MANAGER.flush()
+        _CHAIN_MANAGER.reset()
+        pending = _PendingStep(program, params, self)
+        st.pending = pending
+        return pending
+
+    def _op_matches(self, program, pending, key, inputs, diff_mask,
+                    num_outputs):
+        op = program.chain.ops[pending.op_pos]
+        if key != op.key or diff_mask != op.diff_mask \
+                or num_outputs != op.num_outputs \
+                or len(inputs) != len(op.wiring):
+            return False
+        slots = program.chain.ext_of[pending.op_pos]
+        for k, (t, w) in enumerate(zip(inputs, op.wiring)):
+            if _is_pending(t) and t._pending_chain is pending:
+                if w[0] != "prev" or t._chain_coord != (w[1], w[2]):
+                    return False
+            elif w[0] != "ext":
+                return False
+            else:
+                pk = program.param_slots.get(slots[k])
+                if pk is not None and t is not pending.params[pk]:
+                    # the slot must be fed by the SAME parameter object the
+                    # program was built against — identity is the binding
+                    return False
+        return True
+
+    def _defer(self, st, pending, inputs, num_outputs):
+        program = pending.program
+        op = program.chain.ops[pending.op_pos]
+        for k, t in enumerate(inputs):
+            if op.wiring[k][0] != "ext":
+                continue
+            pending.ext_vals.append(t._value)
+            if op.diff_mask is not None and op.diff_mask[k]:
+                node = t._grad_node if t._grad_node is not None \
+                    else t._ensure_grad_node()
+                pending.ext_edges.append((node, t._out_index))
+            else:
+                pending.ext_edges.append(None)
+        outs = tuple(
+            _DeferredTensor(av, op.out_stop_grads[j], pending,
+                            (pending.op_pos, j))
+            for j, av in enumerate(op.out_avals))
+        pending.placeholders.append(outs)
+        pending.op_pos += 1
+        pending.entry_pos += 1
+        if num_outputs is not None:
+            return list(outs)
+        return outs[0]
+
+    def _install_grad_placeholders(self, pending):
+        program = pending.program
+        phs = []
+        for k, p in enumerate(pending.params):
+            v = p._value
+            ph = _DeferredTensor((v.shape, v.dtype, False), True, pending,
+                                 ("grad", k))
+            ph.name = (p.name + "@GRAD") if p.name else "grad"
+            p.grad = ph
+            phs.append(ph)
+        pending.grad_phs = phs
+
+    def _verify_fire(self, program, pending, opt):
+        from ..jit.train_step import bake_decay_flags
+        if opt is not program.opt_ref():
+            return False
+        params = pending.params
+        slot_items = program.param_slots.items()
+        if any(pending.ext_vals[s] is not params[k]._value
+               for s, k in slot_items):
+            # a parameter buffer was swapped mid-cycle (in-place mutation):
+            # the forward consumed the captured value, the update would use
+            # the new one — not fusable
+            return False
+        for p, nm, nc, pr in zip(params, program.param_names,
+                                 program.need_clip, program.param_regs):
+            if p.stop_gradient or p._hooks or p.name != nm:
+                return False
+            if getattr(p, "need_clip", True) != nc:
+                return False
+            if getattr(p, "regularizer", None) is not pr:
+                return False
+            node = p._grad_node
+            if node is not None and node.out_hooks:
+                return False
+        own = {id(p) for p in params}
+        for p in opt._parameter_list:
+            if id(p) not in own and p.grad is not None:
+                # an outside gradient would be updated by the eager step
+                # but not by the fused one
+                return False
+        if opt._grad_clip is not program.clip_ref \
+                or _snapshot_obj(opt._grad_clip) != program.clip_snapshot:
+            self._kill(program)
+            return False
+        if opt.regularization is not program.reg_ref \
+                or _snapshot_obj(opt.regularization) != program.reg_snapshot:
+            self._kill(program)
+            return False
+        bake_decay_flags(opt, params)
+        if tuple(opt._extra_cache_key()) != program.extra_key:
+            self._kill(program)
+            return False
+        opt._create_accumulators(params)
+        if tuple(sorted(opt._accumulators.keys())) != program.acc_names:
+            self._kill(program)
+            return False
+        return True
+
+    def _kill(self, program):
+        """A baked-in constant (clip/regularizer attrs, optimizer hyper
+        params, accumulator structure) changed: the compiled executable is
+        stale for good. Drop it so a re-stabilized loop rebuilds."""
+        st = self._tls
+        if not program.dead:
+            program.dead = True
+            program.release_heavy()
+            STEP_STATS.deactivated += 1
+        if st.active is program:
+            st.active = None
+        st.library.pop(program.sig, None)
+
+    def _fire(self, st, pending, opt):
+        """All entries matched and the optimizer is verified: run the ONE
+        fused executable and commit. Returns False (after splitting) on a
+        fault so the caller falls back to the eager step."""
+        from ..jit.train_step import bake_decay_flags
+        program = pending.program
+        params = pending.params
+        acc_names = program.acc_names
+        st.busy = True
+        if not hasattr(opt, "_step_count"):
+            opt._step_count = 0
+        opt._step_count += 1
+        try:
+            bake_decay_flags(opt, params)
+            pvals = [p._value for p in params]
+            ext = [pending.ext_vals[s] for s in program.ext_order]
+            accs = [[opt._accumulators[n].get(p.name) for n in acc_names]
+                    for p in params]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_count = jnp.asarray(opt._step_count, jnp.int32)
+            root_val, grads, new_p, new_accs = program.exe()(
+                pvals, ext, accs, lr, step_count)
+        except jax.errors.JaxRuntimeError:
+            # transient execution fault: keep the program and replay
+            # eagerly — UNLESS the launch already consumed the donated
+            # accumulator (or param) buffers, in which case a transparent
+            # fallback is impossible and the fault must surface (the
+            # eager optimizer's own donating update has the same contract)
+            opt._step_count -= 1
+            consumed = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for row in accs for a in row if a is not None)
+            if program.donate_params and not consumed:
+                consumed = any(
+                    getattr(v, "is_deleted", lambda: False)()
+                    for v in pvals)
+            if consumed:
+                st.busy = False
+                st.pending = None   # placeholders resolve via escape-split
+                self._kill(program)
+                raise
+            st.busy = False
+            self._split(pending, escape=False)
+            return False
+        except Exception:
+            # the fused trace failed: never let fusion take eager down
+            opt._step_count -= 1
+            st.busy = False
+            self._kill(program)
+            self._split(pending, escape=False)
+            return False
+        try:
+            for p, v in zip(params, new_p):
+                p._value = v
+            for p, ac in zip(params, new_accs):
+                for n, v in zip(acc_names, ac):
+                    if v is not None:
+                        opt._accumulators[n][p.name] = v
+            # the loss: served from the fused outputs, tape-marked consumed
+            i, j = program.root_coord
+            root_ph = pending.placeholders[i][j]
+            if _VALUE_SLOT.__get__(root_ph) is _PENDING:
+                _VALUE_SLOT.__set__(root_ph, root_val)
+            node = FusedStepNode(program.label,
+                                 (root_val.shape, root_val.dtype))
+            _NODE_SLOT.__set__(root_ph, node)
+            _IDX_SLOT.__set__(root_ph, 0)
+            root_ph._pending_chain = None
+            # raw grads land in the placeholders installed at backward
+            for ph, g in zip(pending.grad_phs, grads):
+                if _VALUE_SLOT.__get__(ph) is _PENDING:
+                    _VALUE_SLOT.__set__(ph, g)
+                ph._pending_chain = None
+            pending.fired = True
+            program.fail_streak = 0
+            elapsed = time.perf_counter_ns() - pending.t0
+            STEP_STATS.replay(program.label, program.n_launches,
+                              program.baseline_ns - elapsed)
+        finally:
+            st.busy = False
+            st.pending = None
+        return True
+
+    def resolve_pending(self, pending, escape):
+        """Owner-protocol escape hatch (ops/fusion._DeferredTensor._force).
+        Pre-fire: any touch of a pending placeholder splits the replay.
+        Post-fire: intermediates are lazily recomputed through the per-op
+        path (the fused step only materialized the loss and the grads)."""
+        st = self._tls
+        with pending.lock:
+            if pending.done:
+                pass
+            elif pending.fired:
+                self._recompute(pending)
+            else:
+                self._split(pending, escape=escape)
+        if st.pending is pending:
+            st.pending = None
+
+    def _recompute(self, pending):
+        """A placeholder of a FIRED step was read: materialize every
+        intermediate via the per-op cached path from the captured external
+        inputs (the pre-update parameter values among them)."""
+        st = self._tls
+        st.busy = True
+        try:
+            replay_ops_per_op(pending.program.chain.ops, pending.ext_vals,
+                              pending.ext_edges, pending.placeholders,
+                              pending.op_pos, skip_materialized=True)
+            pending.done = True
+        finally:
+            st.busy = False
+
+    def _split(self, pending, escape):
+        """Transactional fallback: the deferred prefix replays per-op; if
+        the backward event was already consumed, the real tape backward
+        runs so p.grad holds exactly what unfused dispatch would have
+        produced. Callers hold pending.lock."""
+        st = self._tls
+        program = pending.program
+        if pending.done:
+            return
+        st.busy = True
+        try:
+            replay_ops_per_op(program.chain.ops, pending.ext_vals,
+                              pending.ext_edges, pending.placeholders,
+                              pending.op_pos)
+            if pending.backward_done:
+                for p in pending.params:
+                    p.grad = None
+                i, j = program.root_coord
+                root = pending.placeholders[i][j]
+                node = _NODE_SLOT.__get__(root)
+                if node is not None:
+                    seed = _autograd._one_cotangent(
+                        _VALUE_SLOT.__get__(root).shape,
+                        _VALUE_SLOT.__get__(root).dtype)
+                    run_backward(node, _IDX_SLOT.__get__(root), seed)
+                for p, ph in zip(pending.params, pending.grad_phs):
+                    real = p.grad
+                    if real is not None:
+                        if _VALUE_SLOT.__get__(ph) is _PENDING:
+                            _VALUE_SLOT.__set__(ph, real._value)
+                        ph._pending_chain = None
+                        p.grad = ph
+                    else:
+                        ph._pending_chain = None
+            pending.done = True
+            program.fail_streak += 1
+            if program.fail_streak >= _MAX_FAIL_STREAK \
+                    and not program.dead:
+                program.dead = True
+                program.release_heavy()
+                STEP_STATS.deactivated += 1
+                if st.active is program:
+                    st.active = None
+            STEP_STATS.split(program.label, escape=escape)
+            self._mark_dirty(st)
+        finally:
+            st.busy = False
+            if st.pending is pending:
+                st.pending = None
+
+    # -- cycle boundary / promotion ----------------------------------------
+    def _mark_dirty(self, st):
+        if st.recording is None:
+            st.recording = _Cycle()
+        st.recording.poison()
+
+    def _after_boundary(self, st):
+        st.recording = _Cycle()
+        st.replay_arm = st.active is not None
+
+    def _boundary(self, st, opt, dirty):
+        cyc = st.recording
+        if cyc is None or dirty or cyc.dirty:
+            st.prev_sig, st.streak = None, 0
+            self._after_boundary(st)
+            return
+        updated = [p for p in opt._parameter_list if p.grad is not None]
+        cyc.entries.append(("step", id(opt), tuple(id(p) for p in updated)))
+        sig = tuple(cyc.entries)
+        if sig == st.prev_sig:
+            st.streak += 1
+        else:
+            st.prev_sig, st.streak = sig, 1
+        min_count = int(
+            _FLAGS.get("FLAGS_eager_step_fusion_min_count", 40) or 1)
+        if st.streak >= min_count:
+            program = st.library.get(sig)
+            if program is None and sig not in st.library:
+                program = self._build(st, cyc, sig, opt, updated)
+                st.library[sig] = program if program is not None \
+                    else _UNBUILDABLE
+                cap = int(_FLAGS.get("FLAGS_eager_step_fusion_cache_size",
+                                     8) or 0)
+                while len(st.library) > max(cap, 1):
+                    st.library.popitem(last=False)
+            if isinstance(program, _StepProgram) and not program.dead:
+                st.library.move_to_end(sig)
+                st.active = program
+        self._after_boundary(st)
+
+    def _build(self, st, cyc, sig, opt, updated):
+        """Compile-time qualification + program construction from the last
+        observed cycle. Returns None when the cycle cannot promote."""
+        from ..jit.train_step import bake_decay_flags
+        entries = []
+        bwd_entries = [e for e in cyc.entries if e[0] == "bwd"]
+        if len(bwd_entries) != 1 or bwd_entries[0][1] is None \
+                or not cyc.ops or not updated:
+            return None
+        if any(p._hooks or p.stop_gradient for p in updated):
+            return None
+        for p in updated:
+            node = p._grad_node
+            if node is not None and node.out_hooks:
+                return None
+        ops = [
+            _ChainOp(r.name, r.key, r.fn, r.wiring, r.diff_mask,
+                     r.num_outputs, r.out_avals, r.out_stop_grads)
+            for r in cyc.ops]
+        chain = Chain(sig, ops, 0)
+        if not chain.grad_mode:
+            return None
+        # flat index of the backward root in the chain's output catalog
+        root_coord = bwd_entries[0][1]
+        root_flat = None
+        for flat, owner in enumerate(chain.owners):
+            if owner == root_coord:
+                root_flat = flat
+                break
+        if root_flat is None:
+            return None
+        # classify external slots: every differentiable ext input must be
+        # one of the optimizer's updated params, every updated param must
+        # appear (otherwise the eager step and the fused step would update
+        # different sets)
+        param_idx = {id(p): k for k, p in enumerate(updated)}
+        slot_inputs = {}
+        for i, rec in enumerate(cyc.ops):
+            slots = chain.ext_of[i]
+            for k, s in enumerate(slots):
+                if s is not None:
+                    slot_inputs[s] = rec.ins[k]
+        param_slots = {}
+        for s in chain.diff_ext_idx:
+            k = param_idx.get(id(slot_inputs[s]))
+            if k is None:
+                return None
+            param_slots[s] = k
+        if {k for k in param_slots.values()} != set(range(len(updated))):
+            return None
+        # events with per-op entries collapsed to ("op",) markers, in order
+        # (the trailing ("step", ...) sig entry becomes the terminal event)
+        op_iter = 0
+        for e in cyc.entries:
+            if e[0] == "op":
+                entries.append(("op", op_iter))
+                op_iter += 1
+            elif e[0] != "step":
+                entries.append(e)
+        entries.append(("step",))
+        program = _StepProgram()
+        program.sig = sig
+        program.chain = chain
+        program.entries = tuple(entries)
+        program.root_coord = root_coord
+        program.root_flat = root_flat
+        program.param_refs = tuple(weakref.ref(p) for p in updated)
+        program.param_names = tuple(p.name for p in updated)
+        program.param_regs = tuple(
+            getattr(p, "regularizer", None) for p in updated)
+        program.need_clip = tuple(
+            getattr(p, "need_clip", True) for p in updated)
+        program.param_slots = param_slots
+        program.ext_order = tuple(
+            s for s in range(chain.n_ext) if s not in param_slots)
+        program.opt_ref = weakref.ref(opt)
+        program.clip_ref = opt._grad_clip
+        program.clip_snapshot = _snapshot_obj(opt._grad_clip)
+        program.reg_ref = opt.regularization
+        program.reg_snapshot = _snapshot_obj(opt.regularization)
+        bake_decay_flags(opt, updated)
+        program.extra_key = tuple(opt._extra_cache_key())
+        program.acc_names = tuple(sorted(opt._accumulators.keys()))
+        names = [op.name for op in ops]
+        head = "→".join(names[:3]) + ("→…" if len(names) > 3 else "")
+        program.label = (f"{head}[{len(ops)}ops]"
+                         f"+{type(opt).__name__}")
+        program.n_launches = len(ops) + sum(
+            1 for op in ops if op.diff_mask is not None) + 1
+        program.baseline_ns = time.perf_counter_ns() - cyc.t0
+        program.donate_params = bool(
+            _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
+        STEP_STATS.promoted(program.label)
+        return program
+
+    def _disable(self, st):
+        """Flag flipped off mid-run: resolve and forget everything."""
+        if st.pending is not None and not st.pending.fired:
+            with st.pending.lock:
+                if not st.pending.done:
+                    self._split(st.pending, escape=False)
+        st.pending = None
+        st.recording = None
+        st.prev_sig, st.streak = None, 0
+        st.active = None
+        st.replay_arm = False
+
+    # -- maintenance --------------------------------------------------------
+    def clear(self):
+        """Drop the calling thread's promoted steps, observation state, and
+        any pending replay (test hook / clear_dispatch_cache)."""
+        st = self._tls
+        self._disable(st)
+        st.library.clear()
+
+    def info(self):
+        st = self._tls
+        return {
+            "library": len(st.library),
+            "active": st.active.label if st.active is not None else None,
+            "streak": st.streak,
+            "programs": [
+                {"label": p.label, "ops": len(p.chain.ops),
+                 "params": len(p.param_refs), "dead": p.dead,
+                 "launches_estimate": p.n_launches}
+                for p in st.library.values()
+                if isinstance(p, _StepProgram)],
+        }
+
+
+STEP = _StepFusionManager()
+
+
+def clear_step_cache():
+    """Drop every promoted whole-step program and observation state on the
+    calling thread (test hook / manual invalidation)."""
+    STEP.clear()
+
+
+def step_cache_info():
+    """Promoted-step library summary for the calling thread."""
+    return STEP.info()

@@ -87,6 +87,13 @@ class Optimizer:
 
     # -- main entry points --------------------------------------------------
     def step(self):
+        # whole-step fusion (ops/step_fusion.py): when a fused train-step
+        # replay is pending and verified, ONE compiled executable has
+        # already computed loss, grads, and this update — nothing left to
+        # do. In observation mode the hook just delimits the step cycle.
+        from ..ops.step_fusion import STEP as _step_fusion
+        if _step_fusion.on_optimizer_step(self):
+            return
         params = [p for p in self._parameter_list
                   if not p.stop_gradient or p.grad is not None]
         params_grads = [(p, p.grad) for p in params if p.grad is not None]
@@ -153,6 +160,8 @@ class Optimizer:
         return None, None
 
     def clear_grad(self, set_to_zero=True):
+        from ..ops.step_fusion import STEP as _step_fusion
+        _step_fusion.on_clear_grad(self)
         for p in self._parameter_list:
             p.grad = None
 

@@ -20,13 +20,17 @@ from .dispatch import (DispatchStats, dispatch_cache_stats,
                        reset_dispatch_cache_stats)
 from .chain_fusion import (ChainFusionStats, chain_fusion_stats,
                            reset_chain_fusion_stats)
+from .step_fusion import (StepFusionStats, step_fusion_stats,
+                          reset_step_fusion_stats)
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result", "benchmark", "SortedKeys", "SummaryView",
            "DispatchStats", "dispatch_cache_stats",
            "reset_dispatch_cache_stats", "ChainFusionStats",
-           "chain_fusion_stats", "reset_chain_fusion_stats"]
+           "chain_fusion_stats", "reset_chain_fusion_stats",
+           "StepFusionStats", "step_fusion_stats",
+           "reset_step_fusion_stats"]
 
 
 class SortedKeys(Enum):

@@ -25,6 +25,12 @@ Counter semantics:
                     baseline is the dispatch wall time measured for the
                     occurrence that crossed the hotness threshold, so this
                     is an estimate, not a re-measurement
+  chains_stitched   chains created by window stitching: two chains that
+                    replayed back-to-back with matching boundary wiring,
+                    registered as ONE longer chain (so blocks longer than
+                    the detection window still fuse into a single launch;
+                    a stitched replay counts its launches-saved once, the
+                    constituent chains no longer replay)
   retraces          jax traces of chain-owned fused executables (side-effect
                     counter that only runs while tracing)
   evictions         chain LRU evictions past FLAGS_eager_chain_cache_size
@@ -43,10 +49,10 @@ __all__ = ["ChainFusionStats", "CHAIN_STATS", "chain_fusion_stats",
 
 
 class ChainFusionStats:
-    __slots__ = ("_lock", "chains_detected", "fused_replays",
-                 "fallback_splits", "escapes", "launches_saved",
-                 "wall_time_saved_ns", "retraces", "evictions",
-                 "deactivated", "per_chain")
+    __slots__ = ("_lock", "chains_detected", "chains_stitched",
+                 "fused_replays", "fallback_splits", "escapes",
+                 "launches_saved", "wall_time_saved_ns", "retraces",
+                 "evictions", "deactivated", "per_chain")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -55,6 +61,7 @@ class ChainFusionStats:
     def reset(self):
         with self._lock:
             self.chains_detected = 0
+            self.chains_stitched = 0
             self.fused_replays = 0
             self.fallback_splits = 0
             self.escapes = 0
@@ -74,6 +81,10 @@ class ChainFusionStats:
 
     def detected(self, label):
         self.chains_detected += 1
+        self._chain(label)
+
+    def stitched(self, label):
+        self.chains_stitched += 1
         self._chain(label)
 
     def replay(self, label, length, saved_ns):
@@ -99,6 +110,7 @@ class ChainFusionStats:
             attempts = self.fused_replays + self.fallback_splits
             out = {
                 "chains_detected": self.chains_detected,
+                "chains_stitched": self.chains_stitched,
                 "fused_replays": self.fused_replays,
                 "fallback_splits": self.fallback_splits,
                 "escapes": self.escapes,

@@ -31,6 +31,9 @@ _DEFAULT_FLAGS = {
     "FLAGS_eager_chain_fusion": True,
     "FLAGS_eager_chain_fusion_min_count": 3,
     "FLAGS_eager_chain_cache_size": 128,
+    "FLAGS_eager_chain_stitching": True,
+    # chain-layer tests must see chains, not whole-step replays
+    "FLAGS_eager_step_fusion": False,
 }
 
 
@@ -331,6 +334,105 @@ class TestFlags:
         info = chain_cache_info()
         assert info["entries"] <= 1
         assert chain_fusion_stats()["evictions"] > 0
+
+
+class TestWindowStitching:
+    """Adjacent hot chains stitch into one longer chain (PR 3): sequences
+    longer than the 8-op rolling window converge to a single launch."""
+
+    @staticmethod
+    def _pipeline(x, depth=8):
+        h = x
+        for _ in range(depth):
+            h = paddle.tanh(h)
+            h = paddle.scale(h, 0.9)
+            h = paddle.exp(paddle.scale(h, 0.1))
+        return h                     # 3 * depth unary ops, one dataflow
+
+    def test_stitching_fuses_16_plus_op_chain(self):
+        """A 24-op body converges past the 8-op detection window: a single
+        stitched chain of ≥16 ops ends up doing the replays, bitwise equal
+        to the unfused pipeline."""
+        x = _t(np.linspace(-1.0, 1.0, 32, dtype=np.float32).reshape(4, 8))
+        outs = []
+        for _ in range(40):
+            outs.append(self._pipeline(x).numpy().copy())
+        s = chain_fusion_stats()
+        assert s["chains_stitched"] >= 1, s
+        info = chain_cache_info()
+        long_replayed = [c for c in info["chains"]
+                         if c["ops"] >= 16 and c["replays"] > 0]
+        assert long_replayed, \
+            f"no ≥16-op chain replayed: {[(c['ops'], c['replays']) for c in info['chains']]}"
+        set_flags({"FLAGS_eager_chain_fusion": False})
+        clear_dispatch_cache()
+        ref = self._pipeline(x).numpy()
+        np.testing.assert_array_equal(outs[-1], ref)
+
+    def test_stitched_replay_counts_launches_saved_once(self):
+        """Telemetry must not double-count: in the stitched steady state,
+        each replay of an L-op chain adds exactly L-1 launches saved — the
+        constituent chains stop replaying entirely."""
+        x = _t(np.linspace(-1.0, 1.0, 32, dtype=np.float32).reshape(4, 8))
+        for _ in range(40):            # converge to the stitched chain
+            self._pipeline(x)
+        info = chain_cache_info()
+        top = max((c for c in info["chains"] if c["replays"] > 0),
+                  key=lambda c: c["ops"])
+        s0 = chain_fusion_stats()
+        for _ in range(5):
+            self._pipeline(x)
+        s1 = chain_fusion_stats()
+        replays = s1["fused_replays"] - s0["fused_replays"]
+        saved = s1["launches_saved"] - s0["launches_saved"]
+        assert replays > 0
+        # every steady-state replay is the one stitched chain: launches
+        # saved must be exactly (L-1) per replay, not the sum over the
+        # constituent chains as well
+        assert saved == replays * (top["ops"] - 1), \
+            (saved, replays, top["ops"])
+
+    def test_stitching_disabled_keeps_window_sized_chains(self):
+        from paddle_tpu.ops.fusion import _WINDOW
+        set_flags({"FLAGS_eager_chain_stitching": False})
+        x = _t(np.linspace(-1.0, 1.0, 32, dtype=np.float32).reshape(4, 8))
+        for _ in range(40):
+            self._pipeline(x)
+        s = chain_fusion_stats()
+        assert s["chains_stitched"] == 0
+        info = chain_cache_info()
+        assert all(c["ops"] <= _WINDOW for c in info["chains"]), \
+            [c["ops"] for c in info["chains"]]
+
+    def test_stitched_chain_backward_parity(self):
+        """Stitched chains in a grad-recording pipeline: forward values
+        stay bitwise identical to the unfused path; the fused backward of
+        a long (18-op) chain is ONE XLA program whose reassociation can
+        differ from the per-op multiply sequence at the last ULP (the same
+        single-program compilation noise as jit.TrainStep), so grads are
+        checked at ULP-scale tolerance. Fallback splits remain bitwise —
+        covered by TestEscapesAndSplits."""
+        def run(fused):
+            set_flags({"FLAGS_eager_chain_fusion": fused})
+            clear_dispatch_cache()
+            rng = np.random.default_rng(5)
+            x = _t(rng.standard_normal((4, 8)).astype(np.float32),
+                   stop_gradient=False)
+            out = []
+            for _ in range(30):
+                y = self._pipeline(x, depth=6)     # 18 ops
+                loss = y.sum()
+                loss.backward()
+                out.append((loss.numpy().copy(), x.grad.numpy().copy()))
+                x.clear_grad()
+            return out
+
+        unfused = run(False)
+        fused = run(True)
+        assert chain_fusion_stats()["chains_stitched"] >= 1
+        for u, f in zip(unfused, fused):
+            np.testing.assert_array_equal(u[0], f[0])
+            np.testing.assert_allclose(u[1], f[1], rtol=2e-6, atol=1e-12)
 
 
 class TestMicroBenchmark:

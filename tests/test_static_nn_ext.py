@@ -1,6 +1,8 @@
 """static.nn extended builders (reference: python/paddle/static/nn 41
 exports). Sequence ops use the padded-dense [B, T, ...] (+ lengths)
 representation — LoD has no TPU analog."""
+from pathlib import Path
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -14,6 +16,9 @@ def _t(a, dtype=np.float32):
 
 
 class TestLayerDelegates:
+    @pytest.mark.skipif(not Path("/root/reference").exists(),
+                        reason="reference checkout not mounted in this "
+                               "container")
     def test_all_41_present(self):
         import ast
         tree = ast.parse(open(

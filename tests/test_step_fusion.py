@@ -1,0 +1,455 @@
+"""Whole-step eager fusion: the auto-TrainStep layer (ops/step_fusion.py).
+
+Covers cycle promotion + fused replay parity against the unfused eager
+path over SGD / Momentum / Adam (including grad clipping, weight decay,
+and an LR schedule), split-on-escape correctness (mid-step peeks fall back
+BITWISE-identically — they replay through the same per-op executables),
+invalidation (param `stop_gradient` flips, registry-generation bumps,
+clip-attr mutation, clear_dispatch_cache), flag interactions
+(FLAGS_eager_op_cache_size=0 must leave step fusion inert), zero
+post-warmup retraces, the FusedStepNode tape marking, and the acceptance
+micro-benchmark: ≥1.3x over PR 2's chain fusion on the matmul→add→gelu
+fwd+bwd+SGD loop.
+
+Parity note: a fused whole-step replay compiles forward + backward +
+optimizer update into ONE XLA program. XLA's layout and fusion decisions
+inside a single program differ from the multi-executable eager path at the
+last-ULP level — exactly as `jit.TrainStep` differs from eager — so
+fused-vs-unfused TRAJECTORIES are compared with tight allclose bounds
+(observed deviations are ~1e-7 relative per step). Every transactional
+FALLBACK (split) replays through the identical per-op executables and is
+asserted bitwise.
+"""
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.autograd import FusedStepNode
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.ops.dispatch import clear_dispatch_cache
+from paddle_tpu.ops.step_fusion import step_cache_info
+from paddle_tpu.ops.registry import get_op, override_kernel
+from paddle_tpu.profiler import (chain_fusion_stats, dispatch_cache_stats,
+                                 reset_chain_fusion_stats,
+                                 reset_dispatch_cache_stats,
+                                 reset_step_fusion_stats, step_fusion_stats)
+
+_DEFAULT_FLAGS = {
+    "FLAGS_eager_op_cache": True,
+    "FLAGS_eager_op_cache_size": 512,
+    "FLAGS_eager_chain_fusion": True,
+    "FLAGS_eager_chain_fusion_min_count": 3,
+    "FLAGS_eager_chain_cache_size": 128,
+    "FLAGS_eager_chain_stitching": True,
+    "FLAGS_eager_step_fusion": True,
+    "FLAGS_eager_step_fusion_min_count": 4,
+    "FLAGS_eager_step_fusion_cache_size": 8,
+    "FLAGS_eager_step_fusion_donate_params": False,
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    set_flags(dict(_DEFAULT_FLAGS))
+    clear_dispatch_cache()
+    reset_dispatch_cache_stats()
+    reset_chain_fusion_stats()
+    reset_step_fusion_stats()
+    yield
+    set_flags(dict(_DEFAULT_FLAGS))
+    clear_dispatch_cache()
+    reset_dispatch_cache_stats()
+    reset_chain_fusion_stats()
+    reset_step_fusion_stats()
+
+
+def _params(seed=7, b=8, d=16):
+    rng = np.random.default_rng(seed)
+    x = paddle.to_tensor(rng.standard_normal((b, d)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((d, d)).astype(np.float32),
+                         stop_gradient=False)
+    bias = paddle.to_tensor(rng.standard_normal(d).astype(np.float32),
+                            stop_gradient=False)
+    return x, w, bias
+
+
+def _make_opt(kind, params):
+    if kind == "sgd":
+        return paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+    if kind == "momentum":
+        return paddle.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9, parameters=params,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    if kind == "adam":
+        sched = paddle.optimizer.lr.StepDecay(
+            learning_rate=0.01, step_size=5, gamma=0.5)
+        return paddle.optimizer.Adam(
+            learning_rate=sched, parameters=params, weight_decay=0.01,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    raise AssertionError(kind)
+
+
+def _cycle(x, w, b, opt, sched=None):
+    y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+    loss = y.sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    if sched is not None:
+        sched.step()
+    # reading the loss AFTER the step must be served from the fused outputs
+    return float(loss.numpy())
+
+
+def _run(kind, fused, n=30):
+    set_flags({"FLAGS_eager_step_fusion": fused})
+    clear_dispatch_cache()
+    x, w, b = _params()
+    opt = _make_opt(kind, [w, b])
+    sched = opt._learning_rate \
+        if not isinstance(opt._learning_rate, float) else None
+    losses = [_cycle(x, w, b, opt, sched) for _ in range(n)]
+    return np.asarray(losses), w.numpy().copy(), b.numpy().copy()
+
+
+class TestParity:
+    @pytest.mark.parametrize("kind", ["sgd", "momentum", "adam"])
+    def test_trajectory_parity(self, kind):
+        """Fused whole-step replays track the unfused eager trajectory
+        (incl. grad clip, weight decay, LR schedule) within single-program
+        compilation noise, and actually fuse."""
+        unfused, w0, b0 = _run(kind, False)
+        fused, w1, b1 = _run(kind, True)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] >= 1
+        assert s["fused_steps"] >= 20, s
+        assert s["fallback_splits"] == 0, s
+        np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(b1, b0, rtol=1e-4, atol=1e-6)
+
+    def test_lr_schedule_never_splits(self):
+        """The LR value is hoisted to a scalar argument: a schedule that
+        changes it every step must not break replay."""
+        x, w, b = _params()
+        sched = paddle.optimizer.lr.ExponentialDecay(
+            learning_rate=0.05, gamma=0.9)
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w, b])
+        for _ in range(20):
+            _cycle(x, w, b, opt, sched)
+        s = step_fusion_stats()
+        assert s["fused_steps"] >= 10
+        assert s["fallback_splits"] == 0
+
+    def test_fused_root_is_fused_step_node(self):
+        """After a fused replay the loss carries a FusedStepNode: it is not
+        a leaf, and a second backward raises the consumed-graph error."""
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        loss = None
+        for _ in range(10):
+            y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+            loss = y.sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert step_fusion_stats()["fused_steps"] > 0
+        assert isinstance(loss._grad_node, FusedStepNode)
+        assert not loss.is_leaf
+        with pytest.raises(RuntimeError, match="fused whole-step"):
+            loss.backward()
+
+
+class TestSplits:
+    def test_mid_step_peek_splits_bitwise(self):
+        """A loss.numpy() between backward and opt.step is a mid-step peek:
+        every cycle splits, nothing ever fuses, and the whole trajectory is
+        BITWISE identical to the unfused path (the fallback replays through
+        the same per-op executables)."""
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            out = []
+            for _ in range(12):
+                y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                loss = y.sum()
+                loss.backward()
+                peek = loss.numpy().copy()     # mid-step peek
+                opt.step()
+                opt.clear_grad()
+                out.append((peek, w.numpy().copy(), b.numpy().copy()))
+            return out
+
+        unfused = run(False)
+        fused = run(True)
+        s = step_fusion_stats()
+        assert s["fused_steps"] == 0
+        assert s["fallback_splits"] > 0 and s["escapes"] > 0
+        for u, f in zip(unfused, fused):
+            for i, (uv, fv) in enumerate(zip(u, f)):
+                np.testing.assert_array_equal(uv, fv, err_msg=f"field {i}")
+
+    def test_grad_read_pre_step_splits_and_serves_real_grads(self):
+        """Reading p.grad between backward and step forces the pending
+        grad placeholder: the replay splits and the grads are the real
+        (bitwise) per-op backward results."""
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            grads = []
+            for _ in range(10):
+                y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                loss = y.sum()
+                loss.backward()
+                grads.append(w.grad.numpy().copy())
+                opt.step()
+                opt.clear_grad()
+            return grads
+
+        unfused = run(False)
+        fused = run(True)
+        assert step_fusion_stats()["fallback_splits"] > 0
+        for u, f in zip(unfused, fused):
+            np.testing.assert_array_equal(u, f)
+
+    def test_persistent_splits_deactivate(self):
+        """A cycle that always peeks stops being attempted: the program is
+        deactivated after its fail streak."""
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(20):
+            y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+            loss = y.sum()
+            loss.backward()
+            _ = loss.numpy()
+            opt.step()
+            opt.clear_grad()
+        s = step_fusion_stats()
+        assert s["deactivated"] >= 1
+        assert s["fallback_splits"] <= 8, \
+            "splits kept accruing after deactivation"
+
+    def test_post_fire_intermediate_read_recomputes(self):
+        """Reading a mid-step intermediate AFTER the fused step fired is
+        served by a lazy per-op recompute from the captured inputs."""
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        h = None
+        for _ in range(10):
+            h = paddle.add(paddle.matmul(x, w), b)
+            y = F.gelu(h)
+            loss = y.sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert step_fusion_stats()["fused_steps"] > 0
+        val = h.numpy()                  # post-fire lazy recompute
+        assert val.shape == (8, 16)
+        assert np.isfinite(val).all()
+
+
+class TestInvalidation:
+    def test_param_stop_gradient_flip_splits(self):
+        """Flipping a param to stop_gradient re-keys its ops (diff mask):
+        the promoted program stops matching on the very next cycle."""
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(8):
+            _cycle(x, w, b, opt)
+        assert step_fusion_stats()["fused_steps"] > 0
+        before = step_fusion_stats()
+        b.stop_gradient = True
+        y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+        loss = y.sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        after = step_fusion_stats()
+        assert after["fused_steps"] == before["fused_steps"]
+        assert after["fallback_splits"] > before["fallback_splits"]
+        assert w.grad is None and b.grad is None    # step+clear ran eagerly
+
+    def test_registry_bump_invalidates(self):
+        """A kernel override takes effect on the very next cycle — the
+        bumped generation re-keys the op, the replay splits, and the
+        override's numerics are served immediately."""
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(8):
+            _cycle(x, w, b, opt)
+        base = _cycle(x, w, b, opt)
+        before = step_fusion_stats()
+        override_kernel(
+            "gelu", "tripled",
+            lambda v: jnp.asarray(0.5 * v * (1.0 + jnp.tanh(v)),
+                                  v.dtype) * 3.0,
+            activate=True)
+        try:
+            changed = _cycle(x, w, b, opt)
+            after = step_fusion_stats()
+            assert after["fused_steps"] == before["fused_steps"]
+            assert after["fallback_splits"] > before["fallback_splits"]
+            assert changed != base
+        finally:
+            get_op("gelu").active = None
+
+    def test_clip_attr_mutation_kills_program(self):
+        """Clip attributes are baked into the traced step: mutating them
+        deactivates the stale executable instead of serving it."""
+        x, w, b = _params()
+        clip = paddle.nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b],
+                                   grad_clip=clip)
+        for _ in range(8):
+            _cycle(x, w, b, opt)
+        assert step_fusion_stats()["fused_steps"] > 0
+        before = step_fusion_stats()
+        clip.clip_norm = 0.01
+        _cycle(x, w, b, opt)
+        after = step_fusion_stats()
+        assert after["fused_steps"] == before["fused_steps"]
+        assert after["deactivated"] > before["deactivated"]
+
+    def test_clear_dispatch_cache_drops_programs(self):
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(8):
+            _cycle(x, w, b, opt)
+        assert step_cache_info()["library"] >= 1
+        clear_dispatch_cache()
+        assert step_cache_info()["library"] == 0
+        assert step_cache_info()["active"] is None
+
+
+class TestFlags:
+    def test_disabled_never_promotes(self):
+        set_flags({"FLAGS_eager_step_fusion": False})
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(12):
+            _cycle(x, w, b, opt)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] == 0 and s["fused_steps"] == 0
+
+    def test_op_cache_size_zero_leaves_step_fusion_inert(self):
+        """FLAGS_eager_op_cache_size=0 disables the per-op cache, so cycle
+        ops cannot be keyed: step fusion must observe nothing, promote
+        nothing, and numerics must equal the cached unfused path bitwise."""
+        def run(cache_size):
+            set_flags({"FLAGS_eager_op_cache_size": cache_size,
+                       "FLAGS_eager_step_fusion": cache_size == 0,
+                       "FLAGS_eager_chain_fusion": False})
+            clear_dispatch_cache()
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            return [_cycle(x, w, b, opt) for _ in range(10)], w.numpy()
+
+        base, w0 = run(512)             # cached, no step fusion
+        reset_step_fusion_stats()
+        uncached, w1 = run(0)           # uncached, step fusion flag ON
+        s = step_fusion_stats()
+        assert s["steps_promoted"] == 0 and s["fused_steps"] == 0
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(uncached))
+        np.testing.assert_array_equal(w0, w1)
+
+
+class TestLayerInterplay:
+    def test_chain_fusion_replays_while_step_fusion_observes(self):
+        """Step fusion in observation mode (threshold not reached) must not
+        interfere with the chain layer: chains keep replaying and nothing
+        escape-splits — the step manager's pre-forcing must never touch
+        this thread's own in-flight chain pending."""
+        set_flags({"FLAGS_eager_step_fusion_min_count": 1000})
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+        for _ in range(20):
+            _cycle(x, w, b, opt)
+        c = chain_fusion_stats()
+        assert c["fused_replays"] >= 10, c
+        assert c["escapes"] == 0, c
+        assert step_fusion_stats()["steps_promoted"] == 0
+
+
+class TestZeroRetrace:
+    @pytest.mark.perf_smoke
+    def test_zero_retraces_after_warmup(self):
+        """After promotion, 30 more cycles run with zero new traces
+        anywhere — per-op, chain, or step executables — and every cycle is
+        one fused replay."""
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(10):
+            _cycle(x, w, b, opt)
+        d0, c0, s0 = (dispatch_cache_stats(), chain_fusion_stats(),
+                      step_fusion_stats())
+        assert s0["fused_steps"] > 0, "fusion never engaged during warmup"
+        for _ in range(30):
+            _cycle(x, w, b, opt)
+        d1, c1, s1 = (dispatch_cache_stats(), chain_fusion_stats(),
+                      step_fusion_stats())
+        assert d1["retraces"] == d0["retraces"], "per-op retrace"
+        assert c1["retraces"] == c0["retraces"], "chain retrace"
+        assert s1["retraces"] == s0["retraces"], "step retrace"
+        assert s1["fused_steps"] - s0["fused_steps"] == 30
+        assert s1["fallback_splits"] == s0["fallback_splits"]
+
+
+class TestMicroBenchmark:
+    @pytest.mark.perf_smoke
+    def test_fused_step_beats_chain_fusion(self):
+        """The acceptance micro-benchmark: the whole-step executable beats
+        PR 2's chain-fusion path by ≥1.3x wall time on the repeated
+        matmul→add→gelu fwd+bwd+SGD loop (CPU). Best-of-3 timing per mode,
+        up to 4 attempts, to keep shared-CI noise out of the signal."""
+        def bench(step_fused, iters=100):
+            set_flags({"FLAGS_eager_step_fusion": step_fused,
+                       "FLAGS_eager_step_fusion_min_count": 6})
+            clear_dispatch_cache()
+            rng = np.random.default_rng(3)
+            x = paddle.to_tensor(
+                rng.standard_normal((32, 64)).astype(np.float32))
+            w = paddle.to_tensor(
+                rng.standard_normal((64, 64)).astype(np.float32),
+                stop_gradient=False)
+            b = paddle.to_tensor(
+                rng.standard_normal(64).astype(np.float32),
+                stop_gradient=False)
+            opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                                       parameters=[w, b])
+            def step():
+                y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                loss = y.sum()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            for _ in range(16):
+                step()
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    step()
+                best = min(best, (time.perf_counter() - t0) / iters)
+            return best
+
+        ratios = []
+        for _ in range(4):      # retries absorb shared-CI load spikes
+            t_chain = bench(False)
+            t_step = bench(True)
+            ratios.append(t_chain / t_step)
+            if ratios[-1] >= 1.3:
+                break
+        assert max(ratios) >= 1.3, \
+            f"fused step below 1.3x: {[round(r, 2) for r in ratios]}"
+        assert step_fusion_stats()["fused_steps"] > 0
