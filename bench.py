@@ -118,22 +118,40 @@ def _trace(config_name, platform, fn):
 # --------------------------------------------------------------------------
 
 def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
+    # each config runs in its own subprocess, but reset anyway so the
+    # record's dispatch_cache / chain_fusion blocks cover exactly this run
+    # (retries incl.)
+    from paddle_tpu.profiler import (reset_dispatch_cache_stats,
+                                     reset_chain_fusion_stats,
+                                     reset_step_fusion_stats,
+                                     clear_fusion_events)
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    reset_dispatch_cache_stats()
+    reset_chain_fusion_stats()
+    reset_step_fusion_stats()
+    # fusion flight recorder armed for the whole run: the headline embeds
+    # the split-reason telemetry (fusion_events block) so every BENCH
+    # round records WHY any split/bypass happened, not just how many.
+    # try/finally restores the PRIOR value — a raise mid-run must not
+    # leave the recorder armed, nor may a finished run disarm a user's
+    # globally-enabled recorder
+    clear_fusion_events()
+    prev = get_flags(["FLAGS_profiler_events"])
+    set_flags({"FLAGS_profiler_events": True})
+    try:
+        return _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu,
+                                   trace_tag)
+    finally:
+        set_flags(prev)
+
+
+def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.incubate.models import (GPTForCausalLM,
                                             GPTPretrainingCriterion)
     from paddle_tpu.jit import TrainStep
-
-    # each config runs in its own subprocess, but reset anyway so the
-    # record's dispatch_cache / chain_fusion blocks cover exactly this run
-    # (retries incl.)
-    from paddle_tpu.profiler import (reset_dispatch_cache_stats,
-                                     reset_chain_fusion_stats,
-                                     reset_step_fusion_stats)
-    reset_dispatch_cache_stats()
-    reset_chain_fusion_stats()
-    reset_step_fusion_stats()
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -175,7 +193,11 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     # regressions (step_fusion stays zero on the explicit TrainStep path —
     # nonzero values here would mean eager leaked into the compiled loop)
     from paddle_tpu.profiler import (dispatch_cache_stats,
-                                     chain_fusion_stats, step_fusion_stats)
+                                     chain_fusion_stats, step_fusion_stats,
+                                     events_summary, fusion_events)
+    from paddle_tpu.profiler.explain import explain
+    ev = fusion_events()
+    doctor = explain(ev)
 
     return {
         "metric": metric,
@@ -188,7 +210,13 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
                   "platform": platform, "trace": tdir,
                   "dispatch_cache": dispatch_cache_stats(),
                   "chain_fusion": chain_fusion_stats(),
-                  "step_fusion": step_fusion_stats()},
+                  "step_fusion": step_fusion_stats(),
+                  # split-reason attribution (fusion flight recorder):
+                  # per-category event counts + (category, reason, op)
+                  # tables, and the doctor's one-line verdict
+                  "fusion_events": events_summary(ev),
+                  "fusion_doctor": {"verdict": doctor["verdict"],
+                                    "headline": doctor["headline"]}},
     }
 
 

@@ -276,6 +276,13 @@ class saved_tensors_hooks:
         self.unpack_hook = unpack_hook
 
     def __enter__(self):
+        # flight recorder: active hooks silently block chain/step fusion
+        # (every backward inside this scope poisons its cycle), so the
+        # installation itself is worth a timeline marker
+        from ..profiler.events import EVENTS as _EVENTS
+        _EVENTS.emit("step.record", "saved_tensors_hooks",
+                     reason="hook_present",
+                     detail={"kind": "hooks_installed"})
         _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
         return self
 
@@ -389,6 +396,13 @@ def run_backward(root_node: GradNode, root_index: int, seed_grad,
         if isinstance(node, AccumulationNode):
             node.accumulate()
             continue
+        if isinstance(node, FusedChainNode):
+            # flight recorder: the chain's single fused vjp fires here —
+            # the backward half of the chain.fire the forward replay logged
+            from ..profiler.events import EVENTS as _EVENTS
+            _EVENTS.emit("chain.fire", node.name,
+                         detail={"phase": "bwd",
+                                 "ops": len(node.op_names)})
         grads = node.collect_input_grads(final=not retain_graph)
         if not retain_graph:
             node.release()

@@ -159,6 +159,28 @@ define_flag("FLAGS_eager_step_fusion_cache_size", 8,
             "loop that temporarily diverges and re-stabilizes reuses its "
             "compiled whole-step executable instead of recompiling. 0 "
             "disables step fusion")
+# Fusion flight recorder (profiler/events.py): a bounded, thread-aware
+# ring-buffer event log for the dispatch/fusion pipeline. Every decision
+# point that bumps a telemetry counter — cache hit/miss/bypass, chain
+# detect/compile/fire/split/stitch, step record/promote/fire/split/
+# deactivate — also emits a typed event carrying the op name, a cache-key
+# digest, and a machine-readable reason code, so a loop that silently
+# never promotes (or splits mid-step) can be root-caused with
+# paddle_tpu.profiler.explain / tools/fusion_doctor.py instead of staring
+# at aggregate counters. Near-zero cost when off (one flag check per
+# decision point); the profiler drains the ring into chrome-trace lanes.
+define_flag("FLAGS_profiler_events", False,
+            "record dispatch/chain/step fusion lifecycle events into the "
+            "bounded in-process ring buffer (profiler/events.py). Off by "
+            "default: every emission site degenerates to a single flag "
+            "check. Enabled automatically inside a Profiler window and by "
+            "tools/fusion_doctor.py")
+define_flag("FLAGS_profiler_events_capacity", 65536,
+            "ring-buffer capacity (events) of the fusion flight recorder; "
+            "oldest events are dropped past this size. Applied when the "
+            "ring is (re)created — clear_fusion_events() picks up a "
+            "changed value")
+
 define_flag("FLAGS_eager_step_fusion_donate_params", False,
             "EXPERIMENTAL: donate parameter buffers (in addition to the "
             "optimizer-slot buffers, which are always donated exactly as "

@@ -13,7 +13,7 @@ import threading
 import jax
 
 __all__ = ["seed", "get_rng_key", "split_key", "default_generator",
-           "tracing_key_scope", "RNGKeyContext"]
+           "tracing_key_scope", "RNGKeyContext", "rng_epoch"]
 
 
 class _GlobalGenerator:
@@ -27,6 +27,10 @@ class _GlobalGenerator:
         self._lock = threading.Lock()
         self._key = None
         self.initial_seed = seed_val
+        # bumped on every key split: dispatch reads this to attribute an
+        # un-keyable op to fresh randomness (`rng_rekey` in the fusion
+        # flight recorder) instead of a generic un-keyable closure
+        self.epoch = 0
         # whether the user explicitly seeded (paddle.seed): consumers that
         # want "deterministic iff seeded" semantics (DataLoader worker
         # seeding) check this instead of guessing from the value
@@ -44,6 +48,7 @@ class _GlobalGenerator:
             if self._key is None:
                 self._key = jax.random.key(self.initial_seed)
             self._key, sub = jax.random.split(self._key)
+            self.epoch += 1
         return sub
 
 
@@ -82,6 +87,14 @@ class tracing_key_scope:
     def __exit__(self, *exc):
         _tracing_ctx.stack.pop()
         return False
+
+
+def rng_epoch():
+    """Monotonic count of keys split off the global generator. An op whose
+    fn is un-keyable AND whose dispatch follows an epoch advance consumed
+    fresh randomness this call — the `rng_rekey` signature (dropout et
+    al.) in ops/dispatch.py bypass attribution."""
+    return default_generator.epoch
 
 
 def seed(seed_val: int):

@@ -75,7 +75,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     k = ensure_tensor(key)
     v = ensure_tensor(value)
     scale = 1.0 / math.sqrt(q.shape[-1])
-    mask_v = ensure_tensor(attn_mask)._value if attn_mask is not None else None
+    # the mask stays a Tensor: it becomes a dispatch INPUT below (not a
+    # closure capture), and eligibility checks only need its presence —
+    # never force a deferred fusion placeholder's buffer here
+    mask_t = ensure_tensor(attn_mask) if attn_mask is not None else None
 
     # sequence/context parallelism: inside an SPMD trace binding the "sep"
     # axis, q/k/v are sequence shards — use ring attention so no chip ever
@@ -84,7 +87,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...distributed.fleet.meta_parallel.mp_ops import in_spmd_axis
     if in_spmd_axis("sep"):
         eff_dropout = dropout_p if training else 0.0
-        if mask_v is not None or eff_dropout:
+        if mask_t is not None or eff_dropout:
             # a shard-local dense fallback would attend only to this chip's
             # keys — globally wrong. Fail loudly instead.
             raise NotImplementedError(
@@ -105,7 +108,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # deferred fusion placeholders) instead of forcing q/k/v buffers
     _shape_of = lambda t: _ShapeMeta(t.ndim, tuple(t.shape))
     if use_flash_attention is not False and \
-            fa.is_eligible(_shape_of(q), _shape_of(k), _shape_of(v), mask_v,
+            fa.is_eligible(_shape_of(q), _shape_of(k), _shape_of(v), mask_t,
                            eff_dropout, is_causal=is_causal):
         def fn(qq, kk, vv):
             return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
@@ -117,8 +120,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...framework.random import get_rng_key
         drop_key = get_rng_key()
 
+    # the mask is a dispatch INPUT (not a closure capture): closing over
+    # the per-batch array would make every masked attention un-keyable,
+    # bypassing the per-op cache and poisoning chain/step fusion cycles
+    if mask_t is not None:
+        def fn(qq, kk, vv, mm):
+            return _plain_attention(qq, kk, vv, mm, is_causal, scale,
+                                    dropout_p if training else 0.0, drop_key)
+        return call_op("scaled_dot_product_attention", fn, (q, k, v, mask_t))
+
     def fn(qq, kk, vv):
-        return _plain_attention(qq, kk, vv, mask_v, is_causal, scale,
+        return _plain_attention(qq, kk, vv, None, is_causal, scale,
                                 dropout_p if training else 0.0, drop_key)
     return call_op("scaled_dot_product_attention", fn, (q, k, v))
 

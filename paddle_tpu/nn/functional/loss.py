@@ -117,9 +117,11 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
     input = ensure_tensor(input)
     label = ensure_tensor(label)
-    lab_v = label._value
 
-    def fn(logp, *w):
+    # labels are a dispatch INPUT (not a closure capture): closing over the
+    # per-batch array would make every loss un-keyable, bypassing the
+    # per-op cache and poisoning chain/step fusion cycles
+    def fn(logp, lab_v, *w):
         lab_idx = jnp.clip(lab_v, 0, logp.shape[1] - 1).astype(jnp.int32)
         picked = jnp.take_along_axis(logp, lab_idx[:, None], axis=1)[:, 0] \
             if logp.ndim == 2 else jnp.take_along_axis(
@@ -139,7 +141,8 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
         if reduction == "sum":
             return jnp.sum(nll)
         return nll
-    args = (input,) if weight is None else (input, ensure_tensor(weight))
+    args = (input, label) if weight is None else \
+        (input, label, ensure_tensor(weight))
     return call_op("nll_loss", fn, args)
 
 

@@ -47,6 +47,7 @@ from ..framework.core import Tensor
 from ..framework.autograd import pack_saved_values as _pack_saved, GradNode, is_grad_enabled
 from ..framework.flags import _FLAGS
 from ..profiler.dispatch import STATS as _STATS
+from ..profiler.events import EVENTS as _EVENTS
 
 __all__ = ["call_op", "call_op_multi", "clear_dispatch_cache",
            "dispatch_cache_info"]
@@ -146,6 +147,43 @@ def _make_edges(tensors):
 
 _UNKEYABLE = object()
 
+# Per-thread keying-failure context for the fusion flight recorder: WHAT
+# kind of value made the last key attempt fail (array/tensor/object/tracer)
+# and the RNG epoch at the last classified bypass — together they turn an
+# anonymous bypass into a `rng_rekey` / `unkeyable_closure` / `tracer_input`
+# reason code (profiler/events.py). Written only on the (already slow)
+# bypass path; the keyable fast path never touches it.
+_keyctx = threading.local()
+
+
+def _note_unkeyable(v):
+    if isinstance(v, Tensor):
+        _keyctx.kind = "tensor"
+    elif hasattr(v, "shape") and hasattr(v, "dtype"):
+        _keyctx.kind = "array"
+    else:
+        _keyctx.kind = "object"
+
+
+def _classify_bypass(name):
+    """Reason code for a key=None bypass, consuming the per-thread keying
+    context. An array-like closure capture right after a global-RNG epoch
+    advance is the dropout signature: the op re-keys every call."""
+    kind = getattr(_keyctx, "kind", None)
+    _keyctx.kind = None
+    if kind == "tracer":
+        return "tracer_input"
+    if kind in ("array", "tensor"):
+        from ..framework.random import rng_epoch
+        ep = rng_epoch()
+        seen = getattr(_keyctx, "rng_seen", None)
+        _keyctx.rng_seen = ep
+        # the very first classified bypass has no epoch baseline — stay
+        # conservative (unkeyable_closure) rather than blaming the RNG
+        if seen is not None and ep != seen:
+            return "rng_rekey"
+    return "unkeyable_closure"
+
 # Types whose hash/equality is value-based and whose value cannot change
 # under the key's feet. Anything outside this set (arrays, Tensors — whose
 # __hash__ is id() but whose _value mutates in-place, arbitrary objects)
@@ -191,6 +229,7 @@ def _token_of(v, depth):
         return ("dict", items)
     if callable(v):
         return _fn_token(v, depth + 1)
+    _note_unkeyable(v)
     return _UNKEYABLE
 
 
@@ -340,6 +379,7 @@ def _make_key(name, fn, inputs, diff_mask, reg_token):
     for t in inputs:
         av = _input_aval(t)
         if av is None:          # tracer input
+            _keyctx.kind = "tracer"
             return None
         avals.append(av)
     return (name, ftok, tuple(avals), diff_mask, _amp_token(name), reg_token)
@@ -406,14 +446,15 @@ def dispatch_cache_info():
             "keys": keys}
 
 
-def _build_fwd(fn):
+def _build_fwd(name, fn):
     def traced(*vals):
         _STATS.retraces += 1      # side effect: runs only while tracing
+        _EVENTS.emit("dispatch.retrace", name)
         return fn(*vals)
     return jax.jit(traced)
 
 
-def _build_fwd_vjp(fn, diff_idx):
+def _build_fwd_vjp(name, fn, diff_idx):
     """Jitted (out, vjp) pair. jax.vjp's pullback is a jax.tree_util.Partial
     — a pytree with the residual buffers as leaves — so it crosses the jit
     boundary; the compiled forward then emits fresh residuals every call
@@ -421,6 +462,7 @@ def _build_fwd_vjp(fn, diff_idx):
     as one cached executable keyed on the Partial's (stable) treedef."""
     def traced(*vals):
         _STATS.retraces += 1
+        _EVENTS.emit("dispatch.retrace", name)
         if len(diff_idx) == len(vals):
             return jax.vjp(fn, *vals)
 
@@ -453,28 +495,35 @@ def _cached_call(key, name, fn, diff_idx, vals):
     exe = _cache_get(key)
     if exe is _BYPASS:
         _STATS.bypass(name)
+        _EVENTS.emit("dispatch.bypass", name, key, "unjittable")
         return False, None
     if exe is not None:
         _STATS.hit(name)
+        _EVENTS.emit("dispatch.hit", name, key)
         try:
             return True, exe(*vals)
         except jax.errors.JaxRuntimeError:
+            _EVENTS.emit("dispatch.bypass", name, key, "exec_fault")
             # same transient-fault contract as the miss path: fall back to
             # the eager call this once, keep the executable for next time
             return False, None
     _STATS.miss(name)
-    exe = _build_fwd(fn) if diff_idx is None else _build_fwd_vjp(fn, diff_idx)
+    _EVENTS.emit("dispatch.miss", name, key)
+    exe = _build_fwd(name, fn) if diff_idx is None \
+        else _build_fwd_vjp(name, fn, diff_idx)
     try:
         res = exe(*vals)
     except jax.errors.JaxRuntimeError:
         # transient execution fault (OOM, device reset): do NOT negative-
         # cache a jittable key — let the next call try again
+        _EVENTS.emit("dispatch.bypass", name, key, "exec_fault")
         return False, None
     except Exception:
         # un-jittable (value-dependent python control flow, dynamic output
         # shape, ...) or a genuine user error: either way the eager path
         # owns this call — and raises the uncached error message
         _cache_put(key, _BYPASS)
+        _EVENTS.emit("dispatch.bypass", name, key, "unjittable")
         return False, None
     _cache_put(key, exe)
     return True, res
@@ -572,11 +621,14 @@ def _dispatch(name, fn, inputs, num_outputs):
     fn, inputs, reg_token = _prologue(name, fn, inputs)
     debug = _FLAGS.get("FLAGS_check_nan_inf") or _FLAGS.get("FLAGS_benchmark")
     cache_on = bool(_FLAGS.get("FLAGS_eager_op_cache"))
+    bypass_reason = None
     if cache_on and int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 0) <= 0:
         # size 0 disables caching entirely — keyable or not, every call
         # takes the uncached path and is counted as a bypass
         cache_on = False
+        bypass_reason = "cache_disabled"
         _STATS.bypass(name)
+        _EVENTS.emit("dispatch.bypass", name, None, bypass_reason)
 
     grad_on = _requires_grad(inputs)
     diff_mask = tuple(_differentiable(t) for t in inputs) if grad_on else None
@@ -584,7 +636,9 @@ def _dispatch(name, fn, inputs, num_outputs):
     key = _make_key(name, fn, inputs, diff_mask, reg_token) if cache_on \
         else None
     if cache_on and key is None:
+        bypass_reason = _classify_bypass(name)
         _STATS.bypass(name)
+        _EVENTS.emit("dispatch.bypass", name, None, bypass_reason)
 
     fus = _fusion()
     sf = _step_fusion()
@@ -592,15 +646,17 @@ def _dispatch(name, fn, inputs, num_outputs):
         # debug modes need materialized outputs op-by-op: resolve any
         # pending replay and keep both fusion layers out of the way
         sf.STEP.interrupt()
-        fus.MANAGER.flush()
+        fus.MANAGER.flush(reason="debug_interrupt")
         fus.MANAGER.reset()
     else:
         # whole-step replay gets first crack: while it is matching, the
         # chain layer is quiescent (the fused step IS the chain)
-        res = sf.STEP.step(name, fn, inputs, num_outputs, key, diff_mask)
+        res = sf.STEP.step(name, fn, inputs, num_outputs, key, diff_mask,
+                           bypass_reason=bypass_reason)
         if res is not sf.MISS:
             return res
-        res = fus.MANAGER.step(name, fn, inputs, num_outputs, key, diff_mask)
+        res = fus.MANAGER.step(name, fn, inputs, num_outputs, key, diff_mask,
+                               bypass_reason=bypass_reason)
         if res is not fus.MISS:
             # chain-deferred ops still feed the step-cycle recorder: the
             # placeholders carry avals, so nothing materializes
@@ -623,13 +679,13 @@ def _dispatch(name, fn, inputs, num_outputs):
                 _debug_checks(name, out_vals)
             outs = [Tensor(v, stop_gradient=True) for v in out_vals]
             _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
-                             key, None, outs, t0)
+                             key, None, outs, t0, bypass_reason)
             return outs
         if debug:
             _debug_checks(name, (out_vals,))
         out = Tensor(out_vals, stop_gradient=True)
         _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
-                         key, None, (out,), t0)
+                         key, None, (out,), t0, bypass_reason)
         return out
 
     diff_idx = tuple(i for i, d in enumerate(diff_mask) if d)
@@ -659,18 +715,18 @@ def _dispatch(name, fn, inputs, num_outputs):
             t._out_index = j
             outs.append(t)
         _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
-                         key, diff_mask, outs, t0)
+                         key, diff_mask, outs, t0, bypass_reason)
         return outs
     out = Tensor(out_vals, stop_gradient=False)
     out._grad_node = node
     out._out_index = 0
     _record_dispatch(fus, ok, debug, name, fn, inputs, num_outputs,
-                     key, diff_mask, (out,), t0)
+                     key, diff_mask, (out,), t0, bypass_reason)
     return out
 
 
 def _record_dispatch(fus, cached_ok, debug, name, fn, inputs, num_outputs,
-                     key, diff_mask, outs, t0):
+                     key, diff_mask, outs, t0, bypass_reason=None):
     """Feed the chain detector and the step-cycle recorder after the
     per-op path ran. Only dispatches that went through the executable
     cache are fusion material; an uncached or un-keyable call breaks the
@@ -679,7 +735,8 @@ def _record_dispatch(fus, cached_ok, debug, name, fn, inputs, num_outputs,
     if debug:
         return
     _step_fusion().STEP.record(name, fn, inputs, num_outputs, key,
-                               diff_mask, tuple(outs), cached_ok=cached_ok)
+                               diff_mask, tuple(outs), cached_ok=cached_ok,
+                               bypass_reason=bypass_reason)
     if key is None:
         return
     if cached_ok:

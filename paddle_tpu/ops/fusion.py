@@ -51,8 +51,25 @@ from ..framework.autograd import FusedChainNode, GradNode, \
     pack_saved_values as _pack_saved
 from ..framework.flags import _FLAGS
 from ..profiler.chain_fusion import CHAIN_STATS
+from ..profiler.events import EVENTS as _EVENTS
 
 __all__ = ["MANAGER", "MISS", "clear_chain_cache", "chain_cache_info"]
+
+
+def _key_diff_reason(expected, got):
+    """Reason code for a replay key mismatch, by diffing the per-op cache
+    key components — (name, fn token, avals, diff mask, AMP, registry
+    token). Shared with step fusion (ops/step_fusion.py)."""
+    try:
+        if expected[0] != got[0]:
+            return "key_mismatch"        # a different op arrived
+        if expected[2] != got[2]:
+            return "shape_mismatch"      # same op, different input avals
+        if expected[5] != got[5]:
+            return "registry_bump"       # kernel override (de)activated
+    except (IndexError, TypeError):
+        pass
+    return "key_mismatch"                # fn token / diff mask / AMP state
 
 MISS = object()          # step() result: "not handled, take the per-op path"
 _PENDING = object()      # placeholder _value before its chain fires
@@ -299,6 +316,8 @@ def _build_chain_fwd(chain):
 
     def traced(*ext_vals):
         CHAIN_STATS.retraces += 1     # side effect: runs only while tracing
+        _EVENTS.emit("chain.compile", chain.label,
+                     detail={"ops": len(chain.ops)})
         return run(*ext_vals)
     return jax.jit(traced)
 
@@ -313,6 +332,8 @@ def _build_chain_fwd_vjp(chain):
 
     def traced(*ext_vals):
         CHAIN_STATS.retraces += 1
+        _EVENTS.emit("chain.compile", chain.label,
+                     detail={"ops": len(chain.ops), "grad": True})
         if len(diff) == len(ext_vals):
             return jax.vjp(run, *ext_vals)
 
@@ -513,24 +534,27 @@ class _FusionManager:
             return kid
 
     # -- dispatch hooks ----------------------------------------------------
-    def step(self, name, fn, inputs, num_outputs, key, diff_mask):
+    def step(self, name, fn, inputs, num_outputs, key, diff_mask,
+             bypass_reason=None):
         """Called by the dispatcher before it launches anything. Returns the
         op's result (deferred placeholders, materialized on chain
         completion) or MISS → the caller takes the per-op path and reports
-        the outcome through record()/reset()."""
+        the outcome through record()/reset(). `bypass_reason` attributes a
+        key=None split to the dispatch-level cause (rng_rekey, ...)."""
         st = self._tls
         if st.busy:
             return MISS
         if st.pending is not None and st.pending.done:
             st.pending = None       # resolved by another thread's escape
         if not self.enabled():
-            self.flush()
+            self.flush(reason="flag_off")
             if st.window:
                 self._reset_window(st)
             return MISS
         if key is None:
             # un-keyable op: chains cannot cross it
-            self.flush()
+            self.flush(reason=bypass_reason or "unkeyable_closure",
+                       blocked_op=name)
             self._reset_window(st)
             st.last_fire = None
             st.stitch_gap = []
@@ -563,7 +587,12 @@ class _FusionManager:
                                                             inputs):
                         return self._defer(st, pending, op, inputs,
                                            num_outputs)
-                    self._split(pending, escape=False)
+                    if kid != self._intern.get(op.key):
+                        reason = _key_diff_reason(op.key, key)
+                    else:
+                        reason = "wiring_mismatch"
+                    self._split(pending, escape=False, reason=reason,
+                                blocked_op=name)
             # fall through: this op may start a new chain or be recorded
 
         chain = self._lookup_start(kid, key)
@@ -635,14 +664,15 @@ class _FusionManager:
         st.last_fire = None
         st.stitch_gap = []
 
-    def flush(self):
+    def flush(self, reason=None, blocked_op=None):
         """Resolve any pending chain on this thread (split if incomplete)."""
         st = self._tls
         if st.pending is not None:
             pending = st.pending
             with pending.lock:
                 if not pending.done:
-                    self._split(pending, escape=False)
+                    self._split(pending, escape=False, reason=reason,
+                                blocked_op=blocked_op)
             st.pending = None
 
     def _reset_window(self, st):
@@ -681,6 +711,9 @@ class _FusionManager:
         for sig, recs in to_register:
             self._register(sig, recs)
 
+    # chain labels can repeat across distinct signatures; events carry the
+    # label (human attribution) while the sig stays internal
+
     def _register(self, sig, recs):
         ops = [
             # the per-record rel wiring is sig's second element — no need
@@ -691,6 +724,8 @@ class _FusionManager:
         chain = Chain(sig, ops, sum(r.dur_ns for r in recs))
         if self._insert_chain(sig, chain):
             CHAIN_STATS.detected(chain.label)
+            _EVENTS.emit("chain.detect", chain.label,
+                         detail={"ops": len(chain.ops)})
 
     def _insert_chain(self, sig, chain):
         """Registry insertion + LRU eviction, shared by window detection and
@@ -804,6 +839,9 @@ class _FusionManager:
                       + sum(r.dur_ns for r in gap))
         if self._insert_chain(sig, chain):
             CHAIN_STATS.stitched(chain.label)
+            _EVENTS.emit("chain.stitch", chain.label,
+                         detail={"ops": len(chain.ops),
+                                 "from_ops": [n_a, n_g, len(b.ops)]})
 
     def _drop_head(self, chain):
         lst = self._heads.get(chain.head_kid)
@@ -951,7 +989,7 @@ class _FusionManager:
         except jax.errors.JaxRuntimeError:
             # transient execution fault: keep the chain, replay per-op
             st.busy = False
-            self._split(pending, escape=False)
+            self._split(pending, escape=False, reason="exec_fault")
             if st.pending is pending:
                 st.pending = None
             return
@@ -962,7 +1000,7 @@ class _FusionManager:
             chain.dead = True
             CHAIN_STATS.deactivated += 1
             st.busy = False
-            self._split(pending, escape=False)
+            self._split(pending, escape=False, reason="trace_fail")
             if st.pending is pending:
                 st.pending = None
             return
@@ -979,6 +1017,9 @@ class _FusionManager:
             elapsed = time.perf_counter_ns() - pending.t0
             CHAIN_STATS.replay(chain.label, len(chain.ops),
                                chain.baseline_ns - elapsed)
+            _EVENTS.emit("chain.fire", chain.label,
+                         detail={"ops": len(chain.ops),
+                                 "launches_saved": len(chain.ops) - 1})
             if pending.prev_fire is not None \
                     and any(c is not None for c in pending.boundary):
                 self._register_stitched(pending.prev_fire, pending)
@@ -1000,12 +1041,14 @@ class _FusionManager:
             if st.pending is pending:
                 st.pending = None
 
-    def _split(self, pending, escape):
+    def _split(self, pending, escape, reason=None, blocked_op=None):
         """Replay the deferred prefix through the per-op cached path,
         filling the placeholders with bitwise-identical results. Callers
         hold pending.lock (owner via step/flush, escapees via
         resolve_pending); the guard below makes a second resolution a
-        no-op."""
+        no-op. `reason` is the flight-recorder attribution (a
+        REASON_CODES entry); `blocked_op` names the op that broke the
+        chain when the split was caused by a specific dispatch."""
         st = self._tls
         chain = pending.chain
         if pending.done:
@@ -1021,10 +1064,21 @@ class _FusionManager:
             pending.gap = ()
             pending.gap_outs = {}
             chain.fail_streak += 1
+            deactivated = False
             if chain.fail_streak >= _MAX_FAIL_STREAK and not chain.dead:
                 chain.dead = True
+                deactivated = True
                 CHAIN_STATS.deactivated += 1
             CHAIN_STATS.split(chain.label, escape=escape)
+            if reason is None:
+                reason = "mid_chain_escape" if escape else "key_mismatch"
+            detail = {"pos": pending.pos, "ops": len(chain.ops)}
+            if blocked_op:
+                detail["blocked_op"] = blocked_op
+            if deactivated:
+                detail["deactivated"] = True
+            _EVENTS.emit("chain.split", chain.label, reason=reason,
+                         detail=detail)
         finally:
             st.busy = False
             if st.pending is pending:

@@ -70,9 +70,11 @@ from ..framework import autograd as _autograd
 from ..framework.autograd import FusedStepNode, run_backward
 from ..framework.flags import _FLAGS
 from ..profiler.step_fusion import STEP_STATS
+from ..profiler.events import EVENTS as _EVENTS
 from .fusion import (MANAGER as _CHAIN_MANAGER, Chain, _ChainOp,
                      _DeferredTensor, _PENDING, _VALUE_SLOT, _NODE_SLOT,
-                     _IDX_SLOT, _is_pending, replay_ops_per_op)
+                     _IDX_SLOT, _is_pending, _key_diff_reason,
+                     replay_ops_per_op)
 
 __all__ = ["STEP", "MISS", "clear_step_cache", "step_cache_info"]
 
@@ -325,11 +327,13 @@ class _StepFusionManager:
             and int(_FLAGS.get("FLAGS_eager_op_cache_size", 512) or 0) > 0
 
     # -- dispatch hooks ----------------------------------------------------
-    def step(self, name, fn, inputs, num_outputs, key, diff_mask):
+    def step(self, name, fn, inputs, num_outputs, key, diff_mask,
+             bypass_reason=None):
         """First crack at every non-debug dispatch (before chain fusion).
         Returns deferred placeholders while a whole-step replay is
         matching, else MISS (the dispatcher proceeds and later feeds
-        record())."""
+        record()). `bypass_reason` attributes a key=None poison/split to
+        the dispatch-level cause (rng_rekey, unkeyable_closure, ...)."""
         st = self._tls
         if st.busy:
             return MISS
@@ -342,12 +346,15 @@ class _StepFusionManager:
         st.replay_arm = False
         if key is None:
             # un-jittable/un-keyable op: the cycle cannot promote
-            self._mark_dirty(st)
+            self._poison(st, bypass_reason or "unkeyable_closure", op=name)
             pending = st.pending
             if pending is not None and not pending.fired:
                 with pending.lock:
                     if not pending.done:
-                        self._split(pending, escape=False)
+                        self._split(pending, escape=False,
+                                    reason=bypass_reason
+                                    or "unkeyable_closure",
+                                    blocked_op=name)
                 st.pending = None
             return MISS
 
@@ -372,11 +379,17 @@ class _StepFusionManager:
                     st.pending = None
                 else:
                     entry = program.entries[pending.entry_pos]
-                    if entry[0] == "op" and self._op_matches(
-                            program, pending, key, inputs, diff_mask,
-                            num_outputs):
+                    if entry[0] != "op":
+                        self._split(pending, escape=False,
+                                    reason="event_mismatch", blocked_op=name)
+                        return MISS
+                    mismatch = self._op_mismatch_reason(
+                        program, pending, key, inputs, diff_mask,
+                        num_outputs)
+                    if mismatch is None:
                         return self._defer(st, pending, inputs, num_outputs)
-                    self._split(pending, escape=False)
+                    self._split(pending, escape=False, reason=mismatch,
+                                blocked_op=name)
             return MISS
         if arm and st.active is not None:
             program = st.active
@@ -384,15 +397,18 @@ class _StepFusionManager:
                 pending = self._start_pending(st, program)
                 if pending is not None:
                     with pending.lock:
-                        if self._op_matches(program, pending, key, inputs,
-                                            diff_mask, num_outputs):
+                        mismatch = self._op_mismatch_reason(
+                            program, pending, key, inputs, diff_mask,
+                            num_outputs)
+                        if mismatch is None:
                             return self._defer(st, pending, inputs,
                                                num_outputs)
-                        self._split(pending, escape=False)
+                        self._split(pending, escape=False, reason=mismatch,
+                                    blocked_op=name)
         return MISS
 
     def record(self, name, fn, inputs, num_outputs, key, diff_mask, outs,
-               cached_ok):
+               cached_ok, bypass_reason=None):
         """Feed the cycle recorder after a dispatch ran (per-op cached,
         per-op uncached, or deferred into a chain replay)."""
         st = self._tls
@@ -404,7 +420,13 @@ class _StepFusionManager:
         if cyc.dirty:
             return
         if key is None or not cached_ok or len(cyc.ops) >= _MAX_CYCLE_OPS:
-            cyc.poison()
+            if key is None:
+                reason = bypass_reason or "unkeyable_closure"
+            elif not cached_ok:
+                reason = "uncached_dispatch"
+            else:
+                reason = "cycle_too_long"
+            self._poison(st, reason, op=name)
             return
         wiring = tuple(
             ("prev",) + cyc.produced[id(t)] if id(t) in cyc.produced
@@ -413,7 +435,7 @@ class _StepFusionManager:
         try:
             out_avals = tuple(_out_aval(t) for t in outs)
         except Exception:
-            cyc.poison()
+            self._poison(st, "tracer_input", op=name)
             return
         cyc.entries.append(("op", key, wiring, diff_mask, num_outputs))
         cyc.ops.append(_OpRec(
@@ -433,9 +455,10 @@ class _StepFusionManager:
         if st.pending is not None and not st.pending.fired:
             with st.pending.lock:
                 if not st.pending.done:
-                    self._split(st.pending, escape=False)
+                    self._split(st.pending, escape=False,
+                                reason="debug_interrupt")
             st.pending = None
-        self._mark_dirty(st)
+        self._poison(st, "debug_interrupt")
 
     # -- backward / optimizer hooks ----------------------------------------
     def on_backward(self, tensor, grad_tensor, retain_graph):
@@ -464,7 +487,15 @@ class _StepFusionManager:
                     pending.backward_done = True
                     self._install_grad_placeholders(pending)
                     return True
-                self._split(pending, escape=False)
+                if entry[0] != "bwd" or not self._is_root(pending, tensor):
+                    reason = "event_mismatch"
+                else:
+                    # retain_graph / explicit grad seed / saved-tensor or
+                    # param hooks / stale grads: semantics a fused replay
+                    # cannot honor
+                    reason = "hook_present"
+                self._split(pending, escape=False, reason=reason,
+                            blocked_op="backward")
             return False
         # observation
         cyc = st.recording
@@ -476,9 +507,17 @@ class _StepFusionManager:
         coord = cyc.produced.get(id(tensor))
         if coord is None or grad_tensor is not None or retain_graph \
                 or _autograd._saved_tensor_hooks or cyc.n_backward > 1:
-            cyc.poison()
+            if cyc.n_backward > 1:
+                reason = "multi_backward"
+            elif coord is None:
+                reason = "event_mismatch"   # root not in the recorded cycle
+            else:
+                reason = "hook_present"
+            self._poison(st, reason, op="backward")
             return False
         cyc.entries.append(("bwd", coord))
+        _EVENTS.emit("step.record", "backward",
+                     detail={"kind": "bwd", "pos": len(cyc.ops)})
         return False
 
     def on_clear_grad(self, opt):
@@ -500,7 +539,9 @@ class _StepFusionManager:
                     if entry[0] == "cg" and opt is program.opt_ref():
                         pending.entry_pos += 1
                     else:
-                        self._split(pending, escape=False)
+                        self._split(pending, escape=False,
+                                    reason="event_mismatch",
+                                    blocked_op="clear_grad")
             return
         if arm and st.active is not None:
             program = st.active
@@ -532,17 +573,29 @@ class _StepFusionManager:
                     st.pending = None
                 else:
                     entry = program.entries[pending.entry_pos]
+                    split_reason = "event_mismatch"
                     if entry[0] == "step" \
                             and pending.entry_pos \
                             == len(program.entries) - 1 \
                             and pending.backward_done \
-                            and pending.op_pos == len(program.chain.ops) \
-                            and self._verify_fire(program, pending, opt):
-                        if self._fire(st, pending, opt):
-                            self._after_boundary(st)
-                            return True
-                    if not pending.done:
-                        self._split(pending, escape=False)
+                            and pending.op_pos == len(program.chain.ops):
+                        verify_fail = self._verify_fire(program, pending,
+                                                        opt)
+                        if verify_fail is None:
+                            if self._fire(st, pending, opt):
+                                self._after_boundary(st)
+                                return True
+                            split_reason = None   # _fire already split
+                        else:
+                            split_reason = verify_fail
+                    if not pending.done and split_reason is not None:
+                        self._split(pending, escape=False,
+                                    reason=split_reason,
+                                    blocked_op="optimizer_step")
+                    elif not pending.done:
+                        self._split(pending, escape=False,
+                                    reason="exec_fault",
+                                    blocked_op="optimizer_step")
             st.pending = None
             self._boundary(st, opt, dirty=True)
             return False
@@ -565,6 +618,9 @@ class _StepFusionManager:
         params = [r() for r in program.param_refs]
         if any(p is None for p in params):
             program.dead = True
+            _EVENTS.emit("step.deactivate", program.label,
+                         reason="param_mismatch",
+                         detail={"why": "parameter_gc"})
             st.active = None
             return None
         # the chain layer must not be mid-replay under a step replay
@@ -574,27 +630,30 @@ class _StepFusionManager:
         st.pending = pending
         return pending
 
-    def _op_matches(self, program, pending, key, inputs, diff_mask,
-                    num_outputs):
+    def _op_mismatch_reason(self, program, pending, key, inputs, diff_mask,
+                            num_outputs):
+        """None when the incoming dispatch matches the program's next op
+        template; else the reason code the split should carry."""
         op = program.chain.ops[pending.op_pos]
-        if key != op.key or diff_mask != op.diff_mask \
-                or num_outputs != op.num_outputs \
+        if key != op.key:
+            return _key_diff_reason(op.key, key)
+        if diff_mask != op.diff_mask or num_outputs != op.num_outputs \
                 or len(inputs) != len(op.wiring):
-            return False
+            return "key_mismatch"
         slots = program.chain.ext_of[pending.op_pos]
         for k, (t, w) in enumerate(zip(inputs, op.wiring)):
             if _is_pending(t) and t._pending_chain is pending:
                 if w[0] != "prev" or t._chain_coord != (w[1], w[2]):
-                    return False
+                    return "wiring_mismatch"
             elif w[0] != "ext":
-                return False
+                return "wiring_mismatch"
             else:
                 pk = program.param_slots.get(slots[k])
                 if pk is not None and t is not pending.params[pk]:
                     # the slot must be fed by the SAME parameter object the
                     # program was built against — identity is the binding
-                    return False
-        return True
+                    return "param_mismatch"
+        return None
 
     def _defer(self, st, pending, inputs, num_outputs):
         program = pending.program
@@ -633,9 +692,12 @@ class _StepFusionManager:
         pending.grad_phs = phs
 
     def _verify_fire(self, program, pending, opt):
+        """None when the fused fire may proceed; else the reason code the
+        split should carry (optimizer-state changes also kill the
+        program: the baked constants are stale for good)."""
         from ..jit.train_step import bake_decay_flags
         if opt is not program.opt_ref():
-            return False
+            return "param_mismatch"
         params = pending.params
         slot_items = program.param_slots.items()
         if any(pending.ext_vals[s] is not params[k]._value
@@ -643,43 +705,45 @@ class _StepFusionManager:
             # a parameter buffer was swapped mid-cycle (in-place mutation):
             # the forward consumed the captured value, the update would use
             # the new one — not fusable
-            return False
+            return "param_mismatch"
         for p, nm, nc, pr in zip(params, program.param_names,
                                  program.need_clip, program.param_regs):
-            if p.stop_gradient or p._hooks or p.name != nm:
-                return False
+            if p._hooks:
+                return "hook_present"
+            if p.stop_gradient or p.name != nm:
+                return "param_mismatch"
             if getattr(p, "need_clip", True) != nc:
-                return False
+                return "optimizer_state_change"
             if getattr(p, "regularizer", None) is not pr:
-                return False
+                return "optimizer_state_change"
             node = p._grad_node
             if node is not None and node.out_hooks:
-                return False
+                return "hook_present"
         own = {id(p) for p in params}
         for p in opt._parameter_list:
             if id(p) not in own and p.grad is not None:
                 # an outside gradient would be updated by the eager step
                 # but not by the fused one
-                return False
+                return "param_mismatch"
         if opt._grad_clip is not program.clip_ref \
                 or _snapshot_obj(opt._grad_clip) != program.clip_snapshot:
             self._kill(program)
-            return False
+            return "optimizer_state_change"
         if opt.regularization is not program.reg_ref \
                 or _snapshot_obj(opt.regularization) != program.reg_snapshot:
             self._kill(program)
-            return False
+            return "optimizer_state_change"
         bake_decay_flags(opt, params)
         if tuple(opt._extra_cache_key()) != program.extra_key:
             self._kill(program)
-            return False
+            return "optimizer_state_change"
         opt._create_accumulators(params)
         if tuple(sorted(opt._accumulators.keys())) != program.acc_names:
             self._kill(program)
-            return False
-        return True
+            return "optimizer_state_change"
+        return None
 
-    def _kill(self, program):
+    def _kill(self, program, reason="optimizer_state_change"):
         """A baked-in constant (clip/regularizer attrs, optimizer hyper
         params, accumulator structure) changed: the compiled executable is
         stale for good. Drop it so a re-stabilized loop rebuilds."""
@@ -688,6 +752,7 @@ class _StepFusionManager:
             program.dead = True
             program.release_heavy()
             STEP_STATS.deactivated += 1
+            _EVENTS.emit("step.deactivate", program.label, reason=reason)
         if st.active is program:
             st.active = None
         st.library.pop(program.sig, None)
@@ -731,17 +796,17 @@ class _StepFusionManager:
             if consumed:
                 st.busy = False
                 st.pending = None   # placeholders resolve via escape-split
-                self._kill(program)
+                self._kill(program, reason="exec_fault")
                 raise
             st.busy = False
-            self._split(pending, escape=False)
+            self._split(pending, escape=False, reason="exec_fault")
             return False
         except Exception:
             # the fused trace failed: never let fusion take eager down
             opt._step_count -= 1
             st.busy = False
-            self._kill(program)
-            self._split(pending, escape=False)
+            self._kill(program, reason="trace_fail")
+            self._split(pending, escape=False, reason="trace_fail")
             return False
         try:
             for p, v in zip(params, new_p):
@@ -770,6 +835,9 @@ class _StepFusionManager:
             elapsed = time.perf_counter_ns() - pending.t0
             STEP_STATS.replay(program.label, program.n_launches,
                               program.baseline_ns - elapsed)
+            _EVENTS.emit("step.fire", program.label,
+                         detail={"ops": len(program.chain.ops),
+                                 "launches_saved": program.n_launches - 1})
         finally:
             st.busy = False
             st.pending = None
@@ -805,11 +873,13 @@ class _StepFusionManager:
         finally:
             st.busy = False
 
-    def _split(self, pending, escape):
+    def _split(self, pending, escape, reason=None, blocked_op=None):
         """Transactional fallback: the deferred prefix replays per-op; if
         the backward event was already consumed, the real tape backward
         runs so p.grad holds exactly what unfused dispatch would have
-        produced. Callers hold pending.lock."""
+        produced. Callers hold pending.lock. `reason` is the
+        flight-recorder attribution (a REASON_CODES entry); `blocked_op`
+        names the dispatch/event that broke the replay."""
         st = self._tls
         program = pending.program
         if pending.done:
@@ -841,14 +911,30 @@ class _StepFusionManager:
                         ph._pending_chain = None
             pending.done = True
             program.fail_streak += 1
+            deactivated = False
             if program.fail_streak >= _MAX_FAIL_STREAK \
                     and not program.dead:
                 program.dead = True
+                deactivated = True
                 program.release_heavy()
                 STEP_STATS.deactivated += 1
                 if st.active is program:
                     st.active = None
             STEP_STATS.split(program.label, escape=escape)
+            if reason is None:
+                reason = "mid_step_peek" if escape else "key_mismatch"
+            detail = {"entry_pos": pending.entry_pos,
+                      "op_pos": pending.op_pos,
+                      "ops": len(program.chain.ops)}
+            if blocked_op:
+                detail["blocked_op"] = blocked_op
+            if deactivated:
+                detail["deactivated"] = True
+            _EVENTS.emit("step.split", program.label, reason=reason,
+                         detail=detail)
+            if deactivated:
+                _EVENTS.emit("step.deactivate", program.label,
+                             reason="fail_streak")
             self._mark_dirty(st)
         finally:
             st.busy = False
@@ -861,6 +947,20 @@ class _StepFusionManager:
             st.recording = _Cycle()
         st.recording.poison()
 
+    def _poison(self, st, reason, op=""):
+        """Mark the observation cycle un-promotable AND record why in the
+        flight recorder. The (reason, op) pairs emitted here are exactly
+        what the fusion doctor aggregates into "step never promoted:
+        <op> <reason> ×N" — every poison call emits (not just the first
+        of a cycle) so per-cycle multiplicity survives into the report."""
+        if st.recording is None:
+            st.recording = _Cycle()
+        cyc = st.recording
+        _EVENTS.emit("step.record", op, reason=reason,
+                     detail={"kind": "poison", "pos": len(cyc.ops),
+                             "first": not cyc.dirty})
+        cyc.poison()
+
     def _after_boundary(self, st):
         st.recording = _Cycle()
         st.replay_arm = st.active is not None
@@ -868,6 +968,8 @@ class _StepFusionManager:
     def _boundary(self, st, opt, dirty):
         cyc = st.recording
         if cyc is None or dirty or cyc.dirty:
+            _EVENTS.emit("step.record", "optimizer_step",
+                         detail={"kind": "cycle", "clean": False})
             st.prev_sig, st.streak = None, 0
             self._after_boundary(st)
             return
@@ -878,6 +980,9 @@ class _StepFusionManager:
             st.streak += 1
         else:
             st.prev_sig, st.streak = sig, 1
+        _EVENTS.emit("step.record", "optimizer_step",
+                     detail={"kind": "cycle", "clean": True,
+                             "ops": len(cyc.ops), "streak": st.streak})
         min_count = int(
             _FLAGS.get("FLAGS_eager_step_fusion_min_count", 40) or 1)
         if st.streak >= min_count:
@@ -897,26 +1002,35 @@ class _StepFusionManager:
 
     def _build(self, st, cyc, sig, opt, updated):
         """Compile-time qualification + program construction from the last
-        observed cycle. Returns None when the cycle cannot promote."""
+        observed cycle. Returns None when the cycle cannot promote — every
+        None is attributed in the flight recorder (`unpromotable_cycle`
+        with a `why` detail) so a loop that records clean cycles but never
+        promotes still explains itself."""
         from ..jit.train_step import bake_decay_flags
+
+        def unbuildable(why, op=""):
+            _EVENTS.emit("step.record", op, reason="unpromotable_cycle",
+                         detail={"kind": "build_fail", "why": why})
+            return None
+
         entries = []
         bwd_entries = [e for e in cyc.entries if e[0] == "bwd"]
         if len(bwd_entries) != 1 or bwd_entries[0][1] is None \
                 or not cyc.ops or not updated:
-            return None
+            return unbuildable("no_backward_or_params")
         if any(p._hooks or p.stop_gradient for p in updated):
-            return None
+            return unbuildable("param_hooks")
         for p in updated:
             node = p._grad_node
             if node is not None and node.out_hooks:
-                return None
+                return unbuildable("param_hooks")
         ops = [
             _ChainOp(r.name, r.key, r.fn, r.wiring, r.diff_mask,
                      r.num_outputs, r.out_avals, r.out_stop_grads)
             for r in cyc.ops]
         chain = Chain(sig, ops, 0)
         if not chain.grad_mode:
-            return None
+            return unbuildable("no_grad_ops")
         # flat index of the backward root in the chain's output catalog
         root_coord = bwd_entries[0][1]
         root_flat = None
@@ -925,7 +1039,7 @@ class _StepFusionManager:
                 root_flat = flat
                 break
         if root_flat is None:
-            return None
+            return unbuildable("root_not_in_chain")
         # classify external slots: every differentiable ext input must be
         # one of the optimizer's updated params, every updated param must
         # appear (otherwise the eager step and the fused step would update
@@ -941,10 +1055,12 @@ class _StepFusionManager:
         for s in chain.diff_ext_idx:
             k = param_idx.get(id(slot_inputs[s]))
             if k is None:
-                return None
+                # a differentiable external input that is not an updated
+                # parameter (e.g. a float mask with stop_gradient=False)
+                return unbuildable("nonparam_diff_input")
             param_slots[s] = k
         if {k for k in param_slots.values()} != set(range(len(updated))):
-            return None
+            return unbuildable("param_set_mismatch")
         # events with per-op entries collapsed to ("op",) markers, in order
         # (the trailing ("step", ...) sig entry becomes the terminal event)
         op_iter = 0
@@ -988,6 +1104,9 @@ class _StepFusionManager:
         program.donate_params = bool(
             _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
         STEP_STATS.promoted(program.label)
+        _EVENTS.emit("step.promote", program.label,
+                     detail={"ops": len(ops), "params": len(updated),
+                             "launches_estimate": program.n_launches})
         return program
 
     def _disable(self, st):
@@ -995,7 +1114,8 @@ class _StepFusionManager:
         if st.pending is not None and not st.pending.fired:
             with st.pending.lock:
                 if not st.pending.done:
-                    self._split(st.pending, escape=False)
+                    self._split(st.pending, escape=False,
+                                reason="flag_off")
         st.pending = None
         st.recording = None
         st.prev_sig, st.streak = None, 0

@@ -97,6 +97,13 @@ class Optimizer:
         params = [p for p in self._parameter_list
                   if not p.stop_gradient or p.grad is not None]
         params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        # flight recorder: an EAGER (unfused) optimizer step ran — during a
+        # never-promoting loop this is the per-step heartbeat the doctor
+        # correlates with the poison events that explain why
+        from ..profiler.events import EVENTS as _EVENTS
+        _EVENTS.emit("step.record", "optimizer_step",
+                     detail={"kind": "eager_step",
+                             "params": len(params_grads)})
         if not params_grads:
             return
         if self.regularization is not None:

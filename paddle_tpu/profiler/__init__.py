@@ -22,6 +22,9 @@ from .chain_fusion import (ChainFusionStats, chain_fusion_stats,
                            reset_chain_fusion_stats)
 from .step_fusion import (StepFusionStats, step_fusion_stats,
                           reset_step_fusion_stats)
+from .events import (EVENTS, CATEGORIES, REASON_CODES, FusionEventLog,
+                     fusion_events, clear_fusion_events,
+                     fusion_events_enabled, events_summary)
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
@@ -30,7 +33,10 @@ __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "reset_dispatch_cache_stats", "ChainFusionStats",
            "chain_fusion_stats", "reset_chain_fusion_stats",
            "StepFusionStats", "step_fusion_stats",
-           "reset_step_fusion_stats"]
+           "reset_step_fusion_stats",
+           "CATEGORIES", "REASON_CODES", "FusionEventLog", "fusion_events",
+           "clear_fusion_events", "fusion_events_enabled", "events_summary",
+           "LoadedProfilerResult"]
 
 
 class SortedKeys(Enum):
@@ -47,7 +53,9 @@ class SortedKeys(Enum):
 
 
 class SummaryView(Enum):
-    """Summary view selector (reference profiler.py:41)."""
+    """Summary view selector (reference profiler.py:41). FusionView is
+    TPU-native (no reference analog): the dispatch/fusion pipeline's
+    counters + flight-recorder split tables."""
     DeviceView = 0
     OverView = 1
     ModelView = 2
@@ -57,6 +65,7 @@ class SummaryView(Enum):
     MemoryView = 6
     MemoryManipulationView = 7
     UDFView = 8
+    FusionView = 9
 
 
 def export_protobuf(dir_name, worker_name=None):
@@ -192,6 +201,9 @@ class Profiler:
         self.timer_only = timer_only
         self._step = 0
         self._events = []
+        self._fusion_events = []
+        self._events_flag_prev = None
+        self._events_since = 0
         self._jax_trace_dir = None
         self._state = ProfilerState.CLOSED
 
@@ -202,6 +214,15 @@ class Profiler:
         from ..core import host_tracer
         host_tracer.harvest()          # discard pre-start events
         host_tracer.enable(True)
+        # fusion flight recorder (events.py): auto-armed for the window so
+        # the exported trace always carries the dispatch/chain/step lanes;
+        # the flag is restored on stop() (a user who set it globally keeps
+        # recording past the window)
+        if not self.timer_only:
+            from ..framework.flags import _FLAGS
+            self._events_flag_prev = bool(_FLAGS.get("FLAGS_profiler_events"))
+            _FLAGS["FLAGS_profiler_events"] = True
+            self._events_since = EVENTS.total
         self._state = ProfilerState.RECORD
         if not self.timer_only and ProfilerTarget.TPU in self.targets:
             import tempfile
@@ -221,10 +242,27 @@ class Profiler:
                                  "ph": "X", "pid": os.getpid(),
                                  "cat": "host"})
 
+    def _drain_fusion(self):
+        """Pull the window's fusion events out of the ring. Drained
+        incrementally (stop() and every step()) so a long window survives
+        ring wraparound: only events older than the last drain can be
+        lost, and the `since` high-water mark makes drains disjoint."""
+        if self._events_flag_prev is None:
+            return
+        new = EVENTS.snapshot(since_seq=self._events_since)
+        if new:
+            self._fusion_events.extend(new)
+            self._events_since = new[-1]["seq"]
+
     def stop(self):
         global _active_profiler
         self._events.extend(_recorder.drain())
         self._drain_native()
+        self._drain_fusion()
+        if self._events_flag_prev is not None:
+            from ..framework.flags import _FLAGS
+            _FLAGS["FLAGS_profiler_events"] = self._events_flag_prev
+            self._events_flag_prev = None
         from ..core import host_tracer
         host_tracer.enable(False)
         if self._jax_trace_dir:
@@ -243,6 +281,7 @@ class Profiler:
         self._step += 1
         self._events.extend(_recorder.drain())
         self._drain_native()
+        self._drain_fusion()
         benchmark().step(num_samples)
 
     def step_info(self, unit=None):
@@ -256,9 +295,18 @@ class Profiler:
         return False
 
     def export(self, path, format="json"):
+        """Chrome-trace JSON: host lane(s) + one synthetic lane per fusion
+        tier (dispatch/chain/step), loadable in perfetto next to the XLA
+        xplane device profile (`jax_trace_dir`). The raw event dicts also
+        ride along under `fusion_events` so `load_profiler_result`
+        round-trips without loss (the lane projection is lossy: chrome
+        args stringify keys)."""
         with open(path, "w") as f:
-            json.dump({"traceEvents": self._events,
+            json.dump({"traceEvents":
+                       self._events + _fusion_trace_events(
+                           self._fusion_events),
                        "displayTimeUnit": "ms",
+                       "fusion_events": self._fusion_events,
                        "jax_trace_dir": self._jax_trace_dir}, f)
         return path
 
@@ -288,13 +336,128 @@ class Profiler:
         for name, (calls, dur, _mx, _mn) in sorted(agg.items(), key=key):
             lines.append(f"{name:<40} {calls:>8} {dur:>12.1f}")
         table = "\n".join(lines)
+        # FusionView: the dispatch/fusion pipeline counters + the window's
+        # flight-recorder split tables, folded into the same summary so
+        # one call shows the whole picture (host time AND why/where the
+        # fusion tiers hit, split, or never promoted)
+        if isinstance(views, SummaryView):
+            views = [views]
+        if views is None or SummaryView.FusionView in views:
+            table += _fusion_summary_table(self._fusion_events,
+                                           time_unit=time_unit)
         print(table)
         return table
 
 
+# synthetic chrome-trace tids for the fusion lifecycle lanes; thread_name
+# metadata labels them in perfetto. High values keep clear of real tids.
+_FUSION_LANE_TID = {"dispatch": 0x7F5E0001, "chain": 0x7F5E0002,
+                    "step": 0x7F5E0003}
+
+
+def _fusion_trace_events(fusion_events):
+    """Project flight-recorder event dicts into chrome-trace instant
+    events: one lane (synthetic tid) per fusion tier so perfetto shows the
+    dispatch / chain / step lifecycles as parallel tracks under the host
+    timeline."""
+    if not fusion_events:
+        return []
+    pid = os.getpid()
+    out = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": f"fusion:{tier}"}}
+           for tier, tid in _FUSION_LANE_TID.items()]
+    for e in fusion_events:
+        tier = e["cat"].split(".", 1)[0]
+        name = e["cat"] if not e.get("op") else f"{e['cat']}({e['op']})"
+        if e.get("reason"):
+            name += f" [{e['reason']}]"
+        rec = {"name": name, "ph": "i", "s": "t",
+               "ts": e["ts_ns"] / 1000.0, "pid": pid,
+               "tid": _FUSION_LANE_TID.get(tier, _FUSION_LANE_TID["step"]),
+               "cat": f"fusion.{tier}",
+               "args": {k: e[k] for k in ("seq", "tid", "op", "key",
+                                          "reason", "detail")
+                        if e.get(k) is not None}}
+        out.append(rec)
+    return out
+
+
+def _fusion_summary_table(fusion_events, time_unit="ms"):
+    """FusionView text: the three counter structs folded with the
+    flight-recorder aggregation (per-category counts + per-reason split/
+    bypass attribution)."""
+    lines = ["", "---------------- Fusion View ----------------"]
+
+    def block(title, d):
+        lines.append(f"{title}:")
+        for k, v in d.items():
+            if isinstance(v, dict):
+                continue
+            lines.append(f"  {k:<28} {v}")
+
+    block("dispatch_cache", dispatch_cache_stats())
+    block("chain_fusion", chain_fusion_stats())
+    block("step_fusion", step_fusion_stats())
+    agg = events_summary(fusion_events)
+    lines.append(f"fusion events ({agg['events']} in window):")
+    for cat, n in agg["by_category"].items():
+        lines.append(f"  {cat:<28} {n}")
+    if agg["reasons"]:
+        lines.append(f"{'split/bypass reason':<40} {'count':>8}")
+        for key, n in sorted(agg["reasons"].items(),
+                             key=lambda kv: -kv[1]):
+            lines.append(f"  {key:<38} {n:>8}")
+        by_op = [(k, n) for k, n in agg["by_op"].items()
+                 if k.rsplit(":", 1)[-1]]
+        for key, n in sorted(by_op, key=lambda kv: -kv[1])[:20]:
+            lines.append(f"    {key:<36} {n:>8}")
+    return "\n".join(lines)
+
+
+class LoadedProfilerResult(dict):
+    """`load_profiler_result` return value: the exported JSON dict plus
+    re-summarization over the round-tripped lanes — `trace_events`,
+    `fusion_events`, `events_summary()` and `summary()` re-aggregate from
+    the file with no live profiler state."""
+
+    @property
+    def trace_events(self):
+        return self.get("traceEvents", [])
+
+    @property
+    def fusion_events(self):
+        return self.get("fusion_events", [])
+
+    def events_summary(self):
+        return events_summary(self.fusion_events)
+
+    def summary(self):
+        from collections import defaultdict
+        agg = defaultdict(lambda: [0, 0.0])
+        for e in self.trace_events:
+            if e.get("ph") != "X":
+                continue
+            a = agg[e["name"]]
+            a[0] += 1
+            a[1] += e.get("dur", 0.0)
+        lines = [f"{'name':<40} {'calls':>8} {'total_us':>12}"]
+        for name, (calls, dur) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40} {calls:>8} {dur:>12.1f}")
+        ev = self.fusion_events
+        if ev:
+            a = self.events_summary()
+            lines.append(f"fusion events: {a['events']}")
+            for cat, n in a["by_category"].items():
+                lines.append(f"  {cat:<28} {n}")
+            for key, n in sorted(a["reasons"].items(),
+                                 key=lambda kv: -kv[1]):
+                lines.append(f"  {key:<38} {n:>8}")
+        return "\n".join(lines)
+
+
 def load_profiler_result(filename):
     with open(filename) as f:
-        return json.load(f)
+        return LoadedProfilerResult(json.load(f))
 
 
 class _Benchmark:

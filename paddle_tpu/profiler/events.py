@@ -1,0 +1,218 @@
+"""Fusion flight recorder: a structured event timeline for the dispatch/
+fusion pipeline.
+
+The three fusion tiers (per-op executable cache → chain fusion → whole-step
+promotion) are the dominant eager-performance variable, but their counter
+structs (profiler/{dispatch,chain_fusion,step_fusion}.py) only say HOW OFTEN
+something happened — never which op, which reason, or when. This module is
+the missing "when/why" layer: a bounded, thread-aware ring buffer of typed
+events, each carrying the op (or chain/step label), a cache-key digest, and
+a machine-readable reason code. The reference Paddle ships a full Profiler
+(HostTracer + CUPTI → chrome trace + summary tables) for its kernel
+launches; this is the TPU-native analog for the fusion pipeline's
+*decisions*.
+
+Event categories (a public contract — tests assert the set):
+
+  dispatch.hit / dispatch.miss / dispatch.bypass / dispatch.retrace
+      per-op executable-cache outcomes (ops/dispatch.py)
+  chain.detect / chain.compile / chain.fire / chain.split / chain.stitch
+      op-chain fusion lifecycle (ops/fusion.py)
+  step.record / step.promote / step.fire / step.split / step.deactivate
+      whole-step promotion lifecycle (ops/step_fusion.py; `step.record`
+      covers observation-side events: cycle boundaries, cycle poisons,
+      eager tape backwards and optimizer steps)
+
+Reason codes (also a public contract) attribute every bypass/split/poison
+to its cause — `rng_rekey` (the op consumed fresh global randomness and its
+closure re-keys every call: dropout), `unkeyable_closure` (an array/Tensor
+baked into the op fn), `mid_step_peek` (a pending value was read
+mid-replay), `registry_bump`, `shape_mismatch`, ... — see REASON_CODES.
+Coarse causes live in the reason code; free-form specifics (which op
+blocked a chain, which cycle position poisoned) live in the event's
+`detail` dict.
+
+Cost contract: gated by FLAGS_profiler_events; when off, `emit()` is one
+dict lookup and a return (tools/perf_smoke.py guards the disabled path at
+<3% of the fused smoke-loop step). When on, an emission is a tuple build
+plus a lock-guarded seq increment + deque append (unique seq across
+threads is what the Profiler's drain dedup keys on) — the ring
+(FLAGS_profiler_events_capacity) never grows unbounded. Events are drained into chrome-trace lanes by the
+Profiler (profiler/__init__.py) and aggregated into root-cause reports by
+profiler/explain.py / tools/fusion_doctor.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..framework.flags import _FLAGS
+
+__all__ = ["EVENTS", "CATEGORIES", "REASON_CODES", "FusionEventLog",
+           "fusion_events", "clear_fusion_events", "fusion_events_enabled",
+           "events_summary"]
+
+
+CATEGORIES = frozenset({
+    "dispatch.hit", "dispatch.miss", "dispatch.bypass", "dispatch.retrace",
+    "chain.detect", "chain.compile", "chain.fire", "chain.split",
+    "chain.stitch",
+    "step.record", "step.promote", "step.fire", "step.split",
+    "step.deactivate",
+})
+
+# Machine-readable causes. Stable across releases: the fusion doctor, the
+# perf-smoke "no unexplained splits" guard, and downstream trace tooling
+# key on these strings.
+REASON_CODES = frozenset({
+    # -- why a dispatch bypassed the executable cache ----------------------
+    "unkeyable_closure",   # fn closes over an array/Tensor/stateful object
+    "rng_rekey",           # op consumed fresh global RNG; re-keys per call
+    "tracer_input",        # input is a jax tracer (inside an outer trace)
+    "cache_disabled",      # cache flag off or size 0
+    "unjittable",          # negative-cached: the op cannot be jitted
+    # -- why a chain/step replay split -------------------------------------
+    "key_mismatch",        # next op's cache key diverged from the template
+    "shape_mismatch",      # same op, different input avals
+    "wiring_mismatch",     # dataflow wiring diverged from the template
+    "registry_bump",       # a kernel override (de)activation re-keyed the op
+    "mid_chain_escape",    # a chain intermediate was read before the fire
+    "mid_step_peek",       # a pending step value was read before opt.step()
+    "event_mismatch",      # backward/clear_grad/step event out of order
+    "param_mismatch",      # parameter set/binding/buffer identity changed
+    "optimizer_state_change",  # clip/regularizer/hyper-param/slot change
+    "hook_present",        # tensor/grad/saved-tensor hooks block fusion
+    "exec_fault",          # transient XLA execution fault during the fire
+    "trace_fail",          # the fused executable failed to trace
+    "debug_interrupt",     # NaN-scan/benchmark mode forced per-op dispatch
+    "flag_off",            # a fusion flag flipped off mid-run
+    # -- why a cycle could not promote (observation side) ------------------
+    "uncached_dispatch",   # an op took the uncached path inside the cycle
+    "multi_backward",      # >1 backward per cycle (grad accumulation)
+    "cycle_too_long",      # cycle exceeded the recording cap
+    "unpromotable_cycle",  # build-time qualification failed (see detail)
+    "fail_streak",         # deactivated after repeated failed replays
+})
+
+
+class FusionEventLog:
+    """The process-global ring. An emission is a tuple build plus a
+    lock-guarded seq increment + deque append (the lock is only touched
+    when the recorder is ON; the off path is a single flag check).
+    `total` is a monotonic high-water mark used by the Profiler to drain
+    only the events of its window — seq values must be unique across
+    threads or the drain dedup would drop/double events, hence the lock
+    rather than a bare `total += 1`."""
+
+    __slots__ = ("_buf", "_lock", "total")
+
+    def __init__(self):
+        self._buf = deque(maxlen=self._capacity())
+        self._lock = threading.Lock()
+        self.total = 0
+
+    @staticmethod
+    def _capacity():
+        try:
+            cap = int(_FLAGS.get("FLAGS_profiler_events_capacity", 65536)
+                      or 0)
+        except (TypeError, ValueError):
+            cap = 65536
+        return max(cap, 1)
+
+    @property
+    def enabled(self):
+        return bool(_FLAGS.get("FLAGS_profiler_events"))
+
+    # -- emission (hot path) ------------------------------------------------
+    def emit(self, cat, op="", key=None, reason=None, detail=None):
+        """Record one event. No-op (one flag check) when the recorder is
+        off. `key` is digested to a short stable hex string so raw cache
+        keys (code objects, avals) never sit in the ring."""
+        if not _FLAGS.get("FLAGS_profiler_events"):
+            return
+        row_tail = (threading.get_ident(), cat, op, _key_digest(key),
+                    reason, detail)
+        with self._lock:
+            seq = self.total = self.total + 1
+            self._buf.append((seq, time.perf_counter_ns()) + row_tail)
+
+    # -- reading ------------------------------------------------------------
+    def snapshot(self, category=None, since_seq=0):
+        """Events as dicts, oldest first. `category` filters by exact
+        category or by tier prefix ("chain" matches every chain.* event);
+        `since_seq` returns only events emitted after that high-water
+        mark."""
+        rows = list(self._buf)
+        out = []
+        for seq, ts, tid, cat, op, key, reason, detail in rows:
+            if seq <= since_seq:
+                continue
+            if category is not None and cat != category \
+                    and not cat.startswith(category + "."):
+                continue
+            out.append({"seq": seq, "ts_ns": ts, "tid": tid, "cat": cat,
+                        "op": op, "key": key, "reason": reason,
+                        "detail": detail})
+        return out
+
+    def clear(self):
+        """Drop every recorded event and re-apply the capacity flag."""
+        with self._lock:
+            self._buf = deque(maxlen=self._capacity())
+
+    def __len__(self):
+        return len(self._buf)
+
+
+def _key_digest(key):
+    if key is None:
+        return None
+    try:
+        return format(hash(key) & 0xFFFFFFFFFFFF, "012x")
+    except TypeError:
+        return None
+
+
+EVENTS = FusionEventLog()
+
+
+def fusion_events(category=None, since_seq=0):
+    """Snapshot of the fusion flight recorder (list of event dicts)."""
+    return EVENTS.snapshot(category, since_seq)
+
+
+def clear_fusion_events():
+    EVENTS.clear()
+
+
+def fusion_events_enabled():
+    return EVENTS.enabled
+
+
+def events_summary(events=None):
+    """Aggregate a list of event dicts (default: the live ring) into the
+    compact shape bench.py embeds and perf_smoke.py guards on:
+    per-category counts plus (category, reason) split/bypass attribution."""
+    if events is None:
+        events = EVENTS.snapshot()
+    by_cat: dict = {}
+    reasons: dict = {}
+    ops: dict = {}
+    for e in events:
+        cat = e["cat"]
+        by_cat[cat] = by_cat.get(cat, 0) + 1
+        r = e.get("reason")
+        if r is not None:
+            rk = f"{cat}:{r}"
+            reasons[rk] = reasons.get(rk, 0) + 1
+            ok = (cat, r, e.get("op") or "")
+            ops[ok] = ops.get(ok, 0) + 1
+    return {
+        "events": len(events),
+        "by_category": dict(sorted(by_cat.items())),
+        "reasons": dict(sorted(reasons.items())),
+        "by_op": {f"{c}:{r}:{o}": n
+                  for (c, r, o), n in sorted(ops.items())},
+    }

@@ -1,0 +1,315 @@
+"""Fusion doctor core: root-cause aggregation of the flight recorder.
+
+`explain()` turns the raw event timeline (profiler/events.py) into a
+structured report answering the one question the counter structs cannot:
+*why* didn't this training loop promote (or why did it split)? The report
+names the op, the reason code, and the multiplicity — "step never
+promoted: `dropout` re-keys every call (rng_rekey ×40)" — and
+`format_report()` renders it for humans. `tools/fusion_doctor.py` is the
+CLI wrapper; `bench.py` embeds the compact dict in its headline extra.
+
+Works on any list of event dicts: the live ring (default), a Profiler
+window (`prof._fusion_events`), or a re-loaded chrome trace
+(`load_profiler_result(path).fusion_events`).
+"""
+from __future__ import annotations
+
+from .events import EVENTS, REASON_CODES
+
+__all__ = ["explain", "format_report", "REASON_HINTS"]
+
+
+# actionable one-liners per reason code: what the attribution means and the
+# ROADMAP-backed fix. Keyed on the public REASON_CODES contract.
+REASON_HINTS = {
+    "rng_rekey": (
+        "the op consumes fresh global randomness every call (dropout "
+        "family), so its closure re-keys per dispatch and every cycle is "
+        "poisoned. Fix: hoist the PRNG key to a step argument (ROADMAP "
+        "follow-on (b)) or run with dropout disabled to promote."),
+    "unkeyable_closure": (
+        "a per-batch array/Tensor is baked into the op's closure instead "
+        "of being a dispatch input. Fix: thread it through the op's "
+        "inputs as done for embedding/cross_entropy/attention-mask/"
+        "nll_loss."),
+    "tracer_input": (
+        "the op ran under an outer jax trace (jit/grad of a paddle "
+        "function); eager fusion stands down there by design."),
+    "cache_disabled": (
+        "FLAGS_eager_op_cache is off or its size is 0 — nothing above "
+        "the per-op tier can engage."),
+    "unjittable": (
+        "the op failed to jit and is negative-cached; it hard-breaks any "
+        "chain or cycle containing it."),
+    "key_mismatch": (
+        "a different op (or the same op with different fn/AMP/diff "
+        "state) arrived where the template expected another — the loop "
+        "body is not actually identical across iterations."),
+    "shape_mismatch": (
+        "same op, different input shapes/dtypes — variable batch or "
+        "sequence length re-keys the template. Fix: pad/bucket shapes."),
+    "wiring_mismatch": (
+        "dataflow between ops diverged from the recorded template "
+        "(a value was fed from a different producer)."),
+    "registry_bump": (
+        "a kernel override was (de)activated mid-loop, re-keying the "
+        "op."),
+    "mid_chain_escape": (
+        "an intermediate tensor was read (value/grad/hook) before its "
+        "chain fired; the chain split to materialize it."),
+    "mid_step_peek": (
+        "a pending whole-step value (loss/grad/intermediate) was read "
+        "before optimizer.step(); the replay split to serve it. Fix: "
+        "move logging of loss values after step(), or log every N "
+        "steps."),
+    "event_mismatch": (
+        "the backward/clear_grad/step event order diverged from the "
+        "recorded cycle (extra backward, different root, out-of-order "
+        "optimizer calls)."),
+    "param_mismatch": (
+        "the parameter set/binding changed: a buffer was swapped, a "
+        "param was added/removed, or an outside grad appeared."),
+    "optimizer_state_change": (
+        "clip/regularizer attributes, hyper-params, or accumulator "
+        "structure changed — the baked step executable is stale (the "
+        "program is dropped and rebuilt if the loop re-stabilizes)."),
+    "hook_present": (
+        "tensor/grad/saved-tensor hooks are installed; a fused replay "
+        "cannot honor observer semantics, so fusion stands down."),
+    "exec_fault": (
+        "a transient XLA execution fault during the fused fire; the "
+        "replay fell back per-op (bitwise identical)."),
+    "trace_fail": (
+        "the fused executable failed to trace; the program was "
+        "deactivated."),
+    "debug_interrupt": (
+        "FLAGS_check_nan_inf / FLAGS_benchmark forces materialized "
+        "per-op results; fusion is disabled while set."),
+    "flag_off": (
+        "a fusion flag was flipped off mid-run."),
+    "uncached_dispatch": (
+        "an op inside the cycle took the uncached path (first-call "
+        "compile or a cache fault) — transient during warmup; "
+        "persistent occurrences mean cache thrash (check "
+        "FLAGS_eager_op_cache_size / evictions)."),
+    "multi_backward": (
+        "more than one backward() per cycle (gradient accumulation); "
+        "the step recorder requires exactly one (ROADMAP open item)."),
+    "cycle_too_long": (
+        "the cycle exceeded the recording cap (_MAX_CYCLE_OPS); a "
+        "whole-step compile would not amortize."),
+    "unpromotable_cycle": (
+        "build-time qualification failed — see the `why` detail "
+        "(no_backward_or_params / param_hooks / nonparam_diff_input / "
+        "...)."),
+    "fail_streak": (
+        "the promoted step was deactivated after repeated failed "
+        "replays — look at the step.split reasons right before it."),
+}
+
+
+def _attr(events, pred):
+    """{reason: {"count": n, "ops": {op: n}}} over events matching pred."""
+    out = {}
+    for e in events:
+        if not pred(e):
+            continue
+        r = e.get("reason") or "unattributed"
+        rec = out.setdefault(r, {"count": 0, "ops": {}})
+        rec["count"] += 1
+        op = e.get("op") or ""
+        if op:
+            rec["ops"][op] = rec["ops"].get(op, 0) + 1
+    return out
+
+
+def _top_op(rec):
+    ops = rec.get("ops") or {}
+    return max(ops.items(), key=lambda kv: kv[1])[0] if ops else ""
+
+
+def explain(events=None):
+    """Aggregate flight-recorder events into a root-cause report dict.
+
+    `events`: list of event dicts (default: the live ring). Returns a
+    JSON-ready report; feed it to `format_report` for text.
+    """
+    if events is None:
+        events = EVENTS.snapshot()
+    cats = {}
+    for e in events:
+        cats[e["cat"]] = cats.get(e["cat"], 0) + 1
+
+    def n(cat):
+        return cats.get(cat, 0)
+
+    step_splits = _attr(events, lambda e: e["cat"] == "step.split")
+    poisons = _attr(events, lambda e: e["cat"] == "step.record"
+                    and e.get("reason") is not None)
+    chain_splits = _attr(events, lambda e: e["cat"] == "chain.split")
+    bypasses = _attr(events, lambda e: e["cat"] == "dispatch.bypass")
+    clean_cycles = dirty_cycles = 0
+    build_fail_whys = {}
+    for e in events:
+        if e["cat"] == "step.record":
+            d = e.get("detail") or {}
+            if d.get("kind") == "cycle":
+                if d.get("clean"):
+                    clean_cycles += 1
+                else:
+                    dirty_cycles += 1
+            elif d.get("kind") == "build_fail":
+                w = d.get("why", "?")
+                build_fail_whys[w] = build_fail_whys.get(w, 0) + 1
+
+    report = {
+        "events": len(events),
+        "step": {
+            "promoted": n("step.promote"),
+            "fired": n("step.fire"),
+            "splits": n("step.split"),
+            "deactivated": n("step.deactivate"),
+            "split_reasons": step_splits,
+            "poisons": poisons,
+            "cycles": {"clean": clean_cycles, "dirty": dirty_cycles},
+            "build_failures": build_fail_whys,
+        },
+        "chain": {
+            "detected": n("chain.detect"),
+            "compiled": n("chain.compile"),
+            "fired": n("chain.fire"),
+            "splits": n("chain.split"),
+            "stitched": n("chain.stitch"),
+            "split_reasons": chain_splits,
+        },
+        "dispatch": {
+            "hits": n("dispatch.hit"),
+            "misses": n("dispatch.miss"),
+            "bypasses": n("dispatch.bypass"),
+            "retraces": n("dispatch.retrace"),
+            "bypass_reasons": bypasses,
+        },
+    }
+
+    findings = []
+    unknown = sorted({r for src in (step_splits, poisons, chain_splits,
+                                    bypasses)
+                      for r in src
+                      if r not in REASON_CODES and r != "unattributed"})
+    if unknown:
+        findings.append(
+            f"UNKNOWN reason code(s) {unknown}: the emitting site is off "
+            "the public contract — fix the instrumentation")
+
+    promoted, fired, splits = (report["step"][k] for k in
+                               ("promoted", "fired", "splits"))
+    if not events:
+        verdict = "no_data"
+        headline = ("no fusion events recorded — enable "
+                    "FLAGS_profiler_events (or run inside a Profiler "
+                    "window / fusion_doctor)")
+    elif fired and not splits and not poisons:
+        verdict = "clean_promotion"
+        headline = (f"clean promotion: {fired} fused whole-step "
+                    f"replay(s), 0 splits, 0 poisoned cycles")
+    elif promoted or fired:
+        worst_split = max(step_splits.items(),
+                          key=lambda kv: kv[1]["count"], default=None)
+        worst_poison = max(poisons.items(),
+                           key=lambda kv: kv[1]["count"], default=None)
+        if worst_split:
+            verdict = "unstable_promotion"
+            r, rec = worst_split
+            via = _top_op(rec)
+            headline = (f"promoted but split {splits}× — dominant cause "
+                        f"{r}" + (f" at `{via}`" if via else "")
+                        + f" ×{rec['count']}")
+        elif worst_poison:
+            verdict = "promoted_with_noise"
+            r, rec = worst_poison
+            headline = (f"promoted, {fired} fired, but cycles keep "
+                        f"poisoning: {r} ×{rec['count']}"
+                        + (f" at `{_top_op(rec)}`" if _top_op(rec) else ""))
+        else:
+            # promoted on the window's last boundary: no fire, no split,
+            # no poison yet — the loop simply ended too early (a window
+            # with fires and a clean record took the first branch)
+            verdict = "promoted_not_yet_fired"
+            headline = (f"promoted ({promoted}), {fired} fired, 0 splits "
+                        "— run more steps for a steady-state verdict")
+    elif poisons:
+        verdict = "never_promoted"
+        r, rec = max(poisons.items(), key=lambda kv: kv[1]["count"])
+        via = _top_op(rec)
+        headline = (f"step never promoted: "
+                    + (f"`{via}` " if via else "")
+                    + f"{r} ×{rec['count']}")
+    elif clean_cycles:
+        verdict = "not_yet_promoted"
+        headline = (f"{clean_cycles} clean cycle(s) recorded but the "
+                    "promotion threshold (FLAGS_eager_step_fusion_"
+                    "min_count) was not reached — run more steps")
+    else:
+        verdict = "no_step_activity"
+        headline = ("no step-fusion activity observed (no optimizer-step "
+                    "boundaries in the window)")
+    report["verdict"] = verdict
+    report["headline"] = headline
+
+    for r, rec in sorted(poisons.items(), key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        findings.append(
+            f"cycle poison {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
+    for r, rec in sorted(step_splits.items(),
+                         key=lambda kv: -kv[1]["count"]):
+        findings.append(
+            f"step split {r} ×{rec['count']}"
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
+    for r, rec in sorted(chain_splits.items(),
+                         key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        findings.append(
+            f"chain split {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
+    for r, rec in sorted(bypasses.items(), key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        findings.append(
+            f"dispatch bypass {r} ×{rec['count']}"
+            + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
+    for w, c in sorted(build_fail_whys.items(), key=lambda kv: -kv[1]):
+        findings.append(f"promotion build failed: {w} ×{c}")
+    report["findings"] = findings
+    return report
+
+
+def format_report(report):
+    """Human-readable fusion-doctor report."""
+    s = report["step"]
+    c = report["chain"]
+    d = report["dispatch"]
+    lines = [
+        "================ fusion doctor ================",
+        f"verdict : {report['verdict']}",
+        f"headline: {report['headline']}",
+        "",
+        f"step  : promoted={s['promoted']} fired={s['fired']} "
+        f"splits={s['splits']} deactivated={s['deactivated']} "
+        f"cycles(clean/dirty)={s['cycles']['clean']}/"
+        f"{s['cycles']['dirty']}",
+        f"chain : detected={c['detected']} fired={c['fired']} "
+        f"splits={c['splits']} stitched={c['stitched']}",
+        f"disp  : hits={d['hits']} misses={d['misses']} "
+        f"bypasses={d['bypasses']} retraces={d['retraces']}",
+    ]
+    if report["findings"]:
+        lines.append("")
+        lines.append("findings:")
+        for f in report["findings"]:
+            lines.append(f"  - {f}")
+    lines.append("===============================================")
+    return "\n".join(lines)

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Fusion doctor: explain WHY a training loop didn't promote (or split).
+
+Runs a training script (or a built-in demo loop) with the fusion flight
+recorder armed, then aggregates the event timeline into a root-cause
+report: which op poisoned the step cycle, with which reason code, how many
+times — e.g.
+
+    verdict : never_promoted
+    headline: step never promoted: `dropout` rng_rekey ×40
+    findings:
+      - cycle poison rng_rekey ×40 (`dropout`×40) — the op consumes fresh
+        global randomness every call ...
+
+Usage:
+
+    # any training script (its own argv after --)
+    JAX_PLATFORMS=cpu python tools/fusion_doctor.py train.py -- --epochs 1
+
+    # built-in demos (acceptance fixtures): a tiny GPT-ish loop
+    python tools/fusion_doctor.py --demo dropout   # never promotes: rng_rekey
+    python tools/fusion_doctor.py --demo masked    # clean promotion
+
+    # machine-readable
+    python tools/fusion_doctor.py --demo dropout --json
+
+The doctor only ARMS the recorder (FLAGS_profiler_events); it does not
+change the fusion configuration of a user script — if the script runs with
+caching/fusion off, the report says so instead of inventing activity.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+# runnable from a source checkout without an install
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _demo(variant, steps):
+    """Tiny single-head GPT-ish loop (embedding → attention → [dropout] →
+    projection → cross_entropy → SGD). `dropout` never promotes (the
+    rng_rekey acceptance fixture); `masked` feeds an attention mask — now
+    a dispatch input — and promotes cleanly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops import manipulation as manip
+
+    set_flags({"FLAGS_eager_op_cache": True,
+               "FLAGS_eager_chain_fusion": True,
+               "FLAGS_eager_chain_fusion_min_count": 4,
+               "FLAGS_eager_step_fusion": True,
+               "FLAGS_eager_step_fusion_min_count": 5})
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    B, T, D, V = 2, 8, 16, 32
+    ids = paddle.to_tensor(rng.integers(0, V, (B, T)))
+    labels = paddle.to_tensor(rng.integers(0, V, (B * T,)))
+    emb_w = paddle.to_tensor(
+        (rng.standard_normal((V, D)) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    wq, wk, wv, wo = (
+        paddle.to_tensor((rng.standard_normal((D, D)) * 0.1)
+                         .astype(np.float32), stop_gradient=False)
+        for _ in range(4))
+    w_out = paddle.to_tensor(
+        (rng.standard_normal((D, V)) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    mask = None
+    if variant == "masked":
+        causal = np.tril(np.ones((T, T), bool))
+        mask = paddle.to_tensor(causal[None, None])   # [1, 1, T, T]
+    params = [emb_w, wq, wk, wv, wo, w_out]
+    opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=params)
+
+    for _ in range(steps):
+        h = F.embedding(ids, emb_w)                       # [B, T, D]
+        q = manip.reshape(paddle.matmul(h, wq), [B, T, 1, D])
+        k = manip.reshape(paddle.matmul(h, wk), [B, T, 1, D])
+        v = manip.reshape(paddle.matmul(h, wv), [B, T, 1, D])
+        a = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, is_causal=(mask is None))
+        h = paddle.matmul(manip.reshape(a, [B, T, D]), wo)
+        if variant == "dropout":
+            h = F.dropout(h, 0.1)
+        logits = manip.reshape(paddle.matmul(h, w_out), [B * T, V])
+        loss = F.cross_entropy(logits, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fusion_doctor",
+        description="explain why a training loop didn't promote/split "
+                    "(fusion flight-recorder root-cause report)")
+    ap.add_argument("script", nargs="?",
+                    help="training script to run under the recorder")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="arguments passed to the script (after --)")
+    ap.add_argument("--demo", choices=("dropout", "masked"),
+                    help="run a built-in tiny GPT-ish demo loop instead "
+                         "of a script")
+    ap.add_argument("--steps", type=int, default=20,
+                    help="demo loop steps (default 20)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    if not args.demo and not args.script:
+        ap.error("either a script or --demo is required")
+
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.profiler.events import EVENTS, clear_fusion_events
+    from paddle_tpu.profiler.explain import explain, format_report
+
+    clear_fusion_events()
+    set_flags({"FLAGS_profiler_events": True})
+    try:
+        if args.demo:
+            _demo(args.demo, args.steps)
+        else:
+            sa = args.script_args
+            if sa and sa[0] == "--":
+                sa = sa[1:]
+            old_argv = sys.argv
+            sys.argv = [args.script] + sa
+            try:
+                runpy.run_path(args.script, run_name="__main__")
+            except SystemExit as e:
+                if e.code not in (0, None):
+                    print(f"fusion_doctor: script exited with {e.code} "
+                          "(reporting on the events recorded so far)",
+                          file=sys.stderr)
+            finally:
+                sys.argv = old_argv
+    finally:
+        set_flags({"FLAGS_profiler_events": False})
+
+    report = explain(EVENTS.snapshot())
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,14 @@ stack silently regressed:
   * zero whole-step fusion replays, a post-warmup step retrace, or a
     fused-step speedup below the guard — the stable fwd+bwd+optimizer
     cycle must be promoted to ONE fused executable (ops/step_fusion.py)
-    and beat the chain-fusion path (a PR 3 regression).
+    and beat the chain-fusion path (a PR 3 regression);
+  * unexplained splits — with the fusion flight recorder armed
+    (FLAGS_profiler_events), every chain.split/step.split event must
+    carry a known reason code, and the steady-state loop must report
+    ZERO splits (a PR 4 attribution regression);
+  * events-off overhead — the recorder's disabled path (one flag check
+    per emission site) must cost <3% of a fused step at the observed
+    events-per-step rate (a PR 4 hot-path regression).
 
 Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
 in tests/test_chain_fusion.py and tests/test_step_fusion.py — this CLI is
@@ -141,12 +148,73 @@ def main() -> int:
             f"fused step {t_step*1e6:.0f}us): the fused path lost its win "
             "(PR 3 regression)")
 
+    # ---- flight-recorder legs (PR 4 guards) ------------------------------
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.profiler.events import (EVENTS, REASON_CODES,
+                                            clear_fusion_events)
+
+    # (a) no unexplained splits + steady-state zero splits: re-run the
+    # fused loop with the recorder armed; warmup splits must all carry a
+    # known reason code and the measured window must contain none at all
+    step = _loop(step_fused=True)
+    clear_fusion_events()
+    set_flags({"FLAGS_profiler_events": True})
+    for _ in range(WARMUP):
+        step()
+    steady_seq = EVENTS.total
+    for _ in range(MEASURE):
+        step()
+    set_flags({"FLAGS_profiler_events": False})
+    split_events = [e for e in EVENTS.snapshot()
+                    if e["cat"] in ("chain.split", "step.split")]
+    unexplained = [e for e in split_events
+                   if e["reason"] not in REASON_CODES]
+    if unexplained:
+        failures.append(
+            f"{len(unexplained)} split event(s) without a known reason "
+            f"code (first: {unexplained[0]}): split attribution broke "
+            "(PR 4 regression)")
+    steady_splits = [e for e in split_events if e["seq"] > steady_seq]
+    if steady_splits:
+        failures.append(
+            f"{len(steady_splits)} steady-state split(s) in the smoke "
+            f"loop (first: {steady_splits[0]['cat']}:"
+            f"{steady_splits[0]['reason']}): the stable cycle should "
+            "replay without splitting (PR 4 regression)")
+    events_per_step = (EVENTS.total - steady_seq) / MEASURE
+    clear_fusion_events()
+
+    # (b) events-off overhead: the disabled emit path is one flag check;
+    # at the observed events-per-step rate its total cost must stay <3%
+    # of a fused step (timing the loop against a never-instrumented
+    # binary is impossible in-process, so guard the unit cost directly)
+    N_EMIT = 200_000
+    t0 = time.perf_counter()
+    for _ in range(N_EMIT):
+        EVENTS.emit("dispatch.hit", "x")
+    emit_off_ns = (time.perf_counter() - t0) / N_EMIT * 1e9
+    if len(EVENTS):
+        failures.append(
+            f"{len(EVENTS)} event(s) recorded with FLAGS_profiler_events "
+            "off: the gate is broken (PR 4 regression)")
+    overhead_frac = emit_off_ns * events_per_step / max(t_step * 1e9, 1.0)
+    if overhead_frac >= 0.03:
+        failures.append(
+            f"events-off emit cost {emit_off_ns:.0f}ns x "
+            f"{events_per_step:.1f} events/step is "
+            f"{overhead_frac * 100:.2f}% of a fused step (>=3%): the "
+            "disabled path got expensive (PR 4 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
           f"(step retraces={step_retraces}), "
           f"step-vs-chain speedup={speedup:.2f}x, "
-          f"launches_saved={s1['launches_saved'] - s0['launches_saved']}")
+          f"launches_saved={s1['launches_saved'] - s0['launches_saved']}, "
+          f"splits={len(split_events)} (steady={len(steady_splits)}, "
+          f"unexplained={len(unexplained)}), "
+          f"events-off emit={emit_off_ns:.0f}ns "
+          f"({overhead_frac * 100:.3f}%/step)")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
