@@ -196,6 +196,7 @@ def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
                                      chain_fusion_stats, step_fusion_stats,
                                      events_summary, fusion_events)
     from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.ops.guardian import guardian_stats as _guardian_stats
     ev = fusion_events()
     doctor = explain(ev)
 
@@ -211,6 +212,11 @@ def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
                   "dispatch_cache": dispatch_cache_stats(),
                   "chain_fusion": chain_fusion_stats(),
                   "step_fusion": step_fusion_stats(),
+                  # non-finite step guardian (FLAGS_check_numerics):
+                  # all-zero unless the config armed it — nonzero
+                  # steps_skipped on a clean bench run means the model
+                  # itself is producing non-finite grads
+                  "guardian": _guardian_stats(),
                   # split-reason attribution (fusion flight recorder):
                   # per-category event counts + (category, reason, op)
                   # tables, and the doctor's one-line verdict

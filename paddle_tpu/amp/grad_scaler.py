@@ -6,6 +6,17 @@ TPU-first: bf16 training needs no loss scaling, so with bf16 autocast this is
 a documented no-op passthrough. For fp16, the full dynamic loss-scaling state
 machine is implemented (scale on loss, unscale+finite-check on grads, skip
 step and shrink scale on overflow, grow after N good steps).
+
+Fusion contract (PR 5, ops/guardian.py + ops/step_fusion.py): all scaler
+state lives on DEVICE and the loss scale rides as a dispatch *input* — keyed
+by aval, never by value — so a backoff changes nothing about the compiled
+step and dynamic-loss-scaled loops promote to ONE fused executable. Under
+`FLAGS_check_numerics` the skip-step decision is in-graph
+(`where(finite, new, old)` inside the optimizer update), so `step()` never
+syncs: a found-inf batch is a bitwise no-op update. Without the guardian the
+legacy semantics are kept — one host sync of the found-inf scalar per step
+and a Python-level skip (which is also why such loops cannot whole-step
+fuse; the step recorder attributes them as `mid_step_peek`).
 """
 from __future__ import annotations
 
@@ -13,6 +24,12 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["GradScaler"]
+
+
+def _scale_mul(v, s):
+    """Loss scaling as a keyable dispatched op: the scale arrives as an
+    input aval (hoisted scalar), not a closure constant."""
+    return v * s.astype(v.dtype)
 
 
 class GradScaler:
@@ -26,58 +43,127 @@ class GradScaler:
         self._incr_every_n_steps = incr_every_n_steps
         self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
+        # device scalars after the first transition; python numbers until
+        # then (constructing jnp arrays here would touch the backend at
+        # import-adjacent time)
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        # set by a fused whole-step fire (ops/step_fusion.py): the
+        # executable already computed (found_inf, scale', good', bad');
+        # update() commits it instead of re-running the transition
+        self._fused_next = None
 
+    # -- fused-step integration helpers -------------------------------------
+    def _consts(self):
+        """The constants a fused step executable bakes in (snapshot-verified
+        at every fire; a change kills the promoted program)."""
+        return (bool(self._enable), bool(self._dynamic),
+                float(self._incr_ratio), float(self._decr_ratio),
+                int(self._incr_every_n_steps),
+                int(self._decr_every_n_nan_or_inf))
+
+    def _state_arrays(self):
+        return (jnp.asarray(self._scale, jnp.float32),
+                jnp.asarray(self._good_steps, jnp.int32),
+                jnp.asarray(self._bad_steps, jnp.int32))
+
+    # -- public API ----------------------------------------------------------
     def scale(self, var):
         if not self._enable:
             return var
-        return var * self._scale
+        from ..framework.core import Tensor
+        from ..ops import guardian
+        from ..ops.dispatch import call_op
+        # AMP thread: fp16 forward overflow is expected and rescued by the
+        # found-inf/skip-step path, so the guardian attributes non-finite
+        # forward outputs instead of raising
+        guardian.mark_scaler_active()
+        s = Tensor(jnp.asarray(self._scale, jnp.float32),
+                   stop_gradient=True, name="loss_scale")
+        return call_op("scale_loss", _scale_mul, (var, s))
 
     def unscale_(self, optimizer):
         """check_finite_and_unscale analog: divide grads by scale, record
-        whether any grad is non-finite."""
-        if not self._enable or self._unscaled:
+        whether any grad is non-finite — as ONE device scalar, no host
+        sync here (the legacy step() path syncs it once; the guardian path
+        never does)."""
+        if not self._enable:
             return
-        found = False
-        inv = 1.0 / self._scale
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._value * jnp.asarray(inv, p.grad._value.dtype)
-            found = found or bool(~jnp.isfinite(g).all())
-            p.grad._value = g
-        self._found_inf = found
+        if self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
+        from ..ops import guardian
+        grads = [p.grad for p in optimizer._parameter_list
+                 if p.grad is not None]
+        if grads:
+            # reading ._value forces any pending fused-step placeholder,
+            # which splits the replay first (mid_step_peek) — grads are
+            # real past this line
+            gvals = [g._value for g in grads]
+            inv = jnp.asarray(1.0, jnp.float32) \
+                / jnp.asarray(self._scale, jnp.float32)
+            self._found_inf = jnp.logical_not(guardian.finite_all(gvals))
+            for g, gv in zip(grads, gvals):
+                g._value = gv * inv.astype(gv.dtype)
+        else:
+            self._found_inf = False
         self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
+        from ..ops import guardian
+        from ..ops.step_fusion import STEP as _step_fusion
+        guardian.mark_scaler_active()
+        if _step_fusion.on_scaler_step(self, optimizer):
+            # a pending whole-step replay matched: ONE fused executable
+            # already unscaled, finite-checked, where()-updated the
+            # params/slots and advanced the loss-scale state
+            self._unscaled = False
+            guardian.maybe_flush()
+            return
         if not self._unscaled:
             self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
         self._unscaled = False
+        if guardian.skip_step_enabled():
+            # in-graph skip-step rescue: the optimizer update applies
+            # where(finite, new, old), so step() runs unconditionally
+            # (and advances the step counter) with no host sync — a
+            # found-inf batch is a bitwise no-op on params and slots
+            optimizer.step()
+        elif not bool(np.asarray(self._found_inf)):
+            # legacy semantics: one host sync, Python-level skip
+            optimizer.step()
 
     def update(self):
-        """update_loss_scaling analog."""
-        if not self._enable or not self._dynamic:
+        """update_loss_scaling analog — the state transition runs on
+        device (guardian.update_scaler_state) or is committed from the
+        fused step executable's outputs; nothing here syncs."""
+        if not self._enable:
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every_n_nan_or_inf:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
+        fused = self._fused_next
+        self._fused_next = None
+        if not self._dynamic:
+            self._found_inf = False
+            return
+        from ..ops import guardian
+        if fused is not None:
+            # the fused fire already traced the identical transition in;
+            # its backoff (if any) was attributed at the fire
+            _found, s2, g2, b2 = fused
         else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
+            scale, good, bad = self._state_arrays()
+            s2, g2, b2 = guardian.update_scaler_state(
+                scale, good, bad, self._found_inf, self._incr_ratio,
+                self._decr_ratio, self._incr_every_n_steps,
+                self._decr_every_n_nan_or_inf)
+            if guardian.enabled():
+                guardian.note_scaler(scale, s2)
+        self._scale, self._good_steps, self._bad_steps = s2, g2, b2
         self._found_inf = False
 
     def minimize(self, optimizer, scaled_loss):
@@ -91,16 +177,21 @@ class GradScaler:
         return self._dynamic
 
     def get_init_loss_scaling(self):
-        return self._scale
+        return float(np.asarray(self._scale))
 
     def state_dict(self):
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+        return {"scale": float(np.asarray(self._scale)),
+                "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every_n_steps,
                 "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
-                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+                "good_steps": int(np.asarray(self._good_steps)),
+                "bad_steps": int(np.asarray(self._bad_steps))}
 
     def load_state_dict(self, state):
-        self._scale = state["scale"]
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        self._scale = float(np.asarray(state["scale"]))
+        self._good_steps = int(state.get("good_steps", 0))
+        self._bad_steps = int(state.get("bad_steps", 0))
+        self._found_inf = False
+        self._unscaled = False
+        self._fused_next = None

@@ -166,6 +166,11 @@ class Tensor:
             self._ensure_grad_node()
             node = self._grad_node
         run_backward(node, self._out_index, seed, retain_graph=retain_graph)
+        # guardian (FLAGS_check_numerics): the backward boundary resolves
+        # the queued in-graph finite checks — one batched device->host
+        # transfer; a no-op (empty queue) when the flag is off
+        from ..ops.guardian import maybe_flush
+        maybe_flush()
 
     def register_hook(self, hook):
         """Register a grad hook (fires at accumulation for leaves, at the

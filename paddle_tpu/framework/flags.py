@@ -33,8 +33,40 @@ def define_flag(name, default, help_str=""):
 
 # Core flags mirroring the reference set (platform/flags.cc)
 define_flag("FLAGS_check_nan_inf", False,
-            "scan op outputs for NaN/Inf (nan_inf_utils.h analog)")
+            "scan op outputs for NaN/Inf (nan_inf_utils.h analog). STRICT "
+            "debug mode: forces per-op dispatch with a device sync per "
+            "inexact output, flushing any chain/step fusion — use it to "
+            "LOCALIZE a known blowup. For always-on production checking "
+            "see FLAGS_check_numerics, which keeps the fusion stack "
+            "engaged")
 define_flag("FLAGS_check_nan_inf_level", 0, "0: fail on nan/inf")
+
+# Non-finite step guardian (ops/guardian.py). Unlike FLAGS_check_nan_inf —
+# which drops dispatch to the per-op debug path and flushes every chain —
+# this mode compiles a cheap all-finite reduction INTO the cached
+# executables of all three fusion tiers: a per-op launch, a fused chain
+# launch, and a fused whole-step launch each emit ONE extra scalar. The
+# scalars are checked lazily (a small per-thread queue flushed at backward
+# / optimizer-step boundaries), so there is no per-op host sync and the
+# chain/step fusion wins survive. A promoted whole-step executable
+# additionally computes a global grads-finite predicate and applies the
+# update as where(finite, new_state, old_state): a poisoned batch becomes
+# a bitwise no-op step (`nonfinite_skip` in the fusion flight recorder)
+# instead of corrupted parameters. The eager (unfused) optimizer path
+# applies the same skip-step semantics for parity.
+define_flag("FLAGS_check_numerics", False,
+            "fused in-graph numerics guardian: compile an all-finite "
+            "reduction into per-op/chain/step executables (one scalar per "
+            "launch, no per-op sync, fusion stays engaged), raise/warn on "
+            "non-finite forward outputs at the next backward/step "
+            "boundary, and turn a non-finite-gradient step into a bitwise "
+            "no-op update (skip-step rescue). FLAGS_check_nan_inf remains "
+            "the strict per-op fallback and takes precedence when set")
+define_flag("FLAGS_check_numerics_level", 0,
+            "0: raise FloatingPointError on a non-finite forward output; "
+            ">=1: warn and continue. Gradient non-finiteness never raises "
+            "— it skips the step (and backs off the GradScaler loss scale "
+            "when one is attached)")
 define_flag("FLAGS_benchmark", False, "sync after each op for timing")
 define_flag("FLAGS_use_flash_attention", True,
             "route eligible attention through the Pallas flash kernel")

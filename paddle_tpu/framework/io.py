@@ -1,6 +1,13 @@
 """paddle.save / paddle.load. Reference analog:
 python/paddle/framework/io.py:640 (save) / :882 (load) — pickle protocol with
 tensors converted to numpy payloads; nested state dict structures preserved.
+
+Crash safety (PR 5): every path-targeted save — sync and async alike —
+writes to a same-directory temp file, fsyncs, appends a CRC-32 trailer, and
+`os.replace`s into place, so a crash or kill -9 mid-save can never leave a
+torn checkpoint under the real name: the previous complete file survives.
+`load()` verifies the trailer when present and raises
+`CheckpointCorruptError` (an IOError) instead of unpickling junk.
 """
 from __future__ import annotations
 
@@ -11,7 +18,15 @@ import numpy as np
 
 from .core import Tensor, Parameter
 
-__all__ = ["save", "load", "async_save", "AsyncSaveHandle"]
+__all__ = ["save", "load", "async_save", "AsyncSaveHandle",
+           "CheckpointCorruptError"]
+
+
+class CheckpointCorruptError(IOError):
+    """A checkpoint file failed its integrity check (CRC mismatch,
+    truncation, or an unreadable pickle stream). The file on disk is not a
+    usable checkpoint — restore from an earlier one (EpochRange retains a
+    rolling window) instead of training on partial state."""
 
 _PROTOCOL_KEY = "__paddle_tpu_tensor__"
 
@@ -54,15 +69,38 @@ def _unpack(obj, return_numpy=False):
     return obj
 
 
+def _write_atomic(path, payload):
+    """tmp + fsync + os.replace with the CRC-32 trailer: the destination
+    either keeps its previous complete content or becomes the new complete
+    content — never a torn mix (shared by save and the async fallback)."""
+    import struct
+    import zlib
+    dirname = os.path.dirname(path)
+    if dirname and not os.path.isdir(dirname):
+        os.makedirs(dirname, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.write(struct.pack("<QQQ", _TRAILER_MAGIC, len(payload),
+                                zlib.crc32(payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save(obj, path, protocol=4, **configs):
     if hasattr(path, "write"):
         pickle.dump(_pack(obj), path, protocol=protocol)
         return
-    dirname = os.path.dirname(path)
-    if dirname and not os.path.isdir(dirname):
-        os.makedirs(dirname, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    _write_atomic(os.fspath(path),
+                  pickle.dumps(_pack(obj), protocol=protocol))
 
 
 class AsyncSaveHandle:
@@ -123,16 +161,7 @@ def async_save(obj, path, protocol=4):
     if lib is None:
         # synchronous fallback keeps the same guarantees: atomic tmp+rename
         # and the CRC trailer (pure-python zlib.crc32 == IEEE CRC-32)
-        import struct
-        import zlib
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            f.write(struct.pack("<QQQ", _TRAILER_MAGIC, len(payload),
-                                zlib.crc32(payload)))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        _write_atomic(path, payload)
         sync = AsyncSaveHandle(None, None, path)
         sync._finished = True
         return sync
@@ -171,12 +200,13 @@ def _verify_trailer(path):
         while left > 0:
             chunk = f.read(min(left, 1 << 20))
             if not chunk:
-                raise IOError(f"checkpoint {path} is corrupt (truncated)")
+                raise CheckpointCorruptError(
+                    f"checkpoint {path} is corrupt (truncated)")
             crc = zlib.crc32(chunk, crc)
             left -= len(chunk)
     if crc != crc_stored:
-        raise IOError(f"checkpoint {path} is corrupt (CRC mismatch — torn "
-                      "write?)")
+        raise CheckpointCorruptError(
+            f"checkpoint {path} is corrupt (CRC mismatch — torn write?)")
 
 
 def load(path, **configs):
@@ -185,8 +215,18 @@ def load(path, **configs):
         data = pickle.load(path)
     else:
         _verify_trailer(path)
-        with open(path, "rb") as f:
-            # pickle.load stops at the end of the pickle stream, so the
-            # 24-byte CRC trailer from async_save is transparently ignored
-            data = pickle.load(f)
+        try:
+            with open(path, "rb") as f:
+                # pickle.load stops at the end of the pickle stream, so the
+                # 24-byte CRC trailer is transparently ignored
+                data = pickle.load(f)
+        except (pickle.UnpicklingError, EOFError, IndexError) as e:
+            # a legacy (trailer-less) file that is nevertheless broken:
+            # surface a checkpoint error, not a pickle internals traceback.
+            # AttributeError/ImportError are NOT caught — a missing class
+            # or module is code-version skew on a healthy file, and
+            # EpochRange.restore() must not skip past it as "corrupt"
+            raise CheckpointCorruptError(
+                f"checkpoint {path} is corrupt (unreadable pickle stream: "
+                f"{e})") from e
     return _unpack(data, return_numpy)

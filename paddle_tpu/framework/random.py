@@ -13,7 +13,8 @@ import threading
 import jax
 
 __all__ = ["seed", "get_rng_key", "split_key", "default_generator",
-           "tracing_key_scope", "RNGKeyContext", "rng_epoch"]
+           "tracing_key_scope", "RNGKeyContext", "rng_epoch",
+           "rng_checkpoint_state", "set_rng_checkpoint_state"]
 
 
 class _GlobalGenerator:
@@ -133,3 +134,31 @@ def set_rng_state(state):
 # here; reference: python/paddle/framework/random.py get_cuda_rng_state)
 get_cuda_rng_state = get_rng_state
 set_cuda_rng_state = set_rng_state
+
+
+def rng_checkpoint_state():
+    """Pickle-safe snapshot of the global generator for crash-safe
+    checkpoints (incubate/checkpoint.py): the raw key bits as numpy (typed
+    jax keys don't pickle portably), the epoch counter (so `rng_rekey`
+    attribution and any epoch-derived seeding resume exactly), and the
+    seed bookkeeping."""
+    import numpy as np
+    g = default_generator
+    with g._lock:
+        key = g._key
+        key_data = None if key is None \
+            else np.asarray(jax.random.key_data(key))
+        return {"key_data": key_data, "epoch": g.epoch,
+                "initial_seed": g.initial_seed, "seeded": g.seeded}
+
+
+def set_rng_checkpoint_state(state):
+    """Restore a `rng_checkpoint_state()` snapshot; resumed sampling
+    continues the interrupted stream bit-for-bit."""
+    g = default_generator
+    kd = state.get("key_data")
+    with g._lock:
+        g._key = None if kd is None else jax.random.wrap_key_data(kd)
+        g.epoch = int(state.get("epoch", 0))
+        g.initial_seed = int(state.get("initial_seed", 0))
+        g.seeded = bool(state.get("seeded", False))

@@ -11,7 +11,8 @@ beat per-op dispatch overhead with the PHI kernel library plus codegen'd C++
 forwards; here the same cost is beaten with a per-op executable cache:
 
   key   = (op name, fn token, input (shape, dtype, weak_type) avals,
-           diff mask, AMP-state token, registry override token)
+           diff mask, AMP-state token, registry override token,
+           guardian check flag)
   value = a jitted forward (no-grad path), or a jitted forward+vjp pair
           (grad path) whose vjp comes back as a `jax.tree_util.Partial`
           pytree — residual buffers as leaves — applied through one shared
@@ -48,6 +49,7 @@ from ..framework.autograd import pack_saved_values as _pack_saved, GradNode, is_
 from ..framework.flags import _FLAGS
 from ..profiler.dispatch import STATS as _STATS
 from ..profiler.events import EVENTS as _EVENTS
+from . import guardian as _guardian
 
 __all__ = ["call_op", "call_op_multi", "clear_dispatch_cache",
            "dispatch_cache_info"]
@@ -367,11 +369,14 @@ def _amp_token(name):
     return (st.level, st.dtype, name in st.white, name in st.black)
 
 
-def _make_key(name, fn, inputs, diff_mask, reg_token):
+def _make_key(name, fn, inputs, diff_mask, reg_token, check=False):
     """The cache key, or None when this call must bypass the cache. Takes
     the input TENSORS (not raw values) so avals of deferred fusion
     placeholders come from chain metadata instead of forcing a
-    materialization."""
+    materialization. `check` (FLAGS_check_numerics) is the LAST component:
+    executables built under the guardian return an extra all-finite
+    scalar, so the two shapes must never share a cache entry — and
+    _cached_call reads the flag back off the key to unwrap."""
     ftok = _fn_token(fn)
     if ftok is _UNKEYABLE:
         return None
@@ -382,7 +387,8 @@ def _make_key(name, fn, inputs, diff_mask, reg_token):
             _keyctx.kind = "tracer"
             return None
         avals.append(av)
-    return (name, ftok, tuple(avals), diff_mask, _amp_token(name), reg_token)
+    return (name, ftok, tuple(avals), diff_mask, _amp_token(name), reg_token,
+            check)
 
 
 # ---------------------------------------------------------------------------
@@ -446,15 +452,21 @@ def dispatch_cache_info():
             "keys": keys}
 
 
-def _build_fwd(name, fn):
+def _build_fwd(name, fn, check=False):
     def traced(*vals):
         _STATS.retraces += 1      # side effect: runs only while tracing
         _EVENTS.emit("dispatch.retrace", name)
-        return fn(*vals)
+        out = fn(*vals)
+        if check:
+            # guardian (FLAGS_check_numerics): ONE fused all-finite scalar
+            # compiled into the executable — no extra launch, no sync
+            outs = out if isinstance(out, tuple) else (out,)
+            return out, _guardian.finite_all(outs)
+        return out
     return jax.jit(traced)
 
 
-def _build_fwd_vjp(name, fn, diff_idx):
+def _build_fwd_vjp(name, fn, diff_idx, check=False):
     """Jitted (out, vjp) pair. jax.vjp's pullback is a jax.tree_util.Partial
     — a pytree with the residual buffers as leaves — so it crosses the jit
     boundary; the compiled forward then emits fresh residuals every call
@@ -464,14 +476,19 @@ def _build_fwd_vjp(name, fn, diff_idx):
         _STATS.retraces += 1
         _EVENTS.emit("dispatch.retrace", name)
         if len(diff_idx) == len(vals):
-            return jax.vjp(fn, *vals)
-
-        def pf(*dv):
-            full = list(vals)
-            for i, v in zip(diff_idx, dv):
-                full[i] = v
-            return fn(*full)
-        return jax.vjp(pf, *(vals[i] for i in diff_idx))
+            res = jax.vjp(fn, *vals)
+        else:
+            def pf(*dv):
+                full = list(vals)
+                for i, v in zip(diff_idx, dv):
+                    full[i] = v
+                return fn(*full)
+            res = jax.vjp(pf, *(vals[i] for i in diff_idx))
+        if check:
+            out = res[0]
+            outs = out if isinstance(out, tuple) else (out,)
+            return res, _guardian.finite_all(outs)
+        return res
     return jax.jit(traced)
 
 
@@ -491,7 +508,12 @@ def _cached_call(key, name, fn, diff_idx, vals):
     """Run the op through the executable cache. Returns (ok, result);
     ok=False → the caller must take the uncached path (also the landing
     spot for keys negative-cached after a failed trace, so jit-incompatible
-    ops fail over exactly once)."""
+    ops fail over exactly once). Keys built under FLAGS_check_numerics
+    (key[-1]) carry executables that return an extra all-finite scalar;
+    it is stripped and queued for the guardian here so every caller —
+    dispatch, chain-split replay, step-split replay — gets the original
+    result shape."""
+    check = key[-1]
     exe = _cache_get(key)
     if exe is _BYPASS:
         _STATS.bypass(name)
@@ -501,16 +523,20 @@ def _cached_call(key, name, fn, diff_idx, vals):
         _STATS.hit(name)
         _EVENTS.emit("dispatch.hit", name, key)
         try:
-            return True, exe(*vals)
+            res = exe(*vals)
         except jax.errors.JaxRuntimeError:
             _EVENTS.emit("dispatch.bypass", name, key, "exec_fault")
             # same transient-fault contract as the miss path: fall back to
             # the eager call this once, keep the executable for next time
             return False, None
+        if check:
+            res, fin = res
+            _guardian.enqueue_fwd(name, fin)
+        return True, res
     _STATS.miss(name)
     _EVENTS.emit("dispatch.miss", name, key)
-    exe = _build_fwd(name, fn) if diff_idx is None \
-        else _build_fwd_vjp(name, fn, diff_idx)
+    exe = _build_fwd(name, fn, check) if diff_idx is None \
+        else _build_fwd_vjp(name, fn, diff_idx, check)
     try:
         res = exe(*vals)
     except jax.errors.JaxRuntimeError:
@@ -526,6 +552,9 @@ def _cached_call(key, name, fn, diff_idx, vals):
         _EVENTS.emit("dispatch.bypass", name, key, "unjittable")
         return False, None
     _cache_put(key, exe)
+    if check:
+        res, fin = res
+        _guardian.enqueue_fwd(name, fin)
     return True, res
 
 
@@ -633,8 +662,12 @@ def _dispatch(name, fn, inputs, num_outputs):
     grad_on = _requires_grad(inputs)
     diff_mask = tuple(_differentiable(t) for t in inputs) if grad_on else None
 
-    key = _make_key(name, fn, inputs, diff_mask, reg_token) if cache_on \
-        else None
+    # guardian (FLAGS_check_numerics): the check compiles INTO the cached
+    # executables (keyed), so fusion stays engaged — unlike the strict
+    # debug path above
+    chk = _guardian.enabled()
+    key = _make_key(name, fn, inputs, diff_mask, reg_token, chk) \
+        if cache_on else None
     if cache_on and key is None:
         bypass_reason = _classify_bypass(name)
         _STATS.bypass(name)
@@ -674,6 +707,10 @@ def _dispatch(name, fn, inputs, num_outputs):
             ok, out_vals = _cached_call(key, name, fn, None, vals)
         if not ok:
             out_vals = fn(*vals)
+            if chk:
+                _guardian.observe(name, out_vals if multi else (out_vals,))
+        if _guardian._INJECTORS:
+            out_vals = _guardian.maybe_inject(name, out_vals, multi)
         if multi:
             if debug:
                 _debug_checks(name, out_vals)
@@ -699,6 +736,10 @@ def _dispatch(name, fn, inputs, num_outputs):
         wrapped_vjp = _make_cached_vjp(vjp_partial, diff_idx, n_in, multi)
     else:
         out_vals, wrapped_vjp = _slow_vjp(fn, vals, diff_idx, n_in, multi)
+        if chk:
+            _guardian.observe(name, out_vals if multi else (out_vals,))
+    if _guardian._INJECTORS:
+        out_vals = _guardian.maybe_inject(name, out_vals, multi)
 
     if debug:
         _debug_checks(name, out_vals if multi else (out_vals,))

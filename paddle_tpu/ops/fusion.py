@@ -229,7 +229,7 @@ class Chain:
     __slots__ = ("sig", "ops", "label", "n_ext", "ext_of", "diff_ext_idx",
                  "grad_mode", "flat_avals", "flat_node_avals", "owners",
                  "baseline_ns", "pure_fn", "_fwd", "_fwd_vjp", "dead",
-                 "fail_streak", "head_kid", "replays")
+                 "fail_streak", "head_kid", "replays", "check")
 
     def __init__(self, sig, ops, baseline_ns):
         self.sig = sig
@@ -239,6 +239,11 @@ class Chain:
         self.dead = False
         self.fail_streak = 0
         self.replays = 0
+        # guardian (FLAGS_check_numerics): the per-op keys carry the check
+        # flag as their last component, so a chain's check-ness is fixed by
+        # its signature — the fused executable emits ONE all-finite scalar
+        # for the whole chain and a flag flip simply re-keys the stream
+        self.check = bool(ops and ops[0].key[-1])
         # external-slot enumeration: one slot per ("ext",) wiring entry, in
         # (op, input) order; ext_of[i][k] = slot (or None for prev wiring)
         self.ext_of = []
@@ -313,12 +318,17 @@ def _chain_pure_fn(chain):
 
 def _build_chain_fwd(chain):
     run = chain.pure_fn
+    check = chain.check
 
     def traced(*ext_vals):
         CHAIN_STATS.retraces += 1     # side effect: runs only while tracing
         _EVENTS.emit("chain.compile", chain.label,
                      detail={"ops": len(chain.ops)})
-        return run(*ext_vals)
+        out = run(*ext_vals)
+        if check:
+            from . import guardian
+            return out, guardian.finite_all(out)
+        return out
     return jax.jit(traced)
 
 
@@ -329,20 +339,25 @@ def _build_chain_fwd_vjp(chain):
     PR 1 per-op contract scaled to N ops."""
     run = chain.pure_fn
     diff = chain.diff_ext_idx
+    check = chain.check
 
     def traced(*ext_vals):
         CHAIN_STATS.retraces += 1
         _EVENTS.emit("chain.compile", chain.label,
                      detail={"ops": len(chain.ops), "grad": True})
         if len(diff) == len(ext_vals):
-            return jax.vjp(run, *ext_vals)
-
-        def pf(*dv):
-            full = list(ext_vals)
-            for i, v in zip(diff, dv):
-                full[i] = v
-            return run(*full)
-        return jax.vjp(pf, *(ext_vals[i] for i in diff))
+            res = jax.vjp(run, *ext_vals)
+        else:
+            def pf(*dv):
+                full = list(ext_vals)
+                for i, v in zip(diff, dv):
+                    full[i] = v
+                return run(*full)
+            res = jax.vjp(pf, *(ext_vals[i] for i in diff))
+        if check:
+            from . import guardian
+            return res, guardian.finite_all(res[0])
+        return res
     return jax.jit(traced)
 
 
@@ -973,7 +988,12 @@ class _FusionManager:
         try:
             ext = tuple(pending.ext_vals)
             if chain.grad_mode:
-                out_vals, vjp_partial = chain.fwd_vjp()(*ext)
+                res = chain.fwd_vjp()(*ext)
+                if chain.check:
+                    from . import guardian
+                    res, fin = res
+                    guardian.enqueue_fwd(chain.label, fin)
+                out_vals, vjp_partial = res
                 wrapped = _make_chain_vjp(vjp_partial, chain.diff_ext_idx,
                                           chain.n_ext)
                 node = FusedChainNode(
@@ -985,6 +1005,10 @@ class _FusionManager:
                     ext, pending.ext_edges)
             else:
                 out_vals = chain.fwd()(*ext)
+                if chain.check:
+                    from . import guardian
+                    out_vals, fin = out_vals
+                    guardian.enqueue_fwd(chain.label, fin)
                 node = None
         except jax.errors.JaxRuntimeError:
             # transient execution fault: keep the chain, replay per-op

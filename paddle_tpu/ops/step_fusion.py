@@ -136,7 +136,8 @@ class _OpRec:
 class _Cycle:
     """Observation state for one training iteration."""
 
-    __slots__ = ("entries", "ops", "produced", "dirty", "t0", "n_backward")
+    __slots__ = ("entries", "ops", "produced", "dirty", "t0", "n_backward",
+                 "scaler")
 
     def __init__(self):
         self.entries = []
@@ -145,6 +146,7 @@ class _Cycle:
         self.dirty = False
         self.t0 = time.perf_counter_ns()
         self.n_backward = 0
+        self.scaler = None     # GradScaler seen by on_scaler_step, if any
 
     def poison(self):
         """The cycle cannot promote: drop every recorded detail NOW so a
@@ -155,6 +157,7 @@ class _Cycle:
         self.entries.clear()
         self.ops.clear()
         self.produced.clear()
+        self.scaler = None
 
 
 class _ParamShim:
@@ -174,13 +177,21 @@ class _StepProgram:
                  "param_slots", "ext_order", "opt_ref", "clip_ref",
                  "clip_snapshot", "reg_ref", "reg_snapshot", "extra_key",
                  "acc_names", "label", "n_launches", "baseline_ns",
-                 "fail_streak", "dead", "_exe", "_shims", "donate_params")
+                 "fail_streak", "dead", "_exe", "_shims", "donate_params",
+                 "check", "scaler_ref", "scaler_consts")
 
     def __init__(self):
         self.fail_streak = 0
         self.dead = False
         self._exe = None
         self._shims = None
+        # guardian (FLAGS_check_numerics, ops/guardian.py): check-ness is
+        # fixed by the signature (the per-op keys carry the flag), and the
+        # executable then folds the skip-step where()-rescue in; a fused
+        # GradScaler additionally folds unscale/found-inf/scale-update
+        self.check = False
+        self.scaler_ref = None
+        self.scaler_consts = None
 
     def release_heavy(self):
         """A deactivated program stays in the library as a tombstone (so
@@ -216,6 +227,7 @@ class _StepProgram:
         if self._exe is not None:
             return self._exe
         from ..jit.train_step import donation_argnums
+        from . import guardian
         chain = self.chain
         pure = chain.pure_fn
         root = self.root_flat
@@ -230,6 +242,8 @@ class _StepProgram:
         # time, when the firing hook has the optimizer live in hand.
         opt_ref = self.opt_ref
         acc_names = self.acc_names
+        check = self.check
+        scaler_consts = self.scaler_consts
         if self._shims is None:
             shims = []
             for nm, nc, pr in zip(self.param_names, self.need_clip,
@@ -241,7 +255,7 @@ class _StepProgram:
                 shims.append(s)
             self._shims = shims
 
-        def step_fn(pvals, ext, accs, lr, step_count):
+        def step_body(pvals, ext, accs, lr, step_count, scaler_state):
             STEP_STATS.retraces += 1   # side effect: runs only while tracing
             full = [None] * n_ext
             for pos, slot in enumerate(ext_order):
@@ -255,6 +269,23 @@ class _StepProgram:
 
             root_val, vjp = jax.vjp(fwd, list(pvals))
             (grads,) = vjp(jnp.ones(seed_shape, seed_dtype))
+            extras = ()
+            if scaler_state is not None:
+                # check_finite_and_unscale + update_loss_scaling, folded
+                # in: grads leave the executable UNSCALED (exactly what
+                # the eager path leaves in p.grad after scaler.step), and
+                # the loss-scale transition is the same pure function the
+                # eager GradScaler.update() evaluates
+                scale, good, bad = scaler_state
+                inv = jnp.asarray(1.0, jnp.float32) / scale
+                grads = [g * inv.astype(g.dtype) for g in grads]
+                found_inf = jnp.logical_not(guardian.finite_all(grads))
+                (_en, _dyn, incr_ratio, decr_ratio,
+                 incr_n, decr_n) = scaler_consts
+                scale2, good2, bad2 = guardian.update_scaler_state(
+                    scale, good, bad, found_inf, incr_ratio, decr_ratio,
+                    incr_n, decr_n)
+                extras = (found_inf, scale2, good2, bad2)
             upd = self._grad_transform(pvals, grads)
             opt = opt_ref()   # trace-time only; firing keeps it alive
             new_p, new_accs = [], []
@@ -264,7 +295,28 @@ class _StepProgram:
                                               step_count)
                 new_p.append(np_)
                 new_accs.append([na_.get(n) for n in acc_names])
-            return root_val, grads, new_p, new_accs
+            if check:
+                # skip-step rescue: non-finite grads make the whole update
+                # a bitwise no-op on params AND optimizer slots — ONE
+                # fused scalar predicate, zero extra launches
+                upd_finite = guardian.finite_all(upd)
+                fwd_finite = guardian.finite_all([root_val])
+                new_p = [jnp.where(upd_finite, nv, pv)
+                         for nv, pv in zip(new_p, pvals)]
+                new_accs = [
+                    [None if nv is None else jnp.where(upd_finite, nv, ov)
+                     for nv, ov in zip(row, ac)]
+                    for row, ac in zip(new_accs, accs)]
+                extras = (upd_finite, fwd_finite) + extras
+            return (root_val, grads, new_p, new_accs) + extras
+
+        if scaler_consts is not None:
+            def step_fn(pvals, ext, accs, lr, step_count, scale, good, bad):
+                return step_body(pvals, ext, accs, lr, step_count,
+                                 (scale, good, bad))
+        else:
+            def step_fn(pvals, ext, accs, lr, step_count):
+                return step_body(pvals, ext, accs, lr, step_count, None)
 
         self._exe = jax.jit(
             step_fn,
@@ -602,6 +654,76 @@ class _StepFusionManager:
         self._boundary(st, opt, dirty=False)
         return False
 
+    def on_scaler_step(self, scaler, opt):
+        """Called at the top of GradScaler.step (an ENABLED scaler), before
+        its eager unscale/step path. Returns True when a pending
+        whole-step replay matched through the scaler event and the ONE
+        fused executable performed unscale + finite-check + the
+        where()-rescued update + the loss-scale transition (the caller
+        must skip its eager path and let update() commit the transition).
+        During observation it records the scaler into the cycle — only
+        under the guardian (FLAGS_check_numerics), whose in-graph
+        skip-step semantics make the fold legal — and returns False."""
+        from . import guardian
+        st = self._tls
+        if st.busy or not self.enabled():
+            return False
+        st.replay_arm = False
+        pending = st.pending
+        if pending is not None and not pending.fired:
+            program = pending.program
+            fired = False
+            with pending.lock:
+                if pending.done:
+                    st.pending = None
+                    return False
+                entry = program.entries[pending.entry_pos]
+                if entry[0] != "scaler":
+                    # the program was recorded without this scaler (legacy
+                    # mode / changed loop): let the eager path run — its
+                    # grad reads split the replay as mid_step_peek
+                    return False
+                split_reason = "event_mismatch"
+                if program.scaler_ref() is not scaler \
+                        or scaler._consts() != program.scaler_consts:
+                    # the scale hyper-parameters are baked into the traced
+                    # loss-scale transition: a change is stale for good
+                    self._kill(program)
+                    split_reason = "optimizer_state_change"
+                elif pending.entry_pos == len(program.entries) - 2 \
+                        and pending.backward_done \
+                        and pending.op_pos == len(program.chain.ops):
+                    pending.entry_pos += 1
+                    verify_fail = self._verify_fire(program, pending, opt)
+                    if verify_fail is None:
+                        if self._fire(st, pending, opt, scaler=scaler):
+                            fired = True
+                            self._after_boundary(st)
+                        else:
+                            split_reason = None   # _fire already split
+                    else:
+                        split_reason = verify_fail
+                if not fired and not pending.done \
+                        and split_reason is not None:
+                    self._split(pending, escape=False, reason=split_reason,
+                                blocked_op="scaler_step")
+            if fired:
+                return True
+            st.pending = None
+            self._boundary(st, opt, dirty=True)
+            return False
+        # observation: the scaler joins the cycle signature so _build folds
+        # it into the fused step (guardian mode only — without the in-graph
+        # skip the eager scaler syncs found_inf per step and cannot fuse)
+        if guardian.skip_step_enabled():
+            cyc = st.recording
+            if cyc is None:
+                cyc = st.recording = _Cycle()
+            if not cyc.dirty:
+                cyc.entries.append(("scaler", id(scaler), scaler._consts()))
+                cyc.scaler = scaler
+        return False
+
     # -- replay internals --------------------------------------------------
     @staticmethod
     def _is_root(pending, tensor):
@@ -757,14 +879,19 @@ class _StepFusionManager:
             st.active = None
         st.library.pop(program.sig, None)
 
-    def _fire(self, st, pending, opt):
+    def _fire(self, st, pending, opt, scaler=None):
         """All entries matched and the optimizer is verified: run the ONE
         fused executable and commit. Returns False (after splitting) on a
-        fault so the caller falls back to the eager step."""
+        fault so the caller falls back to the eager step. `scaler` is the
+        verified GradScaler of a scaler-folded program (on_scaler_step):
+        its state rides as hoisted scalar args and the computed transition
+        lands in `scaler._fused_next` for update() to commit."""
         from ..jit.train_step import bake_decay_flags
         program = pending.program
         params = pending.params
         acc_names = program.acc_names
+        check = program.check
+        upd_finite = fwd_finite = scale_before = scale_after = None
         st.busy = True
         if not hasattr(opt, "_step_count"):
             opt._step_count = 0
@@ -777,8 +904,19 @@ class _StepFusionManager:
                     for p in params]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_count = jnp.asarray(opt._step_count, jnp.int32)
-            root_val, grads, new_p, new_accs = program.exe()(
-                pvals, ext, accs, lr, step_count)
+            if scaler is not None:
+                scale_before, good, bad = scaler._state_arrays()
+                (root_val, grads, new_p, new_accs, upd_finite, fwd_finite,
+                 found_inf, scale_after, good2, bad2) = program.exe()(
+                    pvals, ext, accs, lr, step_count, scale_before, good,
+                    bad)
+            elif check:
+                (root_val, grads, new_p, new_accs, upd_finite,
+                 fwd_finite) = program.exe()(pvals, ext, accs, lr,
+                                             step_count)
+            else:
+                root_val, grads, new_p, new_accs = program.exe()(
+                    pvals, ext, accs, lr, step_count)
         except jax.errors.JaxRuntimeError:
             # transient execution fault: keep the program and replay
             # eagerly — UNLESS the launch already consumed the donated
@@ -826,10 +964,21 @@ class _StepFusionManager:
             _IDX_SLOT.__set__(root_ph, 0)
             root_ph._pending_chain = None
             # raw grads land in the placeholders installed at backward
+            # (scaler programs emit them UNSCALED, like the eager path)
             for ph, g in zip(pending.grad_phs, grads):
                 if _VALUE_SLOT.__get__(ph) is _PENDING:
                     _VALUE_SLOT.__set__(ph, g)
                 ph._pending_chain = None
+            if scaler is not None:
+                # update() commits this instead of re-running the
+                # transition (the backoff, if any, is attributed by the
+                # note_step flush below — never twice)
+                scaler._found_inf = found_inf
+                scaler._fused_next = (found_inf, scale_after, good2, bad2)
+            if check:
+                from . import guardian
+                guardian.note_step(program.label, upd_finite, fwd_finite,
+                                   scale_before, scale_after)
             pending.fired = True
             program.fail_streak = 0
             elapsed = time.perf_counter_ns() - pending.t0
@@ -1031,6 +1180,24 @@ class _StepFusionManager:
         chain = Chain(sig, ops, 0)
         if not chain.grad_mode:
             return unbuildable("no_grad_ops")
+        # GradScaler folding (on_scaler_step): requires the guardian —
+        # the in-graph where() skip is what makes an unconditional fused
+        # update legal — and the scaler event must follow the backward
+        # (unscale consumes its grads)
+        scaler_es = [e for e in cyc.entries if e[0] == "scaler"]
+        scaler_obj = cyc.scaler
+        if len(scaler_es) > 1:
+            return unbuildable("multi_scaler")
+        if scaler_es:
+            if scaler_obj is None or id(scaler_obj) != scaler_es[0][1]:
+                return unbuildable("scaler_gone")
+            if not chain.check:
+                return unbuildable("scaler_without_guardian")
+            order = [e[0] for e in cyc.entries]
+            if order.index("scaler") < order.index("bwd"):
+                return unbuildable("scaler_before_backward")
+        else:
+            scaler_obj = None
         # flat index of the backward root in the chain's output catalog
         root_coord = bwd_entries[0][1]
         root_flat = None
@@ -1094,12 +1261,18 @@ class _StepFusionManager:
         bake_decay_flags(opt, updated)
         program.extra_key = tuple(opt._extra_cache_key())
         program.acc_names = tuple(sorted(opt._accumulators.keys()))
+        program.check = chain.check
+        if scaler_obj is not None:
+            program.scaler_ref = weakref.ref(scaler_obj)
+            program.scaler_consts = scaler_es[0][2]
         names = [op.name for op in ops]
         head = "→".join(names[:3]) + ("→…" if len(names) > 3 else "")
         program.label = (f"{head}[{len(ops)}ops]"
-                         f"+{type(opt).__name__}")
+                         f"+{type(opt).__name__}"
+                         + ("+GradScaler" if scaler_obj is not None else ""))
         program.n_launches = len(ops) + sum(
-            1 for op in ops if op.diff_mask is not None) + 1
+            1 for op in ops if op.diff_mask is not None) + 1 \
+            + (2 if scaler_obj is not None else 0)
         program.baseline_ns = time.perf_counter_ns() - cyc.t0
         program.donate_params = bool(
             _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))

@@ -92,7 +92,9 @@ class Optimizer:
         # already computed loss, grads, and this update — nothing left to
         # do. In observation mode the hook just delimits the step cycle.
         from ..ops.step_fusion import STEP as _step_fusion
+        from ..ops import guardian
         if _step_fusion.on_optimizer_step(self):
+            guardian.maybe_flush()
             return
         params = [p for p in self._parameter_list
                   if not p.stop_gradient or p.grad is not None]
@@ -105,6 +107,7 @@ class Optimizer:
                      detail={"kind": "eager_step",
                              "params": len(params_grads)})
         if not params_grads:
+            guardian.maybe_flush()
             return
         if self.regularization is not None:
             params_grads = [
@@ -113,8 +116,17 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         self._create_accumulators([p for p, _ in params_grads])
         self._apply_optimize(params_grads)
+        # the step boundary resolves the guardian's queued in-graph checks
+        # (one batched device->host transfer); a no-op when the queue is
+        # empty (FLAGS_check_numerics off)
+        guardian.maybe_flush()
 
     def _apply_optimize(self, params_grads):
+        from ..ops import guardian
+        # guardian skip-step rescue (FLAGS_check_numerics): the finite
+        # check and the where() no-op rescue compile INTO the jitted
+        # update (keyed), matching the fused whole-step semantics bitwise
+        check = guardian.skip_step_enabled()
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         acc_names = sorted(self._accumulators.keys())
         step_key = "_step_count"
@@ -133,7 +145,7 @@ class Optimizer:
         structure_key = (len(params_grads),
                          tuple((v.shape, str(v.dtype)) for v in pvals),
                          tuple(acc_names),
-                         self._extra_cache_key())
+                         self._extra_cache_key(), check)
         update = self._jitted_update.get(structure_key)
         if update is None:
             single = self._single_update
@@ -145,7 +157,17 @@ class Optimizer:
                     np_, na_ = single(pv, gv, acc_dict, lr, step_count)
                     new_p.append(np_)
                     new_a.append([na_.get(n) for n in acc_names])
-                return new_p, new_a
+                if not check:
+                    return new_p, new_a, None
+                # non-finite grads -> the whole update is a bitwise no-op
+                # on params AND slots; ONE fused scalar predicate
+                finite = guardian.finite_all(gvals)
+                new_p = [jnp.where(finite, nv, pv)
+                         for nv, pv in zip(new_p, pvals)]
+                new_a = [[None if nv is None else jnp.where(finite, nv, ov)
+                          for nv, ov in zip(row, ac)]
+                         for row, ac in zip(new_a, accs)]
+                return new_p, new_a, finite
 
             # only accumulator buffers are donated: param buffers may be
             # aliased by user-held tensors (detach() shares storage), and
@@ -153,12 +175,15 @@ class Optimizer:
             update = jax.jit(batch_update, donate_argnums=(2,))
             self._jitted_update[structure_key] = update
 
-        new_pvals, new_accs = update(pvals, gvals, accs, lr, step_count)
+        new_pvals, new_accs, finite = update(pvals, gvals, accs, lr,
+                                             step_count)
         for (p, _), npv, nac in zip(params_grads, new_pvals, new_accs):
             p._value = npv
             for n, v in zip(acc_names, nac):
                 if v is not None:
                     self._accumulators[n][p.name] = v
+        if check:
+            guardian.note_step("eager_step", finite)
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

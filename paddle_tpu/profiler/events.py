@@ -93,6 +93,11 @@ REASON_CODES = frozenset({
     "cycle_too_long",      # cycle exceeded the recording cap
     "unpromotable_cycle",  # build-time qualification failed (see detail)
     "fail_streak",         # deactivated after repeated failed replays
+    # -- step-guardian decisions (FLAGS_check_numerics, ops/guardian.py) ---
+    "nonfinite_output",    # a forward output was non-finite (guardian check)
+    "nonfinite_skip",      # non-finite grads: the update was a bitwise no-op
+    "scaler_backoff",      # GradScaler shrank the loss scale after bad steps
+    "injected_fault",      # a chaos-harness fault hook fired (tools/chaos.py)
 })
 
 

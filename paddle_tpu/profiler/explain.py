@@ -18,7 +18,6 @@ from .events import EVENTS, REASON_CODES
 
 __all__ = ["explain", "format_report", "REASON_HINTS"]
 
-
 # actionable one-liners per reason code: what the attribution means and the
 # ROADMAP-backed fix. Keyed on the public REASON_CODES contract.
 REASON_HINTS = {
@@ -105,6 +104,22 @@ REASON_HINTS = {
     "fail_streak": (
         "the promoted step was deactivated after repeated failed "
         "replays — look at the step.split reasons right before it."),
+    "nonfinite_output": (
+        "a forward output was non-finite (FLAGS_check_numerics guardian). "
+        "Re-run with FLAGS_check_nan_inf=1 to localize the op "
+        "synchronously; check the LR / init / input pipeline."),
+    "nonfinite_skip": (
+        "gradients were non-finite, so the guardian applied the update "
+        "as where(finite, new, old) — the step was a bitwise no-op. "
+        "Expected under fp16 GradScaler warmup; persistent skips mean "
+        "the loss scale (or the LR) is too high."),
+    "scaler_backoff": (
+        "GradScaler shrank the loss scale after consecutive non-finite "
+        "steps (update_loss_scaling semantics); the scale is a hoisted "
+        "scalar arg, so fusion survives the change."),
+    "injected_fault": (
+        "a chaos-harness fault hook fired (tools/chaos.py): the event is "
+        "deliberate; the surrounding splits/poisons validate recovery."),
 }
 
 
@@ -144,8 +159,14 @@ def explain(events=None):
         return cats.get(cat, 0)
 
     step_splits = _attr(events, lambda e: e["cat"] == "step.split")
+    # guardian decisions ride step.record with detail.kind == "guardian":
+    # they are deliberate outcomes, never cycle poisons (a skipped step
+    # still fused) — aggregate them into their own section
+    guardian_ev = _attr(
+        events, lambda e: (e.get("detail") or {}).get("kind") == "guardian")
     poisons = _attr(events, lambda e: e["cat"] == "step.record"
-                    and e.get("reason") is not None)
+                    and e.get("reason") is not None
+                    and (e.get("detail") or {}).get("kind") != "guardian")
     chain_splits = _attr(events, lambda e: e["cat"] == "chain.split")
     bypasses = _attr(events, lambda e: e["cat"] == "dispatch.bypass")
     clean_cycles = dirty_cycles = 0
@@ -189,11 +210,16 @@ def explain(events=None):
             "retraces": n("dispatch.retrace"),
             "bypass_reasons": bypasses,
         },
+        # non-finite step guardian (FLAGS_check_numerics, ops/guardian.py):
+        # why did step N not update? nonfinite_skip = the where() rescue
+        # made it a bitwise no-op; scaler_backoff = the loss scale shrank;
+        # injected_fault = the chaos harness did it on purpose
+        "guardian": guardian_ev,
     }
 
     findings = []
     unknown = sorted({r for src in (step_splits, poisons, chain_splits,
-                                    bypasses)
+                                    bypasses, guardian_ev)
                       for r in src
                       if r not in REASON_CODES and r != "unattributed"})
     if unknown:
@@ -256,6 +282,12 @@ def explain(events=None):
     report["verdict"] = verdict
     report["headline"] = headline
 
+    for r, rec in sorted(guardian_ev.items(), key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        findings.append(
+            f"guardian {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
     for r, rec in sorted(poisons.items(), key=lambda kv: -kv[1]["count"]):
         ops = ", ".join(f"`{o}`×{c}" for o, c in
                         sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
@@ -306,6 +338,10 @@ def format_report(report):
         f"disp  : hits={d['hits']} misses={d['misses']} "
         f"bypasses={d['bypasses']} retraces={d['retraces']}",
     ]
+    g = report.get("guardian") or {}
+    if g:
+        lines.append("guard : " + " ".join(
+            f"{r}={rec['count']}" for r, rec in sorted(g.items())))
     if report["findings"]:
         lines.append("")
         lines.append("findings:")

@@ -124,6 +124,9 @@ class TestPublicContract:
             "flag_off",
             "uncached_dispatch", "multi_backward", "cycle_too_long",
             "unpromotable_cycle", "fail_streak",
+            # step-guardian decisions (PR 5, FLAGS_check_numerics)
+            "nonfinite_output", "nonfinite_skip", "scaler_backoff",
+            "injected_fault",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

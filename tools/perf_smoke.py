@@ -21,7 +21,15 @@ stack silently regressed:
     ZERO splits (a PR 4 attribution regression);
   * events-off overhead — the recorder's disabled path (one flag check
     per emission site) must cost <3% of a fused step at the observed
-    events-per-step rate (a PR 4 hot-path regression).
+    events-per-step rate (a PR 4 hot-path regression);
+  * guardian overhead — FLAGS_check_numerics compiles its finite checks
+    INTO the fused executables (one scalar per launch, one batched sync
+    per step), so the guarded fused loop must stay within 5% of the
+    unguarded one AND keep replaying fused (a PR 5 regression);
+  * AMP promotion — a dynamic-loss-scaled GradScaler loop under the
+    guardian must reach whole-step zero-retrace steady state (scale and
+    growth-tracker ride as hoisted scalar args; promotion is no longer
+    poisoned by the mid-step grad read — a PR 5 regression).
 
 Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
 in tests/test_chain_fusion.py and tests/test_step_fusion.py — this CLI is
@@ -47,7 +55,7 @@ MEASURE = 40
 STEP_SPEEDUP_GUARD = 1.15
 
 
-def _loop(step_fused):
+def _loop(step_fused, check_numerics=False, use_scaler=False):
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
@@ -60,7 +68,8 @@ def _loop(step_fused):
                # sized for training loops, not a 54-iteration smoke)
                "FLAGS_eager_chain_fusion_min_count": 4,
                "FLAGS_eager_step_fusion": step_fused,
-               "FLAGS_eager_step_fusion_min_count": 5})
+               "FLAGS_eager_step_fusion_min_count": 5,
+               "FLAGS_check_numerics": check_numerics})
     clear_dispatch_cache()
 
     rng = np.random.default_rng(0)
@@ -70,14 +79,28 @@ def _loop(step_fused):
     b = paddle.to_tensor(rng.standard_normal(32).astype(np.float32),
                          stop_gradient=False)
     opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[w, b])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0) \
+        if use_scaler else None
 
     def step():
         y = F.gelu(paddle.add(paddle.matmul(x, w), b))
         loss = y.sum()
-        loss.backward()
-        opt.step()
+        if scaler is None:
+            loss.backward()
+            opt.step()
+        else:
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
         opt.clear_grad()
 
+    def sync():
+        # drain the async dispatch queue (measurement-boundary hygiene:
+        # without it, one leg's enqueued-but-unexecuted work bleeds into
+        # the next leg's timed window)
+        w._value.block_until_ready()
+
+    step.sync = sync
     return step
 
 
@@ -205,6 +228,97 @@ def main() -> int:
             f"{overhead_frac * 100:.2f}% of a fused step (>=3%): the "
             "disabled path got expensive (PR 4 regression)")
 
+    # ---- guardian legs (PR 5 guards) -------------------------------------
+    # (c) FLAGS_check_numerics cost: the checks compile INTO the fused
+    # executables, so the guarded loop must stay within 5% of the
+    # unguarded fused step (and must still replay fused at all). The
+    # the baseline and the guarded loop are measured in INTERLEAVED
+    # windows (flag flipped per window — each loop's promoted program
+    # re-arms from the per-thread library without retracing) and compared
+    # on best-window times: a load spike hits both legs alike instead of
+    # faking (or masking) a few-percent regression. The earlier t_step is
+    # minutes old by now; process drift dwarfs the effect guarded here.
+    base_step = _loop(step_fused=True)
+    for _ in range(WARMUP):
+        base_step()
+    step = _loop(step_fused=True, check_numerics=True)
+    for _ in range(WARMUP):
+        step()
+    # _loop() above cleared the caches, so the base leg's promoted program
+    # is gone: re-warm it or window 0's baseline pays full re-record +
+    # re-promote + XLA compile, its ratio craters, and min-of-ratios would
+    # wave through ANY real guardian regression
+    set_flags({"FLAGS_check_numerics": False})
+    for _ in range(WARMUP):
+        base_step()
+    # the guard statistic is the MIN over paired window ratios: a real
+    # guardian regression (an added per-step sync costs 2x+) inflates
+    # EVERY pair, while a CI-box load spike only inflates the pairs it
+    # lands on — so min-of-ratios tracks the true marginal cost even when
+    # single-window times swing 2-3x
+    ratios = []
+    t_base = t_guard = float("inf")
+    for _ in range(6):
+        set_flags({"FLAGS_check_numerics": False})
+        base_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            base_step()
+        base_step.sync()
+        tb = (time.perf_counter() - t0) / MEASURE
+        set_flags({"FLAGS_check_numerics": True})
+        step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            step()
+        step.sync()
+        tg = (time.perf_counter() - t0) / MEASURE
+        t_base, t_guard = min(t_base, tb), min(t_guard, tg)
+        ratios.append(tg / tb if tb > 0 else float("inf"))
+    # (flag is still on) the guarded loop must actually be REPLAYING fused
+    g0 = step_fusion_stats()
+    for _ in range(8):
+        step()
+    g1 = step_fusion_stats()
+    if g1["fused_steps"] - g0["fused_steps"] == 0:
+        failures.append(
+            "whole-step fusion stopped replaying under "
+            "FLAGS_check_numerics: the guardian un-fused the loop "
+            "(PR 5 regression)")
+    guard_overhead = min(ratios) - 1.0
+    guard_median = sorted(ratios)[len(ratios) // 2] - 1.0
+    if guard_overhead >= 0.05:
+        failures.append(
+            f"FLAGS_check_numerics costs {guard_overhead * 100:.1f}%/step "
+            f"(best guarded window {t_guard * 1e6:.0f}us vs base "
+            f"{t_base * 1e6:.0f}us, >=5%): the in-graph checks stopped "
+            "amortizing (PR 5 regression)")
+
+    # (d) dynamic-loss-scaled AMP promotion: scale/growth-tracker ride as
+    # hoisted args, unscale/found-inf/backoff fold into the ONE fused
+    # executable — the GradScaler loop must reach zero-retrace steady
+    # state instead of splitting on the mid-step grad read
+    step = _loop(step_fused=True, check_numerics=True, use_scaler=True)
+    for _ in range(WARMUP):
+        step()
+    a0 = step_fusion_stats()
+    for _ in range(MEASURE):
+        step()
+    a1 = step_fusion_stats()
+    amp_replays = min(a1["fused_steps"] - a0["fused_steps"], MEASURE)
+    amp_retraces = a1["retraces"] - a0["retraces"]
+    if amp_replays == 0:
+        failures.append(
+            "GradScaler AMP loop did not promote under the guardian "
+            f"(promoted={a1['steps_promoted']}, "
+            f"splits={a1['fallback_splits']}): scaled training lost "
+            "whole-step fusion (PR 5 regression)")
+    if amp_retraces:
+        failures.append(
+            f"{amp_retraces} post-warmup retrace(s) in the guarded AMP "
+            "loop: the scaler state is no longer a hoisted arg "
+            "(PR 5 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -214,7 +328,11 @@ def main() -> int:
           f"splits={len(split_events)} (steady={len(steady_splits)}, "
           f"unexplained={len(unexplained)}), "
           f"events-off emit={emit_off_ns:.0f}ns "
-          f"({overhead_frac * 100:.3f}%/step)")
+          f"({overhead_frac * 100:.3f}%/step), "
+          f"guardian overhead={guard_median * 100:.1f}%/step (median; "
+          f"min {guard_overhead * 100:.1f}%), "
+          f"AMP fused steps={amp_replays}/{MEASURE} "
+          f"(retraces={amp_retraces})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
