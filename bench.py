@@ -6,6 +6,10 @@ Configs (BASELINE.md):
   2c: GPT-2 seq-4096 flash-attention train — tokens/s/chip + MFU
   5:  ViT-L/16 train     — images/s, fused vs unfused (fused >= unfused)
   serving: GPT-2 decode  — ms/step, compiled per-token program (<= 0.08 ms)
+  serve_1/8/64: continuous-batching engine (paddle_tpu.serving.LLMEngine)
+      — tokens/s + p50/p99 step ms at 1/8/64 concurrent mixed-length
+      streams through ONE compiled decode executable (paged KV cache;
+      decode_compiles in the record must stay 0 in the measured window)
 
 Hang-proof architecture (rounds 3/4 produced rc=1 / rc=124 because the TPU
 tunnel can HANG — not raise — inside backend init or compile, and an
@@ -431,12 +435,35 @@ def bench_decode(on_tpu):
 
 
 # --------------------------------------------------------------------------
+# serve_1 / serve_8 / serve_64: the continuous-batching engine
+# --------------------------------------------------------------------------
+
+def _bench_serve(streams):
+    """Serving-engine leg at N concurrent streams; the heavy lifting
+    (workload, warmup, zero-retrace window accounting) lives in
+    tools/serve_bench.run_serve_bench so the CLI and the bench measure
+    the same thing."""
+    def run(on_tpu):
+        import jax
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import serve_bench
+        platform = jax.devices()[0].platform
+        tdir = os.path.join(TRACE_ROOT, platform, f"serve_{streams}")
+        return serve_bench.run_serve_bench(streams, on_tpu, trace_dir=tdir)
+    return run
+
+
+# --------------------------------------------------------------------------
 # child / parent plumbing
 # --------------------------------------------------------------------------
 
 CONFIG_FNS = {
     "vit": bench_vit,
     "decode": bench_decode,
+    "serve_1": _bench_serve(1),
+    "serve_8": _bench_serve(8),
+    "serve_64": _bench_serve(64),
     "flash4096": bench_flash4096,
     "gpt2_355m": bench_gpt2_355m,
     "gpt2_train": bench_gpt2_train,
@@ -444,7 +471,8 @@ CONFIG_FNS = {
 
 # per-config hard timeouts (seconds) when the probe said TPU; CPU smoke
 # versions are tiny and get a flat cap
-TPU_CAPS = {"vit": 180, "decode": 150, "flash4096": 210, "gpt2_355m": 240,
+TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
+            "serve_64": 150, "flash4096": 210, "gpt2_355m": 240,
             "gpt2_train": 280}
 CPU_CAP = 150
 HEADLINE = "gpt2_train"
@@ -544,7 +572,8 @@ def main():
                 "platform": plat, "elapsed_s": round(dur, 1)}
 
     results = {}
-    for name in ("vit", "decode", "flash4096", "gpt2_355m"):
+    for name in ("vit", "decode", "serve_1", "serve_8", "serve_64",
+                 "flash4096", "gpt2_355m"):
         avail = remaining() - HEADLINE_RESERVE
         if avail < 45:
             results[name] = {"metric": name, "skipped": "budget_exhausted",

@@ -46,6 +46,7 @@ from . import profiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import autograd  # noqa: F401
 from . import distribution  # noqa: F401
 from . import geometric  # noqa: F401

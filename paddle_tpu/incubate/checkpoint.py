@@ -27,7 +27,7 @@ import re
 import shutil
 import time
 
-__all__ = ["train_epoch_range", "EpochRange"]
+__all__ = ["train_epoch_range", "EpochRange", "StepCheckpointer"]
 
 
 def _state_of(model):
@@ -50,6 +50,32 @@ def _apply_model_state(model, state):
     for name, t in model.items():
         v = state[name]
         t._value = v._value if hasattr(v, "_value") else v
+
+
+def _snapshot_payload(model, optimizer, scaler, extra):
+    """One resumable training snapshot: model + optimizer (accumulators,
+    step counter, LR schedule) + GradScaler + the global RNG stream —
+    shared by EpochRange.save and StepCheckpointer.save so epoch- and
+    step-granular checkpoints stay byte-compatible."""
+    from ..framework import random as _random
+    return {
+        "model": _state_of(model),
+        "optimizer": None if optimizer is None else optimizer.state_dict(),
+        "scaler": None if scaler is None else scaler.state_dict(),
+        "rng": _random.rng_checkpoint_state(),
+        "extra": extra,
+    }
+
+
+def _apply_payload(payload, model, optimizer, scaler):
+    from ..framework import random as _random
+    _apply_model_state(model, payload.get("model"))
+    if optimizer is not None and payload.get("optimizer") is not None:
+        optimizer.set_state_dict(payload["optimizer"])
+    if scaler is not None and payload.get("scaler") is not None:
+        scaler.load_state_dict(payload["scaler"])
+    if payload.get("rng") is not None:
+        _random.set_rng_checkpoint_state(payload["rng"])
 
 
 class EpochRange:
@@ -131,16 +157,8 @@ class EpochRange:
         prunes checkpoints beyond the newest `max_checkpoints`. Returns
         the checkpoint directory."""
         from ..framework import io as _io
-        from ..framework import random as _random
-        payload = {
-            "epoch": int(epoch),
-            "model": _state_of(model),
-            "optimizer": None if optimizer is None
-            else optimizer.state_dict(),
-            "scaler": None if scaler is None else scaler.state_dict(),
-            "rng": _random.rng_checkpoint_state(),
-            "extra": extra,
-        }
+        payload = _snapshot_payload(model, optimizer, scaler, extra)
+        payload["epoch"] = int(epoch)
         d = self.checkpoint_path(epoch)
         _io.save(payload, os.path.join(d, self.CKPT_FILE))
         if epoch > self._completed:
@@ -174,7 +192,6 @@ class EpochRange:
         mismatch) falls back to the next retained one. Returns the saved
         `extra` payload, or None when nothing was restored."""
         from ..framework import io as _io
-        from ..framework import random as _random
         if self._completed < 0:
             return None
         candidates = [e for e in self._retained_epochs()
@@ -189,13 +206,7 @@ class EpochRange:
             except _io.CheckpointCorruptError:
                 corrupt.append(path)
                 continue
-            _apply_model_state(model, payload.get("model"))
-            if optimizer is not None and payload.get("optimizer") is not None:
-                optimizer.set_state_dict(payload["optimizer"])
-            if scaler is not None and payload.get("scaler") is not None:
-                scaler.load_state_dict(payload["scaler"])
-            if payload.get("rng") is not None:
-                _random.set_rng_checkpoint_state(payload["rng"])
+            _apply_payload(payload, model, optimizer, scaler)
             if e != self._completed:
                 # resumed from an OLDER epoch (newer snapshot was corrupt):
                 # re-run the epochs after it
@@ -213,6 +224,108 @@ class EpochRange:
                 f"{self._completed + 1} on uninitialized state — delete "
                 "the marker file to restart from scratch")
         return None
+
+
+class StepCheckpointer:
+    """Step-granular `save_every_n_steps` checkpoints on the same atomic,
+    CRC-verified, rolling-retention machinery as `EpochRange` — for runs
+    where an epoch is hours long and preemption (spot TPU reclaims,
+    serving-engine co-tenancy, the multi-host runs of ROADMAP item 1)
+    cannot afford to lose one.
+
+    Usage::
+
+        ck = StepCheckpointer(".ckpt", save_every_n_steps=200)
+        start = ck.restore(model=model, optimizer=opt, scaler=scaler)
+        for step, batch in enumerate(loader, start=start + 1):
+            train_step(batch)
+            ck.tick(step, model=model, optimizer=opt, scaler=scaler)
+
+    `tick(step)` saves only on every n-th step (cheap no-op otherwise);
+    `restore()` loads the newest intact snapshot — optimizer step
+    counter, LR schedule, loss-scale growth tracker, and RNG stream
+    included — skipping corrupt files, and returns the step it resumed
+    at (-1 for a fresh run). Like EpochRange, it REFUSES (raises) when
+    snapshots exist but none survives the integrity check.
+    """
+
+    CKPT_FILE = EpochRange.CKPT_FILE
+
+    def __init__(self, save_dir, save_every_n_steps=100, run_id=None,
+                 max_checkpoints=3):
+        self.save_dir = save_dir
+        self.save_every_n_steps = max(1, int(save_every_n_steps))
+        self.max_checkpoints = max(1, int(max_checkpoints or 1))
+        self.run_id = run_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.last_extra = None
+
+    def _base(self):
+        return os.path.join(self.save_dir, f"{self.run_id}_steps")
+
+    def checkpoint_path(self, step):
+        return os.path.join(self._base(), f"step_{step}")
+
+    def _retained_steps(self):
+        base = self._base()
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for nm in os.listdir(base):
+            m = re.fullmatch(r"step_(\d+)", nm)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def tick(self, step, model=None, optimizer=None, scaler=None,
+             extra=None):
+        """Per-step hook: saves when `step` lands on the
+        save_every_n_steps grid, else returns None without touching the
+        filesystem."""
+        step = int(step)
+        if step % self.save_every_n_steps:
+            return None
+        return self.save(step, model=model, optimizer=optimizer,
+                         scaler=scaler, extra=extra)
+
+    def save(self, step, model=None, optimizer=None, scaler=None,
+             extra=None):
+        """Unconditional atomic snapshot at `step`; prunes beyond the
+        newest `max_checkpoints`. Returns the checkpoint directory."""
+        from ..framework import io as _io
+        payload = _snapshot_payload(model, optimizer, scaler, extra)
+        payload["step"] = int(step)
+        d = self.checkpoint_path(int(step))
+        _io.save(payload, os.path.join(d, self.CKPT_FILE))
+        for s in self._retained_steps()[:-self.max_checkpoints]:
+            shutil.rmtree(self.checkpoint_path(s), ignore_errors=True)
+        return d
+
+    def restore(self, model=None, optimizer=None, scaler=None):
+        """Load the newest intact step snapshot into the given objects;
+        corrupt snapshots fall back to older ones. Returns the resumed
+        step (-1 when no snapshot exists); the saved `extra` lands in
+        `self.last_extra`."""
+        from ..framework import io as _io
+        corrupt = []
+        for s in reversed(self._retained_steps()):
+            path = os.path.join(self.checkpoint_path(s), self.CKPT_FILE)
+            if not os.path.exists(path):
+                continue
+            try:
+                payload = _io.load(path)
+            except _io.CheckpointCorruptError:
+                corrupt.append(path)
+                continue
+            _apply_payload(payload, model, optimizer, scaler)
+            self.last_extra = payload.get("extra")
+            return int(payload.get("step", s))
+        if corrupt:
+            raise _io.CheckpointCorruptError(
+                "every retained step checkpoint failed its integrity "
+                f"check ({', '.join(corrupt)}); refusing to resume on "
+                "uninitialized state — delete the step_* directories to "
+                "restart from scratch")
+        return -1
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,

@@ -104,6 +104,12 @@ class GPTAttention(Layer):
         q = manip.squeeze(manip.slice(qkv, [2], [0], [1]), 2)
         k = manip.squeeze(manip.slice(qkv, [2], [1], [2]), 2)
         v = manip.squeeze(manip.slice(qkv, [2], [2], [3]), 2)
+        if cache is not None and hasattr(cache, "block_tables"):
+            # paged serving cache (serving/cache.py PagedCacheView): the
+            # continuous-batching engine's block-pool memory — sequences
+            # of different lengths share one pool via per-slot block
+            # tables, so ONE compiled decode step serves every tenant mix
+            return self._paged_decode_step(q, k, v, cache, b, n)
         if cache is not None and len(cache) == 3:
             # static serving cache: preallocated [B, T, H, D] buffers + a
             # write position — one compiled decode step serves every token
@@ -166,6 +172,36 @@ class GPTAttention(Layer):
         return out, (new_k, new_v, pos)
 
 
+    def _paged_decode_step(self, q, k, v, cache, b, n):
+        """Single-token attention against the paged block pool: write this
+        step's K/V at each slot's write position, gather that slot's blocks
+        by table, attend over positions <= seq_len. Shapes are fixed by
+        (max_batch, max_blocks, block_size), so the serving engine compiles
+        ONE program for every batch composition."""
+        if n != 1:
+            raise ValueError(
+                "paged decode is single-token; prefill goes through the "
+                f"dynamic-cache path (got a {n}-token chunk)")
+        from ...nn.functional.attention import paged_decode_attention
+        from ...ops._helpers import call_op_multi, ensure_tensor
+        block_size = cache.block_size
+
+        def fn(qv, kv, vv, kp, vp, tab, lens, act):
+            return paged_decode_attention(qv, kv, vv, kp, vp, tab, lens,
+                                          act, block_size)
+
+        out, new_k, new_v = call_op_multi(
+            "gpt_paged_decode_attention", fn,
+            (ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
+             ensure_tensor(cache.k_pool), ensure_tensor(cache.v_pool),
+             ensure_tensor(cache.block_tables),
+             ensure_tensor(cache.seq_lens), ensure_tensor(cache.active)),
+            num_outputs=3)
+        out = manip.reshape(out, [b, n, self.hidden_size])
+        out = self.out_proj(out)
+        return out, cache.updated(new_k._value, new_v._value)
+
+
 class GPTMLP(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -219,13 +255,24 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None):
         b, n = input_ids.shape[0], input_ids.shape[1]
-        static_cache = caches is not None and len(caches[0]) == 3
-        if static_cache:
+        paged = caches is not None and hasattr(caches[0], "block_tables")
+        static_cache = caches is not None and not paged \
+            and len(caches[0]) == 3
+        if paged:
+            past_len = None
+        elif static_cache:
             past = caches[0][2]._value           # current write position
             past_len = None
         else:
             past_len = caches[0][0].shape[1] if caches is not None else 0
-        if position_ids is None and static_cache:
+        if position_ids is None and paged:
+            # continuous batching: every slot sits at its OWN position
+            # (seq_lens), unlike the dense static cache's shared scalar
+            raw = caches[0].seq_lens
+            lens = jnp.asarray(getattr(raw, "_value", raw)).astype(jnp.int32)
+            pos = Tensor(lens[:, None]
+                         + jnp.arange(n, dtype=jnp.int32)[None, :])
+        elif position_ids is None and static_cache:
             pos = Tensor(past.astype(jnp.int32)
                          + jnp.arange(n, dtype=jnp.int32)[None, :])
         elif position_ids is None:

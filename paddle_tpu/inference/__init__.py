@@ -7,7 +7,28 @@ TPU-first: the reference's IR-analysis/fusion pass pipeline and TensorRT
 subgraph capture are XLA's job — the saved artifact is jax.export StableHLO
 (produced by paddle_tpu.jit.save / static.save_inference_model), and the
 predictor is a thin handle-based wrapper so reference deployment code ports
-unchanged."""
+unchanged.
+
+Scope: `Predictor` replays ONE exported program per `run()` — right for
+stateless single-model inference (classification, embedding, scoring)
+and for porting reference `paddle_infer` call sites. For **batched
+autoregressive GENERATION under live traffic** use
+`paddle_tpu.serving.LLMEngine` instead: it is the engine behind the
+reference's serving deployments rebuilt for TPU — continuous
+(iteration-level) batching over a paged KV cache, ONE compiled
+decode-step executable for every tenant mix (zero retraces as requests
+join/leave), bucketed prefill, preempt-resume, and streaming `on_token`
+callbacks::
+
+    from paddle_tpu.serving import LLMEngine
+    engine = LLMEngine(model, max_batch_size=8, block_size=16)
+    outs = engine.generate(prompt_id_lists, max_new_tokens=64)
+
+A `PredictorPool` of per-request predictors (the reference's serving
+pattern) freezes batch composition for a request's lifetime; `LLMEngine`
+re-forms the batch at every token boundary — that is the difference
+between one-user latency and millions-of-users throughput. See the
+README "Serving" section and `tools/serve_bench.py`."""
 from __future__ import annotations
 
 import numpy as np
@@ -244,7 +265,9 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
 
 class PredictorPool:
     """Pool of Predictors sharing one config (reference
-    paddle_infer.PredictorPool — serving worker pools)."""
+    paddle_infer.PredictorPool — serving worker pools). For generation
+    workloads prefer `paddle_tpu.serving.LLMEngine`: one continuous
+    batch instead of one frozen batch per pooled worker."""
 
     def __init__(self, config, size=1):
         self._predictors = [create_predictor(config)

@@ -135,6 +135,63 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return call_op("scaled_dot_product_attention", fn, (q, k, v))
 
 
+def paged_decode_attention(q, k_new, v_new, k_pool, v_pool, block_tables,
+                           seq_lens, active, block_size):
+    """One decode step of attention against a paged block-pool KV cache
+    (the PagedAttention memory model; serving/cache.py).
+
+    q/k_new/v_new: ``[S, 1, H, D]`` — this step's projections for every
+    batch slot (S is the engine's fixed max-batch slot count).
+    k_pool/v_pool: ``[num_blocks, block_size, H, D]`` — one layer's pool.
+    block_tables: ``[S, max_blocks]`` int32 — per-slot ordered block ids;
+    gathered position ``t`` of slot ``s`` is token position ``t`` of that
+    sequence (tables are dense prefixes, padded with the null block).
+    seq_lens: ``[S]`` int32 — cached tokens per slot; the new token is
+    written at position ``seq_lens[s]`` and attended to (self-attention).
+    active: ``[S]`` bool — inactive slots write to the reserved null
+    block and their outputs are garbage by design (the engine never reads
+    them).
+
+    Pure jnp and shape-static: ONE compiled program serves every token of
+    every tenant mix — join/leave/evict is a table edit, never a retrace.
+    Returns ``(out [S, 1, H, D], new_k_pool, new_v_pool)``.
+    """
+    s = q.shape[0]
+    head_dim = q.shape[-1]
+    n_blocks_per_seq = block_tables.shape[1]
+    lens = jnp.where(active, seq_lens, 0).astype(jnp.int32)
+    rows = jnp.arange(s, dtype=jnp.int32)
+    # write the new token's K/V at (table[len // bs], len % bs); inactive
+    # slots all target the null block (duplicate writes there are fine —
+    # its content is never unmasked)
+    write_block = jnp.where(
+        active, block_tables[rows, lens // block_size], 0).astype(jnp.int32)
+    write_off = lens % block_size
+    k_pool = k_pool.at[write_block, write_off].set(
+        k_new[:, 0].astype(k_pool.dtype))
+    v_pool = v_pool.at[write_block, write_off].set(
+        v_new[:, 0].astype(v_pool.dtype))
+    # gather-by-block-table: [S, M, bs, H, D] -> [S, T, H, D] where
+    # gathered index t IS token position t (tables are ordered)
+    t_max = n_blocks_per_seq * block_size
+    keys = k_pool[block_tables].reshape(s, t_max, *k_pool.shape[2:])
+    vals = v_pool[block_tables].reshape(s, t_max, *v_pool.shape[2:])
+    qh = q[:, 0]                                       # [S, H, D]
+    scores = jnp.einsum("shd,sthd->sht", qh,
+                        keys.astype(qh.dtype)) \
+        / jnp.sqrt(jnp.asarray(head_dim, qh.dtype))
+    valid = jnp.arange(t_max, dtype=jnp.int32)[None, :] <= lens[:, None]
+    scores = jnp.where(valid[:, None, :], scores,
+                       jnp.asarray(-1e9, qh.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32),
+                           axis=-1).astype(qh.dtype)
+    out = jnp.einsum("sht,sthd->shd", probs, vals.astype(qh.dtype))
+    return out[:, None], k_pool, v_pool
+
+
+__all__ += ["paged_decode_attention"]
+
+
 @register_op("sparse_attention", "attention",
              ref="fluid/operators/sparse_attention_op.cu")
 def sparse_attention(query, key, value, sparse_csr_offset,

@@ -220,17 +220,20 @@ def observe(name, out_vals):
 
 
 def note_step(label, grads_finite, fwd_finite=None, scale_before=None,
-              scale_after=None):
-    """Queue a step-level guardian outcome: the grads-finite predicate
-    that drove the where() rescue (fused or eager), the optional forward
-    (loss) finiteness, and the loss-scale transition when a GradScaler
-    was folded in. Step entries never raise at flush — the skip already
-    rescued the step; the flush only attributes it."""
+              scale_after=None, step_index=None):
+    """Queue a step-level guardian outcome: the skip predicate that drove
+    the where() rescue (fused or eager — non-finite update OR non-finite
+    new params/slots), the optional forward (loss) finiteness, and the
+    loss-scale transition when a GradScaler was folded in. `step_index`
+    is the optimizer's step counter at the decision: it rides the queue
+    so the flight-recorder events (and the fusion doctor) can say WHICH
+    step skipped, not just how many. Step entries never raise at flush —
+    the skip already rescued the step; the flush only attributes it."""
     GUARD_STATS.checks_enqueued += 1
     GUARD_STATS.steps_guarded += 1
     q = _tls.queue
     q.append(("step", label, grads_finite, fwd_finite, scale_before,
-              scale_after))
+              scale_after, step_index))
     if len(q) >= _MAX_QUEUE:
         flush()
 
@@ -353,12 +356,15 @@ def _resolve_batch(entries, scalars):
                                  "scale": [float(_host(s_before)),
                                            float(_host(s_after))]})
         else:
-            _kind, label, grads_fin, fwd_fin, s_before, s_after = e
+            _kind, label, grads_fin, fwd_fin, s_before, s_after, step_idx = e
+            stamp = {"kind": "guardian"}
+            if step_idx is not None:
+                stamp["step"] = int(step_idx)
             skipped = not bool(_host(grads_fin))
             if skipped:
                 GUARD_STATS.steps_skipped += 1
                 _EVENTS.emit("step.record", label, reason="nonfinite_skip",
-                             detail={"kind": "guardian"})
+                             detail=stamp)
             if fwd_fin is not None and not bool(_host(fwd_fin)):
                 # the loss itself was non-finite; the skip already rescued
                 # the parameters — but the FORWARD contract must match the
@@ -367,7 +373,7 @@ def _resolve_batch(entries, scalars):
                 GUARD_STATS.nonfinite_outputs += 1
                 _EVENTS.emit("step.record", label,
                              reason="nonfinite_output",
-                             detail={"kind": "guardian", "rescued": True})
+                             detail=dict(stamp, rescued=True))
                 msg = (f"Fused step '{label}' produced a non-finite loss "
                        "(FLAGS_check_numerics guardian; parameters were "
                        "rescued by the skip-step no-op — re-run with "
@@ -386,8 +392,8 @@ def _resolve_batch(entries, scalars):
                     GUARD_STATS.scaler_backoffs += 1
                     _EVENTS.emit("step.record", label,
                                  reason="scaler_backoff",
-                                 detail={"kind": "guardian",
-                                         "scale": [before, after]})
+                                 detail=dict(stamp,
+                                             scale=[before, after]))
     return first_error
 
 

@@ -296,10 +296,17 @@ class _StepProgram:
                 new_p.append(np_)
                 new_accs.append([na_.get(n) for n in acc_names])
             if check:
-                # skip-step rescue: non-finite grads make the whole update
-                # a bitwise no-op on params AND optimizer slots — ONE
-                # fused scalar predicate, zero extra launches
-                upd_finite = guardian.finite_all(upd)
+                # skip-step rescue: non-finite grads OR a non-finite
+                # updated state make the whole update a bitwise no-op on
+                # params AND optimizer slots — ONE fused scalar
+                # predicate, zero extra launches. The new params/slots
+                # are part of the predicate because finite grads can
+                # still blow up the state (an LR spike overflowing
+                # `p - lr*g`, a momentum buffer saturating): gating on
+                # grads alone would wave the blowup through the gate.
+                new_state = list(new_p) + [v for row in new_accs
+                                           for v in row if v is not None]
+                upd_finite = guardian.finite_all(list(upd) + new_state)
                 fwd_finite = guardian.finite_all([root_val])
                 new_p = [jnp.where(upd_finite, nv, pv)
                          for nv, pv in zip(new_p, pvals)]
@@ -978,7 +985,8 @@ class _StepFusionManager:
             if check:
                 from . import guardian
                 guardian.note_step(program.label, upd_finite, fwd_finite,
-                                   scale_before, scale_after)
+                                   scale_before, scale_after,
+                                   step_index=opt._step_count)
             pending.fired = True
             program.fail_streak = 0
             elapsed = time.perf_counter_ns() - pending.t0

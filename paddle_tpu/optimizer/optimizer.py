@@ -159,9 +159,15 @@ class Optimizer:
                     new_a.append([na_.get(n) for n in acc_names])
                 if not check:
                     return new_p, new_a, None
-                # non-finite grads -> the whole update is a bitwise no-op
-                # on params AND slots; ONE fused scalar predicate
-                finite = guardian.finite_all(gvals)
+                # non-finite grads OR non-finite NEW state -> the whole
+                # update is a bitwise no-op on params AND slots; ONE
+                # fused scalar predicate. The new params/slots join the
+                # predicate because finite grads can still overflow the
+                # state (LR spike, saturating momentum) — matching the
+                # fused whole-step gate (ops/step_fusion.py) bitwise
+                new_state = list(new_p) + [v for row in new_a
+                                           for v in row if v is not None]
+                finite = guardian.finite_all(list(gvals) + new_state)
                 new_p = [jnp.where(finite, nv, pv)
                          for nv, pv in zip(new_p, pvals)]
                 new_a = [[None if nv is None else jnp.where(finite, nv, ov)
@@ -183,7 +189,8 @@ class Optimizer:
                 if v is not None:
                     self._accumulators[n][p.name] = v
         if check:
-            guardian.note_step("eager_step", finite)
+            guardian.note_step("eager_step", finite,
+                               step_index=self._step_count)
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

@@ -22,6 +22,11 @@ Event categories (a public contract — tests assert the set):
       whole-step promotion lifecycle (ops/step_fusion.py; `step.record`
       covers observation-side events: cycle boundaries, cycle poisons,
       eager tape backwards and optimizer steps)
+  serve.enqueue / serve.admit / serve.step / serve.evict / serve.complete
+      serving-engine request lifecycle (paddle_tpu/serving/engine.py):
+      continuous-batching admission, the compiled decode step, KV-pool
+      preemption, completion — with `kv_exhausted` / `bucket_retrace`
+      reason codes
 
 Reason codes (also a public contract) attribute every bypass/split/poison
 to its cause — `rng_rekey` (the op consumed fresh global randomness and its
@@ -60,6 +65,11 @@ CATEGORIES = frozenset({
     "chain.stitch",
     "step.record", "step.promote", "step.fire", "step.split",
     "step.deactivate",
+    # serving-engine lifecycle (paddle_tpu/serving/engine.py): request
+    # queued / joined the running batch (prefilled) / one compiled decode
+    # step ran / preempted-evicted / finished-or-failed
+    "serve.enqueue", "serve.admit", "serve.step", "serve.evict",
+    "serve.complete",
 })
 
 # Machine-readable causes. Stable across releases: the fusion doctor, the
@@ -98,6 +108,9 @@ REASON_CODES = frozenset({
     "nonfinite_skip",      # non-finite grads: the update was a bitwise no-op
     "scaler_backoff",      # GradScaler shrank the loss scale after bad steps
     "injected_fault",      # a chaos-harness fault hook fired (tools/chaos.py)
+    # -- serving-engine outcomes (paddle_tpu/serving/) ---------------------
+    "kv_exhausted",        # KV block pool dry: eviction / admission refusal
+    "bucket_retrace",      # a new prefill length bucket compiled
 })
 
 

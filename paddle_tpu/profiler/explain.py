@@ -109,10 +109,11 @@ REASON_HINTS = {
         "Re-run with FLAGS_check_nan_inf=1 to localize the op "
         "synchronously; check the LR / init / input pipeline."),
     "nonfinite_skip": (
-        "gradients were non-finite, so the guardian applied the update "
-        "as where(finite, new, old) — the step was a bitwise no-op. "
-        "Expected under fp16 GradScaler warmup; persistent skips mean "
-        "the loss scale (or the LR) is too high."),
+        "gradients or the UPDATED params/optimizer state were non-finite, "
+        "so the guardian applied the update as where(finite, new, old) — "
+        "the step was a bitwise no-op. Expected under fp16 GradScaler "
+        "warmup; persistent skips mean the loss scale (or the LR) is too "
+        "high."),
     "scaler_backoff": (
         "GradScaler shrank the loss scale after consecutive non-finite "
         "steps (update_loss_scaling semantics); the scale is a hoisted "
@@ -120,6 +121,16 @@ REASON_HINTS = {
     "injected_fault": (
         "a chaos-harness fault hook fired (tools/chaos.py): the event is "
         "deliberate; the surrounding splits/poisons validate recovery."),
+    "kv_exhausted": (
+        "the serving engine's KV block pool ran dry: a running stream "
+        "was preempted (resume re-prefills, tokens stay identical) or a "
+        "request was refused at admission. Fix: raise num_blocks, lower "
+        "max_batch_size, or shorten max_new_tokens."),
+    "bucket_retrace": (
+        "a prompt landed in a prefill length bucket that had not "
+        "compiled yet — expected at most log2(max_context) times per "
+        "engine; frequent occurrences mean the bucket cache is being "
+        "discarded (rebuild the engine less often)."),
 }
 
 
@@ -164,6 +175,15 @@ def explain(events=None):
     # still fused) — aggregate them into their own section
     guardian_ev = _attr(
         events, lambda e: (e.get("detail") or {}).get("kind") == "guardian")
+    # each guardian decision is stamped with the optimizer step index
+    # (guardian.note_step step_index) — so the report can say WHICH step
+    # skipped / backed off, not just how many did
+    for e in events:
+        d = e.get("detail") or {}
+        if d.get("kind") == "guardian" and d.get("step") is not None \
+                and e.get("reason") in guardian_ev:
+            rec = guardian_ev[e["reason"]]
+            rec.setdefault("steps", []).append(d["step"])
     poisons = _attr(events, lambda e: e["cat"] == "step.record"
                     and e.get("reason") is not None
                     and (e.get("detail") or {}).get("kind") != "guardian")
@@ -217,9 +237,31 @@ def explain(events=None):
         "guardian": guardian_ev,
     }
 
+    # serving engine (serve.* events, paddle_tpu/serving/engine.py):
+    # request lifecycle counts, decode-batch occupancy, and the reasons
+    # behind evictions / refusals / prefill compiles
+    serve_steps = [e for e in events if e["cat"] == "serve.step"]
+    if any(e["cat"].startswith("serve.") for e in events):
+        occ = [(e.get("detail") or {}).get("occupancy") for e in serve_steps]
+        occ = [o for o in occ if o is not None]
+        report["serving"] = {
+            "enqueued": n("serve.enqueue"),
+            "admitted": n("serve.admit"),
+            "decode_steps": n("serve.step"),
+            "evictions": n("serve.evict"),
+            "completed": n("serve.complete"),
+            "occupancy_mean": (round(sum(occ) / len(occ), 4)
+                               if occ else None),
+            "reasons": _attr(events,
+                             lambda e: e["cat"].startswith("serve.")
+                             and e.get("reason") is not None),
+        }
+
+    serve_reasons = (report.get("serving") or {}).get("reasons", {})
+
     findings = []
     unknown = sorted({r for src in (step_splits, poisons, chain_splits,
-                                    bypasses, guardian_ev)
+                                    bypasses, guardian_ev, serve_reasons)
                       for r in src
                       if r not in REASON_CODES and r != "unattributed"})
     if unknown:
@@ -263,6 +305,23 @@ def explain(events=None):
             verdict = "promoted_not_yet_fired"
             headline = (f"promoted ({promoted}), {fired} fired, 0 splits "
                         "— run more steps for a steady-state verdict")
+    elif report.get("serving") and not any(
+            e["cat"] == "step.record"
+            and (e.get("detail") or {}).get("kind") == "eager_step"
+            for e in events):
+        # a serving-engine process with NO optimizer-step boundaries: the
+        # jit-traced model calls leave cycle-poison noise (tracer_input)
+        # that would otherwise read as a broken TRAINING loop — the
+        # serving verdict is the truthful one here. A combined
+        # train+serve process still gets the training diagnosis above.
+        sv = report["serving"]
+        verdict = "serving"
+        headline = (f"serving: {sv['admitted']} admission(s), "
+                    f"{sv['decode_steps']} decode step(s), "
+                    f"{sv['evictions']} eviction(s), "
+                    f"{sv['completed']} completion(s)"
+                    + (f", occupancy {sv['occupancy_mean']}"
+                       if sv["occupancy_mean"] is not None else ""))
     elif poisons:
         verdict = "never_promoted"
         r, rec = max(poisons.items(), key=lambda kv: kv[1]["count"])
@@ -282,11 +341,25 @@ def explain(events=None):
     report["verdict"] = verdict
     report["headline"] = headline
 
-    for r, rec in sorted(guardian_ev.items(), key=lambda kv: -kv[1]["count"]):
+    for r, rec in sorted(serve_reasons.items(),
+                         key=lambda kv: -kv[1]["count"]):
         ops = ", ".join(f"`{o}`×{c}" for o, c in
                         sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
         findings.append(
+            f"serving {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
+    for r, rec in sorted(guardian_ev.items(), key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        steps = rec.get("steps") or []
+        at = ""
+        if steps:
+            shown = ", ".join(str(s) for s in steps[:8])
+            at = (f" at step(s) {shown}"
+                  + (f" (+{len(steps) - 8} more)" if len(steps) > 8 else ""))
+        findings.append(
             f"guardian {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + at
             + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
     for r, rec in sorted(poisons.items(), key=lambda kv: -kv[1]["count"]):
         ops = ", ".join(f"`{o}`×{c}" for o, c in
@@ -342,6 +415,14 @@ def format_report(report):
     if g:
         lines.append("guard : " + " ".join(
             f"{r}={rec['count']}" for r, rec in sorted(g.items())))
+    sv = report.get("serving")
+    if sv:
+        lines.append(
+            f"serve : enqueued={sv['enqueued']} admitted={sv['admitted']} "
+            f"steps={sv['decode_steps']} evictions={sv['evictions']} "
+            f"completed={sv['completed']}"
+            + (f" occupancy={sv['occupancy_mean']}"
+               if sv["occupancy_mean"] is not None else ""))
     if report["findings"]:
         lines.append("")
         lines.append("findings:")

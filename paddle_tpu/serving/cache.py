@@ -1,0 +1,162 @@
+"""Paged KV cache: block-pool attention memory for continuous batching.
+
+Reference analog: the reference serves through `fused_multi_transformer`'s
+dense per-request `[B, max_len, H, D]` cache buffers behind
+`AnalysisPredictor` (inference/api/analysis_predictor.h:95). Dense buffers
+reserve `max_len` for EVERY sequence, so a 16-token chat and a 2k-token
+document cost the same HBM and a new request of a different length means a
+new buffer (and on TPU a new compiled shape). This module is the
+PagedAttention memory model (vLLM, SOSP'23) rebuilt TPU-native:
+
+  * ONE preallocated block pool per layer, shape
+    ``[num_blocks, block_size, H, D]`` — total KV memory is fixed at
+    engine construction, independent of how many sequences share it;
+  * each sequence owns an ordered list of block ids (its *block table*);
+    token position ``p`` of a sequence lives at
+    ``(table[p // block_size], p % block_size)``;
+  * admission / growth / eviction / preemption are *host-side edits of
+    integer tables* — no cache copy, no reshape, no recompile. The
+    compiled decode step (serving/engine.py) only ever sees the fixed
+    ``[S, max_blocks]`` int32 table and the fixed pools, so sequences of
+    wildly different lengths batch into one executable with zero
+    retraces.
+
+Block 0 is reserved as the *null block*: inactive batch slots and padded
+table entries point at it, so in-graph gathers/scatters never need a
+branch — garbage goes to (and comes from) block 0 and is masked out of
+the attention softmax.
+
+The device side of the design lives in
+`nn/functional/attention.py::paged_decode_attention` (gather-by-block-table
+attention) and `scatter_prefill` below (bulk prompt-KV insertion); the
+policy side (who gets blocks, who is evicted) lives in
+serving/scheduler.py.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import jax.numpy as jnp
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView",
+           "scatter_prefill", "NULL_BLOCK"]
+
+# block id 0 is never allocated: it is the write/read target for inactive
+# slots and out-of-range table entries (see module docstring)
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the pool's block ids.
+
+    Pure bookkeeping — no device state. O(1) allocate/free; the free
+    count is the scheduler's admission-watermark signal.
+    """
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (one is the reserved null block), got "
+                f"{num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # block 0 reserved; 1..num_blocks-1 allocatable
+        self._free = deque(range(1, self.num_blocks))
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def capacity(self):
+        """Allocatable blocks (pool minus the null block)."""
+        return self.num_blocks - 1
+
+    def allocate(self, n):
+        """Pop `n` block ids, or None (allocating nothing) when fewer
+        than `n` are free — admission is all-or-nothing."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, blocks):
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("attempt to free the reserved null block")
+            self._free.append(b)
+
+
+class PagedCacheView:
+    """One layer's paged cache as seen from INSIDE the compiled decode
+    step: the layer's pools plus the batch's block tables / lengths /
+    active mask (jnp arrays or tracers). `GPTAttention` detects this view
+    by its `block_tables` attribute and routes to the paged decode path;
+    `updated()` threads the written pools back out of the model."""
+
+    __slots__ = ("k_pool", "v_pool", "block_tables", "seq_lens", "active",
+                 "block_size")
+
+    def __init__(self, k_pool, v_pool, block_tables, seq_lens, active,
+                 block_size):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.block_tables = block_tables
+        self.seq_lens = seq_lens
+        self.active = active
+        self.block_size = int(block_size)
+
+    def updated(self, k_pool, v_pool):
+        return PagedCacheView(k_pool, v_pool, self.block_tables,
+                              self.seq_lens, self.active, self.block_size)
+
+
+class PagedKVCache:
+    """The device pools + the allocator, sized once at engine start.
+
+    Pools are stacked over layers — ``[L, num_blocks, block_size, H, D]``
+    — so the compiled decode/prefill programs donate exactly two buffers
+    regardless of depth. Sizing policy (blocks per context length, the
+    admission budget) lives in ONE place: serving/scheduler.py.
+    """
+
+    def __init__(self, num_layers, num_heads, head_dim, num_blocks,
+                 block_size, dtype=jnp.float32):
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype
+        shape = (self.num_layers, self.num_blocks, self.block_size,
+                 self.num_heads, self.head_dim)
+        self.k_pools = jnp.zeros(shape, dtype)
+        self.v_pools = jnp.zeros(shape, dtype)
+        self.allocator = BlockAllocator(self.num_blocks)
+
+
+def scatter_prefill(k_pools, v_pools, k_layers, v_layers, block_row,
+                    length, block_size):
+    """Bulk-insert a prefilled prompt's K/V into the pools.
+
+    k_layers/v_layers: ``[L, T_bucket, H, D]`` — the per-layer prompt KV
+    computed by the bucketed prefill program (right-padded to the bucket).
+    block_row: ``[max_blocks]`` int32 — the sequence's block table.
+    length: scalar int32 — true prompt length; padded positions are
+    routed to the null block (their values are garbage by construction
+    and never read: gather masks by `seq_lens`).
+
+    Traceable (runs inside the jitted prefill program). Returns the
+    updated pools.
+    """
+    t_bucket = k_layers.shape[1]
+    pidx = jnp.arange(t_bucket, dtype=jnp.int32)
+    blocks = jnp.where(pidx < length,
+                       block_row[pidx // block_size],
+                       jnp.asarray(NULL_BLOCK, jnp.int32))
+    offs = pidx % block_size
+    num_layers = k_layers.shape[0]
+    for layer in range(num_layers):
+        k_pools = k_pools.at[layer, blocks, offs].set(
+            k_layers[layer].astype(k_pools.dtype))
+        v_pools = v_pools.at[layer, blocks, offs].set(
+            v_layers[layer].astype(v_pools.dtype))
+    return k_pools, v_pools

@@ -1,0 +1,517 @@
+"""Continuous-batching serving engine: ONE compiled decode step for every
+tenant mix.
+
+Reference analog: the reference's serving story is `AnalysisPredictor`
+replaying a `fused_multi_transformer` program per request
+(inference/api/analysis_predictor.h:95) — static batch, dense caches.
+This engine is that layer rebuilt for the north star ("heavy traffic from
+millions of users"), combining:
+
+  * a **paged KV cache** (serving/cache.py): one preallocated block pool
+    shared by every sequence, per-sequence block tables, admission /
+    eviction / preemption as integer-table edits;
+  * a **compiled decode step**: a single `jax.jit` executable over a
+    fixed max-batch slot layout — ``(tokens [S], block_tables [S, M],
+    seq_lens [S], active [S], k_pools, v_pools) -> (next_tokens,
+    new_pools)`` with the pools donated. Requests joining or leaving the
+    batch only change the *values* of the integer inputs, never a shape:
+    the decode program compiles exactly once and then serves every token
+    of every stream (`stats()["decode_compiles"]`, guarded by
+    tools/perf_smoke.py);
+  * **bucketed prefill**: prompts are right-padded to power-of-two
+    length buckets, so admitting a new request compiles at most
+    ``log2(max_context)`` prefill programs ever — and never touches the
+    decode executable (`bucket_retrace` in the flight recorder marks
+    each new bucket);
+  * a **continuous-batching scheduler** (serving/scheduler.py): FCFS +
+    free-block watermark admission, LIFO preempt-resume via block
+    tables, join/leave at token boundaries;
+  * **streaming detokenization**: per-request `on_token` callbacks fire
+    the moment a token is produced (optionally through a tokenizer's
+    `decode`), not when the request completes.
+
+Telemetry rides the PR 4 fusion flight recorder: `serve.*` events
+(enqueue/admit/step/evict/complete) with reason codes `kv_exhausted` /
+`bucket_retrace`, aggregated by `profiler.explain` / `tools/fusion_doctor`
+and benched by `tools/serve_bench.py` + the bench.py `serve` legs.
+"""
+from __future__ import annotations
+
+import math
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.autograd import set_grad_enabled
+from ..profiler.events import EVENTS as _EVENTS
+from .cache import PagedKVCache, PagedCacheView, scatter_prefill
+from .scheduler import (Request, Scheduler, RUNNING, FINISHED, FAILED)
+
+__all__ = ["LLMEngine", "ServeStats"]
+
+_MIN_BUCKET = 8
+
+
+class ServeStats:
+    """Engine counters + step-latency samples. `decode_compiles` is
+    incremented INSIDE the traced decode function (the side effect runs
+    only while tracing), so it counts real XLA traces — the zero-retrace
+    guard reads it directly."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        """Zero the counters IN PLACE: the compiled decode/prefill
+        closures hold a reference to this object (that is how
+        decode_compiles counts real traces), so a bench warmup resets the
+        window without losing retrace visibility."""
+        self.steps = 0
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        self.admitted = 0
+        self.evictions = 0
+        self.completed = 0
+        self.failed = 0
+        self.refused = 0
+        self.occupancy_sum = 0.0
+        self.saturated_steps = 0
+        self.saturated_occupancy_sum = 0.0
+        self.step_times_s = []
+        self.wall_t0 = None
+        self.wall_t1 = None
+
+    def observe_step(self, active, num_slots, demand, dt_s):
+        self.steps += 1
+        occ = active / num_slots
+        self.occupancy_sum += occ
+        if demand >= num_slots:
+            self.saturated_steps += 1
+            self.saturated_occupancy_sum += occ
+        if len(self.step_times_s) < 100_000:
+            self.step_times_s.append(dt_s)
+
+    def snapshot(self):
+        times = sorted(self.step_times_s)
+
+        def pct(p):
+            if not times:
+                return 0.0
+            return times[min(len(times) - 1, int(p / 100.0 * len(times)))]
+
+        elapsed = None
+        if self.wall_t0 is not None and self.wall_t1 is not None:
+            elapsed = self.wall_t1 - self.wall_t0
+        return {
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "decode_compiles": self.decode_compiles,
+            "prefill_compiles": self.prefill_compiles,
+            "admitted": self.admitted,
+            "evictions": self.evictions,
+            "completed": self.completed,
+            "failed": self.failed,
+            "refused": self.refused,
+            "occupancy_mean": (self.occupancy_sum / self.steps
+                               if self.steps else 0.0),
+            "occupancy_saturated": (
+                self.saturated_occupancy_sum / self.saturated_steps
+                if self.saturated_steps else 0.0),
+            "p50_step_ms": pct(50) * 1e3,
+            "p99_step_ms": pct(99) * 1e3,
+            "elapsed_s": elapsed,
+            "tokens_per_sec": (self.tokens_generated / elapsed
+                               if elapsed else 0.0),
+        }
+
+
+class LLMEngine:
+    """Multi-tenant autoregressive serving over a GPT-family model.
+
+    Usage::
+
+        engine = LLMEngine(model, max_batch_size=8, block_size=16)
+        engine.add_request([1, 2, 3], max_new_tokens=32,
+                           on_token=lambda req, tok, text: ...)
+        while engine.step():
+            pass                      # or engine.run()
+
+    Decoding is greedy (matches ``model.generate(do_sample=False)``
+    token-for-token — the parity contract tests/test_serving.py pins).
+    The model is put in eval mode and its parameters are BAKED into the
+    compiled programs as constants (the engine owns the model for its
+    lifetime); swapping weights means building a new engine.
+    """
+
+    def __init__(self, model, max_batch_size=8, block_size=16,
+                 num_blocks=None, max_context=None, watermark_blocks=None,
+                 dtype=None, tokenizer=None):
+        cfg = model.config
+        model.eval()
+        self._model = model
+        self._tokenizer = tokenizer
+        self.max_batch_size = int(max_batch_size)
+        self.block_size = int(block_size)
+        self.max_context = int(max_context
+                               or cfg.max_position_embeddings)
+        self.max_blocks_per_seq = math.ceil(self.max_context
+                                            / self.block_size)
+        if num_blocks is None:
+            # default: every slot can reach max_context (+ null block)
+            num_blocks = 1 + self.max_batch_size * self.max_blocks_per_seq
+        if dtype is None:
+            params = model.parameters()
+            dtype = params[0]._value.dtype if params else jnp.float32
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.cache = PagedKVCache(cfg.num_hidden_layers,
+                                  cfg.num_attention_heads, head_dim,
+                                  num_blocks, self.block_size, dtype)
+        self.scheduler = Scheduler(self.max_batch_size,
+                                   self.cache.allocator, self.block_size,
+                                   watermark_blocks)
+        self._stats = ServeStats()
+        # fixed slot-layout state the compiled decode step consumes
+        s, m = self.max_batch_size, self.max_blocks_per_seq
+        self._tables = np.zeros((s, m), np.int32)
+        self._lens = np.zeros(s, np.int32)
+        self._active = np.zeros(s, bool)
+        self._tokens = np.zeros(s, np.int32)
+        self._k_pools = self.cache.k_pools
+        self._v_pools = self.cache.v_pools
+        self._decode_fn = None
+        self._prefill_fns = {}
+        self._next_rid = 0
+        self.requests = {}
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens=16, request_id=None,
+                    eos_token_id=None, on_token=None):
+        """Enqueue a generation request; returns the Request handle.
+
+        Raises ValueError when the request can NEVER be served (prompt +
+        max_new_tokens beyond the position table, or a peak KV footprint
+        larger than the pool minus the growth watermark) — attributed as
+        `kv_exhausted` in the flight recorder. A request that merely
+        cannot fit *right now* is queued, not refused.
+        """
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        rid = request_id
+        if rid is None:
+            rid = f"r{self._next_rid}"
+        self._next_rid += 1
+        prev = self.requests.get(rid)
+        if prev is not None and not prev.finished:
+            # overwriting would orphan a handle the scheduler still runs
+            raise ValueError(
+                f"request id {rid!r} is already queued/running; ids may "
+                "only be reused after the previous request finishes")
+        req = Request(rid, prompt, max_new_tokens, eos_token_id, on_token)
+        if len(prompt) + req.max_new_tokens > self.max_context:
+            raise ValueError(
+                f"request {rid}: prompt ({len(prompt)}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_context "
+                f"({self.max_context})")
+        sched = self.scheduler
+        peak = sched.max_blocks_of(req)
+        budget = sched.block_budget()
+        if not sched.can_ever_fit(req):
+            self._stats.refused += 1
+            _EVENTS.emit("serve.enqueue", rid, reason="kv_exhausted",
+                         detail={"blocks_needed": peak,
+                                 "blocks_budget": budget})
+            raise ValueError(
+                f"request {rid}: needs {peak} KV blocks at peak but the "
+                f"pool only ever has {budget} (capacity "
+                f"{self.cache.allocator.capacity} - watermark "
+                f"{sched.watermark_blocks}); refuse instead of deadlock")
+        sched.enqueue(req)
+        self.requests[rid] = req
+        _EVENTS.emit("serve.enqueue", rid,
+                     detail={"prompt_len": len(prompt),
+                             "max_new_tokens": req.max_new_tokens})
+        return req
+
+    def step(self):
+        """One engine iteration: admit at the token boundary, grow/evict
+        for KV headroom, run the ONE compiled decode step, stream the
+        produced tokens, retire finished requests. Returns True while
+        any request is running or waiting."""
+        if self._stats.wall_t0 is None:
+            self._stats.wall_t0 = time.perf_counter()
+        sched = self.scheduler
+        # -- admission (token boundary) --------------------------------
+        while True:
+            req = sched.try_admit()
+            if req is None:
+                break
+            self._admit(req)
+        if not sched.running:
+            self._stats.wall_t1 = time.perf_counter()
+            return bool(sched.waiting)
+        # -- KV growth, preempting (newest first) when the pool is dry --
+        for req in sorted(list(sched.running),
+                          key=lambda r: r.admit_seq):
+            if req.state != RUNNING:
+                continue
+            need = sched.blocks_needed(req.cached_len)
+            while len(req.blocks) < need and req.state == RUNNING:
+                if sched.grow(req):
+                    self._sync_slot(req)
+                    continue
+                victim = sched.preempt_victim(exclude=req)
+                if victim is None:
+                    self._fail(req, "kv_exhausted")
+                    break
+                self._evict(victim)
+        if not sched.running:
+            self._stats.wall_t1 = time.perf_counter()
+            return bool(sched.waiting)
+        # -- the ONE compiled decode step ------------------------------
+        demand = sched.demand
+        n_active = len(sched.running)
+        t0 = time.perf_counter()
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        nxt, self._k_pools, self._v_pools = self._decode_fn(
+            self._tokens, self._tables, self._lens, self._active,
+            self._k_pools, self._v_pools)
+        toks = np.asarray(nxt)
+        dt = time.perf_counter() - t0
+        self._stats.observe_step(n_active, self.max_batch_size, demand, dt)
+        _EVENTS.emit("serve.step", "engine",
+                     detail={"active": n_active,
+                             "occupancy": round(
+                                 n_active / self.max_batch_size, 4),
+                             "ms": round(dt * 1e3, 4)})
+        # -- stream + retire -------------------------------------------
+        for req in list(sched.running):
+            slot = req.slot
+            req.cached_len += 1
+            self._lens[slot] = req.cached_len
+            tok = int(toks[slot])
+            self._tokens[slot] = tok
+            self._emit_token(req, tok)
+        self._stats.wall_t1 = time.perf_counter()
+        return bool(sched.running or sched.waiting)
+
+    def run(self, max_steps=None):
+        """Drive step() until every request drains (or `max_steps`)."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return n
+
+    def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
+        """Batch convenience: enqueue every prompt, run to drain, return
+        the generated token lists (continuous batching under the hood —
+        prompts of different lengths share slots and the block pool)."""
+        reqs = [self.add_request(p, max_new_tokens,
+                                 eos_token_id=eos_token_id)
+                for p in prompts]
+        self.run()
+        for r in reqs:
+            if r.state is FAILED:
+                raise RuntimeError(f"request {r.rid} failed: {r.error}")
+        return [list(r.generated) for r in reqs]
+
+    def stats(self):
+        snap = self._stats.snapshot()
+        snap["scheduler"] = self.scheduler.info()
+        snap["kv_blocks"] = self.cache.num_blocks
+        snap["block_size"] = self.block_size
+        return snap
+
+    def reset_stats(self):
+        """Start a fresh measurement window (counters AND step-time
+        samples); the compiled programs and the KV pool are untouched, so
+        a post-warmup window sees decode_compiles == 0 unless something
+        actually retraced."""
+        self._stats.reset()
+
+    # ------------------------------------------------------------------
+    # admission / prefill
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _bucket_for(n):
+        return max(_MIN_BUCKET, 1 << (int(n - 1)).bit_length())
+
+    def _admit(self, req):
+        """Bucketed prefill of prompt + already-generated tokens (resume
+        case) into the request's freshly assigned blocks, then join the
+        decode batch. Never touches the decode executable."""
+        ctx = req.prompt + req.generated
+        bucket = self._bucket_for(len(ctx))
+        fn = self._prefill_fns.get(bucket)
+        new_bucket = fn is None
+        if new_bucket:
+            fn = self._build_prefill(bucket)
+            self._prefill_fns[bucket] = fn
+        self._stats.admitted += 1
+        self._stats.prefills += 1
+        _EVENTS.emit("serve.admit", req.rid,
+                     reason="bucket_retrace" if new_bucket else None,
+                     detail={"context_len": len(ctx), "bucket": bucket,
+                             "blocks": len(req.blocks),
+                             "resumed": bool(req.generated)})
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(ctx)] = ctx
+        row = np.zeros(self.max_blocks_per_seq, np.int32)
+        row[:len(req.blocks)] = req.blocks
+        nxt, self._k_pools, self._v_pools = fn(
+            padded, np.int32(len(ctx)), row,
+            self._k_pools, self._v_pools)
+        req.cached_len = len(ctx)
+        self._sync_slot(req)
+        tok = int(np.asarray(nxt))
+        # the prefill's sampled token is the next decode step's input
+        self._tokens[req.slot] = tok
+        self._emit_token(req, tok)
+
+    def _sync_slot(self, req):
+        slot = req.slot
+        row = np.zeros(self.max_blocks_per_seq, np.int32)
+        row[:len(req.blocks)] = req.blocks
+        self._tables[slot] = row
+        self._lens[slot] = req.cached_len
+        self._active[slot] = True
+
+    def _clear_slot(self, slot):
+        self._tables[slot] = 0
+        self._lens[slot] = 0
+        self._active[slot] = False
+        self._tokens[slot] = 0
+
+    # ------------------------------------------------------------------
+    # token delivery / retirement
+    # ------------------------------------------------------------------
+    def _emit_token(self, req, tok):
+        req.generated.append(tok)
+        self._stats.tokens_generated += 1
+        if req.first_token_ns is None:
+            req.first_token_ns = time.perf_counter_ns()
+        if req.on_token is not None:
+            text = None
+            if self._tokenizer is not None:
+                try:
+                    text = self._tokenizer.decode([tok])
+                except Exception:
+                    text = None
+            req.on_token(req, tok, text)
+        done = len(req.generated) >= req.max_new_tokens
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            done = True
+        if done:
+            self._finish(req)
+
+    def _finish(self, req):
+        slot = req.slot
+        self.scheduler.release(req)
+        if slot is not None:
+            self._clear_slot(slot)
+        req.state = FINISHED
+        req.finish_ns = time.perf_counter_ns()
+        self._stats.completed += 1
+        _EVENTS.emit("serve.complete", req.rid,
+                     detail={"tokens": len(req.generated),
+                             "preemptions": req.preemptions})
+
+    def _fail(self, req, why):
+        slot = req.slot
+        self.scheduler.release(req)
+        if slot is not None:
+            self._clear_slot(slot)
+        req.state = FAILED
+        req.error = why
+        req.finish_ns = time.perf_counter_ns()
+        self._stats.failed += 1
+        _EVENTS.emit("serve.complete", req.rid, reason=why,
+                     detail={"failed": True,
+                             "tokens": len(req.generated)})
+
+    def _evict(self, victim):
+        """Preempt-resume: forget the victim's KV (a block-table edit),
+        requeue at its arrival position; resume re-prefills."""
+        slot = victim.slot
+        self._stats.evictions += 1
+        _EVENTS.emit("serve.evict", victim.rid, reason="kv_exhausted",
+                     detail={"freed_blocks": len(victim.blocks),
+                             "cached_tokens": victim.cached_len,
+                             "preemptions": victim.preemptions + 1})
+        self.scheduler.preempt(victim)
+        if slot is not None:
+            self._clear_slot(slot)
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _donate(self, argnums):
+        # CPU ignores buffer donation (with a warning per program) —
+        # only request it where it is real
+        return argnums if jax.default_backend() != "cpu" else ()
+
+    def _build_decode(self):
+        model = self._model
+        num_layers = model.config.num_hidden_layers
+        block_size = self.block_size
+        stats = self._stats
+
+        def decode(tokens, tables, lens, active, k_pools, v_pools):
+            stats.decode_compiles += 1   # runs only while tracing
+            views = [PagedCacheView(k_pools[l], v_pools[l], tables, lens,
+                                    active, block_size)
+                     for l in range(num_layers)]
+            with set_grad_enabled(False):
+                logits, new_views = model(
+                    Tensor(tokens[:, None], stop_gradient=True),
+                    caches=views)
+            new_k = jnp.stack([v.k_pool for v in new_views])
+            new_v = jnp.stack([v.v_pool for v in new_views])
+            nxt = jnp.argmax(logits._value[:, -1, :], axis=-1) \
+                .astype(jnp.int32)
+            return nxt, new_k, new_v
+
+        return jax.jit(decode, donate_argnums=self._donate((4, 5)))
+
+    def _build_prefill(self, bucket):
+        model = self._model
+        cfg = model.config
+        num_layers = cfg.num_hidden_layers
+        heads = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // heads
+        block_size = self.block_size
+        params = model.parameters()
+        dt = params[0]._value.dtype if params else jnp.float32
+        stats = self._stats
+
+        def prefill(ids, length, block_row, k_pools, v_pools):
+            stats.prefill_compiles += 1   # runs only while tracing
+            empty = [(Tensor(jnp.zeros((1, 0, heads, head_dim), dt)),) * 2
+                     for _ in range(num_layers)]
+            with set_grad_enabled(False):
+                logits, caches = model(Tensor(ids, stop_gradient=True),
+                                       caches=[tuple(c) for c in empty])
+            k_layers = jnp.stack([c[0]._value[0] for c in caches])
+            v_layers = jnp.stack([c[1]._value[0] for c in caches])
+            k_pools, v_pools = scatter_prefill(
+                k_pools, v_pools, k_layers, v_layers, block_row, length,
+                block_size)
+            last = jax.lax.dynamic_index_in_dim(
+                logits._value[0], length - 1, axis=0, keepdims=False)
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return nxt, k_pools, v_pools
+
+        return jax.jit(prefill, donate_argnums=self._donate((3, 4)))

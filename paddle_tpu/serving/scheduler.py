@@ -1,0 +1,237 @@
+"""Continuous-batching request scheduler (iteration-level scheduling).
+
+Reference analog: the reference serves through a pool of
+`AnalysisPredictor` workers, one request per predictor run — batch
+composition is frozen for a request's whole lifetime. This module is the
+Orca (OSDI'22) iteration-level design instead: scheduling decisions happen
+at TOKEN boundaries, so a request joins the running batch the moment a
+slot and enough KV blocks are free, and leaves the moment it finishes —
+no head-of-batch stragglers, no padding to the slowest tenant.
+
+Policy (deliberately small and predictable):
+
+  * **FCFS admission** — the waiting queue is ordered by arrival; only
+    the head is considered (strict FCFS: no skipping past a big request
+    to admit a small one, so no starvation).
+  * **Free-block watermark** — a request is admitted only if, after
+    taking its prompt's blocks, at least `watermark_blocks` remain free.
+    The watermark is the growth reserve: running sequences allocate one
+    block every `block_size` tokens, and growth ignores the watermark
+    (the reserve exists exactly for it).
+  * **Preempt-resume by block-table edit** — when growth finds the pool
+    dry, the most recently admitted running request is evicted: its
+    blocks return to the pool and the request rejoins the waiting queue
+    at its original arrival position (FCFS preserved). Nothing is
+    copied; resume re-prefills prompt + tokens generated so far
+    (recompute-style preemption, the vLLM default) and continues
+    token-identically.
+
+The scheduler is pure host-side bookkeeping over integers — it owns no
+device state and is unit-testable without jax. The engine
+(serving/engine.py) asks it *who* runs; the block pool (serving/cache.py)
+says *where* their KV lives.
+"""
+from __future__ import annotations
+
+import math
+import time
+
+__all__ = ["Request", "Scheduler", "QUEUED", "RUNNING", "FINISHED",
+           "FAILED"]
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+
+class Request:
+    """One generation request's lifecycle state.
+
+    `generated` accumulates output token ids (streamed through
+    `on_token` as they land); `cached_len` is how many tokens of
+    prompt+generated currently have KV in the pool (0 after a
+    preemption — resume re-prefills). `blocks` is the request's block
+    table: the ONLY thing admission/eviction edits.
+    """
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "on_token", "state", "generated", "blocks", "slot",
+                 "cached_len", "arrival_seq", "admit_seq", "preemptions",
+                 "error", "enqueue_ns", "first_token_ns", "finish_ns")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
+                 on_token=None):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.on_token = on_token
+        self.state = QUEUED
+        self.generated = []
+        self.blocks = []
+        self.slot = None
+        self.cached_len = 0
+        self.arrival_seq = None
+        self.admit_seq = None
+        self.preemptions = 0
+        self.error = None
+        self.enqueue_ns = time.perf_counter_ns()
+        self.first_token_ns = None
+        self.finish_ns = None
+
+    @property
+    def context_len(self):
+        """Tokens the model has consumed/produced so far (prompt +
+        generated) — what a resume must re-prefill."""
+        return len(self.prompt) + len(self.generated)
+
+    @property
+    def finished(self):
+        return self.state in (FINISHED, FAILED)
+
+
+class Scheduler:
+    """FCFS + watermark admission + preempt-resume over `allocator`."""
+
+    def __init__(self, num_slots, allocator, block_size,
+                 watermark_blocks=None):
+        self.num_slots = int(num_slots)
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        if watermark_blocks is None:
+            # default growth reserve: one block per slot, bounded by 5%
+            # of the pool — enough that a full batch can each cross a
+            # block boundary once without an eviction storm
+            watermark_blocks = min(self.num_slots,
+                                   max(1, allocator.capacity // 20))
+        self.watermark_blocks = int(watermark_blocks)
+        self.waiting = []            # Requests, ordered by arrival_seq
+        self.running = []            # admission order
+        self.slots = [None] * self.num_slots
+        self._arrivals = 0
+        self._admissions = 0
+
+    # -- sizing -------------------------------------------------------------
+    def blocks_needed(self, num_tokens):
+        """Blocks for `num_tokens` cached tokens plus the next write."""
+        return max(1, math.ceil((num_tokens + 1) / self.block_size))
+
+    def max_blocks_of(self, req):
+        """Blocks the request needs at its longest (prompt fully decoded:
+        the final generated token is returned but never written)."""
+        peak = len(req.prompt) + req.max_new_tokens - 1
+        return self.blocks_needed(peak)
+
+    def block_budget(self):
+        """Blocks a single request may ever hold: pool capacity minus the
+        admission watermark (try_admit never hands out the reserve, so a
+        request needing more than this could wait forever)."""
+        return self.allocator.capacity - self.watermark_blocks
+
+    def can_ever_fit(self, req):
+        """False when no amount of waiting/eviction can serve this
+        request — its peak block need exceeds what admission will ever
+        grant (capacity minus the watermark reserve). Refuse such a
+        request at enqueue: strict FCFS would deadlock the whole queue
+        behind it."""
+        return self.max_blocks_of(req) <= self.block_budget()
+
+    # -- queue --------------------------------------------------------------
+    def enqueue(self, req):
+        req.arrival_seq = self._arrivals
+        self._arrivals += 1
+        self.waiting.append(req)
+
+    def _requeue(self, req):
+        """Re-insert a preempted request by ORIGINAL arrival order."""
+        req.state = QUEUED
+        i = 0
+        while i < len(self.waiting) \
+                and self.waiting[i].arrival_seq < req.arrival_seq:
+            i += 1
+        self.waiting.insert(i, req)
+
+    # -- admission ----------------------------------------------------------
+    def try_admit(self):
+        """Admit the FCFS head if a slot is free and its context's blocks
+        leave the watermark intact. Returns the Request (now RUNNING,
+        blocks + slot assigned, KV not yet filled) or None."""
+        if not self.waiting:
+            return None
+        try:
+            slot = self.slots.index(None)
+        except ValueError:
+            return None
+        req = self.waiting[0]
+        needed = self.blocks_needed(req.context_len)
+        if self.allocator.num_free - needed < self.watermark_blocks:
+            return None
+        blocks = self.allocator.allocate(needed)
+        if blocks is None:
+            return None
+        self.waiting.pop(0)
+        req.blocks = blocks
+        req.slot = slot
+        req.state = RUNNING
+        req.admit_seq = self._admissions
+        self._admissions += 1
+        self.slots[slot] = req
+        self.running.append(req)
+        return req
+
+    # -- growth / preemption ------------------------------------------------
+    def grow(self, req):
+        """Allocate one more block for `req`. Growth may dip into the
+        watermark reserve — that is what it is for."""
+        got = self.allocator.allocate(1)
+        if got is None:
+            return False
+        req.blocks.extend(got)
+        return True
+
+    def preempt_victim(self, exclude=None):
+        """The most recently admitted running request other than
+        `exclude` (LIFO eviction: the newest tenant re-prefills, the
+        oldest keeps its progress)."""
+        cands = [r for r in self.running if r is not exclude]
+        return max(cands, key=lambda r: r.admit_seq) if cands else None
+
+    def preempt(self, req):
+        """Evict: blocks back to the pool, KV forgotten (cached_len=0 —
+        resume re-prefills context_len tokens), request back in the
+        waiting queue at its arrival position."""
+        self._detach(req)
+        req.preemptions += 1
+        req.cached_len = 0
+        self._requeue(req)
+
+    def release(self, req):
+        """A finished/failed request leaves the batch."""
+        self._detach(req)
+
+    def _detach(self, req):
+        if req.blocks:
+            self.allocator.free(req.blocks)
+            req.blocks = []
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+        if req in self.running:
+            self.running.remove(req)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def demand(self):
+        """Requests that want a slot right now."""
+        return len(self.running) + len(self.waiting)
+
+    def info(self):
+        return {
+            "waiting": len(self.waiting),
+            "running": len(self.running),
+            "free_blocks": self.allocator.num_free,
+            "watermark_blocks": self.watermark_blocks,
+            "slots": [r.rid if r is not None else None
+                      for r in self.slots],
+        }

@@ -111,6 +111,9 @@ class TestPublicContract:
             "chain.stitch",
             "step.record", "step.promote", "step.fire", "step.split",
             "step.deactivate",
+            # serving-engine request lifecycle (PR 6, paddle_tpu/serving)
+            "serve.enqueue", "serve.admit", "serve.step", "serve.evict",
+            "serve.complete",
         })
 
     def test_reason_codes_exact(self):
@@ -127,6 +130,8 @@ class TestPublicContract:
             # step-guardian decisions (PR 5, FLAGS_check_numerics)
             "nonfinite_output", "nonfinite_skip", "scaler_backoff",
             "injected_fault",
+            # serving-engine outcomes (PR 6, paddle_tpu/serving)
+            "kv_exhausted", "bucket_retrace",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

@@ -33,7 +33,8 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.framework import io as fio
 from paddle_tpu.framework import random as frandom
 from paddle_tpu.framework.flags import set_flags
-from paddle_tpu.incubate.checkpoint import train_epoch_range
+from paddle_tpu.incubate.checkpoint import (StepCheckpointer,
+                                            train_epoch_range)
 from paddle_tpu.ops import guardian
 from paddle_tpu.ops.dispatch import clear_dispatch_cache
 from paddle_tpu.profiler import (reset_step_fusion_stats, step_fusion_stats)
@@ -517,6 +518,154 @@ class TestGuardianFused:
         assert splits, "stale scaler constants did not split the replay"
         # the eager fallback still trained the step
         assert not np.array_equal(w_before, np.asarray(w._value))
+
+
+# ---------------------------------------------------------------------------
+# state-blowup gate + step-index stamping (PR 6 guardian follow-ons)
+# ---------------------------------------------------------------------------
+
+def _spike_run(fused, spike_at=9, steps=12):
+    """Loop whose gradients stay FINITE while one step's LR spike
+    overflows `p - lr*g` to inf: a pure optimizer-STATE blowup. The old
+    grads-only predicate waved it through the gate; the new-state fold
+    must turn it into a bitwise no-op step."""
+    set_flags({"FLAGS_check_numerics": True,
+               "FLAGS_eager_step_fusion": fused,
+               "FLAGS_profiler_events": True})
+    clear_dispatch_cache()
+    clear_fusion_events()
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(
+        (rng.standard_normal((4, 8)) * 10).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32),
+                         stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[w])
+    before = []
+    for i in range(steps):
+        # the LR is a hoisted scalar arg of the fused step executable, so
+        # the spike neither splits nor retraces — it rides the same
+        # program and the in-graph gate catches the overflow
+        opt.set_lr(3e38 if i == spike_at else 1e-3)
+        before.append(np.asarray(w._value).copy())
+        paddle.matmul(x, w).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    guardian.flush()
+    return before, w, opt
+
+
+class TestStateBlowupGate:
+    def test_eager_lr_spike_skips_bitwise(self):
+        before, w, opt = _spike_run(fused=False)
+        np.testing.assert_array_equal(before[9], before[10])   # no-op
+        assert not np.array_equal(before[10], before[11])      # resumed
+        assert guardian.guardian_stats()["steps_skipped"] == 1
+
+    def test_fused_lr_spike_skips_bitwise_no_split(self):
+        before, w, opt = _spike_run(fused=True)
+        s = step_fusion_stats()
+        assert s["fused_steps"] >= 2 and s["fallback_splits"] == 0
+        np.testing.assert_array_equal(before[9], before[10])
+        assert not np.array_equal(before[10], before[11])
+        assert guardian.guardian_stats()["steps_skipped"] == 1
+
+    def test_eager_and_fused_agree_bitwise(self):
+        _, w_f, _ = _spike_run(fused=True)
+        guardian.reset_thread_state()
+        guardian.reset_guardian_stats()
+        _, w_e, _ = _spike_run(fused=False)
+        np.testing.assert_array_equal(np.asarray(w_f._value),
+                                      np.asarray(w_e._value))
+
+    def test_doctor_reports_which_step_skipped(self):
+        for fused in (True, False):
+            _reset()
+            _spike_run(fused=fused)
+            skips = [e for e in fusion_events("step.record")
+                     if e["reason"] == "nonfinite_skip"]
+            assert len(skips) == 1
+            # optimizer step counter at the spike (10th step() call)
+            assert skips[0]["detail"]["step"] == 10
+            rep = explain()
+            assert rep["guardian"]["nonfinite_skip"]["steps"] == [10]
+            assert any("nonfinite_skip" in f and "at step(s) 10" in f
+                       for f in rep["findings"])
+
+
+# ---------------------------------------------------------------------------
+# step-granular checkpoints (PR 6: save_every_n_steps)
+# ---------------------------------------------------------------------------
+
+class TestStepCheckpointer:
+    def _loop(self, ck, steps, seed=0):
+        rng = np.random.default_rng(seed)
+        x = paddle.to_tensor(rng.standard_normal((4, 4)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((4, 4)).astype(np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                        parameters=[w])
+        for step in range(1, steps + 1):
+            F.gelu(paddle.matmul(x, w)).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            ck.tick(step, model={"w": w}, optimizer=opt,
+                    extra={"step": step})
+        return w, opt
+
+    def test_tick_grid_retention_and_bitwise_resume(self, tmp_path):
+        ck = StepCheckpointer(str(tmp_path), save_every_n_steps=2,
+                              max_checkpoints=2)
+        w, opt = self._loop(ck, 6)
+        # every 2nd step saved, newest 2 retained
+        assert ck._retained_steps() == [4, 6]
+        w2 = paddle.to_tensor(np.zeros((4, 4), np.float32),
+                              stop_gradient=False)
+        opt2 = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                         parameters=[w2])
+        ck2 = StepCheckpointer(str(tmp_path), save_every_n_steps=2)
+        resumed = ck2.restore(model={"w": w2}, optimizer=opt2)
+        assert resumed == 6
+        assert ck2.last_extra == {"step": 6}
+        np.testing.assert_array_equal(np.asarray(w2._value),
+                                      np.asarray(w._value))
+        # optimizer step counter came back: LR schedules + step fusion
+        # recording resume where the killed run stopped
+        assert opt2._step_count == 6
+
+    def test_off_grid_tick_is_a_noop(self, tmp_path):
+        ck = StepCheckpointer(str(tmp_path), save_every_n_steps=100)
+        assert ck.tick(7, model={}) is None
+        assert ck._retained_steps() == []
+
+    def test_restore_falls_back_past_corrupt(self, tmp_path):
+        ck = StepCheckpointer(str(tmp_path), save_every_n_steps=2,
+                              max_checkpoints=3)
+        self._loop(ck, 6)
+        newest = os.path.join(ck.checkpoint_path(6), ck.CKPT_FILE)
+        with open(newest, "r+b") as f:
+            f.seek(12)
+            f.write(b"\xff\xff\xff")
+        w2 = paddle.to_tensor(np.zeros((4, 4), np.float32),
+                              stop_gradient=False)
+        ck2 = StepCheckpointer(str(tmp_path), save_every_n_steps=2)
+        assert ck2.restore(model={"w": w2}) == 4
+
+    def test_refuses_when_every_snapshot_corrupt(self, tmp_path):
+        ck = StepCheckpointer(str(tmp_path), save_every_n_steps=2,
+                              max_checkpoints=2)
+        self._loop(ck, 4)
+        for s in ck._retained_steps():
+            p = os.path.join(ck.checkpoint_path(s), ck.CKPT_FILE)
+            with open(p, "r+b") as f:
+                f.seek(12)
+                f.write(b"\xff\xff\xff")
+        with pytest.raises(fio.CheckpointCorruptError, match="refusing"):
+            StepCheckpointer(str(tmp_path),
+                             save_every_n_steps=2).restore(model={})
+
+    def test_fresh_run_returns_minus_one(self, tmp_path):
+        ck = StepCheckpointer(str(tmp_path))
+        assert ck.restore(model={}) == -1
 
 
 # ---------------------------------------------------------------------------

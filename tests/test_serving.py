@@ -1,0 +1,382 @@
+"""Serving engine suite (paddle_tpu/serving): continuous batching, paged
+KV cache, ONE compiled decode step.
+
+The contracts pinned here are the ISSUE 6 acceptance criteria:
+
+  * decode output is token-identical to `model.generate(do_sample=False)`
+    for every stream, whatever the batch composition;
+  * a stream already running keeps producing ITS tokens bit-for-bit when
+    other requests join or leave mid-flight (iteration-level batching
+    must not perturb neighbors);
+  * preemption (KV pool dry -> evict -> re-prefill -> resume) is
+    token-equivalent to never having been preempted;
+  * a request whose peak KV footprint can never fit is refused at
+    admission (attributed `kv_exhausted`), not deadlocked;
+  * the decode executable compiles exactly ONCE while 64 mixed-length
+    streams churn through the slots (zero retraces).
+
+The scheduler tests are pure host-side policy checks (no jax work).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.profiler.events import clear_fusion_events, fusion_events
+from paddle_tpu.profiler.explain import explain
+from paddle_tpu.serving import (BlockAllocator, LLMEngine, Request,
+                                Scheduler, NULL_BLOCK, QUEUED, RUNNING,
+                                FINISHED)
+
+VOCAB = 128
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompt(length, seed=0):
+    rng = np.random.default_rng(seed * 1000 + length)
+    return rng.integers(0, VOCAB, length).tolist()
+
+
+_REF_CACHE = {}
+
+
+def _ref(model, prompt, n):
+    """Greedy reference through model.generate (ONE XLA scan program per
+    prompt length — memoized so the module compiles each length once)."""
+    key = (tuple(prompt), n)
+    if key not in _REF_CACHE:
+        out = model.generate(paddle.Tensor(np.asarray([prompt], np.int64)),
+                             max_new_tokens=n, do_sample=False)
+        arr = out._value if hasattr(out, "_value") else out
+        _REF_CACHE[key] = np.asarray(arr)[0].tolist()
+    return _REF_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy (pure host-side, no jax)
+# ---------------------------------------------------------------------------
+
+class TestSchedulerPolicy:
+    def _sched(self, num_slots=2, num_blocks=9, block_size=4,
+               watermark=1):
+        alloc = BlockAllocator(num_blocks)
+        return Scheduler(num_slots, alloc, block_size,
+                         watermark_blocks=watermark), alloc
+
+    def test_allocator_all_or_nothing_and_null_guard(self):
+        alloc = BlockAllocator(4)
+        assert alloc.capacity == 3
+        assert alloc.allocate(4) is None          # more than free: nothing
+        got = alloc.allocate(3)
+        assert len(got) == 3 and NULL_BLOCK not in got
+        with pytest.raises(ValueError):
+            alloc.free([NULL_BLOCK])
+        alloc.free(got)
+        assert alloc.num_free == 3
+
+    def test_fcfs_head_only_no_skipping(self):
+        sched, _ = self._sched(num_slots=2, num_blocks=9, watermark=0)
+        big = Request("big", list(range(20)), 4)     # needs 6 blocks
+        small = Request("small", [1], 2)             # needs 1 block
+        sched.enqueue(big)
+        sched.enqueue(small)
+        # head needs 6 of 8 free; admit it, then the pool can't take the
+        # NEXT head... admit everything that fits in arrival order only
+        first = sched.try_admit()
+        assert first is big                           # strict FCFS
+        second = sched.try_admit()
+        assert second is small
+
+    def test_watermark_blocks_admission(self):
+        sched, alloc = self._sched(num_slots=2, num_blocks=9, watermark=2)
+        # 8 allocatable; a 20-token context needs 6 blocks -> 2 left ==
+        # watermark: OK. A second 4-token request (2 blocks) would leave
+        # 0 < watermark: refused for now (stays QUEUED, not failed)
+        a = Request("a", list(range(20)), 2)
+        b = Request("b", [1, 2, 3, 4], 2)
+        sched.enqueue(a)
+        sched.enqueue(b)
+        assert sched.try_admit() is a
+        assert sched.try_admit() is None
+        assert b.state == QUEUED
+        assert sched.waiting == [b]
+
+    def test_growth_dips_into_watermark(self):
+        sched, alloc = self._sched(num_slots=1, num_blocks=4, watermark=2)
+        r = Request("r", [1, 2, 3], 8)
+        sched.enqueue(r)
+        assert sched.try_admit() is r                 # 1 block, 2 free left
+        assert sched.grow(r) and sched.grow(r)        # growth ignores mark
+        assert alloc.num_free == 0
+
+    def test_preempt_victim_is_lifo_and_requeue_keeps_arrival_order(self):
+        sched, _ = self._sched(num_slots=3, num_blocks=20, watermark=0)
+        reqs = [Request(f"r{i}", [1, 2], 4) for i in range(3)]
+        for r in reqs:
+            sched.enqueue(r)
+        for _ in range(3):
+            assert sched.try_admit() is not None
+        victim = sched.preempt_victim()
+        assert victim is reqs[2]                      # newest admission
+        sched.preempt(victim)
+        assert victim.state == QUEUED and victim.blocks == []
+        assert victim.preemptions == 1
+        late = Request("late", [1], 2)
+        sched.enqueue(late)
+        # the preempted request resumes BEFORE later arrivals
+        assert sched.waiting.index(victim) < sched.waiting.index(late)
+
+    def test_release_returns_blocks_and_slot(self):
+        sched, alloc = self._sched(num_slots=1, num_blocks=9, watermark=0)
+        r = Request("r", list(range(6)), 2)
+        sched.enqueue(r)
+        sched.try_admit()
+        held = list(r.blocks)
+        assert held
+        sched.release(r)
+        assert alloc.num_free == 8 and sched.slots == [None]
+
+    def test_can_ever_fit_respects_watermark(self):
+        sched, _ = self._sched(num_slots=1, num_blocks=4, block_size=4,
+                               watermark=0)
+        assert sched.block_budget() == 3
+        assert sched.can_ever_fit(Request("ok", [1] * 8, 4))      # 3 blocks
+        assert not sched.can_ever_fit(Request("big", [1] * 8, 20))
+        # the watermark reserve is never granted: a request needing the
+        # WHOLE pool can never be admitted once a reserve exists
+        sched2, _ = self._sched(num_slots=1, num_blocks=4, block_size=4,
+                                watermark=1)
+        assert not sched2.can_ever_fit(Request("ok", [1] * 8, 4))
+
+
+# ---------------------------------------------------------------------------
+# engine: parity / continuity / preemption / refusal / zero-retrace
+# ---------------------------------------------------------------------------
+
+class TestDecodeParity:
+    def test_mixed_length_batch_matches_generate(self, model):
+        prompts = [_prompt(n) for n in (11, 5, 17, 3)]
+        refs = [_ref(model, p, 10) for p in prompts]
+        engine = LLMEngine(model, max_batch_size=4, block_size=4)
+        outs = engine.generate(prompts, max_new_tokens=10)
+        assert outs == refs
+        st = engine.stats()
+        assert st["decode_compiles"] == 1
+        assert st["completed"] == 4
+
+    def test_eos_stops_a_stream_early(self, model):
+        p = _prompt(7)
+        ref = _ref(model, p, 12)
+        eos = ref[4]                       # force a stop mid-stream
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        req = engine.add_request(p, max_new_tokens=12, eos_token_id=eos)
+        engine.run()
+        assert req.state == FINISHED
+        # stop at the FIRST occurrence (a tiny model may repeat tokens)
+        stop = ref.index(eos)
+        assert req.generated == ref[:stop + 1]
+        assert len(req.generated) < 12
+
+    def test_streaming_callbacks_fire_per_token(self, model):
+        p = _prompt(9)
+        ref = _ref(model, p, 8)
+        seen = []
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        engine.add_request(p, max_new_tokens=8,
+                           on_token=lambda r, tok, text: seen.append(tok))
+        engine.run()
+        assert seen == ref                 # streamed in generation order
+
+
+class TestContinuousBatching:
+    def test_join_mid_flight_keeps_running_stream_bitwise(self, model):
+        """A request joining the batch must not perturb a stream that is
+        already decoding: same tokens as a solo run, bit for bit."""
+        pa, pb = _prompt(13, seed=1), _prompt(6, seed=2)
+        ref_a = _ref(model, pa, 12)
+        ref_b = _ref(model, pb, 8)
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        ra = engine.add_request(pa, max_new_tokens=12)
+        for _ in range(5):                 # a is mid-flight...
+            engine.step()
+        tokens_before = list(ra.generated)
+        assert tokens_before == ref_a[:len(tokens_before)]
+        rb = engine.add_request(pb, max_new_tokens=8)   # ...b joins
+        engine.run()
+        assert ra.generated == ref_a       # a never noticed
+        assert rb.generated == ref_b
+        assert engine.stats()["decode_compiles"] == 1
+
+    def test_departure_mid_flight_keeps_survivors_bitwise(self, model):
+        """Short streams finishing and leaving slots must not perturb the
+        longer streams still running."""
+        long_p, short_p = _prompt(10, seed=3), _prompt(4, seed=4)
+        ref_long = _ref(model, long_p, 14)
+        engine = LLMEngine(model, max_batch_size=3, block_size=4)
+        rl = engine.add_request(long_p, max_new_tokens=14)
+        rs = engine.add_request(short_p, max_new_tokens=2)
+        engine.run()
+        assert rs.state == FINISHED and len(rs.generated) == 2
+        assert rl.generated == ref_long
+
+    def test_preempt_resume_token_equivalence(self, model):
+        """A deliberately tight pool forces eviction; the evicted stream
+        re-prefills from its block-table-less state and must still match
+        the never-preempted reference."""
+        prompts = [_prompt(n, seed=5) for n in (11, 12, 10, 5)]
+        refs = [_ref(model, p, 10) for p in prompts]
+        engine = LLMEngine(model, max_batch_size=3, block_size=4,
+                           num_blocks=10, watermark_blocks=1)
+        outs = engine.generate(prompts, max_new_tokens=10)
+        st = engine.stats()
+        assert st["evictions"] >= 1        # the tight pool actually bit
+        assert outs == refs
+        assert st["decode_compiles"] == 1  # eviction is a table edit
+        assert any(r.preemptions for r in engine.requests.values())
+
+    def test_kv_exhaustion_admission_refusal(self, model):
+        """A request whose PEAK footprint exceeds the pool budget can
+        never be served: refuse at admission instead of deadlocking the
+        queue."""
+        engine = LLMEngine(model, max_batch_size=2, block_size=4,
+                           num_blocks=6, watermark_blocks=1)
+        with pytest.raises(ValueError, match="KV blocks at peak"):
+            engine.add_request(_prompt(20), max_new_tokens=20)
+        assert engine.stats()["refused"] == 1
+        # a request that merely can't fit RIGHT NOW queues instead
+        ok = engine.add_request(_prompt(4), max_new_tokens=4)
+        assert ok.state == QUEUED
+
+    def test_context_overflow_refused(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        with pytest.raises(ValueError, match="exceeds max_context"):
+            engine.add_request(_prompt(40), max_new_tokens=60)
+        with pytest.raises(ValueError, match="empty prompt"):
+            engine.add_request([], max_new_tokens=4)
+
+    def test_duplicate_active_request_id_refused(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        engine.add_request(_prompt(5), max_new_tokens=4, request_id="x")
+        with pytest.raises(ValueError, match="already queued/running"):
+            engine.add_request(_prompt(6), max_new_tokens=4,
+                               request_id="x")
+        engine.run()
+        # finished ids may be reused (the old handle is replaced)
+        again = engine.add_request(_prompt(6), max_new_tokens=4,
+                                   request_id="x")
+        engine.run()
+        assert again.state == FINISHED
+
+
+class TestZeroRetrace:
+    def test_64_mixed_streams_one_decode_compile(self, model):
+        """The acceptance criterion: 64 concurrent mixed-length requests
+        churning through 8 slots, ONE decode trace, every stream
+        token-identical to generate()."""
+        lengths = (3, 5, 8, 11, 16, 21)
+        uniques = {n: _prompt(n, seed=7) for n in lengths}
+        refs = {n: _ref(model, p, 6) for n, p in uniques.items()}
+        prompts = [uniques[lengths[i % len(lengths)]] for i in range(64)]
+        engine = LLMEngine(model, max_batch_size=8, block_size=4)
+        outs = engine.generate(prompts, max_new_tokens=6)
+        st = engine.stats()
+        assert st["decode_compiles"] == 1
+        assert st["completed"] == 64
+        # prefill buckets are the pow-2 cover of the lengths, compiled
+        # once each — admission never touches the decode program
+        assert st["prefill_compiles"] <= 5
+        for i, out in enumerate(outs):
+            assert out == refs[lengths[i % len(lengths)]], f"stream {i}"
+
+    @pytest.mark.perf_smoke
+    def test_churn_occupancy_saturated(self, model):
+        """perf_smoke guard (mirrors tools/perf_smoke.py leg e): under
+        saturation (demand >= slots) continuous batching must keep the
+        slots >= 75% full, and the decode program must not retrace."""
+        prompts = [_prompt(3 + (i % 9), seed=8) for i in range(24)]
+        engine = LLMEngine(model, max_batch_size=4, block_size=4)
+        engine.generate(prompts, max_new_tokens=5)
+        st = engine.stats()
+        assert st["decode_compiles"] == 1
+        assert st["occupancy_saturated"] >= 0.75
+
+    def test_reset_stats_opens_a_clean_window(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        engine.generate([_prompt(5)], max_new_tokens=3)   # warmup
+        engine.reset_stats()
+        engine.generate([_prompt(5)], max_new_tokens=3)
+        st = engine.stats()
+        assert st["decode_compiles"] == 0       # no retrace in the window
+        assert st["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry: serve.* events through the flight recorder + doctor
+# ---------------------------------------------------------------------------
+
+class TestServeTelemetry:
+    def test_lifecycle_events_and_doctor_verdict(self, model):
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        try:
+            prompts = [_prompt(n, seed=9) for n in (11, 12, 10, 5, 7)]
+            engine = LLMEngine(model, max_batch_size=3, block_size=4,
+                               num_blocks=10, watermark_blocks=1)
+            engine.generate(prompts, max_new_tokens=6)
+            ev = fusion_events()
+        finally:
+            set_flags({"FLAGS_profiler_events": False})
+            clear_fusion_events()
+        cats = {e["cat"] for e in ev}
+        assert {"serve.enqueue", "serve.admit", "serve.step",
+                "serve.complete"} <= cats
+        evicts = [e for e in ev if e["cat"] == "serve.evict"]
+        assert evicts and all(e["reason"] == "kv_exhausted" for e in evicts)
+        resumed = [e for e in ev if e["cat"] == "serve.admit"
+                   and (e.get("detail") or {}).get("resumed")]
+        assert resumed                       # the evicted stream came back
+        rep = explain(ev)
+        assert rep["verdict"] == "serving"
+        sv = rep["serving"]
+        assert sv["completed"] == len(prompts)
+        assert sv["evictions"] == len(evicts)
+        assert sv["occupancy_mean"] is not None
+        assert "kv_exhausted" in sv["reasons"]
+        assert any("kv_exhausted" in f for f in rep["findings"])
+
+    def test_refusal_attributed_kv_exhausted(self, model):
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        try:
+            engine = LLMEngine(model, max_batch_size=2, block_size=4,
+                               num_blocks=6, watermark_blocks=1)
+            with pytest.raises(ValueError):
+                engine.add_request(_prompt(20), max_new_tokens=20)
+            ev = fusion_events()
+        finally:
+            set_flags({"FLAGS_profiler_events": False})
+            clear_fusion_events()
+        refusals = [e for e in ev if e["cat"] == "serve.enqueue"
+                    and e["reason"] == "kv_exhausted"]
+        assert len(refusals) == 1
+        d = refusals[0]["detail"]
+        assert d["blocks_needed"] > d["blocks_budget"]

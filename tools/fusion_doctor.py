@@ -95,6 +95,33 @@ def _demo(variant, steps):
         opt.clear_grad()
 
 
+def _demo_serve(steps):
+    """Tiny continuous-batching serving run (paddle_tpu/serving): a small
+    GPT over a deliberately tight KV pool, so the report shows the
+    serve.* lifecycle including at least one kv_exhausted eviction.
+    `--steps` is the number of requests churned through the batch."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, max_batch_size=3, block_size=4,
+                       num_blocks=10, watermark_blocks=1)
+    rng = np.random.default_rng(0)
+    base = (11, 12, 10, 5, 7, 9)
+    prompts = [rng.integers(0, 128, base[i % len(base)]).tolist()
+               for i in range(max(len(base), steps))]
+    engine.generate(prompts, max_new_tokens=8)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="fusion_doctor",
@@ -104,11 +131,13 @@ def main(argv=None) -> int:
                     help="training script to run under the recorder")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script (after --)")
-    ap.add_argument("--demo", choices=("dropout", "masked"),
+    ap.add_argument("--demo", choices=("dropout", "masked", "serve"),
                     help="run a built-in tiny GPT-ish demo loop instead "
-                         "of a script")
+                         "of a script (`serve`: a continuous-batching "
+                         "serving run over a tight KV pool)")
     ap.add_argument("--steps", type=int, default=20,
-                    help="demo loop steps (default 20)")
+                    help="demo loop steps (requests, for --demo serve; "
+                         "default 20)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as JSON instead of text")
     args = ap.parse_args(argv)
@@ -122,7 +151,9 @@ def main(argv=None) -> int:
     clear_fusion_events()
     set_flags({"FLAGS_profiler_events": True})
     try:
-        if args.demo:
+        if args.demo == "serve":
+            _demo_serve(args.steps)
+        elif args.demo:
             _demo(args.demo, args.steps)
         else:
             sa = args.script_args

@@ -29,7 +29,12 @@ stack silently regressed:
   * AMP promotion — a dynamic-loss-scaled GradScaler loop under the
     guardian must reach whole-step zero-retrace steady state (scale and
     growth-tracker ride as hoisted scalar args; promotion is no longer
-    poisoned by the mid-step grad read — a PR 5 regression).
+    poisoned by the mid-step grad read — a PR 5 regression);
+  * serving decode zero-retrace + occupancy — 64 mixed-length streams
+    churning through a 4-slot continuous batch (paddle_tpu/serving) must
+    compile the decode executable exactly ONCE, and saturated batch
+    occupancy must stay >= 0.75 — the paged KV cache + slot layout keep
+    every tenant mix on one program (a PR 6 regression).
 
 Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
 in tests/test_chain_fusion.py and tests/test_step_fusion.py — this CLI is
@@ -319,6 +324,42 @@ def main() -> int:
             "loop: the scaler state is no longer a hoisted arg "
             "(PR 5 regression)")
 
+    # ---- serving legs (PR 6 guards) --------------------------------------
+    # (e) 64 mixed-length streams churn through a 4-slot continuous
+    # batch: requests join/leave at token boundaries, yet the decode
+    # executable must compile exactly once (slot layout + paged block
+    # tables keep shapes fixed), and saturated occupancy must stay
+    # >= 0.75 (continuous batching actually packs freed slots)
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import LLMEngine
+
+    paddle.seed(0)
+    scfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0,
+                     use_flash_attention=False)
+    smodel = GPTForCausalLM(scfg)
+    smodel.eval()
+    engine = LLMEngine(smodel, max_batch_size=4, block_size=4)
+    srng = np.random.default_rng(0)
+    sprompts = [srng.integers(0, 128, int(n)).tolist()
+                for n in srng.integers(3, 20, 64)]
+    engine.generate(sprompts, max_new_tokens=6)
+    sstats = engine.stats()
+    if sstats["decode_compiles"] != 1:
+        failures.append(
+            f"serving decode compiled {sstats['decode_compiles']}x across "
+            "64 churning streams (must be exactly 1): batch composition "
+            "leaked into the decode shapes (PR 6 regression)")
+    if sstats["occupancy_saturated"] < 0.75:
+        failures.append(
+            f"saturated batch occupancy {sstats['occupancy_saturated']:.2f} "
+            "< 0.75 with 64 streams over 4 slots: continuous batching is "
+            "not refilling freed slots (PR 6 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -332,7 +373,10 @@ def main() -> int:
           f"guardian overhead={guard_median * 100:.1f}%/step (median; "
           f"min {guard_overhead * 100:.1f}%), "
           f"AMP fused steps={amp_replays}/{MEASURE} "
-          f"(retraces={amp_retraces})")
+          f"(retraces={amp_retraces}), "
+          f"serve decode compiles={sstats['decode_compiles']} "
+          f"occupancy={sstats['occupancy_saturated']:.2f} "
+          f"({sstats['completed']} streams)")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
