@@ -621,6 +621,17 @@ def main():
                                    "unit": rec["unit"],
                                    "vs_baseline": rec["vs_baseline"],
                                    "platform": rec.get("platform")}
+            if name.startswith("serve_"):
+                # backpressure/resilience counters ride the trajectory:
+                # a regression in refusal/timeout/preempt behavior shows
+                # here even when throughput looks healthy
+                ex = rec.get("extra") or {}
+                head["extra"][name]["resilience"] = {
+                    k: ex.get(k, 0)
+                    for k in ("evictions", "refused",
+                              "refused_queue_full", "refused_deadline",
+                              "cancelled", "expired", "hangs",
+                              "eager_fallbacks", "resumed")}
     print(json.dumps(head), flush=True)
 
 

@@ -68,6 +68,23 @@ define_flag("FLAGS_check_numerics_level", 0,
             "— it skips the step (and backs off the GradScaler loss scale "
             "when one is attached)")
 define_flag("FLAGS_benchmark", False, "sync after each op for timing")
+
+# Serving resilience (paddle_tpu/serving/resilience.py). The watchdog
+# bounds every decode/prefill fire: the step's result futures are waited
+# on through a monitored completion (spin-then-sleep readiness poll, no
+# extra threads or host syncs beyond the step's own result read). A step
+# that blows the budget emits `serve.hang`, marks the engine degraded and
+# runs the recovery ladder: retry the step, rebuild the decode
+# executable, then fail the active requests with attributed reasons —
+# never wedging the process the way the raw TPU-tunnel hangs of bench
+# rounds 3-4 did.
+define_flag("FLAGS_serve_step_timeout_ms", 0,
+            "hung-step watchdog budget for one serving decode/prefill "
+            "step, in milliseconds. 0 (default) disarms the watchdog: "
+            "the engine blocks on the step result exactly as before. "
+            "Size it at ~100x the expected p99 step latency so a real "
+            "hang is caught in well under a second of TPU time while a "
+            "GC pause or host hiccup never trips it")
 define_flag("FLAGS_use_flash_attention", True,
             "route eligible attention through the Pallas flash kernel")
 define_flag("FLAGS_use_fused_cross_entropy", False,

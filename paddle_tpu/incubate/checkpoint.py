@@ -18,6 +18,11 @@ skipped in favor of the next retained one instead of poisoning the resume.
 The chaos harness (tools/chaos.py, kill scenario) proves the end-to-end
 property: kill -9 mid-epoch, resume, and the final parameters match an
 uninterrupted run bit-for-bit.
+
+PR 7 extends the same machinery from the training loop to the serving
+loop: `ServeCheckpointer` snapshots an LLMEngine's request/scheduler
+state every N engine steps, so a killed server restarts and finishes
+every in-flight stream byte-identically (tools/chaos.py `serve_kill`).
 """
 from __future__ import annotations
 
@@ -27,7 +32,8 @@ import re
 import shutil
 import time
 
-__all__ = ["train_epoch_range", "EpochRange", "StepCheckpointer"]
+__all__ = ["train_epoch_range", "EpochRange", "StepCheckpointer",
+           "ServeCheckpointer"]
 
 
 def _state_of(model):
@@ -226,7 +232,83 @@ class EpochRange:
         return None
 
 
-class StepCheckpointer:
+class _RollingStore:
+    """Shared skeleton of the numbered rolling-retention checkpoint
+    stores: atomic CRC snapshots in `<save_dir>/<run_id>_<suffix>/
+    <prefix>_<n>/`, newest `max_checkpoints` kept, newest-first restore
+    scan that skips corrupt snapshots and REFUSES when none survives.
+    `StepCheckpointer` (training state) and `ServeCheckpointer`
+    (serving state) differ only in what the payload is — the retention
+    and integrity machinery must not be able to diverge between them.
+    """
+
+    CKPT_FILE = EpochRange.CKPT_FILE
+    _DIR_SUFFIX = ""     # subclass: directory name suffix
+    _ITEM_PREFIX = ""    # subclass: per-snapshot directory prefix
+    _REFUSAL = ""        # subclass: all-corrupt refusal message tail
+
+    def __init__(self, save_dir, save_every_n_steps, run_id,
+                 max_checkpoints):
+        self.save_dir = save_dir
+        self.save_every_n_steps = max(1, int(save_every_n_steps))
+        self.max_checkpoints = max(1, int(max_checkpoints or 1))
+        self.run_id = run_id or os.environ.get("PADDLE_JOB_ID", "default")
+
+    def _base(self):
+        return os.path.join(self.save_dir,
+                            f"{self.run_id}_{self._DIR_SUFFIX}")
+
+    def checkpoint_path(self, step):
+        return os.path.join(self._base(), f"{self._ITEM_PREFIX}_{step}")
+
+    def _retained(self):
+        base = self._base()
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for nm in os.listdir(base):
+            m = re.fullmatch(rf"{self._ITEM_PREFIX}_(\d+)", nm)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _on_grid(self, step):
+        return int(step) % self.save_every_n_steps == 0
+
+    def _save_numbered(self, step, payload):
+        """Atomic snapshot at `step` + prune beyond the newest
+        `max_checkpoints`. Returns the checkpoint directory."""
+        from ..framework import io as _io
+        d = self.checkpoint_path(int(step))
+        _io.save(payload, os.path.join(d, self.CKPT_FILE))
+        for s in self._retained()[:-self.max_checkpoints]:
+            shutil.rmtree(self.checkpoint_path(s), ignore_errors=True)
+        return d
+
+    def _restore_scan(self):
+        """(step, payload) of the newest intact snapshot, or None.
+        Corrupt snapshots fall back to older ones; when snapshots exist
+        but NONE survives the integrity check, raise instead of silently
+        resuming on nothing."""
+        from ..framework import io as _io
+        corrupt = []
+        for s in reversed(self._retained()):
+            path = os.path.join(self.checkpoint_path(s), self.CKPT_FILE)
+            if not os.path.exists(path):
+                continue
+            try:
+                return s, _io.load(path)
+            except _io.CheckpointCorruptError:
+                corrupt.append(path)
+        if corrupt:
+            raise _io.CheckpointCorruptError(
+                f"every retained {self._ITEM_PREFIX} checkpoint failed "
+                f"its integrity check ({', '.join(corrupt)}); "
+                f"{self._REFUSAL}")
+        return None
+
+
+class StepCheckpointer(_RollingStore):
     """Step-granular `save_every_n_steps` checkpoints on the same atomic,
     CRC-verified, rolling-retention machinery as `EpochRange` — for runs
     where an epoch is hours long and preemption (spot TPU reclaims,
@@ -249,40 +331,28 @@ class StepCheckpointer:
     snapshots exist but none survives the integrity check.
     """
 
-    CKPT_FILE = EpochRange.CKPT_FILE
+    _DIR_SUFFIX = "steps"
+    _ITEM_PREFIX = "step"
+    _REFUSAL = ("refusing to resume on uninitialized state — delete the "
+                "step_* directories to restart from scratch")
 
     def __init__(self, save_dir, save_every_n_steps=100, run_id=None,
                  max_checkpoints=3):
-        self.save_dir = save_dir
-        self.save_every_n_steps = max(1, int(save_every_n_steps))
-        self.max_checkpoints = max(1, int(max_checkpoints or 1))
-        self.run_id = run_id or os.environ.get("PADDLE_JOB_ID", "default")
+        super().__init__(save_dir, save_every_n_steps, run_id,
+                         max_checkpoints)
         self.last_extra = None
 
-    def _base(self):
-        return os.path.join(self.save_dir, f"{self.run_id}_steps")
-
-    def checkpoint_path(self, step):
-        return os.path.join(self._base(), f"step_{step}")
-
+    # kept under its historical name (the rolling-retention tests and
+    # downstream tooling read it)
     def _retained_steps(self):
-        base = self._base()
-        if not os.path.isdir(base):
-            return []
-        out = []
-        for nm in os.listdir(base):
-            m = re.fullmatch(r"step_(\d+)", nm)
-            if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+        return self._retained()
 
     def tick(self, step, model=None, optimizer=None, scaler=None,
              extra=None):
         """Per-step hook: saves when `step` lands on the
         save_every_n_steps grid, else returns None without touching the
         filesystem."""
-        step = int(step)
-        if step % self.save_every_n_steps:
+        if not self._on_grid(step):
             return None
         return self.save(step, model=model, optimizer=optimizer,
                          scaler=scaler, extra=extra)
@@ -291,41 +361,75 @@ class StepCheckpointer:
              extra=None):
         """Unconditional atomic snapshot at `step`; prunes beyond the
         newest `max_checkpoints`. Returns the checkpoint directory."""
-        from ..framework import io as _io
         payload = _snapshot_payload(model, optimizer, scaler, extra)
         payload["step"] = int(step)
-        d = self.checkpoint_path(int(step))
-        _io.save(payload, os.path.join(d, self.CKPT_FILE))
-        for s in self._retained_steps()[:-self.max_checkpoints]:
-            shutil.rmtree(self.checkpoint_path(s), ignore_errors=True)
-        return d
+        return self._save_numbered(step, payload)
 
     def restore(self, model=None, optimizer=None, scaler=None):
         """Load the newest intact step snapshot into the given objects;
         corrupt snapshots fall back to older ones. Returns the resumed
         step (-1 when no snapshot exists); the saved `extra` lands in
         `self.last_extra`."""
-        from ..framework import io as _io
-        corrupt = []
-        for s in reversed(self._retained_steps()):
-            path = os.path.join(self.checkpoint_path(s), self.CKPT_FILE)
-            if not os.path.exists(path):
-                continue
-            try:
-                payload = _io.load(path)
-            except _io.CheckpointCorruptError:
-                corrupt.append(path)
-                continue
-            _apply_payload(payload, model, optimizer, scaler)
-            self.last_extra = payload.get("extra")
-            return int(payload.get("step", s))
-        if corrupt:
-            raise _io.CheckpointCorruptError(
-                "every retained step checkpoint failed its integrity "
-                f"check ({', '.join(corrupt)}); refusing to resume on "
-                "uninitialized state — delete the step_* directories to "
-                "restart from scratch")
-        return -1
+        found = self._restore_scan()
+        if found is None:
+            return -1
+        s, payload = found
+        _apply_payload(payload, model, optimizer, scaler)
+        self.last_extra = payload.get("extra")
+        return int(payload.get("step", s))
+
+
+class ServeCheckpointer(_RollingStore):
+    """Crash-resumable SERVING state on the StepCheckpointer's atomic,
+    CRC-verified, rolling-retention machinery (PR 7).
+
+    The payload is the engine's `state_payload()` — prompts, emitted
+    tokens, arrival order, remaining TTLs; never the KV pool, which
+    re-prefills token-identically on resume — so a snapshot is a few KB
+    of host data and `tick()` every engine step is affordable. A kill-9'd
+    server restarts, `restore()`s the newest intact snapshot, feeds it to
+    `engine.restore_state()`, and every in-flight stream finishes
+    byte-identically (tools/chaos.py `serve_kill` proves it).
+
+    Usage::
+
+        ck = ServeCheckpointer(".serve_ckpt", save_every_n_steps=1)
+        engine.restore_state(ck.restore())
+        n = 0
+        while engine.step():
+            n += 1
+            ck.tick(n, engine.state_payload())
+    """
+
+    _DIR_SUFFIX = "serve"
+    _ITEM_PREFIX = "serve"
+    _REFUSAL = ("refusing to restart with silently dropped in-flight "
+                "requests — delete the serve_* directories to start "
+                "empty")
+
+    def __init__(self, save_dir, save_every_n_steps=1, run_id=None,
+                 max_checkpoints=3):
+        super().__init__(save_dir, save_every_n_steps, run_id,
+                         max_checkpoints)
+
+    def tick(self, step, payload):
+        """Save `payload` when `step` lands on the grid (else a cheap
+        no-op). Returns the checkpoint directory or None."""
+        if not self._on_grid(step):
+            return None
+        return self.save(step, payload)
+
+    def save(self, step, payload):
+        """Unconditional atomic snapshot of the serving payload at
+        `step`; prunes beyond the newest `max_checkpoints`."""
+        return self._save_numbered(step, {"step": int(step),
+                                          "serve": payload})
+
+    def restore(self):
+        """The newest intact serving payload (for
+        `engine.restore_state()`), or None for a fresh start."""
+        found = self._restore_scan()
+        return None if found is None else found[1].get("serve")
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,

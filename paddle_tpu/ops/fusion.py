@@ -1028,6 +1028,34 @@ class _FusionManager:
             if st.pending is pending:
                 st.pending = None
             return
+        from . import guardian as _guardian
+        if _guardian.faults_armed():
+            # fused-tier chaos (tools/chaos.py): "raise" recovers through
+            # the transactional per-op split (bitwise-identical values);
+            # "nan_output" poisons the FUSED outputs so downstream
+            # detection — the step tier's grads-finite predicate, the
+            # guardian's forward checks — is exercised against corruption
+            # that originates inside a fused region
+            fault = _guardian.poll_fault("fused_chain",
+                                         ("nan_output", "raise"))
+            if fault == "raise":
+                st.busy = False
+                self._split(pending, escape=False,
+                            reason="injected_fault")
+                if st.pending is pending:
+                    st.pending = None
+                return
+            if fault == "nan_output":
+                import jax.numpy as jnp
+                out_vals = tuple(
+                    jnp.full_like(v, jnp.nan)
+                    if jnp.issubdtype(v.dtype, jnp.inexact) else v
+                    for v in out_vals)
+                if _guardian.enabled():
+                    # the in-graph chain scalar saw the CLEAN outputs;
+                    # queue a check on the poisoned ones so the guardian
+                    # still attributes the corruption
+                    _guardian.observe(chain.label, out_vals)
         try:
             flat = 0
             for i, op in enumerate(chain.ops):

@@ -54,8 +54,8 @@ from ..profiler.events import EVENTS as _EVENTS
 __all__ = [
     "enabled", "skip_step_enabled", "finite_all", "flush", "maybe_flush",
     "guardian_stats", "reset_guardian_stats", "update_scaler_state",
-    "mark_scaler_active", "inject_fault", "clear_faults", "ChaosFault",
-    "GUARD_STATS",
+    "mark_scaler_active", "inject_fault", "clear_faults", "poll_fault",
+    "faults_armed", "ChaosFault", "GUARD_STATS",
 ]
 
 # queued-but-unflushed scalars are force-flushed past this depth so a
@@ -446,14 +446,22 @@ def inject_fault(kind, op=None, after=0, times=1):
     """Register a chaos fault hook (tools/chaos.py / tests).
 
     kind: "nan_output" — replace the matching dispatch's outputs with NaN;
-          "raise"      — raise ChaosFault from inside the dispatch.
-    op:   op name to match (None = any dispatched op).
+          "raise"      — raise ChaosFault from inside the dispatch;
+          "hang"       — the matching site behaves as if its device work
+                         never completed (serving watchdog sites and the
+                         fused tiers consult this via `poll_fault`; plain
+                         dispatches ignore it — an eager op cannot "hang"
+                         without wedging the harness itself).
+    op:   op name to match (None = any dispatched op). Non-dispatch
+          sites use reserved names: "serve.decode" / "serve.prefill"
+          (engine step futures), "fused_chain" / "fused_step" (the
+          fused-tier fires, ops/fusion.py + ops/step_fusion.py).
     after: matching dispatches to let through before firing.
     times: firings before the injector disarms.
 
     Returns the injector; call .remove() to disarm early.
     """
-    if kind not in ("nan_output", "raise"):
+    if kind not in ("nan_output", "raise", "hang"):
         raise ValueError(f"unknown fault kind {kind!r}")
     inj = _Injector(kind, op, int(after), int(times))
     _INJECTORS.append(inj)
@@ -464,6 +472,38 @@ def clear_faults():
     del _INJECTORS[:]
 
 
+def faults_armed():
+    """Any injector registered — the fused-tier fire paths gate their
+    poll_fault call on this so chaos costs one truthiness check when
+    disarmed (same contract as the dispatch hook)."""
+    return bool(_INJECTORS)
+
+
+def poll_fault(name, kinds):
+    """Non-dispatch chaos hook: fire the first armed injector matching
+    `name` with a kind in `kinds` and return its kind (or None). Used by
+    the serving engine (decode/prefill watchdog + fused-output poison)
+    and the fused chain/step fire paths, where outputs are not a flat
+    dispatch result `maybe_inject` could transform. The firing is
+    attributed `injected_fault` exactly like a dispatch-level one; the
+    CALLER implements the fault semantics (simulate a hang, poison its
+    outputs, split the replay)."""
+    for inj in list(_INJECTORS):
+        if inj.fired >= inj.times or inj.kind not in kinds:
+            continue
+        if inj.op is not None and inj.op != name:
+            continue
+        inj.seen += 1
+        if inj.seen <= inj.after:
+            continue
+        inj.fired += 1
+        GUARD_STATS.faults_injected += 1
+        _EVENTS.emit("step.record", name, reason="injected_fault",
+                     detail={"kind": "guardian", "fault": inj.kind})
+        return inj.kind
+    return None
+
+
 def maybe_inject(name, out_vals, multi):
     """Apply the first matching armed injector to a dispatch's outputs.
     Only called when _INJECTORS is non-empty. Replayed (deferred) chain/
@@ -471,6 +511,10 @@ def maybe_inject(name, out_vals, multi):
     instead, which exercises the same in-graph detection."""
     for inj in list(_INJECTORS):
         if inj.fired >= inj.times:
+            continue
+        if inj.kind == "hang":
+            # hang faults are only meaningful at monitored-completion
+            # sites (poll_fault); a plain dispatch ignores them
             continue
         if inj.op is not None and inj.op != name:
             continue

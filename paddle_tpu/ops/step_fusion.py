@@ -894,11 +894,21 @@ class _StepFusionManager:
         its state rides as hoisted scalar args and the computed transition
         lands in `scaler._fused_next` for update() to commit."""
         from ..jit.train_step import bake_decay_flags
+        from . import guardian as _guardian
         program = pending.program
         params = pending.params
         acc_names = program.acc_names
         check = program.check
         upd_finite = fwd_finite = scale_before = scale_after = None
+        if _guardian.faults_armed() and _guardian.poll_fault(
+                "fused_step", ("raise", "nan_output")) is not None:
+            # fused-tier chaos: ANY untrusted fused-step output means the
+            # whole transaction is suspect — recover through the
+            # transactional per-op split (bitwise-identical params/grads),
+            # exactly the path a real mid-fire fault takes
+            self._split(pending, escape=False, reason="injected_fault",
+                        blocked_op="chaos")
+            return False
         st.busy = True
         if not hasattr(opt, "_step_count"):
             opt._step_count = 0
@@ -995,10 +1005,33 @@ class _StepFusionManager:
             _EVENTS.emit("step.fire", program.label,
                          detail={"ops": len(program.chain.ops),
                                  "launches_saved": program.n_launches - 1})
+            self._demote(pending)
         finally:
             st.busy = False
             st.pending = None
         return True
+
+    @staticmethod
+    def _demote(pending):
+        """Release the fired step's retention (ROADMAP item 4(c)): swap
+        the placeholder store to weakrefs, breaking the strong
+        pending↔placeholder cycle that used to keep `ext_vals` — the
+        PRE-UPDATE parameter buffers and the batch arrays among them —
+        alive into the next step (until a gc pass, in the worst case).
+        Post-demote the pending survives only through placeholders the
+        CALLER still references (each holds `_pending_chain` strongly),
+        so in the common loop — where mid-step intermediates are
+        temporaries — everything, ext store included, is refcount-freed
+        before `optimizer.step()` returns. A caller that kept an
+        intermediate keeps exactly the state its post-fire lazy
+        recompute needs, no more."""
+        pending.placeholders = [[weakref.ref(t) for t in row]
+                                for row in pending.placeholders]
+        # grads were committed to p.grad and the loss to its own handle;
+        # the pending's strong duplicates would pin those buffers past
+        # clear_grad()
+        pending.grad_phs = None
+        pending.params = ()
 
     def resolve_pending(self, pending, escape):
         """Owner-protocol escape hatch (ops/fusion._DeferredTensor._force).
@@ -1019,12 +1052,25 @@ class _StepFusionManager:
     def _recompute(self, pending):
         """A placeholder of a FIRED step was read: materialize every
         intermediate via the per-op cached path from the captured external
-        inputs (the pre-update parameter values among them)."""
+        inputs (the pre-update parameter values among them). The store
+        was demoted to weakrefs at the fire (`_demote`); the reader that
+        triggered this keeps its own chain of placeholders alive, and
+        rows that died anyway are replayed through throwaway carriers —
+        their values exist only long enough to feed downstream ops."""
         st = self._tls
         st.busy = True
         try:
+            rows = []
+            for row in pending.placeholders:
+                live = []
+                for ref in row:
+                    t = ref()
+                    if t is None:
+                        t = _DeferredTensor(None, True, None, None)
+                    live.append(t)
+                rows.append(live)
             replay_ops_per_op(pending.program.chain.ops, pending.ext_vals,
-                              pending.ext_edges, pending.placeholders,
+                              pending.ext_edges, rows,
                               pending.op_pos, skip_materialized=True)
             pending.done = True
         finally:

@@ -27,6 +27,15 @@ Event categories (a public contract — tests assert the set):
       continuous-batching admission, the compiled decode step, KV-pool
       preemption, completion — with `kv_exhausted` / `bucket_retrace`
       reason codes
+  serve.cancel / serve.expire / serve.refuse / serve.hang / serve.degrade
+  / serve.resume
+      serving resilience decisions (PR 7): client cancellation, deadline
+      expiry (queued or running), bounded-queue/deadline/KV admission
+      refusal, hung-step watchdog firings, degraded-mode transitions
+      (recovery ladder rungs, eager decode fallback), and crash-resume
+      re-admissions — with `client_cancel` / `deadline_expired` /
+      `queue_full` / `deadline_infeasible` / `step_hang` / `decode_fault`
+      / `crash_resume` reason codes
 
 Reason codes (also a public contract) attribute every bypass/split/poison
 to its cause — `rng_rekey` (the op consumed fresh global randomness and its
@@ -70,6 +79,11 @@ CATEGORIES = frozenset({
     # step ran / preempted-evicted / finished-or-failed
     "serve.enqueue", "serve.admit", "serve.step", "serve.evict",
     "serve.complete",
+    # serving resilience (PR 7): cancellation / deadline expiry /
+    # admission refusal / hung-step watchdog / degraded-mode transition /
+    # crash-resume re-admission
+    "serve.cancel", "serve.expire", "serve.refuse", "serve.hang",
+    "serve.degrade", "serve.resume",
 })
 
 # Machine-readable causes. Stable across releases: the fusion doctor, the
@@ -111,6 +125,15 @@ REASON_CODES = frozenset({
     # -- serving-engine outcomes (paddle_tpu/serving/) ---------------------
     "kv_exhausted",        # KV block pool dry: eviction / admission refusal
     "bucket_retrace",      # a new prefill length bucket compiled
+    # -- serving resilience decisions (paddle_tpu/serving/resilience.py) ---
+    "client_cancel",       # cancel(request_id): the client gave up
+    "deadline_expired",    # a request's TTL passed (queued or running)
+    "queue_full",          # bounded waiting queue at max depth: refused
+    "deadline_infeasible", # estimated wait/service exceeds the deadline
+    "step_hang",           # a decode/prefill step blew the watchdog budget
+    "decode_fault",        # the compiled decode faulted/was poisoned;
+                           # requests fell back to eager generate()
+    "crash_resume",        # an in-flight request re-admitted after restart
 })
 
 

@@ -131,6 +131,43 @@ REASON_HINTS = {
         "compiled yet — expected at most log2(max_context) times per "
         "engine; frequent occurrences mean the bucket cache is being "
         "discarded (rebuild the engine less often)."),
+    "client_cancel": (
+        "the client cancelled the request (engine.cancel); its slot/KV "
+        "blocks were reclaimed at the iteration boundary without "
+        "touching the compiled decode program. Deliberate, not an "
+        "error."),
+    "deadline_expired": (
+        "the request's TTL passed while it was queued or running; the "
+        "engine cleared it instead of burning decode steps on a stream "
+        "nobody is waiting for. Frequent expiries mean the queue is "
+        "deeper than the deadline allows — lower max_queue_depth or add "
+        "capacity."),
+    "queue_full": (
+        "the bounded waiting queue was at max_queue_depth, so admission "
+        "refused early (ServeRefusal) instead of queueing doomed work. "
+        "Persistent refusals mean sustained overload: add engine "
+        "replicas or shed load upstream."),
+    "deadline_infeasible": (
+        "the estimated queue wait plus service time already exceeds the "
+        "request's deadline at enqueue; refusing now is strictly better "
+        "than expiring it later. Check the deadline against "
+        "max_new_tokens x step latency."),
+    "step_hang": (
+        "a decode/prefill step did not complete within "
+        "FLAGS_serve_step_timeout_ms; the watchdog ran its recovery "
+        "ladder (retry -> rebuild executable -> fail active requests). "
+        "Organic hangs point at the device runtime (TPU tunnel) — "
+        "check serve.degrade events for how far the ladder climbed."),
+    "decode_fault": (
+        "the compiled decode executable faulted or produced poisoned "
+        "output; affected requests were finished token-identically via "
+        "the eager generate() fallback and the executable was rebuilt. "
+        "Repeated faults on real hardware mean a bad device/driver."),
+    "crash_resume": (
+        "an in-flight request was re-admitted from a serving-state "
+        "snapshot after a restart; resume re-prefills prompt + emitted "
+        "tokens and continues byte-identically. Expected exactly once "
+        "per interrupted request per restart."),
 }
 
 
@@ -250,6 +287,13 @@ def explain(events=None):
             "decode_steps": n("serve.step"),
             "evictions": n("serve.evict"),
             "completed": n("serve.complete"),
+            # resilience decisions (PR 7, serving/resilience.py)
+            "cancelled": n("serve.cancel"),
+            "expired": n("serve.expire"),
+            "refused": n("serve.refuse"),
+            "hangs": n("serve.hang"),
+            "degraded": n("serve.degrade"),
+            "resumed": n("serve.resume"),
             "occupancy_mean": (round(sum(occ) / len(occ), 4)
                                if occ else None),
             "reasons": _attr(events,
@@ -322,6 +366,13 @@ def explain(events=None):
                     f"{sv['completed']} completion(s)"
                     + (f", occupancy {sv['occupancy_mean']}"
                        if sv["occupancy_mean"] is not None else ""))
+        if sv["hangs"] or sv["degraded"]:
+            # a watchdog firing / degraded-mode transition is the lead
+            # story of a serving window, not a footnote
+            verdict = "serving_degraded"
+            headline = (f"serving DEGRADED: {sv['hangs']} hang(s), "
+                        f"{sv['degraded']} degrade transition(s) — "
+                        + headline)
     elif poisons:
         verdict = "never_promoted"
         r, rec = max(poisons.items(), key=lambda kv: kv[1]["count"])
@@ -423,6 +474,12 @@ def format_report(report):
             f"completed={sv['completed']}"
             + (f" occupancy={sv['occupancy_mean']}"
                if sv["occupancy_mean"] is not None else ""))
+        resil = {k: sv[k] for k in ("cancelled", "expired", "refused",
+                                    "hangs", "degraded", "resumed")
+                 if sv[k]}
+        if resil:
+            lines.append("resil : " + " ".join(
+                f"{k}={v}" for k, v in sorted(resil.items())))
     if report["findings"]:
         lines.append("")
         lines.append("findings:")

@@ -13,7 +13,13 @@ the reference's one-request-per-`AnalysisPredictor` serving model
                       via block-table edits (serving/scheduler.py);
   * `PagedKVCache`  — the vLLM/PagedAttention block-pool memory model,
                       TPU-native (serving/cache.py), paired with
-                      `nn.functional.paged_decode_attention`.
+                      `nn.functional.paged_decode_attention`;
+  * resilience      — deadlines/TTLs + `cancel()`, bounded-queue
+                      backpressure (`ServeRefusal`), hung-step watchdog
+                      (`FLAGS_serve_step_timeout_ms` + recovery ladder),
+                      eager-fallback degraded mode, and crash-resumable
+                      serving state (serving/resilience.py +
+                      `incubate.checkpoint.ServeCheckpointer`).
 
 Quick start::
 
@@ -30,10 +36,12 @@ from __future__ import annotations
 from .cache import (BlockAllocator, PagedKVCache, PagedCacheView,  # noqa: F401
                     scatter_prefill, NULL_BLOCK)
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING,  # noqa: F401
-                        FINISHED, FAILED)
+                        FINISHED, FAILED, CANCELLED, EXPIRED)
+from .resilience import ServeRefusal, StepHang  # noqa: F401
 from .engine import LLMEngine, ServeStats  # noqa: F401
 
 __all__ = ["LLMEngine", "ServeStats", "Request", "Scheduler",
            "PagedKVCache", "PagedCacheView", "BlockAllocator",
            "scatter_prefill", "NULL_BLOCK", "QUEUED", "RUNNING",
-           "FINISHED", "FAILED"]
+           "FINISHED", "FAILED", "CANCELLED", "EXPIRED",
+           "ServeRefusal", "StepHang"]

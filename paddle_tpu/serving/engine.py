@@ -30,10 +30,39 @@ millions of users"), combining:
     the moment a token is produced (optionally through a tokenizer's
     `decode`), not when the request completes.
 
+Resilience (PR 7, serving/resilience.py) rides every one of those layers:
+
+  * **deadlines + cancellation** — `add_request(..., ttl_s=)` arms a
+    per-request deadline checked at admission and at every iteration
+    boundary; `cancel(request_id)` reclaims a stream the client gave up
+    on. Expired/cancelled slots are VALUE edits to the fixed layout —
+    the decode executable still compiles exactly once;
+  * **bounded-queue backpressure** — `max_queue_depth` + an
+    estimated-wait feasibility check refuse doomed work early with a
+    structured `ServeRefusal` (`queue_full` / `deadline_infeasible` /
+    `kv_exhausted`) instead of queueing it to rot, and the scheduler's
+    aging guard keeps LIFO preemption from starving a long request;
+  * **hung-step watchdog** — decode/prefill fires resolve through a
+    monitored completion bounded by `FLAGS_serve_step_timeout_ms`; a
+    stuck step emits `serve.hang`, marks the engine degraded, and climbs
+    a recovery ladder (retry -> rebuild the decode executable -> fail
+    the active requests with attributed reasons) instead of wedging;
+  * **degraded-mode fallback** — a faulting/poisoned compiled decode
+    finishes its in-flight streams per-request through the eager
+    `generate()` path, token-identically, then rebuilds;
+  * **crash-resume** — `state_payload()` / `restore_state()` snapshot
+    the request/scheduler state (prompts, emitted tokens, arrival order
+    — never the KV pool) so a kill-9'd server restarts and finishes
+    every stream byte-identically (incubate.checkpoint.ServeCheckpointer
+    + tools/chaos.py `serve_kill`).
+
 Telemetry rides the PR 4 fusion flight recorder: `serve.*` events
-(enqueue/admit/step/evict/complete) with reason codes `kv_exhausted` /
-`bucket_retrace`, aggregated by `profiler.explain` / `tools/fusion_doctor`
-and benched by `tools/serve_bench.py` + the bench.py `serve` legs.
+(enqueue/admit/step/evict/complete + cancel/expire/refuse/hang/degrade/
+resume) with reason codes `kv_exhausted` / `bucket_retrace` /
+`client_cancel` / `deadline_expired` / `queue_full` /
+`deadline_infeasible` / `step_hang` / `decode_fault` / `crash_resume`,
+aggregated by `profiler.explain` / `tools/fusion_doctor` and benched by
+`tools/serve_bench.py` + the bench.py `serve` legs.
 """
 from __future__ import annotations
 
@@ -48,9 +77,15 @@ from ..framework.core import Tensor
 from ..framework.autograd import set_grad_enabled
 from ..profiler.events import EVENTS as _EVENTS
 from .cache import PagedKVCache, PagedCacheView, scatter_prefill
-from .scheduler import (Request, Scheduler, RUNNING, FINISHED, FAILED)
+from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
+                        FAILED, CANCELLED, EXPIRED)
+from .resilience import (ServeRefusal, MonitoredWait, StepHang,
+                         request_payload, payload_request)
 
 __all__ = ["LLMEngine", "ServeStats"]
+
+# recent step-time samples averaged into the admission-time wait estimate
+_EST_WINDOW = 32
 
 _MIN_BUCKET = 8
 
@@ -79,6 +114,14 @@ class ServeStats:
         self.completed = 0
         self.failed = 0
         self.refused = 0
+        # resilience counters (serving/resilience.py semantics)
+        self.refused_queue_full = 0
+        self.refused_deadline = 0
+        self.cancelled = 0
+        self.expired = 0
+        self.hangs = 0
+        self.eager_fallbacks = 0
+        self.resumed = 0
         self.occupancy_sum = 0.0
         self.saturated_steps = 0
         self.saturated_occupancy_sum = 0.0
@@ -118,6 +161,13 @@ class ServeStats:
             "completed": self.completed,
             "failed": self.failed,
             "refused": self.refused,
+            "refused_queue_full": self.refused_queue_full,
+            "refused_deadline": self.refused_deadline,
+            "cancelled": self.cancelled,
+            "expired": self.expired,
+            "hangs": self.hangs,
+            "eager_fallbacks": self.eager_fallbacks,
+            "resumed": self.resumed,
             "occupancy_mean": (self.occupancy_sum / self.steps
                                if self.steps else 0.0),
             "occupancy_saturated": (
@@ -151,7 +201,8 @@ class LLMEngine:
 
     def __init__(self, model, max_batch_size=8, block_size=16,
                  num_blocks=None, max_context=None, watermark_blocks=None,
-                 dtype=None, tokenizer=None):
+                 dtype=None, tokenizer=None, max_queue_depth=None,
+                 aging_max_preemptions=3):
         cfg = model.config
         model.eval()
         self._model = model
@@ -165,17 +216,28 @@ class LLMEngine:
         if num_blocks is None:
             # default: every slot can reach max_context (+ null block)
             num_blocks = 1 + self.max_batch_size * self.max_blocks_per_seq
+        self._num_blocks = num_blocks
         if dtype is None:
             params = model.parameters()
             dtype = params[0]._value.dtype if params else jnp.float32
+        self._dtype = dtype
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.cache = PagedKVCache(cfg.num_hidden_layers,
                                   cfg.num_attention_heads, head_dim,
                                   num_blocks, self.block_size, dtype)
         self.scheduler = Scheduler(self.max_batch_size,
                                    self.cache.allocator, self.block_size,
-                                   watermark_blocks)
+                                   watermark_blocks,
+                                   max_queue_depth=max_queue_depth,
+                                   aging_max_preemptions=
+                                   aging_max_preemptions)
         self._stats = ServeStats()
+        self._monitor = MonitoredWait()
+        # degraded-mode latch: set by the watchdog / a decode fault,
+        # cleared by the first clean decode step afterwards (both
+        # transitions emit serve.degrade so the flight recorder shows the
+        # full degraded window)
+        self.degraded = False
         # fixed slot-layout state the compiled decode step consumes
         s, m = self.max_batch_size, self.max_blocks_per_seq
         self._tables = np.zeros((s, m), np.int32)
@@ -187,20 +249,41 @@ class LLMEngine:
         self._decode_fn = None
         self._prefill_fns = {}
         self._next_rid = 0
+        # rid -> Request: the id registry (duplicate-id checks, cancel(),
+        # introspection). Terminal handles are retained until the caller
+        # drains them with pop_finished() — live scheduling state lives
+        # in scheduler.waiting/running, never here
         self.requests = {}
+        # True while step() is mutating the slot arrays: a cancel()
+        # issued from inside a streaming callback then defers to the
+        # next iteration boundary instead of editing the layout under
+        # the loop's feet
+        self._stepping = False
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16, request_id=None,
-                    eos_token_id=None, on_token=None):
+                    eos_token_id=None, on_token=None, ttl_s=None):
         """Enqueue a generation request; returns the Request handle.
 
-        Raises ValueError when the request can NEVER be served (prompt +
-        max_new_tokens beyond the position table, or a peak KV footprint
-        larger than the pool minus the growth watermark) — attributed as
-        `kv_exhausted` in the flight recorder. A request that merely
-        cannot fit *right now* is queued, not refused.
+        `ttl_s` arms a deadline: the request is expired (attributed
+        `deadline_expired`) if the TTL passes while it waits or runs.
+
+        Raises `ServeRefusal` (a ValueError) when admission would be
+        doomed work, each refusal attributed in the flight recorder as a
+        `serve.refuse` event:
+
+          * `queue_full` — the bounded waiting queue is at
+            `max_queue_depth`;
+          * `kv_exhausted` — the peak KV footprint can NEVER fit in the
+            pool minus the growth watermark;
+          * `deadline_infeasible` — the TTL is already spent, or the
+            estimated queue wait + service time exceeds it.
+
+        A request that merely cannot fit *right now* is queued, not
+        refused. Plain validation errors (empty prompt, context
+        overflow, duplicate live id) stay ValueError.
         """
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
@@ -217,42 +300,171 @@ class LLMEngine:
             raise ValueError(
                 f"request id {rid!r} is already queued/running; ids may "
                 "only be reused after the previous request finishes")
-        req = Request(rid, prompt, max_new_tokens, eos_token_id, on_token)
+        req = Request(rid, prompt, max_new_tokens, eos_token_id, on_token,
+                      ttl_s=ttl_s)
         if len(prompt) + req.max_new_tokens > self.max_context:
             raise ValueError(
                 f"request {rid}: prompt ({len(prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds max_context "
                 f"({self.max_context})")
-        sched = self.scheduler
-        peak = sched.max_blocks_of(req)
-        budget = sched.block_budget()
-        if not sched.can_ever_fit(req):
-            self._stats.refused += 1
-            _EVENTS.emit("serve.enqueue", rid, reason="kv_exhausted",
-                         detail={"blocks_needed": peak,
-                                 "blocks_budget": budget})
-            raise ValueError(
-                f"request {rid}: needs {peak} KV blocks at peak but the "
-                f"pool only ever has {budget} (capacity "
-                f"{self.cache.allocator.capacity} - watermark "
-                f"{sched.watermark_blocks}); refuse instead of deadlock")
-        sched.enqueue(req)
+        self._admission_policy(req)
+        self.scheduler.enqueue(req)
         self.requests[rid] = req
         _EVENTS.emit("serve.enqueue", rid,
                      detail={"prompt_len": len(prompt),
-                             "max_new_tokens": req.max_new_tokens})
+                             "max_new_tokens": req.max_new_tokens,
+                             "ttl_s": ttl_s})
         return req
 
+    def _admission_policy(self, req):
+        """Refuse-early backpressure: raise `ServeRefusal` (and emit the
+        attributed `serve.refuse` event) for work that is doomed at
+        enqueue time. Checked in cost order: queue depth (free), pool
+        feasibility (arithmetic), deadline feasibility (needs latency
+        samples)."""
+        sched = self.scheduler
+        if sched.queue_full():
+            self._refuse(req, "queue_full",
+                         f"request {req.rid}: waiting queue is at "
+                         f"max_queue_depth ({sched.max_queue_depth}); "
+                         "shed load upstream or add capacity",
+                         {"queue_depth": len(sched.waiting),
+                          "max_queue_depth": sched.max_queue_depth})
+        peak = sched.max_blocks_of(req)
+        budget = sched.block_budget()
+        if not sched.can_ever_fit(req):
+            self._refuse(req, "kv_exhausted",
+                         f"request {req.rid}: needs {peak} KV blocks at "
+                         f"peak but the pool only ever has {budget} "
+                         f"(capacity {self.cache.allocator.capacity} - "
+                         f"watermark {sched.watermark_blocks}); refuse "
+                         "instead of deadlock",
+                         {"blocks_needed": peak, "blocks_budget": budget})
+        if req.deadline_ns is None:
+            return
+        remaining = req.ttl_remaining_s()
+        if remaining <= 0:
+            self._refuse(req, "deadline_infeasible",
+                         f"request {req.rid}: deadline already expired "
+                         "at enqueue",
+                         {"ttl_remaining_s": round(remaining, 6)})
+        times = self._stats.step_times_s
+        if times:
+            avg = sum(times[-_EST_WINDOW:]) / len(times[-_EST_WINDOW:])
+            need_steps = sched.estimated_wait_steps(req) \
+                + req.max_new_tokens
+            est = need_steps * avg
+            if est > remaining:
+                self._refuse(
+                    req, "deadline_infeasible",
+                    f"request {req.rid}: estimated wait + service "
+                    f"{est:.3f}s exceeds the remaining TTL "
+                    f"{remaining:.3f}s; refusing now beats expiring "
+                    "later",
+                    {"estimated_s": round(est, 4),
+                     "ttl_remaining_s": round(remaining, 4),
+                     "est_steps": need_steps})
+
+    def _refuse(self, req, reason, message, detail):
+        self._stats.refused += 1
+        if reason == "queue_full":
+            self._stats.refused_queue_full += 1
+        elif reason == "deadline_infeasible":
+            self._stats.refused_deadline += 1
+        _EVENTS.emit("serve.refuse", req.rid, reason=reason, detail=detail)
+        raise ServeRefusal(reason, message, detail)
+
+    def cancel(self, request_id):
+        """Client cancellation: reclaim the stream's slot/KV at the next
+        safe point. Between steps (the usual driver loop) the request is
+        cleared immediately; a cancel issued from inside a streaming
+        `on_token` callback — i.e. while step() is mid-iteration over
+        the slot arrays — is deferred to the next boundary sweep so the
+        fixed layout is only ever edited between decode steps. Either
+        way the edit is value-only: the decode executable never
+        retraces. Returns True when the request was live, False when it
+        was unknown or already terminal (cancel racing completion is a
+        no-op)."""
+        req = self.requests.get(request_id)
+        if req is None or req.finished:
+            return False
+        req.cancel_requested = True
+        if self._stepping and req.slot is not None:
+            return True          # boundary sweep picks it up next step
+        self._cancel_now(req)
+        return True
+
+    def _cancel_now(self, req):
+        slot = req.slot
+        self.scheduler.remove_waiting(req)
+        self.scheduler.release(req)
+        if slot is not None:
+            self._clear_slot(slot)
+        req.state = CANCELLED
+        req.error = "client_cancel"
+        req.finish_ns = time.perf_counter_ns()
+        self._stats.cancelled += 1
+        _EVENTS.emit("serve.cancel", req.rid, reason="client_cancel",
+                     detail={"was_running": slot is not None,
+                             "tokens": len(req.generated)})
+
+    def _expire(self, req):
+        """Deadline passed while queued or running: clear the request
+        (value-only slot edit) and attribute the decision."""
+        slot = req.slot
+        where = "running" if slot is not None else "queued"
+        self.scheduler.remove_waiting(req)
+        self.scheduler.release(req)
+        if slot is not None:
+            self._clear_slot(slot)
+        req.state = EXPIRED
+        req.error = "deadline_expired"
+        req.finish_ns = time.perf_counter_ns()
+        self._stats.expired += 1
+        _EVENTS.emit("serve.expire", req.rid, reason="deadline_expired",
+                     detail={"where": where,
+                             "tokens": len(req.generated)})
+
+    def _boundary_housekeeping(self):
+        """Iteration-boundary sweep: honor cancels deferred from inside
+        streaming callbacks, then expire queued requests (an expired
+        head must never block FCFS admission) and running ones (their
+        slots free up for admission this very boundary)."""
+        sched = self.scheduler
+        for req in [r for r in list(sched.waiting) + list(sched.running)
+                    if r.cancel_requested]:
+            self._cancel_now(req)
+        now = time.perf_counter_ns()
+        for req in sched.expired_waiting(now):
+            self._expire(req)
+        for req in [r for r in list(sched.running) if r.expired(now)]:
+            self._expire(req)
+
     def step(self):
-        """One engine iteration: admit at the token boundary, grow/evict
-        for KV headroom, run the ONE compiled decode step, stream the
-        produced tokens, retire finished requests. Returns True while
-        any request is running or waiting."""
+        """One engine iteration: expire/cancel at the boundary, admit,
+        grow/evict for KV headroom, run the ONE compiled decode step
+        under the watchdog, stream the produced tokens, retire finished
+        requests. Returns True while any request is running or
+        waiting."""
         if self._stats.wall_t0 is None:
             self._stats.wall_t0 = time.perf_counter()
         sched = self.scheduler
-        # -- admission (token boundary) --------------------------------
+        self._stepping = True
+        try:
+            return self._step_locked()
+        finally:
+            self._stepping = False
+
+    def _step_locked(self):
+        sched = self.scheduler
+        # -- cancel/deadline sweep + admission (token boundary) --------
+        self._boundary_housekeeping()
         while True:
+            # expire a dead head BEFORE admission assigns it a slot —
+            # it never ran, and the serve.expire where=queued/running
+            # split must stay truthful for queue-sizing diagnosis
+            while sched.waiting and sched.waiting[0].expired():
+                self._expire(sched.waiting[0])
             req = sched.try_admit()
             if req is None:
                 break
@@ -271,23 +483,29 @@ class LLMEngine:
                     self._sync_slot(req)
                     continue
                 victim = sched.preempt_victim(exclude=req)
-                if victim is None:
-                    self._fail(req, "kv_exhausted")
+                if victim is not None:
+                    self._evict(victim)
+                    continue
+                if not sched.protected(req):
+                    # aging guard: every other tenant is protected —
+                    # the grower steps aside (requeued, not failed)
+                    self._evict(req)
                     break
-                self._evict(victim)
+                self._fail(req, "kv_exhausted")
+                break
         if not sched.running:
             self._stats.wall_t1 = time.perf_counter()
             return bool(sched.waiting)
-        # -- the ONE compiled decode step ------------------------------
+        # -- the ONE compiled decode step (watchdog-monitored) ---------
         demand = sched.demand
         n_active = len(sched.running)
         t0 = time.perf_counter()
-        if self._decode_fn is None:
-            self._decode_fn = self._build_decode()
-        nxt, self._k_pools, self._v_pools = self._decode_fn(
-            self._tokens, self._tables, self._lens, self._active,
-            self._k_pools, self._v_pools)
-        toks = np.asarray(nxt)
+        toks = self._decode_step()
+        if toks is None:
+            # ladder rung 3 / eager fallback retired the batch; the
+            # engine stays serviceable for queued + new work
+            self._stats.wall_t1 = time.perf_counter()
+            return bool(sched.running or sched.waiting)
         dt = time.perf_counter() - t0
         self._stats.observe_step(n_active, self.max_batch_size, demand, dt)
         _EVENTS.emit("serve.step", "engine",
@@ -295,8 +513,17 @@ class LLMEngine:
                              "occupancy": round(
                                  n_active / self.max_batch_size, 4),
                              "ms": round(dt * 1e3, 4)})
+        if self.degraded:
+            # first clean decode step after a hang/fault: recovered
+            self.degraded = False
+            _EVENTS.emit("serve.degrade", "engine",
+                         detail={"recovered": True})
         # -- stream + retire -------------------------------------------
         for req in list(sched.running):
+            if req.finished or req.slot is None:
+                # retired mid-loop (a streaming callback cancelled it);
+                # its token from this launch is dropped on the floor
+                continue
             slot = req.slot
             req.cached_len += 1
             self._lens[slot] = req.cached_len
@@ -324,7 +551,7 @@ class LLMEngine:
                 for p in prompts]
         self.run()
         for r in reqs:
-            if r.state is FAILED:
+            if r.state in (FAILED, EXPIRED, CANCELLED):
                 raise RuntimeError(f"request {r.rid} failed: {r.error}")
         return [list(r.generated) for r in reqs]
 
@@ -341,6 +568,17 @@ class LLMEngine:
         a post-warmup window sees decode_compiles == 0 unless something
         actually retraced."""
         self._stats.reset()
+
+    def pop_finished(self):
+        """Drain terminal request handles (FINISHED/FAILED/CANCELLED/
+        EXPIRED) from the id registry and return them as {rid: Request}.
+        A long-running server calls this after collecting results so the
+        registry stays O(live); drained ids become reusable, exactly as
+        if the handle had been overwritten."""
+        done = {rid: r for rid, r in self.requests.items() if r.finished}
+        for rid in done:
+            del self.requests[rid]
+        return done
 
     # ------------------------------------------------------------------
     # admission / prefill
@@ -371,15 +609,50 @@ class LLMEngine:
         padded[0, :len(ctx)] = ctx
         row = np.zeros(self.max_blocks_per_seq, np.int32)
         row[:len(req.blocks)] = req.blocks
-        nxt, self._k_pools, self._v_pools = fn(
-            padded, np.int32(len(ctx)), row,
-            self._k_pools, self._v_pools)
+        res = self._prefill_step(fn, padded, np.int32(len(ctx)), row, req)
+        if res is None:
+            return            # watchdog failed the request, slot is clear
+        nxt, self._k_pools, self._v_pools = res
         req.cached_len = len(ctx)
         self._sync_slot(req)
         tok = int(np.asarray(nxt))
         # the prefill's sampled token is the next decode step's input
         self._tokens[req.slot] = tok
         self._emit_token(req, tok)
+
+    def _prefill_step(self, fn, padded, length, row, req):
+        """One monitored prefill fire. The ladder is per-request (a hung
+        prefill only has one tenant): retry once, then fail the request
+        with `step_hang` — the decode batch never waits on it."""
+        attempt = 1
+        while True:
+            try:
+                res = fn(padded, length, row, self._k_pools,
+                         self._v_pools)
+                self._monitor.wait(res, "prefill", attempt)
+                return res
+            except StepHang:
+                self._stats.hangs += 1
+                _EVENTS.emit("serve.hang", req.rid, reason="step_hang",
+                             detail={"phase": "prefill",
+                                     "attempt": attempt})
+                consumed = self._pools_consumed()
+                if attempt >= 2 or consumed:
+                    self._degrade("step_hang",
+                                  {"rung": "fail_request",
+                                   "phase": "prefill", "rid": req.rid,
+                                   "pools_consumed": consumed})
+                    self._fail(req, "step_hang")
+                    if consumed:
+                        surviving = list(self.scheduler.running)
+                        for r in surviving:
+                            # their KV lived in the consumed pools
+                            self._evict(r)
+                        self._reset_kv_state()
+                    return None
+                self._degrade("step_hang", {"rung": "retry",
+                                            "phase": "prefill"})
+                attempt += 1
 
     def _sync_slot(self, req):
         slot = req.slot
@@ -454,6 +727,207 @@ class LLMEngine:
         self.scheduler.preempt(victim)
         if slot is not None:
             self._clear_slot(slot)
+
+    # ------------------------------------------------------------------
+    # watchdog + degraded-mode recovery (serving/resilience.py)
+    # ------------------------------------------------------------------
+    def _decode_step(self):
+        """Run the compiled decode step through the monitored completion.
+        Returns the next-token array, or None when the recovery ladder
+        retired the running batch (hang rung 3 / decode-fault eager
+        fallback) — the engine keeps serving queued and new requests
+        either way."""
+        from ..ops import guardian
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        attempt = 1
+        while True:
+            try:
+                res = self._decode_fn(
+                    self._tokens, self._tables, self._lens, self._active,
+                    self._k_pools, self._v_pools)
+                self._monitor.wait(res, "decode", attempt)
+            except StepHang:
+                if not self._on_hang(attempt):
+                    return None
+                attempt += 1
+                continue
+            except jax.errors.JaxRuntimeError as e:
+                # organic execution fault: the program/device state is
+                # suspect — eager-finish the batch, rebuild the program
+                self._degrade("decode_fault",
+                              {"organic": True, "error": str(e)[:200]})
+                self._recover_with_fallback(rebuild=True)
+                return None
+            nxt, new_k, new_v = res
+            if guardian.poll_fault("serve.decode",
+                                   ("nan_output", "raise")) is not None:
+                # chaos-poisoned fused decode output: commit NOTHING from
+                # this launch; the in-flight streams finish through the
+                # eager path token-identically. The executable itself is
+                # healthy (the poison models a transient device fault),
+                # so no rebuild — decode still compiles exactly once.
+                self._degrade("decode_fault", {"injected": True})
+                self._recover_with_fallback(rebuild=False)
+                return None
+            self._k_pools, self._v_pools = new_k, new_v
+            return np.asarray(nxt)
+
+    def _pools_consumed(self):
+        deleted = getattr(self._k_pools, "is_deleted", None)
+        if deleted is not None and deleted():
+            return True
+        deleted = getattr(self._v_pools, "is_deleted", None)
+        return deleted is not None and deleted()
+
+    def _degrade(self, reason, detail):
+        """Enter (or deepen) degraded mode with an attributed
+        transition."""
+        self.degraded = True
+        _EVENTS.emit("serve.degrade", "engine", reason=reason,
+                     detail=detail)
+
+    def _on_hang(self, attempt):
+        """One watchdog firing: attribute it, climb the recovery ladder.
+        Returns True to retry the step (rungs 1-2), False after rung 3
+        (active requests failed, engine reset for new work)."""
+        self._stats.hangs += 1
+        _EVENTS.emit("serve.hang", "engine", reason="step_hang",
+                     detail={"attempt": attempt,
+                             "active": len(self.scheduler.running)})
+        consumed = self._pools_consumed()
+        if consumed or attempt >= 3:
+            # rung 3: the step would not come back (or its donated
+            # buffers are gone) — fail the batch with an attributed
+            # reason instead of wedging, and restore serviceability
+            self._degrade("step_hang", {"rung": "fail_active",
+                                        "pools_consumed": consumed})
+            for req in list(self.scheduler.running):
+                self._fail(req, "step_hang")
+            if consumed:
+                self._reset_kv_state()
+            self._decode_fn = self._build_decode()
+            return False
+        if attempt == 1:
+            # rung 1: transient host/device hiccup — retry the same
+            # executable with the same inputs
+            self._degrade("step_hang", {"rung": "retry"})
+        else:
+            # rung 2: the executable itself is suspect — rebuild it
+            # (the retrace is honest: decode_compiles counts it, the
+            # degrade event explains it)
+            self._degrade("step_hang", {"rung": "rebuild"})
+            self._decode_fn = self._build_decode()
+        return True
+
+    def _recover_with_fallback(self, rebuild):
+        """Degraded-mode fallback: finish every running stream through
+        the model's own eager `generate()` (token-identical to the
+        compiled decode per the PR 6 parity contract), then restore the
+        compiled path for queued/new requests."""
+        for req in list(self.scheduler.running):
+            self._fallback_eager(req)
+        if self._pools_consumed():
+            self._reset_kv_state()
+        if rebuild:
+            self._decode_fn = self._build_decode()
+
+    def _fallback_eager(self, req):
+        """Finish one request via model.generate() from its prompt +
+        emitted tokens; streams through the same on_token path."""
+        self._stats.eager_fallbacks += 1
+        _EVENTS.emit("serve.degrade", req.rid, reason="decode_fault",
+                     detail={"fallback": "eager_generate",
+                             "remaining": req.remaining_tokens})
+        remaining = req.remaining_tokens
+        if remaining > 0:
+            ctx = np.asarray([req.prompt + req.generated], np.int64)
+            out = self._model.generate(ctx, max_new_tokens=remaining,
+                                       do_sample=False)
+            arr = np.asarray(out._value if hasattr(out, "_value")
+                             else out)[0]
+            for tok in arr.tolist():
+                if req.finished:
+                    break
+                self._emit_token(req, int(tok))
+        if not req.finished:
+            self._finish(req)
+
+    def _reset_kv_state(self):
+        """Fresh block pool + slot arrays after a launch consumed or
+        poisoned the KV buffers. Only legal with an empty running batch
+        (callers retire it first); queued requests hold no blocks and
+        re-prefill on admission."""
+        assert not self.scheduler.running, \
+            "KV reset with live streams would corrupt them"
+        cfg = self._model.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.cache = PagedKVCache(cfg.num_hidden_layers,
+                                  cfg.num_attention_heads, head_dim,
+                                  self._num_blocks, self.block_size,
+                                  self._dtype)
+        self.scheduler.allocator = self.cache.allocator
+        s, m = self.max_batch_size, self.max_blocks_per_seq
+        self._tables = np.zeros((s, m), np.int32)
+        self._lens = np.zeros(s, np.int32)
+        self._active = np.zeros(s, bool)
+        self._tokens = np.zeros(s, np.int32)
+        self._k_pools = self.cache.k_pools
+        self._v_pools = self.cache.v_pools
+
+    # ------------------------------------------------------------------
+    # crash-resume (serving/resilience.py + incubate.ServeCheckpointer)
+    # ------------------------------------------------------------------
+    def state_payload(self):
+        """JSON-able snapshot of every in-flight request (prompt, emitted
+        tokens, arrival order, remaining TTL) — NOT the KV pool, which
+        re-prefills token-identically on resume. Saved each boundary by
+        `incubate.checkpoint.ServeCheckpointer`; feed the loaded payload
+        to `restore_state()` in the restarted process."""
+        now = time.perf_counter_ns()
+        # waiting + running IS the live set — O(live) per snapshot, so
+        # the tick-every-step ServeCheckpointer pattern stays affordable
+        # on a long-running server (the id registry may hold terminal
+        # handles until pop_finished() drains them)
+        live = sorted(list(self.scheduler.waiting)
+                      + list(self.scheduler.running),
+                      key=lambda r: (r.arrival_seq
+                                     if r.arrival_seq is not None else -1))
+        return {"version": 1, "kind": "serve_state",
+                "next_rid": self._next_rid,
+                "requests": [request_payload(r, now) for r in live]}
+
+    def restore_state(self, payload, on_token=None):
+        """Re-admit every request of a `state_payload()` snapshot in its
+        original arrival order. Each resumes as QUEUED with its emitted
+        tokens intact — first admission re-prefills prompt + generated
+        and the stream continues byte-identically. `on_token` (callbacks
+        never serialize): None, one callable for every request, or a
+        {request_id: callable} mapping. Returns the restored Requests."""
+        if not payload:
+            return []
+        restored = []
+        for rp in sorted(payload.get("requests", ()),
+                         key=lambda p: p.get("arrival_seq") or 0):
+            rid = rp["rid"]
+            prev = self.requests.get(rid)
+            if prev is not None and not prev.finished:
+                raise ValueError(
+                    f"restore_state: request id {rid!r} is already live "
+                    "in this engine")
+            cb = (on_token.get(rid) if isinstance(on_token, dict)
+                  else on_token)
+            req = payload_request(rp, cb)
+            self.scheduler.enqueue(req)
+            self.requests[rid] = req
+            self._stats.resumed += 1
+            _EVENTS.emit("serve.resume", rid, reason="crash_resume",
+                         detail={"generated": len(req.generated),
+                                 "remaining": req.remaining_tokens})
+            restored.append(req)
+        self._next_rid = max(self._next_rid,
+                             int(payload.get("next_rid") or 0))
+        return restored
 
     # ------------------------------------------------------------------
     # compiled programs

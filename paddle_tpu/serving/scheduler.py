@@ -26,6 +26,25 @@ Policy (deliberately small and predictable):
     (recompute-style preemption, the vLLM default) and continues
     token-identically.
 
+Resilience policy (PR 7, paired with serving/resilience.py):
+
+  * **Deadlines/TTLs** — a request may carry an absolute deadline
+    (monotonic ns). The scheduler never decides on wall time itself; it
+    exposes the bookkeeping (`Request.expired`, `expired_waiting`) and
+    the engine applies it at admission and at every iteration boundary.
+  * **Bounded queue** — `max_queue_depth` caps the waiting queue; the
+    engine turns a full queue into a structured `ServeRefusal`
+    (`queue_full`) instead of queueing work that will expire unserved.
+    `estimated_wait_steps` is the admission-time feasibility signal:
+    a lower bound on decode steps before a new arrival gets a slot.
+  * **Anti-starvation aging guard** — LIFO preemption alone can ping-pong
+    one victim forever: a request that keeps being the newest admission
+    is evicted every time the pool runs dry and never finishes. A request
+    preempted `aging_max_preemptions` times becomes *protected*:
+    `preempt_victim` skips protected requests, so its next admission is
+    the one that sticks. When every candidate is protected the caller
+    must stop evicting (grow fails / self-preempts) rather than starve.
+
 The scheduler is pure host-side bookkeeping over integers — it owns no
 device state and is unit-testable without jax. The engine
 (serving/engine.py) asks it *who* runs; the block pool (serving/cache.py)
@@ -37,12 +56,14 @@ import math
 import time
 
 __all__ = ["Request", "Scheduler", "QUEUED", "RUNNING", "FINISHED",
-           "FAILED"]
+           "FAILED", "CANCELLED", "EXPIRED"]
 
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
+CANCELLED = "CANCELLED"   # client called cancel(request_id)
+EXPIRED = "EXPIRED"       # deadline/TTL passed while queued or running
 
 
 class Request:
@@ -58,10 +79,11 @@ class Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "on_token", "state", "generated", "blocks", "slot",
                  "cached_len", "arrival_seq", "admit_seq", "preemptions",
-                 "error", "enqueue_ns", "first_token_ns", "finish_ns")
+                 "error", "enqueue_ns", "first_token_ns", "finish_ns",
+                 "deadline_ns", "cancel_requested")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
-                 on_token=None):
+                 on_token=None, ttl_s=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -79,6 +101,15 @@ class Request:
         self.enqueue_ns = time.perf_counter_ns()
         self.first_token_ns = None
         self.finish_ns = None
+        # absolute deadline on the perf_counter_ns clock (None = no TTL);
+        # checked by the ENGINE at admission and at iteration boundaries
+        self.deadline_ns = (None if ttl_s is None
+                            else self.enqueue_ns + int(ttl_s * 1e9))
+        # set by engine.cancel(): honored immediately when the engine is
+        # between steps, or by the next boundary sweep when the cancel
+        # arrives from inside a streaming callback mid-step — the fixed
+        # slot layout is only ever edited between decode steps
+        self.cancel_requested = False
 
     @property
     def context_len(self):
@@ -87,15 +118,41 @@ class Request:
         return len(self.prompt) + len(self.generated)
 
     @property
+    def remaining_tokens(self):
+        """Decode steps this request still wants (upper bound: eos may
+        stop it earlier)."""
+        return max(0, self.max_new_tokens - len(self.generated))
+
+    @property
     def finished(self):
-        return self.state in (FINISHED, FAILED)
+        return self.state in (FINISHED, FAILED, CANCELLED, EXPIRED)
+
+    def expired(self, now_ns=None):
+        """Deadline passed (False when the request carries no TTL)."""
+        if self.deadline_ns is None:
+            return False
+        if now_ns is None:
+            now_ns = time.perf_counter_ns()
+        return now_ns >= self.deadline_ns
+
+    def ttl_remaining_s(self, now_ns=None):
+        """Seconds until the deadline (None without one; may be <= 0).
+        Serialized into crash-resume snapshots so a restored request
+        re-arms RELATIVE time — the monotonic clock does not survive a
+        process."""
+        if self.deadline_ns is None:
+            return None
+        if now_ns is None:
+            now_ns = time.perf_counter_ns()
+        return (self.deadline_ns - now_ns) / 1e9
 
 
 class Scheduler:
     """FCFS + watermark admission + preempt-resume over `allocator`."""
 
     def __init__(self, num_slots, allocator, block_size,
-                 watermark_blocks=None):
+                 watermark_blocks=None, max_queue_depth=None,
+                 aging_max_preemptions=3):
         self.num_slots = int(num_slots)
         self.allocator = allocator
         self.block_size = int(block_size)
@@ -106,6 +163,13 @@ class Scheduler:
             watermark_blocks = min(self.num_slots,
                                    max(1, allocator.capacity // 20))
         self.watermark_blocks = int(watermark_blocks)
+        # bounded-queue backpressure: None = unbounded (library default;
+        # a production deployment should size this against its SLO)
+        self.max_queue_depth = (None if max_queue_depth is None
+                                else int(max_queue_depth))
+        # aging guard: preemptions a request absorbs before it becomes
+        # protected from further eviction (see preempt_victim)
+        self.aging_max_preemptions = int(aging_max_preemptions)
         self.waiting = []            # Requests, ordered by arrival_seq
         self.running = []            # admission order
         self.slots = [None] * self.num_slots
@@ -137,11 +201,43 @@ class Scheduler:
         behind it."""
         return self.max_blocks_of(req) <= self.block_budget()
 
+    def queue_full(self):
+        """The bounded waiting queue is at capacity (engine refuses with
+        `queue_full` instead of enqueueing)."""
+        return self.max_queue_depth is not None \
+            and len(self.waiting) >= self.max_queue_depth
+
+    def estimated_wait_steps(self, req=None):
+        """Lower bound on decode steps before a NEW arrival gets a slot:
+        every token still owed to requests ahead of it (running + the
+        whole waiting queue), served `num_slots` at a time. Deliberately
+        optimistic — it ignores preemption re-prefills and eos early
+        stops cut it the other way — so a refusal on this bound
+        (`deadline_infeasible`) is never pessimistic guessing."""
+        ahead = sum(r.remaining_tokens for r in self.running) \
+            + sum(r.remaining_tokens for r in self.waiting if r is not req)
+        return math.ceil(ahead / max(1, self.num_slots))
+
     # -- queue --------------------------------------------------------------
     def enqueue(self, req):
         req.arrival_seq = self._arrivals
         self._arrivals += 1
         self.waiting.append(req)
+
+    def remove_waiting(self, req):
+        """Drop a queued request (cancel/expiry); no-op when absent."""
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+
+    def expired_waiting(self, now_ns=None):
+        """Queued requests whose deadline has passed (engine clears them
+        at the iteration boundary before admission looks at the head —
+        an expired head must never block FCFS admission of live work)."""
+        if now_ns is None:
+            now_ns = time.perf_counter_ns()
+        return [r for r in self.waiting if r.expired(now_ns)]
 
     def _requeue(self, req):
         """Re-insert a preempted request by ORIGINAL arrival order."""
@@ -190,11 +286,21 @@ class Scheduler:
         req.blocks.extend(got)
         return True
 
+    def protected(self, req):
+        """The aging guard: a request preempted `aging_max_preemptions`
+        times has paid its dues — it is never chosen as a victim again,
+        so sustained LIFO preemption cannot starve it forever."""
+        return req.preemptions >= self.aging_max_preemptions
+
     def preempt_victim(self, exclude=None):
         """The most recently admitted running request other than
         `exclude` (LIFO eviction: the newest tenant re-prefills, the
-        oldest keeps its progress)."""
-        cands = [r for r in self.running if r is not exclude]
+        oldest keeps its progress). Requests past the aging guard are
+        skipped; when every candidate is protected this returns None and
+        the caller must stop evicting (fail or self-preempt the grower)
+        rather than override the guard."""
+        cands = [r for r in self.running
+                 if r is not exclude and not self.protected(r)]
         return max(cands, key=lambda r: r.admit_seq) if cands else None
 
     def preempt(self, req):
@@ -232,6 +338,8 @@ class Scheduler:
             "running": len(self.running),
             "free_blocks": self.allocator.num_free,
             "watermark_blocks": self.watermark_blocks,
+            "max_queue_depth": self.max_queue_depth,
+            "aging_max_preemptions": self.aging_max_preemptions,
             "slots": [r.rid if r is not None else None
                       for r in self.slots],
         }

@@ -114,6 +114,9 @@ class TestPublicContract:
             # serving-engine request lifecycle (PR 6, paddle_tpu/serving)
             "serve.enqueue", "serve.admit", "serve.step", "serve.evict",
             "serve.complete",
+            # serving resilience (PR 7, serving/resilience.py)
+            "serve.cancel", "serve.expire", "serve.refuse", "serve.hang",
+            "serve.degrade", "serve.resume",
         })
 
     def test_reason_codes_exact(self):
@@ -132,6 +135,10 @@ class TestPublicContract:
             "injected_fault",
             # serving-engine outcomes (PR 6, paddle_tpu/serving)
             "kv_exhausted", "bucket_retrace",
+            # serving resilience decisions (PR 7, serving/resilience.py)
+            "client_cancel", "deadline_expired", "queue_full",
+            "deadline_infeasible", "step_hang", "decode_fault",
+            "crash_resume",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

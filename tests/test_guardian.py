@@ -744,3 +744,101 @@ class TestChaos:
         chaos = _load_chaos()
         res = chaos.scenario_kill(epochs=3, steps=6)
         assert res["ok"], res["failures"]
+
+
+class TestFusedTierFaultInjection:
+    """PR 7: chaos can poison the FUSED tiers, not only raw dispatches —
+    replayed chain/step ops never reach the dispatch hook, so without
+    these sites the split-path recovery ladders were never exercised."""
+
+    def test_fused_step_fault_splits_bitwise_and_recovers(self):
+        """An injected fault at the fused-step fire recovers through the
+        transactional per-op split: params update with the SAME values
+        the eager path computes, the split is attributed
+        `injected_fault`, and the next cycle replays fused again with
+        zero retraces."""
+        set_flags({"FLAGS_profiler_events": True})
+        clear_fusion_events()
+        x, w, opt = _mk(seed=31)
+        for _ in range(8):
+            _plain_step(x, w, opt)
+        s0 = step_fusion_stats()
+        assert s0["fused_steps"] > 0
+        w_pre = np.asarray(w._value).copy()
+        inj = guardian.inject_fault("raise", op="fused_step", times=1)
+        try:
+            _plain_step(x, w, opt)         # fault -> transactional split
+        finally:
+            inj.remove()
+        s1 = step_fusion_stats()
+        assert s1["fallback_splits"] == s0["fallback_splits"] + 1
+        w_split = np.asarray(w._value).copy()
+        _plain_step(x, w, opt)             # rejoins the fused path
+        s2 = step_fusion_stats()
+        assert s2["fused_steps"] > s1["fused_steps"]
+        assert s2["retraces"] == s1["retraces"]
+        splits = [e for e in fusion_events("step.split")
+                  if e["reason"] == "injected_fault"]
+        assert len(splits) == 1
+        rep = explain()
+        assert rep["guardian"].get("injected_fault", {}).get("count", 0) \
+            >= 1
+        # the split replayed through the per-op executables: its update
+        # is BITWISE what an eager (unfused) step computes from the same
+        # pre-split state
+        set_flags({"FLAGS_eager_step_fusion": False,
+                   "FLAGS_eager_chain_fusion": False})
+        w2 = paddle.to_tensor(w_pre.copy(), stop_gradient=False)
+        opt2 = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[w2])
+        _plain_step(x, w2, opt2)
+        np.testing.assert_array_equal(w_split, np.asarray(w2._value))
+
+    def test_fused_chain_nan_poison_is_detected(self):
+        """Poisoning a fused CHAIN's outputs must not slip past the
+        guardian: the downstream values are NaN and the flush raises,
+        attributing both the injection and the non-finite output."""
+        set_flags({"FLAGS_check_numerics": True,
+                   "FLAGS_eager_step_fusion": False,
+                   "FLAGS_profiler_events": True})
+        clear_fusion_events()
+        x, w, _ = _mk(seed=32)
+        def fwd():
+            return F.gelu(paddle.matmul(x, w)).sum()
+        for _ in range(8):
+            fwd().numpy()
+        guardian.flush()
+        inj = guardian.inject_fault("nan_output", op="fused_chain",
+                                    times=1)
+        try:
+            y = fwd()
+            assert np.isnan(y.numpy()).all()
+            with pytest.raises(FloatingPointError):
+                guardian.flush()
+        finally:
+            inj.remove()
+        ev = fusion_events()
+        assert any(e["reason"] == "injected_fault" for e in ev)
+        assert any(e["reason"] == "nonfinite_output" for e in ev)
+
+    def test_fused_chain_raise_splits_to_clean_values(self):
+        """kind="raise" on the fused chain falls back per-op: the caller
+        sees bitwise-clean values and a `chain.split` attributed
+        `injected_fault` — never an exception, never NaN."""
+        set_flags({"FLAGS_eager_step_fusion": False,
+                   "FLAGS_profiler_events": True})
+        clear_fusion_events()
+        x, w, _ = _mk(seed=33)
+        def fwd():
+            return F.gelu(paddle.matmul(x, w)).sum()
+        ref = None
+        for _ in range(8):
+            ref = fwd().numpy()
+        inj = guardian.inject_fault("raise", op="fused_chain", times=1)
+        try:
+            val = fwd().numpy()
+        finally:
+            inj.remove()
+        np.testing.assert_array_equal(ref, val)
+        splits = [e for e in fusion_events("chain.split")
+                  if e["reason"] == "injected_fault"]
+        assert len(splits) == 1

@@ -26,14 +26,18 @@ import pytest
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+import time
+
 import paddle_tpu as paddle
 from paddle_tpu.framework.flags import set_flags
 from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.ops import guardian
 from paddle_tpu.profiler.events import clear_fusion_events, fusion_events
 from paddle_tpu.profiler.explain import explain
 from paddle_tpu.serving import (BlockAllocator, LLMEngine, Request,
-                                Scheduler, NULL_BLOCK, QUEUED, RUNNING,
-                                FINISHED)
+                                Scheduler, ServeRefusal, NULL_BLOCK,
+                                QUEUED, RUNNING, FINISHED, FAILED,
+                                CANCELLED, EXPIRED)
 
 VOCAB = 128
 
@@ -375,8 +379,488 @@ class TestServeTelemetry:
         finally:
             set_flags({"FLAGS_profiler_events": False})
             clear_fusion_events()
-        refusals = [e for e in ev if e["cat"] == "serve.enqueue"
+        # refusals emit serve.refuse (PR 7): one category for every
+        # admission bounce, whatever the reason code
+        refusals = [e for e in ev if e["cat"] == "serve.refuse"
                     and e["reason"] == "kv_exhausted"]
         assert len(refusals) == 1
         d = refusals[0]["detail"]
         assert d["blocks_needed"] > d["blocks_budget"]
+
+
+# ---------------------------------------------------------------------------
+# resilience: deadlines, cancellation, backpressure, watchdog, fallback,
+# crash-resume (PR 7, serving/resilience.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _no_stale_resilience_state():
+    guardian.clear_faults()
+    set_flags({"FLAGS_serve_step_timeout_ms": 0})
+    yield
+    guardian.clear_faults()
+    set_flags({"FLAGS_serve_step_timeout_ms": 0})
+
+
+class TestBackpressure:
+    def test_queue_full_refusal_is_structured_and_ordered(self, model):
+        """The bounded queue refuses the overflow request with a
+        structured ServeRefusal (a ValueError, reason `queue_full`),
+        WITHOUT perturbing the queued work — the survivors are then
+        served strictly FCFS."""
+        engine = LLMEngine(model, max_batch_size=1, block_size=4,
+                           max_queue_depth=2)
+        first = engine.add_request(_prompt(5, seed=11), max_new_tokens=3,
+                                   request_id="a")
+        engine.step()                                 # "a" is running
+        engine.add_request(_prompt(6, seed=12), max_new_tokens=3,
+                           request_id="b")
+        engine.add_request(_prompt(7, seed=13), max_new_tokens=3,
+                           request_id="c")
+        with pytest.raises(ServeRefusal) as ei:
+            engine.add_request(_prompt(8, seed=14), max_new_tokens=3,
+                               request_id="d")
+        assert ei.value.reason == "queue_full"
+        assert isinstance(ei.value, ValueError)       # PR 6 compat
+        assert ei.value.detail["max_queue_depth"] == 2
+        assert engine.stats()["refused_queue_full"] == 1
+        # queue untouched by the refusal, still FCFS behind the head
+        assert [r.rid for r in engine.scheduler.waiting] == ["b", "c"]
+        engine.run()
+        done = [engine.requests[rid] for rid in ("a", "b", "c")]
+        assert all(r.state == FINISHED for r in done)
+        assert (done[0].finish_ns < done[1].finish_ns
+                < done[2].finish_ns)                  # strict FCFS
+        assert first.state == FINISHED
+
+    def test_deadline_infeasible_refused_at_enqueue(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        # TTL already spent at enqueue
+        with pytest.raises(ServeRefusal) as ei:
+            engine.add_request(_prompt(5, seed=15), max_new_tokens=4,
+                               ttl_s=0.0)
+        assert ei.value.reason == "deadline_infeasible"
+        # with latency samples, an impossible wait+service estimate is
+        # refused even though the TTL has not yet expired
+        engine.generate([_prompt(5, seed=16)], max_new_tokens=4)
+        assert engine._stats.step_times_s
+        with pytest.raises(ServeRefusal) as ei:
+            engine.add_request(_prompt(4, seed=17), max_new_tokens=40,
+                               ttl_s=1e-5)
+        assert ei.value.reason == "deadline_infeasible"
+        assert engine.stats()["refused_deadline"] == 2
+
+
+class TestDeadlines:
+    def test_expiry_while_queued_does_not_block_admission(self, model):
+        """An expired QUEUED request is cleared at the boundary before
+        FCFS admission looks at the head — it must never shadow live
+        work behind it, and the running stream never notices."""
+        ref = _ref(model, _prompt(10, seed=18), 10)
+        engine = LLMEngine(model, max_batch_size=1, block_size=4)
+        live = engine.add_request(_prompt(10, seed=18), max_new_tokens=10)
+        engine.step()                                   # live is running
+        # a generous TTL passes admission; the deterministic seam pulls
+        # the deadline into the past once it is safely queued (wall-clock
+        # racing against CPU step times would flake)
+        doomed = engine.add_request(_prompt(5, seed=19),
+                                    max_new_tokens=4, ttl_s=60.0)
+        behind = engine.add_request(_prompt(6, seed=20), max_new_tokens=2)
+        doomed.deadline_ns = time.perf_counter_ns() - 1
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        try:
+            engine.run()
+            ev = fusion_events()
+        finally:
+            set_flags({"FLAGS_profiler_events": False})
+        assert doomed.state == EXPIRED
+        assert doomed.error == "deadline_expired"
+        assert behind.state == FINISHED                 # not shadowed
+        assert live.generated == ref                    # undisturbed
+        exp = [e for e in ev if e["cat"] == "serve.expire"]
+        assert len(exp) == 1
+        assert exp[0]["reason"] == "deadline_expired"
+        assert exp[0]["detail"]["where"] == "queued"
+        assert engine.stats()["decode_compiles"] == 1
+
+    def test_expiry_while_running_frees_the_slot(self, model):
+        """A RUNNING stream whose deadline passes is cleared at the next
+        iteration boundary (a value-only slot edit): the slot is reused,
+        the survivor stream is bitwise-unaffected, and the decode
+        program never retraces."""
+        ref_b = _ref(model, _prompt(7, seed=21), 12)
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        doomed = engine.add_request(_prompt(9, seed=22),
+                                    max_new_tokens=12, ttl_s=60.0)
+        keeper = engine.add_request(_prompt(7, seed=21), max_new_tokens=12)
+        for _ in range(4):
+            engine.step()
+        assert doomed.state == RUNNING
+        # deterministic expiry: pull the deadline into the past instead
+        # of racing wall-clock against CPU step times
+        doomed.deadline_ns = time.perf_counter_ns() - 1
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        try:
+            engine.step()
+            ev = fusion_events()
+        finally:
+            set_flags({"FLAGS_profiler_events": False})
+        assert doomed.state == EXPIRED
+        exp = [e for e in ev if e["cat"] == "serve.expire"]
+        assert exp and exp[0]["detail"]["where"] == "running"
+        waiter = engine.add_request(_prompt(5, seed=23), max_new_tokens=3)
+        engine.run()
+        assert keeper.generated == ref_b                # bitwise
+        assert waiter.state == FINISHED                 # slot was reused
+        assert engine.stats()["decode_compiles"] == 1
+
+
+class TestCancellation:
+    def test_cancel_queued_and_running(self, model):
+        ref = _ref(model, _prompt(8, seed=24), 10)
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        keeper = engine.add_request(_prompt(8, seed=24), max_new_tokens=10)
+        victim = engine.add_request(_prompt(6, seed=25), max_new_tokens=10)
+        queued = engine.add_request(_prompt(5, seed=26), max_new_tokens=4)
+        for _ in range(3):
+            engine.step()
+        assert victim.state == RUNNING and queued.state == QUEUED
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        try:
+            assert engine.cancel(victim.rid) is True
+            assert engine.cancel(queued.rid) is True
+            ev = fusion_events()
+        finally:
+            set_flags({"FLAGS_profiler_events": False})
+        assert victim.state == CANCELLED
+        assert queued.state == CANCELLED
+        cancels = [e for e in ev if e["cat"] == "serve.cancel"]
+        assert {e["reason"] for e in cancels} == {"client_cancel"}
+        assert {e["detail"]["was_running"] for e in cancels} == \
+            {True, False}
+        engine.run()
+        assert keeper.generated == ref                  # bitwise
+        assert engine.stats()["decode_compiles"] == 1
+        assert engine.stats()["cancelled"] == 2
+
+    def test_cancel_from_streaming_callback_defers_to_boundary(
+            self, model):
+        """A cancel issued from inside an on_token callback — the
+        natural place to notice a client disconnect — must not edit the
+        slot arrays under step()'s feet: it defers to the next boundary
+        sweep, the neighbor stream stays bitwise, and the decode program
+        never retraces."""
+        ref = _ref(model, _prompt(8, seed=42), 10)
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        victim = engine.add_request(_prompt(6, seed=43), max_new_tokens=10)
+
+        def on_tok(req, tok, text):
+            if len(req.generated) == 3:
+                # cross-request cancel from a live stream's callback
+                assert engine.cancel(victim.rid) is True
+        keeper = engine.add_request(_prompt(8, seed=42), max_new_tokens=10,
+                                    on_token=on_tok)
+        engine.run()
+        assert victim.state == CANCELLED
+        assert len(victim.generated) <= 4      # stopped at the boundary
+        assert keeper.generated == ref         # bitwise undisturbed
+        assert engine.stats()["decode_compiles"] == 1
+        # self-cancel from the victim's own callback is equally safe
+        engine2 = LLMEngine(model, max_batch_size=2, block_size=4)
+        selfc = engine2.add_request(
+            _prompt(7, seed=44), max_new_tokens=10,
+            on_token=lambda r, t, txt: (len(r.generated) == 2
+                                        and engine2.cancel(r.rid)))
+        other = engine2.add_request(_prompt(8, seed=42), max_new_tokens=10)
+        engine2.run()
+        assert selfc.state == CANCELLED
+        assert other.generated == ref
+
+    def test_pop_finished_drains_terminal_handles(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        done = engine.add_request(_prompt(5, seed=45), max_new_tokens=3,
+                                  request_id="d")
+        live = engine.add_request(_prompt(6, seed=46), max_new_tokens=40,
+                                  request_id="l")
+        while done.state != FINISHED:
+            engine.step()
+        drained = engine.pop_finished()
+        assert set(drained) == {"d"} and drained["d"] is done
+        assert set(engine.requests) == {"l"}   # live handles stay
+        engine.cancel(live.rid)
+
+    def test_cancel_racing_completion_is_noop(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        req = engine.add_request(_prompt(5, seed=27), max_new_tokens=3)
+        engine.run()
+        assert req.state == FINISHED
+        assert engine.cancel(req.rid) is False          # too late: no-op
+        assert req.state == FINISHED
+        assert engine.cancel("never-existed") is False
+        assert engine.stats()["cancelled"] == 0
+
+
+class TestAgingGuard:
+    def _sched(self, **kw):
+        alloc = BlockAllocator(20)
+        return Scheduler(3, alloc, 4, watermark_blocks=0, **kw)
+
+    def test_protected_request_never_chosen_as_victim(self):
+        sched = self._sched(aging_max_preemptions=2)
+        reqs = [Request(f"r{i}", [1, 2], 4) for i in range(3)]
+        for r in reqs:
+            sched.enqueue(r)
+            sched.try_admit()
+        reqs[2].preemptions = 2                    # paid its dues
+        assert sched.protected(reqs[2])
+        # LIFO would pick r2 (newest); the guard redirects to r1
+        assert sched.preempt_victim() is reqs[1]
+        reqs[1].preemptions = 2
+        reqs[0].preemptions = 2
+        assert sched.preempt_victim() is None      # everyone protected
+
+    def test_sustained_preemption_cannot_starve(self, model):
+        """A request bounced by LIFO preemption becomes protected after
+        aging_max_preemptions evictions: under a sustained stream of
+        competing work over a deliberately tight pool, every stream
+        still completes, nobody's preemption count passes the cap + 1,
+        and the outputs stay token-identical."""
+        prompts = [_prompt(n, seed=28) for n in (11, 12, 10, 5, 9, 7)]
+        refs = [_ref(model, p, 10) for p in prompts]
+        engine = LLMEngine(model, max_batch_size=3, block_size=4,
+                           num_blocks=10, watermark_blocks=1,
+                           aging_max_preemptions=2)
+        outs = engine.generate(prompts, max_new_tokens=10)
+        assert outs == refs
+        assert engine.stats()["evictions"] >= 1    # churn actually bit
+        cap = engine.scheduler.aging_max_preemptions
+        assert all(r.preemptions <= cap + 1
+                   for r in engine.requests.values())
+
+    def test_grower_steps_aside_when_victims_protected(self, model):
+        """When every other tenant is protected, the grower self-preempts
+        (requeued at its arrival slot) instead of being terminally
+        failed — bounded fairness, not collateral damage."""
+        engine = LLMEngine(model, max_batch_size=2, block_size=4,
+                           num_blocks=8, watermark_blocks=1,
+                           aging_max_preemptions=3)
+        a = engine.add_request(_prompt(10, seed=29), max_new_tokens=10)
+        b = engine.add_request(_prompt(9, seed=30), max_new_tokens=10)
+        for _ in range(2):
+            engine.step()
+        assert a.state == RUNNING and b.state == RUNNING
+        a.preemptions = 3                          # a is protected
+        engine.run()
+        assert a.state == FINISHED and b.state == FINISHED
+        assert b.preemptions >= 1                  # b stepped aside
+        assert a.generated == _ref(model, _prompt(10, seed=29), 10)
+        assert b.generated == _ref(model, _prompt(9, seed=30), 10)
+
+
+class TestWatchdog:
+    def test_injected_hang_recovers_within_budget(self, model):
+        """Rung 1: one hung decode step is detected by the watchdog and
+        retried — every stream finishes token-identically, the decode
+        program does NOT retrace, and the hang is attributed."""
+        prompts = [_prompt(n, seed=31) for n in (9, 6)]
+        refs = [_ref(model, p, 8) for p in prompts]
+        set_flags({"FLAGS_serve_step_timeout_ms": 2000})
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            engine.step()
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        guardian.inject_fault("hang", op="serve.decode", times=1)
+        try:
+            engine.run()
+            ev = fusion_events()
+        finally:
+            guardian.clear_faults()
+            set_flags({"FLAGS_profiler_events": False})
+        st = engine.stats()
+        assert st["hangs"] == 1
+        assert st["decode_compiles"] == 1
+        assert not engine.degraded                  # recovered
+        for r, ref in zip(reqs, refs):
+            assert r.state == FINISHED and r.generated == ref
+        hangs = [e for e in ev if e["cat"] == "serve.hang"]
+        assert hangs and hangs[0]["reason"] == "step_hang"
+        # the degraded window is visible: entry + recovery transitions
+        degr = [e for e in ev if e["cat"] == "serve.degrade"]
+        assert any((e.get("detail") or {}).get("rung") == "retry"
+                   for e in degr)
+        assert any((e.get("detail") or {}).get("recovered")
+                   for e in degr)
+        rep = explain(ev)
+        assert rep["verdict"] == "serving_degraded"
+
+    def test_three_hangs_fail_active_without_wedging(self, model):
+        """Rung 3: a step that will not come back fails the ACTIVE
+        requests with an attributed reason; queued and new requests are
+        then served normally — the process never wedges."""
+        set_flags({"FLAGS_serve_step_timeout_ms": 2000})
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        doomed = engine.add_request(_prompt(6, seed=32), max_new_tokens=8)
+        engine.step()
+        guardian.inject_fault("hang", op="serve.decode", times=3)
+        try:
+            engine.run()
+        finally:
+            guardian.clear_faults()
+        assert doomed.state == FAILED
+        assert doomed.error == "step_hang"
+        assert engine.stats()["hangs"] == 3
+        fresh = engine.add_request(_prompt(5, seed=33), max_new_tokens=4)
+        engine.run()
+        assert fresh.state == FINISHED
+
+
+class TestDegradedFallback:
+    def test_poisoned_decode_falls_back_eager_token_identically(
+            self, model):
+        """A poisoned compiled-decode launch is discarded; every
+        in-flight stream finishes through the model's own generate()
+        path with IDENTICAL tokens, streaming callbacks included, and
+        the engine keeps serving new work on the (unrebuilt) compiled
+        program."""
+        prompts = [_prompt(n, seed=34) for n in (10, 7)]
+        refs = [_ref(model, p, 9) for p in prompts]
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        streamed = {p[0]: [] for p in ("a", "b")}
+        reqs = [engine.add_request(
+                    p, max_new_tokens=9, request_id=rid,
+                    on_token=lambda r, tok, text: streamed[r.rid]
+                    .append(tok))
+                for rid, p in zip(("a", "b"), prompts)]
+        for _ in range(4):
+            engine.step()
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        guardian.inject_fault("nan_output", op="serve.decode", times=1)
+        try:
+            engine.run()
+            ev = fusion_events()
+        finally:
+            guardian.clear_faults()
+            set_flags({"FLAGS_profiler_events": False})
+        st = engine.stats()
+        assert st["eager_fallbacks"] == 2
+        assert st["decode_compiles"] == 1           # no rebuild
+        for r, ref in zip(reqs, refs):
+            assert r.state == FINISHED and r.generated == ref
+            assert streamed[r.rid] == ref           # stream continuity
+        degr = [e for e in ev if e["cat"] == "serve.degrade"
+                and e["reason"] == "decode_fault"]
+        assert degr
+        # and the compiled path still serves new requests, zero retrace
+        again = engine.add_request(prompts[0], max_new_tokens=9)
+        engine.run()
+        assert again.generated == refs[0]
+        assert engine.stats()["decode_compiles"] == 1
+
+
+class TestCrashResume:
+    def test_state_payload_restores_byte_identically(self, model):
+        """A mid-flight snapshot restored into a FRESH engine finishes
+        every stream with the same final tokens as the uninterrupted
+        run (re-prefill of prompt + emitted tokens is the PR 6
+        token-identical resume path)."""
+        prompts = [_prompt(n, seed=35) for n in (11, 6, 9)]
+        refs = [_ref(model, p, 10) for p in prompts]
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        for i, p in enumerate(prompts):
+            engine.add_request(p, max_new_tokens=10, request_id=f"s{i}")
+        for _ in range(5):
+            engine.step()                           # mid-flight
+        payload = engine.state_payload()
+        assert payload["requests"]                  # live streams inside
+        engine2 = LLMEngine(model, max_batch_size=2, block_size=4)
+        clear_fusion_events()
+        set_flags({"FLAGS_profiler_events": True})
+        try:
+            restored = engine2.restore_state(payload)
+            ev = fusion_events()
+        finally:
+            set_flags({"FLAGS_profiler_events": False})
+        assert [e["reason"] for e in ev
+                if e["cat"] == "serve.resume"] \
+            == ["crash_resume"] * len(restored)
+        engine2.run()
+        by_rid = {r.rid: r for r in restored}
+        for i, ref in enumerate(refs):
+            rid = f"s{i}"
+            if rid in by_rid:                       # was still in flight
+                assert by_rid[rid].generated == ref
+                assert by_rid[rid].state == FINISHED
+        assert engine2.stats()["resumed"] == len(restored)
+
+    def test_restore_rejects_live_duplicate(self, model):
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        engine.add_request(_prompt(5, seed=36), max_new_tokens=6,
+                           request_id="dup")
+        payload = engine.state_payload()
+        with pytest.raises(ValueError, match="already live"):
+            engine.restore_state(payload)
+
+    def test_serve_checkpointer_roundtrip_and_corruption_refusal(
+            self, model, tmp_path):
+        from paddle_tpu.framework.io import CheckpointCorruptError
+        from paddle_tpu.incubate.checkpoint import ServeCheckpointer
+        ref = _ref(model, _prompt(8, seed=37), 8)
+        ck = ServeCheckpointer(str(tmp_path), save_every_n_steps=1,
+                               max_checkpoints=2)
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        engine.add_request(_prompt(8, seed=37), max_new_tokens=8,
+                           request_id="k")
+        for n in range(1, 4):
+            engine.step()
+            ck.tick(n, engine.state_payload())
+        assert len(ck._retained()) == 2             # rolling retention
+        engine2 = LLMEngine(model, max_batch_size=2, block_size=4)
+        [req] = engine2.restore_state(ck.restore())
+        engine2.run()
+        assert req.generated == ref                 # byte-identical
+        # torn writes on every retained snapshot -> REFUSE, never start
+        # empty while silently dropping in-flight user streams
+        for s in ck._retained():
+            p = os.path.join(ck.checkpoint_path(s), ck.CKPT_FILE)
+            with open(p, "r+b") as fh:
+                fh.seek(8)
+                fh.write(b"XXXX")
+        with pytest.raises(CheckpointCorruptError, match="refusing"):
+            ck.restore()
+
+    @pytest.mark.perf_smoke
+    def test_decode_compiles_once_under_lifecycle_churn(self, model):
+        """The acceptance criterion: cancel/expire/refuse/resume are
+        VALUE edits to the fixed slot layout — the decode executable
+        compiles exactly once through all of it (mirrors
+        tools/perf_smoke.py leg g)."""
+        set_flags({"FLAGS_serve_step_timeout_ms": 2000})
+        engine = LLMEngine(model, max_batch_size=4, block_size=4,
+                           max_queue_depth=6)
+        engine.generate([_prompt(5, seed=38)], max_new_tokens=3)  # warm
+        engine.reset_stats()
+        live = [engine.add_request(_prompt(4 + i, seed=39),
+                                   max_new_tokens=6) for i in range(4)]
+        doomed = engine.add_request(_prompt(5, seed=40), max_new_tokens=6,
+                                    ttl_s=60.0)
+        doomed.deadline_ns = 0        # deterministic queued expiry
+        with pytest.raises(ServeRefusal):
+            for _ in range(16):
+                engine.add_request(_prompt(6, seed=41), max_new_tokens=6)
+        for _ in range(2):
+            engine.step()
+        engine.cancel(live[0].rid)
+        mid = engine.state_payload()
+        engine.run()
+        resumed = engine.restore_state(mid)
+        engine.run()
+        st = engine.stats()
+        assert st["decode_compiles"] == 0           # post-warmup window
+        assert st["cancelled"] >= 1 and st["expired"] >= 1
+        assert st["refused_queue_full"] >= 1 and len(resumed) >= 1

@@ -257,6 +257,47 @@ class TestSplits:
         assert val.shape == (8, 16)
         assert np.isfinite(val).all()
 
+    def test_fired_step_releases_preupdate_buffers(self):
+        """ROADMAP item 4(c): a fired step must NOT retain the pre-update
+        parameter values or the batch buffers into the next step. The
+        ext-val store demotes to weakrefs at the fire, so when the loop
+        keeps no mid-step intermediates, everything the replay captured
+        is refcount-freed before optimizer.step() returns — proven with
+        the cycle collector disabled."""
+        import gc
+        import weakref
+        rng = np.random.default_rng(3)
+        w = paddle.to_tensor(rng.standard_normal((16, 16))
+                             .astype(np.float32), stop_gradient=False)
+        b = paddle.to_tensor(rng.standard_normal(16).astype(np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        gc.disable()
+        try:
+            w_ref = x_ref = None
+            for _ in range(10):
+                xb = paddle.to_tensor(
+                    rng.standard_normal((8, 16)).astype(np.float32))
+                loss = F.gelu(paddle.add(paddle.matmul(xb, w), b)).sum()
+                loss.backward()
+                pre_w = weakref.ref(w._value)     # about to be replaced
+                pre_x = weakref.ref(xb._value)    # the batch buffer
+                opt.step()
+                opt.clear_grad()
+                if step_fusion_stats()["fused_steps"] > 0:
+                    w_ref, x_ref = pre_w, pre_x
+                    del xb                        # dataloader rebinding
+                    break
+            assert w_ref is not None, "loop never promoted"
+            assert w_ref() is None, \
+                "fused step retained the pre-update params past the " \
+                "step boundary"
+            assert x_ref() is None, \
+                "fused step retained the batch buffer past the step " \
+                "boundary"
+        finally:
+            gc.enable()
+
 
 class TestInvalidation:
     def test_param_stop_gradient_flip_splits(self):

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Chaos harness: prove the non-finite step guardian + crash-safe
-checkpoints survive deliberately hostile conditions (PR 5).
+checkpoints (PR 5) and the serving resilience layer (PR 7) survive
+deliberately hostile conditions.
 
-Three scenarios, each exercising one failure class a multi-day training run
-WILL eventually hit:
+Training scenarios, each exercising one failure class a multi-day training
+run WILL eventually hit:
 
   nan        a poisoned (all-NaN) batch lands in a PROMOTED dynamic-loss-
              scaled AMP loop (FLAGS_check_numerics + GradScaler riding ONE
@@ -25,11 +26,35 @@ WILL eventually hit:
              loss scale continue exactly, and the final parameters match an
              uninterrupted run.
 
-Every guardian decision flows through the PR 4 fusion flight recorder, so
-each scenario's report embeds the doctor's verdict.
+Serving scenarios (PR 7), the same methodology against LLMEngine:
+
+  serve_hang        an injected decode hang (guardian.inject_fault
+                    "hang") trips the FLAGS_serve_step_timeout_ms
+                    watchdog. Must hold: rung 1 (retry) recovers with the
+                    decode program still compiled exactly once, rung 2
+                    (two consecutive hangs) rebuilds and still finishes,
+                    every stream stays token-identical to generate(), and
+                    the doctor attributes `step_hang`.
+
+  serve_fused_fault a poisoned fused decode output (`nan_output` on
+                    "serve.decode") discards the launch and finishes the
+                    in-flight streams through the eager generate() path.
+                    Must hold: token-identical outputs, `decode_fault`
+                    attributed, NO decode rebuild (the poison models a
+                    transient fault), and the engine serves new requests
+                    afterwards.
+
+  serve_kill        a serving subprocess (ServeCheckpointer ticking every
+                    step) is SIGKILLed mid-serve, then re-run against the
+                    same checkpoint dir. Must hold: the restarted engine
+                    restores every in-flight request and finishes each
+                    stream BYTE-identically to an uninterrupted run.
+
+Every decision flows through the PR 4 fusion flight recorder, so each
+scenario's report embeds the doctor's verdict.
 
     JAX_PLATFORMS=cpu python tools/chaos.py                # all scenarios
-    JAX_PLATFORMS=cpu python tools/chaos.py --scenario nan --json
+    JAX_PLATFORMS=cpu python tools/chaos.py --scenario serve_hang --json
 """
 from __future__ import annotations
 
@@ -195,6 +220,265 @@ def scenario_exception():
 
 
 # ---------------------------------------------------------------------------
+# serving scenarios (PR 7)
+# ---------------------------------------------------------------------------
+
+def _arm_serve():
+    """Serving-scenario arming: flight recorder on, injectors/stats
+    clean — and the numerics guardian OFF (a prior training scenario may
+    have left it on; its lazy check queue must not interleave with the
+    serving engine's jit-traced model calls)."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops import guardian
+    from paddle_tpu.profiler.events import clear_fusion_events
+    set_flags({"FLAGS_check_numerics": False,
+               "FLAGS_profiler_events": True})
+    guardian.flush()
+    guardian.reset_thread_state()
+    guardian.reset_guardian_stats()
+    guardian.clear_faults()
+    clear_fusion_events()
+
+
+def _serve_setup():
+    """Deterministic tiny GPT + engine workload shared by the serving
+    scenarios (and bit-reproducible across processes: weights come from
+    the framework RNG after paddle.seed)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 128, int(n)).tolist() for n in (9, 6, 12)]
+    return model, prompts
+
+
+def _serve_refs(model, prompts, n):
+    import numpy as np
+    return [np.asarray(model.generate(np.asarray([p], np.int64),
+                                      max_new_tokens=n,
+                                      do_sample=False)._value)[0].tolist()
+            for p in prompts]
+
+
+def scenario_serve_hang():
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops import guardian
+    from paddle_tpu.profiler.events import clear_fusion_events
+    from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.serving import LLMEngine, FINISHED
+
+    _arm_serve()
+    set_flags({"FLAGS_serve_step_timeout_ms": 2000})
+    model, prompts = _serve_setup()
+    refs = _serve_refs(model, prompts, 8)
+    failures = []
+    try:
+        # -- rung 1: one hang -> retry, same executable ---------------------
+        clear_fusion_events()
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            engine.step()
+        guardian.inject_fault("hang", op="serve.decode", times=1)
+        engine.run()
+        guardian.clear_faults()
+        st = engine.stats()
+        if st["hangs"] < 1:
+            failures.append("watchdog never fired on the injected hang")
+        if st["decode_compiles"] != 1:
+            failures.append(
+                f"rung 1 (retry) recompiled decode "
+                f"{st['decode_compiles']}x, expected exactly 1")
+        for r, ref in zip(reqs, refs):
+            if r.state != FINISHED or r.generated != ref:
+                failures.append(
+                    f"stream {r.rid} not token-identical after hang "
+                    f"recovery (state {r.state})")
+        rep = explain()
+        if rep["serving"]["hangs"] < 1 \
+                or "step_hang" not in rep["serving"]["reasons"]:
+            failures.append("doctor did not attribute step_hang")
+        if rep["verdict"] != "serving_degraded":
+            failures.append(
+                f"doctor verdict {rep['verdict']!r}, expected "
+                "serving_degraded")
+
+        # -- rung 2: two consecutive hangs -> rebuild, still finishes -------
+        engine2 = LLMEngine(model, max_batch_size=2, block_size=4)
+        reqs2 = [engine2.add_request(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            engine2.step()
+        guardian.inject_fault("hang", op="serve.decode", times=2)
+        engine2.run()
+        guardian.clear_faults()
+        st2 = engine2.stats()
+        if st2["hangs"] != 2:
+            failures.append(f"expected 2 hangs at rung 2, saw "
+                            f"{st2['hangs']}")
+        if st2["decode_compiles"] != 2:
+            failures.append(
+                f"rung 2 (rebuild) should trace exactly once more "
+                f"(saw {st2['decode_compiles']} compiles)")
+        for r, ref in zip(reqs2, refs):
+            if r.state != FINISHED or r.generated != ref:
+                failures.append(
+                    f"stream {r.rid} not token-identical after rebuild")
+        return {"ok": not failures, "failures": failures,
+                "hangs": [st["hangs"], st2["hangs"]],
+                "doctor": rep["headline"]}
+    finally:
+        guardian.clear_faults()
+        set_flags({"FLAGS_serve_step_timeout_ms": 0})
+
+
+def scenario_serve_fused_fault():
+    from paddle_tpu.ops import guardian
+    from paddle_tpu.profiler.events import clear_fusion_events
+    from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.serving import LLMEngine, FINISHED
+
+    _arm_serve()
+    model, prompts = _serve_setup()
+    refs = _serve_refs(model, prompts, 8)
+    failures = []
+    clear_fusion_events()
+    engine = LLMEngine(model, max_batch_size=2, block_size=4)
+    reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+    for _ in range(3):
+        engine.step()
+    guardian.inject_fault("nan_output", op="serve.decode", times=1)
+    engine.run()
+    guardian.clear_faults()
+    st = engine.stats()
+    if st["eager_fallbacks"] < 1:
+        failures.append("poisoned decode did not trigger the eager "
+                        "fallback")
+    if st["decode_compiles"] != 1:
+        failures.append(
+            f"transient poison must not rebuild decode (saw "
+            f"{st['decode_compiles']} compiles)")
+    for r, ref in zip(reqs, refs):
+        if r.state != FINISHED or r.generated != ref:
+            failures.append(
+                f"stream {r.rid} fallback not token-identical "
+                f"(state {r.state})")
+    rep = explain()
+    if "decode_fault" not in rep["serving"]["reasons"]:
+        failures.append("doctor did not attribute decode_fault")
+    # the engine must still serve NEW work on the compiled path
+    again = engine.add_request(prompts[0], max_new_tokens=8)
+    engine.run()
+    if again.state != FINISHED or again.generated != refs[0]:
+        failures.append("engine did not serve new requests after the "
+                        "fallback")
+    if engine.stats()["decode_compiles"] != 1:
+        failures.append("post-fault serving retraced the decode program")
+    return {"ok": not failures, "failures": failures,
+            "guardian": guardian.guardian_stats(),
+            "doctor": rep["headline"]}
+
+
+def serve_child_main(args):
+    """One resumable serving run (invoked as `chaos.py --serve-child`):
+    deterministic engine + workload, ServeCheckpointer ticking every
+    step, optional SIGKILL at a chosen engine step. Writes {rid: tokens}
+    JSON on completion."""
+    from paddle_tpu.incubate.checkpoint import ServeCheckpointer
+    from paddle_tpu.serving import LLMEngine
+
+    model, prompts = _serve_setup()
+    engine = LLMEngine(model, max_batch_size=2, block_size=4)
+    ck = ServeCheckpointer(args.ckpt_dir, save_every_n_steps=1,
+                           max_checkpoints=3)
+    restored = engine.restore_state(ck.restore())
+    if not restored:
+        for i, p in enumerate(prompts):
+            engine.add_request(p, max_new_tokens=10, request_id=f"s{i}")
+    n = 0
+    while True:
+        if args.kill_at is not None and n == int(args.kill_at):
+            os.kill(os.getpid(), signal.SIGKILL)
+        alive = engine.step()
+        n += 1
+        ck.tick(n, engine.state_payload())
+        if not alive:
+            break
+    out = {r.rid: list(r.generated)
+           for r in engine.requests.values()}
+    out["__resumed__"] = len(restored)
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+def _spawn_serve_child(ckpt_dir, out, kill_at=None, timeout=300):
+    cmd = [sys.executable, os.path.abspath(__file__), "--serve-child",
+           "--ckpt-dir", ckpt_dir, "--out", out]
+    if kill_at is not None:
+        cmd += ["--kill-at", str(kill_at)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def scenario_serve_kill():
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        ck_a = os.path.join(tmp, "interrupted")
+        ck_b = os.path.join(tmp, "clean")
+        out_resumed = os.path.join(tmp, "resumed.json")
+        out_clean = os.path.join(tmp, "clean.json")
+
+        # run 1: killed after 4 engine steps (streams mid-flight)
+        r1 = _spawn_serve_child(ck_a, out_resumed, kill_at=4)
+        if r1.returncode != -signal.SIGKILL:
+            failures.append(
+                f"expected SIGKILL death, rc={r1.returncode} "
+                f"stderr={r1.stderr[-500:]}")
+        if os.path.exists(out_resumed):
+            failures.append("killed serve run still wrote final output")
+
+        # run 2: same ckpt dir — must restore and finish every stream
+        r2 = _spawn_serve_child(ck_a, out_resumed)
+        if r2.returncode != 0:
+            failures.append(f"resumed serve run failed: "
+                            f"{r2.stderr[-800:]}")
+
+        # reference: uninterrupted run
+        r3 = _spawn_serve_child(ck_b, out_clean)
+        if r3.returncode != 0:
+            failures.append(f"reference serve run failed: "
+                            f"{r3.stderr[-800:]}")
+
+        if not failures:
+            with open(out_resumed) as f:
+                res = json.load(f)
+            with open(out_clean) as f:
+                ref = json.load(f)
+            if res.pop("__resumed__") < 1:
+                failures.append("restarted engine restored no requests")
+            ref.pop("__resumed__")
+            if set(res) != set(ref):
+                failures.append(
+                    f"stream sets differ: {sorted(res)} vs {sorted(ref)}")
+            for rid in sorted(set(res) & set(ref)):
+                if res[rid] != ref[rid]:
+                    failures.append(
+                        f"stream {rid} not byte-identical after kill-9 "
+                        "resume")
+    return {"ok": not failures, "failures": failures}
+
+
+# ---------------------------------------------------------------------------
 # kill scenario: child training loop + parent orchestration
 # ---------------------------------------------------------------------------
 
@@ -333,7 +617,9 @@ def scenario_kill(epochs=3, steps=6):
 # ---------------------------------------------------------------------------
 
 SCENARIOS = {"nan": scenario_nan, "exception": scenario_exception,
-             "kill": scenario_kill}
+             "kill": scenario_kill, "serve_hang": scenario_serve_hang,
+             "serve_fused_fault": scenario_serve_fused_fault,
+             "serve_kill": scenario_serve_kill}
 
 
 def main(argv=None):
@@ -342,8 +628,10 @@ def main(argv=None):
                     choices=["all"] + sorted(SCENARIOS))
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
-    # internal: child training run for the kill scenario
+    # internal: child training/serving runs for the kill scenarios
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--serve-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
     ap.add_argument("--epochs", type=int, default=3, help=argparse.SUPPRESS)
@@ -353,6 +641,8 @@ def main(argv=None):
 
     if args.child:
         return child_main(args)
+    if args.serve_child:
+        return serve_child_main(args)
 
     names = sorted(SCENARIOS) if args.scenario == "all" else [args.scenario]
     report = {}

@@ -97,13 +97,15 @@ def _demo(variant, steps):
 
 def _demo_serve(steps):
     """Tiny continuous-batching serving run (paddle_tpu/serving): a small
-    GPT over a deliberately tight KV pool, so the report shows the
-    serve.* lifecycle including at least one kv_exhausted eviction.
-    `--steps` is the number of requests churned through the batch."""
+    GPT over a deliberately tight KV pool AND a bounded queue, so the
+    report shows the full serve.* lifecycle — kv_exhausted evictions plus
+    the PR 7 resilience codes (queue_full refusal, client_cancel,
+    deadline_expired). `--steps` is the number of requests churned
+    through the batch."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
-    from paddle_tpu.serving import LLMEngine
+    from paddle_tpu.serving import LLMEngine, ServeRefusal
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
@@ -114,12 +116,24 @@ def _demo_serve(steps):
     model = GPTForCausalLM(cfg)
     model.eval()
     engine = LLMEngine(model, max_batch_size=3, block_size=4,
-                       num_blocks=10, watermark_blocks=1)
+                       num_blocks=10, watermark_blocks=1,
+                       max_queue_depth=max(4, steps))
     rng = np.random.default_rng(0)
     base = (11, 12, 10, 5, 7, 9)
     prompts = [rng.integers(0, 128, base[i % len(base)]).tolist()
                for i in range(max(len(base), steps))]
-    engine.generate(prompts, max_new_tokens=8)
+    reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+    # one stream the client abandons, one with a TTL the queue ahead of
+    # it will outlast (it expires while QUEUED, at an iteration boundary)
+    engine.cancel(reqs[-1].rid)
+    engine.add_request(prompts[0], max_new_tokens=8, ttl_s=0.01)
+    # fill the bounded queue until admission refuses
+    try:
+        for _ in range(2 * len(prompts)):
+            engine.add_request(prompts[1], max_new_tokens=8)
+    except ServeRefusal:
+        pass
+    engine.run()
 
 
 def main(argv=None) -> int:

@@ -34,7 +34,14 @@ stack silently regressed:
     churning through a 4-slot continuous batch (paddle_tpu/serving) must
     compile the decode executable exactly ONCE, and saturated batch
     occupancy must stay >= 0.75 — the paged KV cache + slot layout keep
-    every tenant mix on one program (a PR 6 regression).
+    every tenant mix on one program (a PR 6 regression);
+  * serving resilience cost + churn — with the hung-step watchdog and
+    per-request deadlines ARMED, the serve_8-style loop must stay within
+    3%/step of the disarmed engine (the monitored completion's spin-poll
+    must never sleep on a healthy step), and the decode executable must
+    STILL compile exactly once while requests are cancelled, expired,
+    refused, and crash-resumed around it — resilience is value edits to
+    the fixed slot layout, never shapes (a PR 7 regression).
 
 Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
 in tests/test_chain_fusion.py and tests/test_step_fusion.py — this CLI is
@@ -360,6 +367,92 @@ def main() -> int:
             "< 0.75 with 64 streams over 4 slots: continuous batching is "
             "not refilling freed slots (PR 6 regression)")
 
+    # ---- serving resilience legs (PR 7 guards) ---------------------------
+    # (f) watchdog + deadline checks armed must be invisible on a healthy
+    # engine: interleaved disarmed/armed windows over the serve_8-style
+    # workload, min-of-paired-ratios < 3%/step (same statistic as the
+    # guardian leg: a load spike hits both legs, a real regression — a
+    # sleep or sync on the hot path — inflates every pair)
+    sprompts8 = [srng.integers(0, 128, int(n)).tolist()
+                 for n in srng.integers(3, 20, 8)]
+    rengine = LLMEngine(smodel, max_batch_size=4, block_size=4)
+    rengine.generate(sprompts8, max_new_tokens=6)          # warm programs
+
+    def serve_window(ttl):
+        for p in sprompts8:
+            rengine.add_request(p, max_new_tokens=6, ttl_s=ttl)
+        rengine.run()
+
+    sratios = []
+    for _ in range(6):
+        set_flags({"FLAGS_serve_step_timeout_ms": 0})
+        t0 = time.perf_counter()
+        serve_window(None)
+        t_off = time.perf_counter() - t0
+        set_flags({"FLAGS_serve_step_timeout_ms": 5000})
+        t0 = time.perf_counter()
+        serve_window(60.0)
+        t_on = time.perf_counter() - t0
+        sratios.append(t_on / t_off if t_off > 0 else float("inf"))
+    set_flags({"FLAGS_serve_step_timeout_ms": 0})
+    resil_overhead = min(sratios) - 1.0
+    if resil_overhead >= 0.03:
+        failures.append(
+            f"armed watchdog + deadlines cost "
+            f"{resil_overhead * 100:.1f}%/step on the serve_8 loop "
+            "(>=3%): the monitored completion stopped being free on "
+            "healthy steps (PR 7 regression)")
+    if rengine.stats()["decode_compiles"] != 1:
+        failures.append(
+            "the resilience timing windows retraced the decode program "
+            "(PR 7 regression)")
+
+    # (g) decode compiles exactly once while requests are cancelled,
+    # expired, refused, and crash-resumed around the running batch
+    from paddle_tpu.serving import ServeRefusal
+    churn = LLMEngine(smodel, max_batch_size=4, block_size=4,
+                      max_queue_depth=6)
+    churn.generate(sprompts8[:4], max_new_tokens=4)        # warm programs
+    churn.reset_stats()
+    set_flags({"FLAGS_serve_step_timeout_ms": 5000})
+    try:
+        live = [churn.add_request(p, max_new_tokens=6)
+                for p in sprompts8[:4]]
+        doomed = churn.add_request(sprompts8[4], max_new_tokens=6,
+                                   ttl_s=60.0)
+        # deterministic queued-expiry: rewind the deadline instead of
+        # racing a tiny TTL against the admission-time feasibility check
+        doomed.deadline_ns = 0
+        refused = 0
+        try:
+            for _ in range(16):
+                churn.add_request(sprompts8[5], max_new_tokens=6)
+        except ServeRefusal:
+            refused = 1
+        for _ in range(2):
+            churn.step()
+        churn.cancel(live[0].rid)
+        mid = churn.state_payload()                        # live streams
+        churn.run()
+        # resume: re-admit a mid-flight snapshot (ids are free again)
+        resumed = churn.restore_state(mid)
+        churn.run()
+    finally:
+        set_flags({"FLAGS_serve_step_timeout_ms": 0})
+    cstats = churn.stats()
+    if cstats["decode_compiles"] != 0:
+        failures.append(
+            f"decode retraced {cstats['decode_compiles']}x under "
+            "cancel/expire/refuse/resume churn — resilience edits leaked "
+            "into the compiled shapes (PR 7 regression)")
+    if not (refused and cstats["cancelled"] >= 1
+            and cstats["expired"] >= 1 and len(resumed) >= 1):
+        failures.append(
+            f"churn leg did not exercise every lifecycle edge "
+            f"(refused={refused}, cancelled={cstats['cancelled']}, "
+            f"expired={cstats['expired']}, resumed={len(resumed)}) "
+            "(PR 7 guard bug)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -376,7 +469,11 @@ def main() -> int:
           f"(retraces={amp_retraces}), "
           f"serve decode compiles={sstats['decode_compiles']} "
           f"occupancy={sstats['occupancy_saturated']:.2f} "
-          f"({sstats['completed']} streams)")
+          f"({sstats['completed']} streams), "
+          f"resilience overhead={resil_overhead * 100:.1f}%/step "
+          f"(churn compiles={cstats['decode_compiles']}, "
+          f"cancelled={cstats['cancelled']} expired={cstats['expired']} "
+          f"refused={refused} resumed={len(resumed)})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)

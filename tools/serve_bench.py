@@ -93,7 +93,12 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
     max_prompt = 48 if on_tpu else 24
     engine = LLMEngine(model, max_batch_size=max_batch,
                        block_size=16 if on_tpu else 8,
-                       max_context=max_prompt + max_new_tokens + 8)
+                       max_context=max_prompt + max_new_tokens + 8,
+                       # bounded queue sized generously for the leg: the
+                       # backpressure counters below stay 0 in a healthy
+                       # run and move in the trajectory when admission or
+                       # deadline behavior regresses
+                       max_queue_depth=4 * streams)
 
     clear_fusion_events()
     prev = get_flags(["FLAGS_profiler_events"])
@@ -155,6 +160,18 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             "admitted": snap["admitted"],
             "evictions": snap["evictions"],
             "completed": snap["completed"],
+            # resilience counters (PR 7): refusal/timeout/cancel/preempt
+            # behavior is part of the trajectory, not just throughput —
+            # a backpressure regression shows here before it shows in
+            # tokens/s
+            "refused": snap["refused"],
+            "refused_queue_full": snap["refused_queue_full"],
+            "refused_deadline": snap["refused_deadline"],
+            "cancelled": snap["cancelled"],
+            "expired": snap["expired"],
+            "hangs": snap["hangs"],
+            "eager_fallbacks": snap["eager_fallbacks"],
+            "resumed": snap["resumed"],
             "platform": platform,
             "trace": tdir,
             "fusion_events": events_summary(ev),
@@ -196,7 +213,8 @@ def main(argv=None) -> int:
               f"occupancy {ex['occupancy_mean']} "
               f"(saturated {ex['occupancy_saturated']}), "
               f"decode_compiles {ex['decode_compiles']} (window), "
-              f"evictions {ex['evictions']}")
+              f"evictions {ex['evictions']}, refused {ex['refused']}, "
+              f"expired {ex['expired']}, hangs {ex['hangs']}")
         print(f"doctor: {ex['fusion_doctor']['headline']}")
     return 0 if rec["extra"]["decode_compiles"] == 0 else 1
 
