@@ -198,7 +198,8 @@ def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     # nonzero values here would mean eager leaked into the compiled loop)
     from paddle_tpu.profiler import (dispatch_cache_stats,
                                      chain_fusion_stats, step_fusion_stats,
-                                     events_summary, fusion_events)
+                                     aot_cache_stats, events_summary,
+                                     fusion_events)
     from paddle_tpu.profiler.explain import explain
     from paddle_tpu.ops.guardian import guardian_stats as _guardian_stats
     ev = fusion_events()
@@ -216,6 +217,10 @@ def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
                   "dispatch_cache": dispatch_cache_stats(),
                   "chain_fusion": chain_fusion_stats(),
                   "step_fusion": step_fusion_stats(),
+                  # persistent AOT executable store (FLAGS_aot_cache):
+                  # all-zero unless the config armed it — nonzero hits
+                  # mean this bench process warm-started off disk
+                  "aot_cache": aot_cache_stats(),
                   # non-finite step guardian (FLAGS_check_numerics):
                   # all-zero unless the config armed it — nonzero
                   # steps_skipped on a clean bench run means the model

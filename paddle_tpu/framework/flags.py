@@ -12,6 +12,10 @@ __all__ = ["define_flag", "set_flags", "get_flags", "FLAGS"]
 _lock = threading.Lock()
 _FLAGS: dict[str, object] = {}
 _DEFS: dict[str, tuple] = {}
+# bumped on every mutation: caches derived from flag values (the AOT
+# store's environment fingerprint, ops/aot_cache.py) key on it so a
+# mid-run set_flags can never leave them stale
+_GENERATION = 0
 
 
 def define_flag(name, default, help_str=""):
@@ -230,6 +234,45 @@ define_flag("FLAGS_profiler_events_capacity", 65536,
             "ring is (re)created — clear_fusion_events() picks up a "
             "changed value")
 
+# Persistent AOT executable cache (ops/aot_cache.py): content-addressed
+# on-disk store of `jax.export`-serialized fused executables — per-op
+# forward / forward+vjp pairs, fused chains, promoted whole-step programs,
+# the serving decode step — keyed by the existing cache-key digests plus an
+# environment fingerprint (jax/jaxlib/numpy versions, backend, device
+# kind, PRNG-key export form), so a restarting worker deserializes
+# yesterday's executables instead of paying the full trace+compile warmup.
+# Writes are atomic (tmp + fsync + rename, CRC-32 trailer shared with the
+# checkpoint writer); torn or corrupt artifacts are detected on load,
+# quarantined, and transparently recompiled — the store can never crash a
+# training or serving process, only make its warmup cheaper.
+define_flag("FLAGS_aot_cache", False,
+            "persist fused executables (per-op/chain/whole-step/serving "
+            "decode) to a content-addressed on-disk store via jax.export "
+            "and reload them on restart: a preempted worker re-promotes "
+            "its fused train step on the first cycle with zero fresh "
+            "traces (warm start). Off by default: storing exports each "
+            "executable once at build time (extra trace cost in COLD "
+            "processes); enable it for fleet workers that restart under "
+            "traffic. Corrupt/version-skewed artifacts are quarantined "
+            "and recompiled, never trusted")
+define_flag("FLAGS_aot_cache_dir", "",
+            "root directory of the AOT executable store. Empty (default): "
+            "$PADDLE_TPU_CACHE_DIR/aot when the env var is set (tests "
+            "share this root with the persistent XLA compile cache), "
+            "else /tmp/paddle_tpu_cache/aot. Content addressing makes "
+            "concurrent multi-process writers safe: same key -> same "
+            "bytes, last atomic rename wins")
+define_flag("FLAGS_aot_cache_max_bytes", 1 << 30,
+            "size budget of the AOT store; past it, eviction removes "
+            "oldest-mtime artifacts first (loads refresh mtime, so the "
+            "policy is LRU-ish). Checked opportunistically after stores "
+            "and by `fusion_doctor --cache --gc`. 0 disables the size "
+            "bound")
+define_flag("FLAGS_aot_cache_max_age_s", 14 * 86400,
+            "age bound of the AOT store (seconds since last use); older "
+            "artifacts and quarantined *.corrupt files are removed by "
+            "eviction. 0 disables the age bound")
+
 define_flag("FLAGS_eager_step_fusion_donate_params", False,
             "EXPERIMENTAL: donate parameter buffers (in addition to the "
             "optimizer-slot buffers, which are always donated exactly as "
@@ -249,18 +292,22 @@ class _FlagsView:
             raise AttributeError(name)
 
     def __setattr__(self, name, value):
+        global _GENERATION
         full = name if name.startswith("FLAGS_") else f"FLAGS_{name}"
         with _lock:
             _FLAGS[full] = value
+            _GENERATION += 1
 
 
 FLAGS = _FlagsView()
 
 
 def set_flags(flags: dict):
+    global _GENERATION
     with _lock:
         for k, v in flags.items():
             _FLAGS[k] = v
+        _GENERATION += 1
 
 
 def get_flags(flags):

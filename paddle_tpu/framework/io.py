@@ -209,6 +209,35 @@ def _verify_trailer(path):
             f"checkpoint {path} is corrupt (CRC mismatch — torn write?)")
 
 
+def read_verified_payload(path, require_trailer=False):
+    """Read a trailer-protected file and return its payload bytes (the
+    content before the 24-byte CRC-32 trailer `_write_atomic` appends).
+
+    Raises CheckpointCorruptError on truncation or a CRC mismatch. With
+    `require_trailer=False` a file without a recognizable trailer is
+    returned whole (legacy checkpoints); with True a missing trailer is
+    itself corruption — used by the AOT executable store
+    (ops/aot_cache.py), whose files are never legacy and must never be
+    deserialized unverified."""
+    import struct
+    import zlib
+    path = os.fspath(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) >= 24:
+        magic, payload_len, crc_stored = struct.unpack("<QQQ", data[-24:])
+        if magic == _TRAILER_MAGIC and payload_len == len(data) - 24:
+            payload = data[:-24]
+            if zlib.crc32(payload) != crc_stored:
+                raise CheckpointCorruptError(
+                    f"{path} is corrupt (CRC mismatch — torn write?)")
+            return payload
+    if require_trailer:
+        raise CheckpointCorruptError(
+            f"{path} is corrupt (missing or damaged integrity trailer)")
+    return data
+
+
 def load(path, **configs):
     return_numpy = configs.get("return_numpy", False)
     if hasattr(path, "read"):

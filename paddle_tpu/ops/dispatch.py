@@ -50,6 +50,7 @@ from ..framework.flags import _FLAGS
 from ..profiler.dispatch import STATS as _STATS
 from ..profiler.events import EVENTS as _EVENTS
 from . import guardian as _guardian
+from . import aot_cache as _aot
 
 __all__ = ["call_op", "call_op_multi", "clear_dispatch_cache",
            "dispatch_cache_info"]
@@ -535,8 +536,15 @@ def _cached_call(key, name, fn, diff_idx, vals):
         return True, res
     _STATS.miss(name)
     _EVENTS.emit("dispatch.miss", name, key)
-    exe = _build_fwd(name, fn, check) if diff_idx is None \
-        else _build_fwd_vjp(name, fn, diff_idx, check)
+    # AOT warm start (ops/aot_cache.py): a restarting worker deserializes
+    # yesterday's executable instead of tracing — corrupt/skewed artifacts
+    # fall through to the live build below, attributed but never fatal
+    exe = _aot.load_op(key, name, fn, diff_idx, check) \
+        if _aot.enabled() else None
+    fresh = exe is None
+    if fresh:
+        exe = _build_fwd(name, fn, check) if diff_idx is None \
+            else _build_fwd_vjp(name, fn, diff_idx, check)
     try:
         res = exe(*vals)
     except jax.errors.JaxRuntimeError:
@@ -552,6 +560,10 @@ def _cached_call(key, name, fn, diff_idx, vals):
         _EVENTS.emit("dispatch.bypass", name, key, "unjittable")
         return False, None
     _cache_put(key, exe)
+    if fresh and _aot.enabled():
+        # store-if-absent AFTER the executable proved itself on real
+        # inputs (an exported unjittable op can't exist — it already ran)
+        _aot.store_op(key, name, fn, diff_idx, check, vals)
     if check:
         res, fin = res
         _guardian.enqueue_fwd(name, fin)
@@ -561,7 +573,13 @@ def _cached_call(key, name, fn, diff_idx, vals):
 def _make_cached_vjp(vjp_partial, diff_idx, n_in, multi):
     """Engine-facing pullback over the cached backward executable. The
     `donate` kwarg (passed by GradNode.collect_input_grads on the final,
-    non-retained backward) routes through the donating applier."""
+    non-retained backward) routes through the donating applier. An
+    AOT-restored executable hands back an AotPullback instead of a
+    residual Partial — its stored rematerializing backward program plays
+    the applier's role (ops/aot_cache.py)."""
+    if isinstance(vjp_partial, _aot.AotPullback):
+        return vjp_partial.make_wrapped(diff_idx, n_in, multi)
+
     def wrapped(g, donate=False):
         if multi and not isinstance(g, tuple):
             # the engine passes a bare cotangent when the op has exactly

@@ -74,6 +74,18 @@ def _key_diff_reason(expected, got):
 MISS = object()          # step() result: "not handled, take the per-op path"
 _PENDING = object()      # placeholder _value before its chain fires
 
+_aot_mod = None
+
+
+def _aot():
+    """ops/aot_cache.py, resolved lazily (it back-imports the chain
+    builders for its healing fallbacks)."""
+    global _aot_mod
+    if _aot_mod is None:
+        from . import aot_cache
+        _aot_mod = aot_cache
+    return _aot_mod
+
 # window / max-chain length: long enough to capture fwd sub-expressions of a
 # layer, short enough that detection stays O(1)-ish per dispatch
 _WINDOW = 8
@@ -229,7 +241,8 @@ class Chain:
     __slots__ = ("sig", "ops", "label", "n_ext", "ext_of", "diff_ext_idx",
                  "grad_mode", "flat_avals", "flat_node_avals", "owners",
                  "baseline_ns", "pure_fn", "_fwd", "_fwd_vjp", "dead",
-                 "fail_streak", "head_kid", "replays", "check")
+                 "fail_streak", "head_kid", "replays", "check",
+                 "aot_digest", "aot_stored")
 
     def __init__(self, sig, ops, baseline_ns):
         self.sig = sig
@@ -280,15 +293,23 @@ class Chain:
         self.pure_fn = _chain_pure_fn(self)
         self._fwd = None
         self._fwd_vjp = None
+        self.aot_digest = 0          # lazily computed (ops/aot_cache.py)
+        self.aot_stored = False
 
     def fwd(self):
         if self._fwd is None:
-            self._fwd = _build_chain_fwd(self)
+            if _aot().enabled():
+                self._fwd = _aot().load_chain(self, grad=False)
+            if self._fwd is None:
+                self._fwd = _build_chain_fwd(self)
         return self._fwd
 
     def fwd_vjp(self):
         if self._fwd_vjp is None:
-            self._fwd_vjp = _build_chain_fwd_vjp(self)
+            if _aot().enabled():
+                self._fwd_vjp = _aot().load_chain(self, grad=True)
+            if self._fwd_vjp is None:
+                self._fwd_vjp = _build_chain_fwd_vjp(self)
         return self._fwd_vjp
 
 
@@ -374,7 +395,13 @@ _chain_vjp_applier_donate = jax.jit(_apply_chain_vjp, donate_argnums=(0,))
 
 def _make_chain_vjp(vjp_partial, diff_idx, n_ext):
     """Engine-facing pullback for a fused node (cf. dispatch._make_cached_vjp
-    — duplicated here only to route through the chain appliers)."""
+    — duplicated here only to route through the chain appliers). An
+    AOT-restored chain hands back an AotPullback whose stored
+    rematerializing backward replaces the applier (ops/aot_cache.py);
+    chain cotangents are always tuples, so multi=True."""
+    if isinstance(vjp_partial, _aot().AotPullback):
+        return vjp_partial.make_wrapped(diff_idx, n_ext, multi=True)
+
     def wrapped(g, donate=False):
         if not isinstance(g, tuple):
             g = (g,)
@@ -1066,6 +1093,11 @@ class _FusionManager:
             pending.done = True
             chain.fail_streak = 0
             chain.replays += 1
+            if not chain.aot_stored and _aot().enabled():
+                # persist the proven executable once (store-if-absent:
+                # a restored chain never re-exports)
+                chain.aot_stored = True
+                _aot().store_chain(chain, ext)
             elapsed = time.perf_counter_ns() - pending.t0
             CHAIN_STATS.replay(chain.label, len(chain.ops),
                                chain.baseline_ns - elapsed)

@@ -178,13 +178,16 @@ class _StepProgram:
                  "clip_snapshot", "reg_ref", "reg_snapshot", "extra_key",
                  "acc_names", "label", "n_launches", "baseline_ns",
                  "fail_streak", "dead", "_exe", "_shims", "donate_params",
-                 "check", "scaler_ref", "scaler_consts")
+                 "check", "scaler_ref", "scaler_consts", "aot_digest",
+                 "aot_stored")
 
     def __init__(self):
         self.fail_streak = 0
         self.dead = False
         self._exe = None
         self._shims = None
+        self.aot_digest = None   # ops/aot_cache.py warm-start address
+        self.aot_stored = False
         # guardian (FLAGS_check_numerics, ops/guardian.py): check-ness is
         # fixed by the signature (the per-op keys carry the flag), and the
         # executable then folds the skip-step where()-rescue in; a fused
@@ -226,6 +229,21 @@ class _StepProgram:
     def exe(self):
         if self._exe is not None:
             return self._exe
+        from ..jit.train_step import donation_argnums
+        from . import aot_cache as _aot
+        if _aot.enabled() and self.aot_digest is not None:
+            # warm start: deserialize the stored whole-step program (zero
+            # fresh traces); a corrupt/mismatched artifact heals through
+            # _compile transparently
+            self._exe = _aot.load_step(
+                self, self._compile,
+                donation_argnums(self.donate_params, 0, 2))
+            if self._exe is not None:
+                return self._exe
+        self._exe = self._compile()
+        return self._exe
+
+    def _compile(self):
         from ..jit.train_step import donation_argnums
         from . import guardian
         chain = self.chain
@@ -365,6 +383,7 @@ class _TLS(threading.local):
         self.replay_arm = False    # next cycle's first entry may start replay
         self.pending = None
         self.busy = False
+        self.aot_probe = {}        # sig -> AOT step digest (or None)
 
 
 class _StepFusionManager:
@@ -923,17 +942,19 @@ class _StepFusionManager:
             step_count = jnp.asarray(opt._step_count, jnp.int32)
             if scaler is not None:
                 scale_before, good, bad = scaler._state_arrays()
+                fire_args = (pvals, ext, accs, lr, step_count,
+                             scale_before, good, bad)
                 (root_val, grads, new_p, new_accs, upd_finite, fwd_finite,
-                 found_inf, scale_after, good2, bad2) = program.exe()(
-                    pvals, ext, accs, lr, step_count, scale_before, good,
-                    bad)
+                 found_inf, scale_after, good2, bad2) = \
+                    program.exe()(*fire_args)
             elif check:
+                fire_args = (pvals, ext, accs, lr, step_count)
                 (root_val, grads, new_p, new_accs, upd_finite,
-                 fwd_finite) = program.exe()(pvals, ext, accs, lr,
-                                             step_count)
+                 fwd_finite) = program.exe()(*fire_args)
             else:
+                fire_args = (pvals, ext, accs, lr, step_count)
                 root_val, grads, new_p, new_accs = program.exe()(
-                    pvals, ext, accs, lr, step_count)
+                    *fire_args)
         except jax.errors.JaxRuntimeError:
             # transient execution fault: keep the program and replay
             # eagerly — UNLESS the launch already consumed the donated
@@ -999,6 +1020,14 @@ class _StepFusionManager:
                                    step_index=opt._step_count)
             pending.fired = True
             program.fail_streak = 0
+            if not program.aot_stored:
+                from . import aot_cache as _aot
+                if _aot.enabled():
+                    # persist the ONE fused step right after it proved
+                    # itself (store-if-absent; restored programs and
+                    # donated-buffer shapes are both handled there)
+                    program.aot_stored = True
+                    _aot.store_step(program, fire_args)
             elapsed = time.perf_counter_ns() - pending.t0
             STEP_STATS.replay(program.label, program.n_launches,
                               program.baseline_ns - elapsed)
@@ -1188,10 +1217,20 @@ class _StepFusionManager:
                              "ops": len(cyc.ops), "streak": st.streak})
         min_count = int(
             _FLAGS.get("FLAGS_eager_step_fusion_min_count", 40) or 1)
-        if st.streak >= min_count:
+        promote = st.streak >= min_count
+        warm = False
+        if not promote and sig not in st.library:
+            # AOT warm start (ops/aot_cache.py): when the store already
+            # holds this cycle's compiled step, the stability threshold is
+            # moot — a restarting worker promotes on its FIRST clean cycle
+            # and fires the restored executable on the next one
+            warm = self._aot_step_digest(st, sig, opt, updated) is not None
+            promote = warm
+        if promote:
             program = st.library.get(sig)
             if program is None and sig not in st.library:
-                program = self._build(st, cyc, sig, opt, updated)
+                program = self._build(st, cyc, sig, opt, updated,
+                                      warm=warm)
                 st.library[sig] = program if program is not None \
                     else _UNBUILDABLE
                 cap = int(_FLAGS.get("FLAGS_eager_step_fusion_cache_size",
@@ -1203,7 +1242,26 @@ class _StepFusionManager:
                 st.active = program
         self._after_boundary(st)
 
-    def _build(self, st, cyc, sig, opt, updated):
+    def _aot_step_digest(self, st, sig, opt, updated):
+        """The warm-start probe: this cycle's AOT step digest when the
+        store holds a matching artifact, else None. The digest computation
+        (canonicalizing every op key) is memoized per sig; the existence
+        check re-runs each boundary — another worker may populate the
+        shared store at any time."""
+        from . import aot_cache as _aot
+        if not _aot.enabled():
+            return None
+        dg = st.aot_probe.get(sig, 0)
+        if dg == 0:
+            dg = _aot.step_digest(sig, opt, updated)
+            if len(st.aot_probe) > 64:
+                st.aot_probe.clear()
+            st.aot_probe[sig] = dg
+        if dg is not None and _aot.has_step(dg):
+            return dg
+        return None
+
+    def _build(self, st, cyc, sig, opt, updated, warm=False):
         """Compile-time qualification + program construction from the last
         observed cycle. Returns None when the cycle cannot promote — every
         None is attributed in the flight recorder (`unpromotable_cycle`
@@ -1330,10 +1388,16 @@ class _StepFusionManager:
         program.baseline_ns = time.perf_counter_ns() - cyc.t0
         program.donate_params = bool(
             _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
+        from . import aot_cache as _aot
+        if _aot.enabled():
+            dg = st.aot_probe.get(sig, 0)
+            program.aot_digest = dg if dg != 0 \
+                else _aot.step_digest(sig, opt, updated)
         STEP_STATS.promoted(program.label)
         _EVENTS.emit("step.promote", program.label,
                      detail={"ops": len(ops), "params": len(updated),
-                             "launches_estimate": program.n_launches})
+                             "launches_estimate": program.n_launches,
+                             "warm_start": warm})
         return program
 
     def _disable(self, st):
@@ -1356,6 +1420,7 @@ class _StepFusionManager:
         st = self._tls
         self._disable(st)
         st.library.clear()
+        st.aot_probe.clear()
 
     def info(self):
         st = self._tls

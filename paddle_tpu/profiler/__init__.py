@@ -22,6 +22,7 @@ from .chain_fusion import (ChainFusionStats, chain_fusion_stats,
                            reset_chain_fusion_stats)
 from .step_fusion import (StepFusionStats, step_fusion_stats,
                           reset_step_fusion_stats)
+from .aot import (AotCacheStats, aot_cache_stats, reset_aot_cache_stats)
 from .events import (EVENTS, CATEGORIES, REASON_CODES, FusionEventLog,
                      fusion_events, clear_fusion_events,
                      fusion_events_enabled, events_summary)
@@ -34,6 +35,7 @@ __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "chain_fusion_stats", "reset_chain_fusion_stats",
            "StepFusionStats", "step_fusion_stats",
            "reset_step_fusion_stats",
+           "AotCacheStats", "aot_cache_stats", "reset_aot_cache_stats",
            "CATEGORIES", "REASON_CODES", "FusionEventLog", "fusion_events",
            "clear_fusion_events", "fusion_events_enabled", "events_summary",
            "LoadedProfilerResult"]
@@ -398,6 +400,7 @@ def _fusion_summary_table(fusion_events, time_unit="ms"):
     block("dispatch_cache", dispatch_cache_stats())
     block("chain_fusion", chain_fusion_stats())
     block("step_fusion", step_fusion_stats())
+    block("aot_cache", aot_cache_stats())
     agg = events_summary(fusion_events)
     lines.append(f"fusion events ({agg['events']} in window):")
     for cat, n in agg["by_category"].items():

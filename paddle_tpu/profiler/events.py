@@ -84,6 +84,11 @@ CATEGORIES = frozenset({
     # crash-resume re-admission
     "serve.cancel", "serve.expire", "serve.refuse", "serve.hang",
     "serve.degrade", "serve.resume",
+    # persistent AOT executable cache (ops/aot_cache.py): warm-start
+    # loads, cold misses, artifact writes, quarantined corruption,
+    # environment-fingerprint skew, size/age eviction
+    "aot.hit", "aot.miss", "aot.store", "aot.corrupt",
+    "aot.version_skew", "aot.evict",
 })
 
 # Machine-readable causes. Stable across releases: the fusion doctor, the
@@ -134,6 +139,9 @@ REASON_CODES = frozenset({
     "decode_fault",        # the compiled decode faulted/was poisoned;
                            # requests fell back to eager generate()
     "crash_resume",        # an in-flight request re-admitted after restart
+    # -- AOT executable store decisions (ops/aot_cache.py) -----------------
+    "artifact_corrupt",    # torn/garbled artifact: quarantined + recompiled
+    "version_skew",        # artifact built under another env fingerprint
 })
 
 

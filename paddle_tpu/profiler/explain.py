@@ -168,6 +168,19 @@ REASON_HINTS = {
         "snapshot after a restart; resume re-prefills prompt + emitted "
         "tokens and continues byte-identically. Expected exactly once "
         "per interrupted request per restart."),
+    "artifact_corrupt": (
+        "an AOT store artifact failed its CRC/envelope check (torn "
+        "write, bit rot, truncation) — it was quarantined as *.corrupt "
+        "and the executable recompiled transparently. Frequent "
+        "occurrences point at the storage medium; `fusion_doctor "
+        "--cache` lists quarantined files, `--gc` removes them."),
+    "version_skew": (
+        "an AOT store artifact for this key was built under a different "
+        "environment fingerprint (jax/jaxlib/numpy version, backend, "
+        "device kind, kernel-routing flags) and was not deserialized — "
+        "the executable recompiled. Expected once per key after an "
+        "upgrade; persistent skew means mixed worker versions share one "
+        "store."),
 }
 
 
@@ -301,11 +314,36 @@ def explain(events=None):
                              and e.get("reason") is not None),
         }
 
+    # AOT executable store (aot.* events, ops/aot_cache.py): how much of
+    # the warmup came off disk, and whether any artifact was corrupt or
+    # version-skewed (each such decision must explain itself)
+    aot_reasons = {}
+    if any(e["cat"].startswith("aot.") for e in events):
+        aot_reasons = _attr(events,
+                            lambda e: e["cat"].startswith("aot.")
+                            and e.get("reason") is not None)
+        # aot.store events carry a `failed` detail when the export could
+        # not be serialized — those must not read as populated-store
+        # writes (aot_cache_stats() splits them as store_failures)
+        store_fails = sum(1 for e in events if e["cat"] == "aot.store"
+                          and (e.get("detail") or {}).get("failed"))
+        report["aot"] = {
+            "hits": n("aot.hit"),
+            "misses": n("aot.miss"),
+            "stores": n("aot.store") - store_fails,
+            "store_failures": store_fails,
+            "corrupt": n("aot.corrupt"),
+            "version_skew": n("aot.version_skew"),
+            "evicted": n("aot.evict"),
+            "reasons": aot_reasons,
+        }
+
     serve_reasons = (report.get("serving") or {}).get("reasons", {})
 
     findings = []
     unknown = sorted({r for src in (step_splits, poisons, chain_splits,
-                                    bypasses, guardian_ev, serve_reasons)
+                                    bypasses, guardian_ev, serve_reasons,
+                                    aot_reasons)
                       for r in src
                       if r not in REASON_CODES and r != "unattributed"})
     if unknown:
@@ -392,6 +430,13 @@ def explain(events=None):
     report["verdict"] = verdict
     report["headline"] = headline
 
+    for r, rec in sorted(aot_reasons.items(),
+                         key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        findings.append(
+            f"aot store {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
     for r, rec in sorted(serve_reasons.items(),
                          key=lambda kv: -kv[1]["count"]):
         ops = ", ".join(f"`{o}`×{c}" for o, c in
@@ -466,6 +511,12 @@ def format_report(report):
     if g:
         lines.append("guard : " + " ".join(
             f"{r}={rec['count']}" for r, rec in sorted(g.items())))
+    a = report.get("aot")
+    if a:
+        lines.append(
+            f"aot   : hits={a['hits']} misses={a['misses']} "
+            f"stores={a['stores']} corrupt={a['corrupt']} "
+            f"skew={a['version_skew']} evicted={a['evicted']}")
     sv = report.get("serving")
     if sv:
         lines.append(

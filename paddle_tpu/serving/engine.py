@@ -248,6 +248,11 @@ class LLMEngine:
         self._v_pools = self.cache.v_pools
         self._decode_fn = None
         self._prefill_fns = {}
+        # AOT warm start (ops/aot_cache.py): the decode digest is computed
+        # lazily (it CRCs the weights once); a pending-store tuple means
+        # the first successful decode step should persist the executable
+        self._aot_digest_cache = None
+        self._aot_pending_store = None
         self._next_rid = 0
         # rid -> Request: the id registry (duplicate-id checks, cancel(),
         # introspection). Terminal handles are retained until the caller
@@ -771,6 +776,7 @@ class LLMEngine:
                 self._recover_with_fallback(rebuild=False)
                 return None
             self._k_pools, self._v_pools = new_k, new_v
+            self._maybe_store_decode()
             return np.asarray(nxt)
 
     def _pools_consumed(self):
@@ -806,7 +812,7 @@ class LLMEngine:
                 self._fail(req, "step_hang")
             if consumed:
                 self._reset_kv_state()
-            self._decode_fn = self._build_decode()
+            self._decode_fn = self._build_decode(use_aot=False)
             return False
         if attempt == 1:
             # rung 1: transient host/device hiccup — retry the same
@@ -817,7 +823,7 @@ class LLMEngine:
             # (the retrace is honest: decode_compiles counts it, the
             # degrade event explains it)
             self._degrade("step_hang", {"rung": "rebuild"})
-            self._decode_fn = self._build_decode()
+            self._decode_fn = self._build_decode(use_aot=False)
         return True
 
     def _recover_with_fallback(self, rebuild):
@@ -830,7 +836,7 @@ class LLMEngine:
         if self._pools_consumed():
             self._reset_kv_state()
         if rebuild:
-            self._decode_fn = self._build_decode()
+            self._decode_fn = self._build_decode(use_aot=False)
 
     def _fallback_eager(self, req):
         """Finish one request via model.generate() from its prompt +
@@ -937,7 +943,64 @@ class LLMEngine:
         # only request it where it is real
         return argnums if jax.default_backend() != "cpu" else ()
 
-    def _build_decode(self):
+    def _aot_decode_digest(self):
+        """Content address of the decode executable: model class + config
+        + slot/pool geometry + a CRC over the weights, so a fine-tune or a
+        resized pool re-keys instead of replaying stale math. Computed
+        once per engine (the CRC walk is O(bytes), paid only with
+        FLAGS_aot_cache on)."""
+        if self._aot_digest_cache is not None:
+            return self._aot_digest_cache or None
+        from ..ops import aot_cache as _aot
+        import zlib
+        try:
+            crc = 0
+            for p in self._model.parameters():
+                v = np.asarray(p._value)
+                crc = zlib.crc32(repr((v.shape, str(v.dtype))).encode(),
+                                 crc)
+                crc = zlib.crc32(v.tobytes(), crc)
+            cfg = {k: v for k, v in vars(self._model.config).items()
+                   if isinstance(v, (int, float, bool, str, type(None)))}
+            dg = _aot._digest_of(
+                ("decode", type(self._model).__qualname__,
+                 tuple(sorted(cfg.items())), self.max_batch_size,
+                 self.block_size, self._num_blocks,
+                 self.max_blocks_per_seq, str(self._dtype), crc))
+        except Exception:
+            dg = None
+        self._aot_digest_cache = dg or ""
+        return dg
+
+    def _maybe_store_decode(self):
+        """Persist the decode executable after its first successful step
+        (the export re-traces `decode`, honestly counted by
+        decode_compiles — paid once, only in storing processes)."""
+        pending, self._aot_pending_store = self._aot_pending_store, None
+        if pending is None:
+            return
+        digest, jitted = pending
+        from ..ops import aot_cache as _aot
+        if not _aot.enabled() or _aot.has_artifact("decode", digest):
+            return
+        try:
+            specs = tuple(_aot._spec_of(a)
+                          for a in (self._tokens, self._tables,
+                                    self._lens, self._active,
+                                    self._k_pools, self._v_pools))
+            blobs = [_aot.export_bytes(jitted, specs)]
+        except Exception as e:
+            from ..profiler.aot import STATS as _ASTATS
+            _ASTATS.store_failures += 1
+            _EVENTS.emit("aot.store", "serve.decode",
+                         detail={"kind": "decode",
+                                 "failed": repr(e)[:200]})
+            return
+        _aot.store_artifact("decode", digest, "serve.decode", blobs,
+                            meta={"max_batch_size": self.max_batch_size,
+                                  "block_size": self.block_size})
+
+    def _build_decode(self, use_aot=True):
         model = self._model
         num_layers = model.config.num_hidden_layers
         block_size = self.block_size
@@ -958,7 +1021,24 @@ class LLMEngine:
                 .astype(jnp.int32)
             return nxt, new_k, new_v
 
-        return jax.jit(decode, donate_argnums=self._donate((4, 5)))
+        jitted = jax.jit(decode, donate_argnums=self._donate((4, 5)))
+        from ..ops import aot_cache as _aot
+        if use_aot and _aot.enabled():
+            # warm start: a restarted replica deserializes yesterday's
+            # decode program and serves its first token without a trace.
+            # The watchdog's rebuild rungs pass use_aot=False — a suspect
+            # program must be replaced by a FRESH trace, not by the very
+            # bytes that may embody the fault
+            digest = self._aot_decode_digest()
+            if digest is not None:
+                exe = _aot.load_callable(
+                    "decode", digest, "serve.decode",
+                    fallback=lambda: jitted,
+                    donate_argnums=self._donate((4, 5)))
+                if exe is not None:
+                    return exe
+                self._aot_pending_store = (digest, jitted)
+        return jitted
 
     def _build_prefill(self, bucket):
         model = self._model

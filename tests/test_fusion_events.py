@@ -117,6 +117,9 @@ class TestPublicContract:
             # serving resilience (PR 7, serving/resilience.py)
             "serve.cancel", "serve.expire", "serve.refuse", "serve.hang",
             "serve.degrade", "serve.resume",
+            # persistent AOT executable cache (PR 9, ops/aot_cache.py)
+            "aot.hit", "aot.miss", "aot.store", "aot.corrupt",
+            "aot.version_skew", "aot.evict",
         })
 
     def test_reason_codes_exact(self):
@@ -139,6 +142,8 @@ class TestPublicContract:
             "client_cancel", "deadline_expired", "queue_full",
             "deadline_infeasible", "step_hang", "decode_fault",
             "crash_resume",
+            # AOT executable-store decisions (PR 9, ops/aot_cache.py)
+            "artifact_corrupt", "version_skew",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

@@ -26,6 +26,21 @@ run WILL eventually hit:
              loss scale continue exactly, and the final parameters match an
              uninterrupted run.
 
+  warm_restart  PR 9: a training worker with the persistent AOT executable
+             cache armed (FLAGS_aot_cache, ops/aot_cache.py) is SIGKILLed
+             mid-run AFTER its fused step was promoted and stored. Must
+             hold: the restarted process (same store + StepCheckpointer
+             state) records ONE observation cycle and re-promotes the
+             fused step at its first boundary with ZERO fresh compiles —
+             no dispatch.retrace events, no chain compiles, no whole-step
+             retrace; every executable deserializes from the store
+             (aot.hit) — firing the restored step on the second cycle,
+             and the combined loss trajectory matches an uninterrupted
+             run. Then every artifact on disk is corrupted in place: a
+             fresh worker must degrade to transparent recompiles
+             (attributed `artifact_corrupt`, files quarantined), finish
+             the run with an identical trajectory, and never crash.
+
 Serving scenarios (PR 7), the same methodology against LLMEngine:
 
   serve_hang        an injected decode hang (guardian.inject_fault
@@ -479,6 +494,227 @@ def scenario_serve_kill():
 
 
 # ---------------------------------------------------------------------------
+# warm-restart scenario (PR 9): AOT store + StepCheckpointer child
+# ---------------------------------------------------------------------------
+
+def aot_child_main(args):
+    """One AOT-warm-startable training run (invoked as `chaos.py
+    --aot-child`): deterministic per-step batches, SGD, the persistent
+    executable store armed, StepCheckpointer ticking every step so a
+    restart resumes STATE from the checkpoint and COMPILATION from the
+    store. Writes a JSON report: per-step losses, the first loop
+    iteration (relative to this process) that fired a fused step, and the
+    compile/AOT event counts the parent asserts on."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.incubate.checkpoint import StepCheckpointer
+    from paddle_tpu.profiler import (dispatch_cache_stats,
+                                     chain_fusion_stats,
+                                     step_fusion_stats, aot_cache_stats)
+    from paddle_tpu.profiler.events import EVENTS
+
+    set_flags({"FLAGS_aot_cache": True,
+               "FLAGS_aot_cache_dir": args.aot_dir,
+               "FLAGS_eager_chain_fusion_min_count": 3,
+               "FLAGS_eager_step_fusion_min_count": 5,
+               "FLAGS_profiler_events": True})
+    paddle.seed(7)
+    rng = np.random.default_rng(11)
+    w = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32),
+                         stop_gradient=False)
+    bias = paddle.to_tensor(rng.standard_normal(8).astype(np.float32),
+                            stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[w, bias])
+    model = {"w": w, "b": bias}
+    ck = StepCheckpointer(args.ckpt_dir, save_every_n_steps=1,
+                          max_checkpoints=3)
+    resumed = ck.restore(model=model, optimizer=opt)
+    start = resumed + 1
+    kill_at = None if args.kill_at is None else int(args.kill_at)
+    losses = {}
+    first_fired_rel = None
+    # lead with clear_grad so the FIRST cycle already has the steady-state
+    # signature (clear_grad otherwise rides the next cycle): the restarted
+    # worker's very first boundary then matches the stored step artifact
+    opt.clear_grad()
+    for rel, step in enumerate(range(start, int(args.steps))):
+        if kill_at is not None and step == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+        srng = np.random.default_rng(1000 + step)
+        x = paddle.to_tensor(
+            srng.standard_normal((4, 8)).astype(np.float32))
+        loss = F.gelu(paddle.add(paddle.matmul(x, w), bias)).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first_fired_rel is None \
+                and step_fusion_stats()["fused_steps"] > 0:
+            first_fired_rel = rel
+        losses[str(step)] = float(loss)
+        ck.tick(step, model=model, optimizer=opt)
+    ev = EVENTS.snapshot()
+
+    def n(cat):
+        return sum(1 for e in ev if e["cat"] == cat)
+
+    report = {
+        "resumed_step": resumed,
+        "losses": losses,
+        "first_fired_rel": first_fired_rel,
+        "params": {"w": np.asarray(w._value).tolist(),
+                   "b": np.asarray(bias._value).tolist()},
+        "dispatch_retraces": dispatch_cache_stats()["retraces"],
+        "chain_retraces": chain_fusion_stats()["retraces"],
+        "step_retraces": step_fusion_stats()["retraces"],
+        "steps_promoted": step_fusion_stats()["steps_promoted"],
+        "fused_steps": step_fusion_stats()["fused_steps"],
+        "aot": aot_cache_stats(),
+        "events": {"dispatch_retrace": n("dispatch.retrace"),
+                   "chain_compile": n("chain.compile"),
+                   "step_promote": n("step.promote"),
+                   "step_fire": n("step.fire"),
+                   "aot_hit": n("aot.hit"),
+                   "aot_store": n("aot.store"),
+                   "aot_corrupt": n("aot.corrupt")},
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f)
+    return 0
+
+
+def _spawn_aot_child(aot_dir, ckpt_dir, out, steps, kill_at=None,
+                     timeout=300):
+    cmd = [sys.executable, os.path.abspath(__file__), "--aot-child",
+           "--aot-dir", aot_dir, "--ckpt-dir", ckpt_dir, "--out", out,
+           "--steps", str(steps)]
+    if kill_at is not None:
+        cmd += ["--kill-at", str(kill_at)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def scenario_warm_restart(steps=14, kill_at=9):
+    import numpy as np
+
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        store = os.path.join(tmp, "aot")
+        cold_store = os.path.join(tmp, "aot_cold")
+        out_warm = os.path.join(tmp, "warm.json")
+        out_ref = os.path.join(tmp, "ref.json")
+        out_cor = os.path.join(tmp, "corrupt.json")
+
+        # run 1: populate the store (fused step promotes at min_count 5,
+        # the artifact lands on the first fire), then die by SIGKILL
+        # mid-run — after promotion, before completion
+        r1 = _spawn_aot_child(store, os.path.join(tmp, "ck_a"), out_warm,
+                              steps, kill_at=kill_at)
+        if r1.returncode != -signal.SIGKILL:
+            failures.append(f"expected SIGKILL death, rc={r1.returncode} "
+                            f"stderr={r1.stderr[-500:]}")
+
+        # run 2: the warm restart — same store, same checkpoint dir
+        r2 = _spawn_aot_child(store, os.path.join(tmp, "ck_a"), out_warm,
+                              steps)
+        if r2.returncode != 0:
+            failures.append(f"warm restart failed: {r2.stderr[-800:]}")
+
+        # reference: uninterrupted run, cold store, fresh checkpoints
+        r3 = _spawn_aot_child(cold_store, os.path.join(tmp, "ck_b"),
+                              out_ref, steps)
+        if r3.returncode != 0:
+            failures.append(f"reference run failed: {r3.stderr[-800:]}")
+
+        warm = ref = None
+        if not failures:
+            with open(out_warm) as f:
+                warm = json.load(f)
+            with open(out_ref) as f:
+                ref = json.load(f)
+            if warm["resumed_step"] < 0:
+                failures.append("restart did not resume from the "
+                                "checkpoint")
+            # THE acceptance: zero fresh compiles in the restarted
+            # process — every executable deserialized from the store
+            for k in ("dispatch_retraces", "chain_retraces",
+                      "step_retraces"):
+                if warm[k] != 0:
+                    failures.append(
+                        f"warm restart paid {warm[k]} {k}: the store did "
+                        "not eliminate the warmup")
+            if warm["events"]["dispatch_retrace"] \
+                    or warm["events"]["chain_compile"]:
+                failures.append(
+                    f"warm restart emitted compile events: "
+                    f"{warm['events']}")
+            if warm["events"]["aot_hit"] < 3:
+                failures.append(
+                    f"warm restart loaded only "
+                    f"{warm['events']['aot_hit']} artifacts")
+            if warm["steps_promoted"] < 1:
+                failures.append("warm restart never promoted")
+            # promote at the FIRST boundary, fire on the next cycle
+            if warm["first_fired_rel"] is None \
+                    or warm["first_fired_rel"] > 1:
+                failures.append(
+                    f"first fused fire at relative cycle "
+                    f"{warm['first_fired_rel']} (expected <= 1: promote "
+                    "at the first boundary, fire on the next)")
+            # loss trajectory: killed-run prefix is gone, but the warm
+            # restart's steps must match the uninterrupted reference at
+            # the same global indices (the fused ONE-program layout
+            # differs from per-op dispatch in the last ULP)
+            for k, v in warm["losses"].items():
+                if abs(v - ref["losses"][k]) > 1e-4:
+                    failures.append(
+                        f"loss diverged at step {k}: {v} vs "
+                        f"{ref['losses'][k]}")
+                    break
+            for k in ("w", "b"):
+                a = np.asarray(warm["params"][k])
+                c = np.asarray(ref["params"][k])
+                if not np.allclose(a, c, rtol=0, atol=1e-5):
+                    failures.append(
+                        f"param {k} diverged after warm restart "
+                        f"(max |Δ|={np.max(np.abs(a - c)):.3e})")
+
+        # corruption leg: flip a byte mid-payload in EVERY artifact — a
+        # fresh worker must quarantine + recompile, never crash
+        import glob as _glob
+        for p in _glob.glob(os.path.join(store, "*.aot")):
+            with open(p, "rb") as f:
+                data = bytearray(f.read())
+            data[len(data) // 2] ^= 0xFF
+            with open(p, "wb") as f:
+                f.write(data)
+        r4 = _spawn_aot_child(store, os.path.join(tmp, "ck_c"), out_cor,
+                              steps)
+        if r4.returncode != 0:
+            failures.append(
+                f"corrupted store crashed the worker: {r4.stderr[-800:]}")
+        elif not failures:
+            with open(out_cor) as f:
+                cor = json.load(f)
+            if cor["events"]["aot_corrupt"] < 1:
+                failures.append("corrupted artifacts were not attributed "
+                                "artifact_corrupt")
+            if cor["steps_promoted"] < 1:
+                failures.append("worker did not re-promote after "
+                                "recompiling corrupt artifacts")
+            for k, v in cor["losses"].items():
+                if abs(v - ref["losses"][k]) > 1e-4:
+                    failures.append(
+                        f"corruption-leg loss diverged at step {k}")
+                    break
+            if not _glob.glob(os.path.join(store, "*.corrupt")):
+                failures.append("corrupt artifacts were not quarantined")
+    return {"ok": not failures, "failures": failures}
+
+
+# ---------------------------------------------------------------------------
 # kill scenario: child training loop + parent orchestration
 # ---------------------------------------------------------------------------
 
@@ -617,7 +853,8 @@ def scenario_kill(epochs=3, steps=6):
 # ---------------------------------------------------------------------------
 
 SCENARIOS = {"nan": scenario_nan, "exception": scenario_exception,
-             "kill": scenario_kill, "serve_hang": scenario_serve_hang,
+             "kill": scenario_kill, "warm_restart": scenario_warm_restart,
+             "serve_hang": scenario_serve_hang,
              "serve_fused_fault": scenario_serve_fused_fault,
              "serve_kill": scenario_serve_kill}
 
@@ -632,7 +869,10 @@ def main(argv=None):
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--serve-child", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--aot-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
+    ap.add_argument("--aot-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
     ap.add_argument("--epochs", type=int, default=3, help=argparse.SUPPRESS)
     ap.add_argument("--steps", type=int, default=6, help=argparse.SUPPRESS)
@@ -643,6 +883,8 @@ def main(argv=None):
         return child_main(args)
     if args.serve_child:
         return serve_child_main(args)
+    if args.aot_child:
+        return aot_child_main(args)
 
     names = sorted(SCENARIOS) if args.scenario == "all" else [args.scenario]
     report = {}

@@ -24,6 +24,11 @@ Usage:
     # machine-readable
     python tools/fusion_doctor.py --demo dropout --json
 
+    # the persistent AOT executable store (ops/aot_cache.py): list
+    # artifacts (kind, digest, size, age, fingerprint match, corruption),
+    # and collect it manually
+    python tools/fusion_doctor.py --cache [--cache-dir DIR] [--gc]
+
 The doctor only ARMS the recorder (FLAGS_profiler_events); it does not
 change the fusion configuration of a user script — if the script runs with
 caching/fusion off, the report says so instead of inventing activity.
@@ -136,6 +141,64 @@ def _demo_serve(steps):
     engine.run()
 
 
+def _cache_report(args) -> int:
+    """`fusion_doctor --cache`: list the AOT executable store (kind,
+    digest, size, age, environment-fingerprint match, label), report
+    corrupt/quarantined/skewed entries, and with `--gc` run the size/age
+    eviction manually."""
+    from paddle_tpu.ops import aot_cache
+
+    root = args.cache_dir or aot_cache.cache_dir()
+    entries = aot_cache.store_entries(root)
+    removed = []
+    if args.gc:
+        # the listing just CRC-verified every artifact: quarantine the
+        # ones that failed so the sweep below removes them too
+        for e in entries:
+            if e["corrupt"] and not e["quarantined"]:
+                p = os.path.join(root, e["file"])
+                try:
+                    os.replace(p, p + ".corrupt")
+                except OSError:
+                    pass
+        removed = aot_cache.gc_store(root, purge_quarantine=True)
+        entries = aot_cache.store_entries(root)
+    n_corrupt = sum(1 for e in entries if e["corrupt"] or e["quarantined"])
+    n_skew = sum(1 for e in entries
+                 if e["fingerprint_match"] is False and not e["corrupt"]
+                 and not e["quarantined"])
+    total = sum(e["bytes"] for e in entries)
+    if args.json:
+        print(json.dumps({
+            "dir": root, "entries": entries, "total_bytes": total,
+            "corrupt": n_corrupt, "version_skew": n_skew,
+            "fingerprint": aot_cache.fingerprint_digest(),
+            "evicted": removed}, indent=2))
+        return 0
+    print(f"AOT executable store: {root}")
+    print(f"  fingerprint {aot_cache.fingerprint_digest()} | "
+          f"{len(entries)} artifact(s), {total / 1024:.1f} KiB | "
+          f"{n_corrupt} corrupt/quarantined, {n_skew} version-skewed")
+    if removed:
+        print(f"  gc removed {len(removed)} file(s): "
+              + ", ".join(removed[:8])
+              + (" …" if len(removed) > 8 else ""))
+    if entries:
+        print(f"  {'kind':<7} {'digest':<12} {'size':>9} {'age':>8} "
+              f"{'fp':>4} {'state':<8} label")
+        for e in entries:
+            state = ("QUARANT" if e["quarantined"]
+                     else "CORRUPT" if e["corrupt"] else "ok")
+            fp = {True: "ok", False: "SKEW", None: "?"}[
+                e["fingerprint_match"]]
+            age = e["age_s"]
+            age_s = f"{age / 3600:.1f}h" if age >= 3600 else f"{age:.0f}s"
+            print(f"  {e['kind']:<7} {e.get('digest', '?')[:12]:<12} "
+                  f"{e['bytes']:>9} {age_s:>8} {fp:>4} {state:<8} "
+                  f"{e['label'] or ''}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="fusion_doctor",
@@ -154,7 +217,20 @@ def main(argv=None) -> int:
                          "default 20)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as JSON instead of text")
+    ap.add_argument("--cache", action="store_true",
+                    help="inspect the persistent AOT executable store "
+                         "(ops/aot_cache.py) instead of running a script: "
+                         "list artifacts with fingerprint/corruption "
+                         "state; combine with --gc to evict")
+    ap.add_argument("--cache-dir", default=None,
+                    help="AOT store root (default: the configured "
+                         "FLAGS_aot_cache_dir / $PADDLE_TPU_CACHE_DIR/aot)")
+    ap.add_argument("--gc", action="store_true",
+                    help="with --cache: run the size/age eviction now "
+                         "(also removes quarantined *.corrupt files)")
     args = ap.parse_args(argv)
+    if args.cache:
+        return _cache_report(args)
     if not args.demo and not args.script:
         ap.error("either a script or --demo is required")
 

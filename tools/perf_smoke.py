@@ -41,7 +41,14 @@ stack silently regressed:
     must never sleep on a healthy step), and the decode executable must
     STILL compile exactly once while requests are cancelled, expired,
     refused, and crash-resumed around it — resilience is value edits to
-    the fixed slot layout, never shapes (a PR 7 regression).
+    the fixed slot layout, never shapes (a PR 7 regression);
+  * AOT warm start — a fresh subprocess against a WARM persistent
+    executable store (FLAGS_aot_cache, ops/aot_cache.py) must reach a
+    promoted fused step with ZERO compile activity (no dispatch
+    retraces, no chain compiles, no whole-step retrace — everything
+    deserializes) and measurably faster time-to-first-promoted-step
+    than the cold subprocess that populated the store (a PR 9
+    regression).
 
 Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
 in tests/test_chain_fusion.py and tests/test_step_fusion.py — this CLI is
@@ -61,6 +68,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 WARMUP = 14
 MEASURE = 40
+# warm-start guard: a warm store must reach the first PROMOTED FUSED step
+# in at most this fraction of the cold process's time-to-first-fire (the
+# cold path pays per-op traces + the whole-step trace + XLA compiles; the
+# warm path only deserializes) — loose enough for loaded CI boxes, tight
+# enough that "the store stopped eliminating the warmup" fails loudly
+AOT_WARM_RATIO_GUARD = 0.85
 # CLI guard is looser than the pytest acceptance bound (1.3x): the smoke
 # must stay green on loaded CI boxes while still catching a real loss of
 # whole-step fusion (which is worth ~1.9x on an idle machine)
@@ -114,6 +127,121 @@ def _loop(step_fused, check_numerics=False, use_scaler=False):
 
     step.sync = sync
     return step
+
+
+def aot_child_main(aot_dir, out_path, steps=12) -> int:
+    """Warm-start measurement child (`perf_smoke.py --aot-child`): a tiny
+    fwd+bwd+SGD loop with the AOT executable store armed. Reports the
+    wall time from loop start to the FIRST fused whole-step fire plus the
+    compile/AOT counters — the parent runs it once cold (empty store) and
+    again warm (populated store) and guards the ratio. Shared with
+    tests/test_aot_cache.py so the pytest guard and this CLI can never
+    drift."""
+    import json
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.profiler import (dispatch_cache_stats,
+                                     chain_fusion_stats,
+                                     step_fusion_stats, aot_cache_stats)
+
+    set_flags({"FLAGS_aot_cache": True,
+               "FLAGS_aot_cache_dir": aot_dir,
+               "FLAGS_eager_chain_fusion_min_count": 3,
+               "FLAGS_eager_step_fusion_min_count": 5})
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((16, 32)).astype(np.float32))
+    w = paddle.to_tensor(rng.standard_normal((32, 32)).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(rng.standard_normal(32).astype(np.float32),
+                         stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1e-3, parameters=[w, b])
+    opt.clear_grad()        # steady-state cycle signature from cycle 1
+    t0 = time.perf_counter()
+    t_first_fire = None
+    for _ in range(steps):
+        loss = F.gelu(paddle.add(paddle.matmul(x, w), b)).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if t_first_fire is None \
+                and step_fusion_stats()["fused_steps"] > 0:
+            t_first_fire = time.perf_counter() - t0
+    report = {
+        "t_first_fire_s": t_first_fire,
+        "dispatch_retraces": dispatch_cache_stats()["retraces"],
+        "chain_retraces": chain_fusion_stats()["retraces"],
+        "step_retraces": step_fusion_stats()["retraces"],
+        "steps_promoted": step_fusion_stats()["steps_promoted"],
+        "fused_steps": step_fusion_stats()["fused_steps"],
+        "aot": aot_cache_stats(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f)
+    return 0
+
+
+def _aot_warm_start_leg(failures):
+    """Leg (h), PR 9: a fresh subprocess against a WARM store must reach
+    a promoted fused step with zero compile activity — no dispatch
+    retraces, no chain compiles, no whole-step retrace — and measurably
+    faster than the cold subprocess that populated the store (min over
+    two warm runs, same best-window hygiene as the guardian leg)."""
+    import json
+    import subprocess
+    import tempfile
+
+    def run(aot_dir, out):
+        cmd = [sys.executable, os.path.abspath(__file__), "--aot-child",
+               "--aot-dir", aot_dir, "--out", out]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=300, env=env)
+        if r.returncode != 0:
+            raise RuntimeError(f"aot child failed: {r.stderr[-800:]}")
+        with open(out) as f:
+            rep = json.load(f)
+        if rep["t_first_fire_s"] is None:
+            # a child that never fired must FAIL the guard below, not
+            # crash the ratio math / report formatting with a TypeError
+            rep["t_first_fire_s"] = float("nan")
+        return rep
+
+    with tempfile.TemporaryDirectory() as tmp:
+        store = os.path.join(tmp, "store")
+        cold = run(store, os.path.join(tmp, "cold.json"))
+        warms = [run(store, os.path.join(tmp, f"warm{i}.json"))
+                 for i in range(2)]
+    warm = min(warms, key=lambda r: r["t_first_fire_s"] or 1e9)
+    if cold["fused_steps"] == 0 or cold["aot"]["stores"] == 0:
+        failures.append(
+            "cold AOT child never promoted/stored — the warm-start leg "
+            "has nothing to measure (PR 9 guard bug)")
+        return cold, warm
+    for r in warms:
+        if r["fused_steps"] == 0:
+            failures.append("warm AOT child never fired a fused step "
+                            "(PR 9 regression)")
+        for k in ("dispatch_retraces", "chain_retraces", "step_retraces"):
+            if r[k] != 0:
+                failures.append(
+                    f"warm AOT child paid {r[k]} {k}: the store stopped "
+                    "eliminating the warmup (PR 9 regression)")
+        if r["aot"]["hits"] == 0:
+            failures.append("warm AOT child loaded no artifacts "
+                            "(PR 9 regression)")
+    ratio = warm["t_first_fire_s"] / cold["t_first_fire_s"] \
+        if cold["t_first_fire_s"] else float("inf")
+    if ratio >= AOT_WARM_RATIO_GUARD:
+        failures.append(
+            f"warm-store time-to-first-promoted-step is {ratio:.2f}x the "
+            f"cold run ({warm['t_first_fire_s']:.2f}s vs "
+            f"{cold['t_first_fire_s']:.2f}s, guard "
+            f"{AOT_WARM_RATIO_GUARD}): the AOT store lost its win "
+            "(PR 9 regression)")
+    return cold, warm
 
 
 def main() -> int:
@@ -453,6 +581,12 @@ def main() -> int:
             f"expired={cstats['expired']}, resumed={len(resumed)}) "
             "(PR 7 guard bug)")
 
+    # ---- AOT warm-start leg (PR 9 guard) ---------------------------------
+    # (h) a fresh subprocess with a warm executable store must promote its
+    # fused step with zero compile activity and beat the cold subprocess's
+    # time-to-first-promoted-step
+    aot_cold, aot_warm = _aot_warm_start_leg(failures)
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -473,7 +607,12 @@ def main() -> int:
           f"resilience overhead={resil_overhead * 100:.1f}%/step "
           f"(churn compiles={cstats['decode_compiles']}, "
           f"cancelled={cstats['cancelled']} expired={cstats['expired']} "
-          f"refused={refused} resumed={len(resumed)})")
+          f"refused={refused} resumed={len(resumed)}), "
+          f"aot warm-start={aot_warm['t_first_fire_s']:.2f}s vs "
+          f"cold={aot_cold['t_first_fire_s']:.2f}s "
+          f"(warm hits={aot_warm['aot']['hits']} "
+          f"retraces={aot_warm['dispatch_retraces']}"
+          f"+{aot_warm['step_retraces']})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
@@ -483,4 +622,13 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--aot-child" in sys.argv:
+        import argparse
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--aot-child", action="store_true")
+        ap.add_argument("--aot-dir", required=True)
+        ap.add_argument("--out", required=True)
+        ap.add_argument("--steps", type=int, default=12)
+        a = ap.parse_args()
+        sys.exit(aot_child_main(a.aot_dir, a.out, a.steps))
     sys.exit(main())
