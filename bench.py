@@ -459,6 +459,109 @@ def _bench_serve(streams):
     return run
 
 
+def bench_dp8(on_tpu):
+    """Multichip leg: a dp=8 data-parallel training loop that auto-promotes
+    into ONE shard_map executable per step (ops/spmd_fusion.py), measured
+    against the same loop with step fusion off (per-op eager dispatch with
+    GSPMD-inserted collectives). On CPU the 8 devices are emulated
+    (xla_force_host_platform_device_count, same harness as the Fleet
+    dryruns / MULTICHIP_r0N.json); on TPU the real chips form the mesh."""
+    import jax
+    if not on_tpu and jax.device_count() < 8:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _force_virtual_cpu_mesh
+        _force_virtual_cpu_mesh(8)
+        import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache
+    from paddle_tpu.ops.step_fusion import step_cache_info
+    from paddle_tpu.profiler.step_fusion import STEP_STATS
+
+    n = min(jax.device_count(), 8)
+    mesh = build_mesh(dp=n, pp=1, sharding=1, sep=1, mp=1,
+                      devices=jax.devices()[:n])
+    set_global_mesh(mesh)
+    sharding = NamedSharding(mesh, P("data"))
+    B, D_IN, D_H, D_OUT = 8 * n, 128, 256, 64
+    warmup, steps = 12, 40
+    rng = np.random.default_rng(0)
+    xv = jax.device_put(
+        rng.standard_normal((B, D_IN)).astype(np.float32), sharding)
+    yv = jax.device_put(
+        rng.standard_normal((B, D_OUT)).astype(np.float32), sharding)
+
+    def timed_loop(fused):
+        set_flags({"FLAGS_eager_op_cache": True,
+                   "FLAGS_eager_chain_fusion": True,
+                   "FLAGS_eager_chain_fusion_min_count": 4,
+                   "FLAGS_eager_step_fusion": fused,
+                   "FLAGS_eager_step_fusion_min_count": 5})
+        clear_dispatch_cache()
+        paddle.seed(0)
+        ri = np.random.default_rng(1)
+        w1 = paddle.to_tensor(
+            (ri.standard_normal((D_IN, D_H)) * 0.05).astype(np.float32),
+            stop_gradient=False)
+        b1 = paddle.to_tensor(np.zeros(D_H, np.float32),
+                              stop_gradient=False)
+        w2 = paddle.to_tensor(
+            (ri.standard_normal((D_H, D_OUT)) * 0.05).astype(np.float32),
+            stop_gradient=False)
+        opt = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                        parameters=[w1, b1, w2])
+        x = paddle.Tensor(xv, stop_gradient=True)
+        y = paddle.Tensor(yv, stop_gradient=True)
+
+        def step():
+            h = F.relu(paddle.add(paddle.matmul(x, w1), b1))
+            out = paddle.matmul(h, w2)
+            diff = paddle.subtract(out, y)
+            loss = paddle.mean(paddle.multiply(diff, diff))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        for _ in range(warmup):
+            step()
+        jax.block_until_ready(w1._value)
+        r0 = STEP_STATS.retraces
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step()
+        jax.block_until_ready(w1._value)
+        return (time.perf_counter() - t0) / steps, \
+            STEP_STATS.retraces - r0
+
+    eager_s, _ = timed_loop(False)
+    fused_s, retraces = timed_loop(True)
+    info = step_cache_info()
+    spmd = next((p["spmd"] for p in info["programs"]
+                 if not p["dead"] and p["spmd"]), None)
+    samples_per_sec = B / fused_s
+    platform = jax.devices()[0].platform
+    return {
+        "metric": "dp8_fused_samples_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/s",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "extra": {
+            "n_devices": n, "mesh": spmd, "batch_global": B,
+            "fused_ms_per_step": round(fused_s * 1e3, 3),
+            "eager_ms_per_step": round(eager_s * 1e3, 3),
+            "speedup_vs_eager_collectives": round(eager_s / fused_s, 3),
+            "retraces_post_promotion": retraces,
+            "step_fusion": STEP_STATS.snapshot(),
+            "platform": platform,
+        },
+    }
+
+
 # --------------------------------------------------------------------------
 # child / parent plumbing
 # --------------------------------------------------------------------------
@@ -472,13 +575,14 @@ CONFIG_FNS = {
     "flash4096": bench_flash4096,
     "gpt2_355m": bench_gpt2_355m,
     "gpt2_train": bench_gpt2_train,
+    "dp8": bench_dp8,
 }
 
 # per-config hard timeouts (seconds) when the probe said TPU; CPU smoke
 # versions are tiny and get a flat cap
 TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
             "serve_64": 150, "flash4096": 210, "gpt2_355m": 240,
-            "gpt2_train": 280}
+            "gpt2_train": 280, "dp8": 180}
 CPU_CAP = 150
 HEADLINE = "gpt2_train"
 HEADLINE_RESERVE = 300      # wall-clock held back for the headline config
@@ -491,6 +595,13 @@ def _child_probe():
 
 
 def _child_config(name, platform, budget_s):
+    if name == "dp8" and platform == "cpu":
+        # the multichip leg needs its 8 emulated devices BEFORE the first
+        # backend init — XLA parses this env var only once per process
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
     if platform == "cpu":
         # force CPU in-process: the axon sitecustomize pre-imports jax with
         # the tunnel platform, so JAX_PLATFORMS=cpu in the env does nothing
@@ -578,7 +689,7 @@ def main():
 
     results = {}
     for name in ("vit", "decode", "serve_1", "serve_8", "serve_64",
-                 "flash4096", "gpt2_355m"):
+                 "flash4096", "gpt2_355m", "dp8"):
         avail = remaining() - HEADLINE_RESERVE
         if avail < 45:
             results[name] = {"metric": name, "skipped": "budget_exhausted",

@@ -318,28 +318,92 @@ def _multi_process(group):
     return group.nranks > 1 and jax.process_count() > 1
 
 
+# ---------------------------------------------------------------------------
+# dispatch-funnel routing: collectives are KEYED eager ops
+# ---------------------------------------------------------------------------
+# Real-work collectives (all_reduce / all_gather / broadcast / scatter /
+# reduce_scatter / alltoall(_single)) go through ops/dispatch.call_op with
+# a canonical collective key — (kind, reduce op, mesh key of the group) —
+# so they land in the per-op executable cache, the chain detector, and the
+# step-cycle recorder like any other op (the fusion stack's collective
+# awareness, ops/spmd_fusion.py, starts here; the host-mediated p2p family
+# stays control-plane). A Group with no mesh-backed process group cannot
+# be keyed: its collective dispatches as an explicit `collective_unkeyed`
+# bypass, which poisons the observation cycle with a reason the fusion
+# doctor reports directly ("step never promoted: `dist.all_reduce`
+# collective_unkeyed ×N").
+
+def _collective_key(kind, op, group, *extra):
+    from .mesh import mesh_key
+    pg = getattr(group, "pg", None)
+    mk = mesh_key(getattr(pg, "mesh", None))
+    if mk is None:
+        return None
+    return (kind, op, mk) + tuple(extra)
+
+
+def _dispatch_collective(name, fn, tensor, key):
+    """Run a collective's value function through the eager dispatch
+    funnel (no-grad: collectives are data-plane ops, not tape nodes)."""
+    from ..ops.dispatch import call_op, mark_collective
+    from ..framework.autograd import no_grad
+    mark_collective(fn, key)
+    with no_grad():
+        return call_op(name, fn, [tensor])
+
+
+def _unkeyed_group(group):
+    """True for a hand-built Group with nranks>1 but no mesh-backed
+    process group — its collectives can be neither keyed nor fused."""
+    return group.nranks > 1 and getattr(group, "pg", None) is None
+
+
+def _dispatch_unkeyed(name, tensor):
+    """Attribute an unkeyable collective in the flight recorder (and
+    poison any step cycle in observation) by dispatching its identity
+    through the funnel with the unkeyable-collective marker."""
+    _dispatch_collective(name, lambda v: v, tensor, None)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce of `tensor` across the group.
 
-    Single-process groups are the identity (one controller owns all data);
-    multi-process uses psum over the global process mesh.
+    Single-process groups are the identity (one controller owns all data —
+    in the sharded single-controller world the gradient sync is the psum
+    the SPMD step promoter fuses in, ops/spmd_fusion.py); multi-process
+    dispatches a KEYED collective op through the eager funnel.
     """
     group = _group_or_default(group)
+    if _unkeyed_group(group):
+        _dispatch_unkeyed("dist.all_reduce", tensor)
+        return Task([tensor._value])
     if group.nranks == 1 or not _multi_process(group):
         return Task([tensor._value])
     pg = group.pg
-    tensor._value = pg.all_reduce(tensor._value, op)
+    out = _dispatch_collective(
+        "dist.all_reduce", lambda v: pg.all_reduce(v, op), tensor,
+        _collective_key("all_reduce", op, group))
+    tensor._value = out._value
     return Task([tensor._value])
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     group = _group_or_default(group)
+    if _unkeyed_group(group):
+        _dispatch_unkeyed("dist.all_gather", tensor)
+        tensor_list.clear()
+        tensor_list.append(tensor.clone() if hasattr(tensor, "clone")
+                           else tensor)
+        return Task([tensor._value])
     if group.nranks == 1 or not _multi_process(group):
         tensor_list.clear()
         tensor_list.append(tensor.clone() if hasattr(tensor, "clone")
                            else tensor)
         return Task([tensor._value])
-    rows = group.pg.gather_all(tensor._value)
+    pg = group.pg
+    rows = _dispatch_collective(
+        "dist.all_gather", lambda v: pg.gather_all(v), tensor,
+        _collective_key("all_gather", None, group))._value
     tensor_list.clear()
     tensor_list.extend(Tensor(rows[i], stop_gradient=True)
                        for i in range(group.nranks))
@@ -375,10 +439,17 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def broadcast(tensor, src, group=None, sync_op=True):
     group = _group_or_default(group)
+    if _unkeyed_group(group):
+        _dispatch_unkeyed("dist.broadcast", tensor)
+        return Task([tensor._value])
     if group.nranks == 1 or not _multi_process(group):
         return Task([tensor._value])
-    src_index = group.get_group_rank(src)
-    tensor._value = group.pg.broadcast(tensor._value, max(src_index, 0))
+    pg = group.pg
+    src_index = max(group.get_group_rank(src), 0)
+    out = _dispatch_collective(
+        "dist.broadcast", lambda v: pg.broadcast(v, src_index), tensor,
+        _collective_key("broadcast", None, group, src_index))
+    tensor._value = out._value
     return Task([tensor._value])
 
 
@@ -389,6 +460,11 @@ def _my_index(group):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     group = _group_or_default(group)
+    if _unkeyed_group(group):
+        _dispatch_unkeyed("dist.scatter", tensor)
+        if tensor_list:
+            tensor._assign_value_(tensor_list[0]._value)
+        return Task([tensor._value])
     if group.nranks == 1 or not _multi_process(group):
         if tensor_list:
             tensor._assign_value_(tensor_list[0]._value)
@@ -403,19 +479,32 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     else:   # non-src ranks contribute a same-shaped placeholder
         stacked = jnp.zeros((n,) + tuple(tensor._value.shape),
                             tensor._value.dtype)
-    rows = group.pg.broadcast(stacked, src_index)
+    pg = group.pg
+    rows = _dispatch_collective(
+        "dist.scatter", lambda v: pg.broadcast(v, src_index),
+        Tensor(stacked, stop_gradient=True),
+        _collective_key("scatter", None, group, src_index))._value
     tensor._assign_value_(rows[_my_index(group)])
     return Task([tensor._value])
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     group = _group_or_default(group)
+    if _unkeyed_group(group) and in_tensor_list:
+        _dispatch_unkeyed("dist.alltoall", in_tensor_list[0])
+        out_tensor_list.clear()
+        out_tensor_list.extend(in_tensor_list)
+        return Task([t._value for t in in_tensor_list])
     if group.nranks == 1 or not _multi_process(group):
         out_tensor_list.clear()
         out_tensor_list.extend(in_tensor_list)
         return Task([t._value for t in in_tensor_list])
     stacked = jnp.stack([t._value for t in in_tensor_list])   # [n, chunk...]
-    mine = group.pg.alltoall(stacked)
+    pg = group.pg
+    mine = _dispatch_collective(
+        "dist.alltoall", lambda v: pg.alltoall(v),
+        Tensor(stacked, stop_gradient=True),
+        _collective_key("alltoall", None, group))._value
     out_tensor_list.clear()
     out_tensor_list.extend(Tensor(mine[i], stop_gradient=True)
                            for i in range(group.nranks))
@@ -425,6 +514,8 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
 def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     group = _group_or_default(group)
+    if _unkeyed_group(group):
+        _dispatch_unkeyed("dist.alltoall", in_tensor)
     if group.nranks == 1 or not _multi_process(group):
         out_tensor._assign_value_(in_tensor._value)
         return Task([out_tensor._value])
@@ -439,7 +530,11 @@ def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
             f"alltoall_single dim0 ({v.shape[0]}) must divide the group "
             f"size {n}")
     rows = v.reshape((n, v.shape[0] // n) + tuple(v.shape[1:]))
-    mine = group.pg.alltoall(rows)
+    pg = group.pg
+    mine = _dispatch_collective(
+        "dist.alltoall", lambda x: pg.alltoall(x),
+        Tensor(rows, stop_gradient=True),
+        _collective_key("alltoall", None, group))._value
     out_tensor._assign_value_(mine.reshape(v.shape))
     return Task([out_tensor._value])
 
@@ -447,6 +542,8 @@ def alltoall_single(in_tensor, out_tensor, in_split_sizes=None,
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     group = _group_or_default(group)
+    if _unkeyed_group(group):
+        _dispatch_unkeyed("dist.reduce_scatter", tensor)
     if group.nranks == 1 or not _multi_process(group):
         acc = tensor_list[0]._value
         for t in tensor_list[1:]:
@@ -454,7 +551,11 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         tensor._assign_value_(acc if group.nranks == 1 else acc)
         return Task([tensor._value])
     rows = jnp.stack([t._value for t in tensor_list])         # [n, chunk...]
-    mine = group.pg.reduce_scatter(rows, op)
+    pg = group.pg
+    mine = _dispatch_collective(
+        "dist.reduce_scatter", lambda v: pg.reduce_scatter(v, op),
+        Tensor(rows, stop_gradient=True),
+        _collective_key("reduce_scatter", op, group))._value
     tensor._assign_value_(mine)
     return Task([tensor._value])
 

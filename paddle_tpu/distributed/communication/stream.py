@@ -6,7 +6,11 @@ ProcessGroupStream semantics).
 TPU-first: XLA owns stream assignment and comm/compute overlap (async
 collectives + the latency-hiding scheduler), so `use_calc_stream` is a
 no-op knob accepted for API parity; `sync_op=False` returns the same
-awaitable Task the eager API returns."""
+awaitable Task the eager API returns. Every primitive here delegates to
+distributed/collective.py and therefore rides the KEYED dispatch funnel:
+real-work collectives land in the per-op executable cache and the
+step-cycle recorder, and groups without a mesh-backed process group are
+attributed `collective_unkeyed` (ops/spmd_fusion.py)."""
 from __future__ import annotations
 
 from .. import collective as _c

@@ -1,10 +1,21 @@
-"""Global device-mesh management.
+"""Global device-mesh management + the mesh runtime surface.
 
 Reference analog: HybridCommunicateGroup's CommunicateTopology
 (fleet/base/topology.py:50) — an N-D cartesian rank space with axes
 ["data","pipe","sharding","sep","model"]. TPU-first: the topology IS a
 jax.sharding.Mesh over physical devices; ICI-adjacency comes from jax's device
 ordering (mesh_utils for real TPU slices).
+
+The runtime surface (`mesh_key` / `topology_token` / `value_mesh_and_spec`)
+is what the fusion stack keys on: the dispatch funnel keys collective ops by
+the canonical mesh they run over (ops/dispatch.py `collective_unkeyed`
+bypasses when no key can be derived), the SPMD step promoter
+(ops/spmd_fusion.py) classifies recorded cycle inputs by their placement on
+a mesh, and the persistent AOT store folds the topology into its environment
+fingerprint so a single-chip artifact can never deserialize into a sharded
+process. `set_global_mesh` bumps a generation counter exactly like the flag
+store, so fingerprint memos derived from the topology invalidate instead of
+going stale.
 """
 from __future__ import annotations
 
@@ -12,13 +23,18 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["build_mesh", "get_global_mesh", "set_global_mesh", "AXIS_ORDER"]
+__all__ = ["build_mesh", "get_global_mesh", "set_global_mesh", "AXIS_ORDER",
+           "mesh_key", "topology_token", "mesh_generation",
+           "value_mesh_and_spec", "current_mesh"]
 
 # reference axis order (fleet/fleet.py:405: ["data","pipe","sharding","model"]
 # + "sep" in later revisions); kept as the canonical ordering here
 AXIS_ORDER = ("data", "pipe", "sharding", "sep", "model")
 
 _global_mesh = None
+# bumped on every set_global_mesh: topology-derived memos (the AOT env
+# fingerprint) key on it so a mid-run mesh swap re-fingerprints
+_MESH_GENERATION = 0
 
 
 def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None):
@@ -57,5 +73,80 @@ def get_global_mesh():
 
 
 def set_global_mesh(mesh):
-    global _global_mesh
+    global _global_mesh, _MESH_GENERATION
     _global_mesh = mesh
+    _MESH_GENERATION += 1
+
+
+def current_mesh():
+    """The global mesh if one was SET (or lazily built); never builds one.
+    Fingerprints and keying must observe the topology, not create it."""
+    return _global_mesh
+
+
+def mesh_generation():
+    return _MESH_GENERATION
+
+
+def mesh_key(mesh):
+    """Canonical hashable identity of a Mesh: axis names + sizes + the
+    device ids in mesh order + platform. Two Mesh objects over the same
+    devices in the same arrangement key equal; anything un-introspectable
+    returns None (→ the caller must treat the mesh as unkeyable)."""
+    if mesh is None:
+        return None
+    try:
+        devs = tuple(int(d.id) for d in mesh.devices.flat)
+        platform = mesh.devices.flat[0].platform
+        return (tuple(mesh.axis_names),
+                tuple(int(s) for s in mesh.devices.shape),
+                devs, platform)
+    except Exception:
+        return None
+
+
+def topology_token():
+    """Small value-token of the process topology for the AOT environment
+    fingerprint (ops/aot_cache.py): global device count plus the axis
+    layout of the global mesh when one is set. A single-chip artifact and
+    an 8-device artifact — or a dp=8 and a dp=2×sharding=4 artifact —
+    fingerprint differently by construction."""
+    try:
+        n = jax.device_count()
+    except Exception:
+        n = -1
+    mesh = _global_mesh
+    if mesh is None:
+        return (n, None)
+    try:
+        axes = tuple((a, int(s)) for a, s in
+                     zip(mesh.axis_names, mesh.devices.shape) if int(s) > 1)
+    except Exception:
+        axes = ("?",)
+    return (n, axes)
+
+
+def value_mesh_and_spec(value):
+    """(mesh, normalized PartitionSpec entries) when `value` is a jax array
+    placed with a NamedSharding over a multi-device mesh; (None, None) for
+    replicated/single-device/host values. The spec entries are normalized
+    to a tuple per dim: () for unsharded dims, a tuple of axis names for
+    sharded dims — hashable and order-stable for keying."""
+    sh = getattr(value, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    spec = getattr(sh, "spec", None)
+    if mesh is None or spec is None or int(np.prod(mesh.devices.shape)) <= 1:
+        return None, None
+    norm = []
+    used = False
+    for e in tuple(spec):
+        if e is None:
+            norm.append(())
+            continue
+        axes = (e,) if isinstance(e, str) else tuple(e)
+        axes = tuple(a for a in axes if int(mesh.shape[a]) > 1)
+        norm.append(axes)
+        used = used or bool(axes)
+    if not used:
+        return None, None     # effectively replicated
+    return mesh, tuple(norm)

@@ -60,6 +60,15 @@ class DataParallel(Layer):
         world = get_world_size(self.group)
         if world <= 1:
             return
+        from .collective import _group_or_default, _multi_process
+        if not _multi_process(_group_or_default(self.group)):
+            # single-controller sharded world: gradients are already the
+            # global (post-psum) values — XLA's partitioner inserts the
+            # all-reduce eagerly, and a promoted step fuses it
+            # explicitly (ops/spmd_fusion.py). An identity sweep here
+            # would only force pending fused-step placeholders and split
+            # the one-launch replay.
+            return
         for p in self._layers.parameters():
             if p.grad is not None:
                 all_reduce(p.grad, op=ReduceOp.SUM, group=self.group)

@@ -212,6 +212,18 @@ define_flag("FLAGS_eager_step_fusion_cache_size", 8,
             "loop that temporarily diverges and re-stabilizes reuses its "
             "compiled whole-step executable instead of recompiling. 0 "
             "disables step fusion")
+define_flag("FLAGS_eager_step_fusion_spmd", True,
+            "distributed lowering of promoted steps (ops/spmd_fusion.py): "
+            "when a cycle's batch lives sharded on a device mesh, compile "
+            "the whole step through shard_map with the collectives fused "
+            "in — gradient pmean over the batch axes, ZeRO-sharded "
+            "optimizer update (slice/update/all-gather) when the slots "
+            "carry a 'sharding' NamedSharding, and all-reduced guardian/"
+            "GradScaler found-inf predicates. The first fire runs under "
+            "probation (eager results commit, fused compared); a "
+            "divergence demotes the program to the plain jit lowering. "
+            "Off: sharded cycles promote through plain jit (GSPMD "
+            "placement)")
 # Fusion flight recorder (profiler/events.py): a bounded, thread-aware
 # ring-buffer event log for the dispatch/fusion pipeline. Every decision
 # point that bumps a telemetry counter — cache hit/miss/bypass, chain

@@ -43,6 +43,24 @@ else:
 _NATIVE_AXIS_SIZE = getattr(lax, "axis_size", None)
 _NATIVE_PCAST = getattr(lax, "pcast", None)
 
+_NATIVE_SM_PARAMS = None
+
+
+def _native_sm_params():
+    """Keyword names the installed jax.shard_map actually accepts: the
+    replication-check kwarg was renamed check_rep → check_vma across jax
+    generations and `axis_names` (partial-manual) appeared late; passing
+    an unknown kwarg raises TypeError at every call site. Resolved once."""
+    global _NATIVE_SM_PARAMS
+    if _NATIVE_SM_PARAMS is None:
+        import inspect
+        try:
+            _NATIVE_SM_PARAMS = frozenset(
+                inspect.signature(_NATIVE_SHARD_MAP).parameters)
+        except (TypeError, ValueError):
+            _NATIVE_SM_PARAMS = frozenset()
+    return _NATIVE_SM_PARAMS
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
               check_vma=None, check_rep=None):
@@ -51,15 +69,25 @@ def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
     `axis_names` restricts manual mapping to a subset of mesh axes (modern
     jax); on 0.4.x it is emulated as documented in the module docstring.
     `check_vma`/`check_rep` are accepted from either API generation and
-    forwarded when the installed jax supports them.
+    forwarded under whichever name the installed jax knows.
     """
+    if axis_names:
+        unknown = set(axis_names) - set(mesh.axis_names)
+        if unknown:
+            raise ValueError(
+                f"shard_map axis_names {sorted(unknown)} not in mesh axes "
+                f"{tuple(mesh.axis_names)}")
     if _NATIVE_SHARD_MAP is not None:
+        params = _native_sm_params()
         kwargs = {}
-        if axis_names:
+        if axis_names and "axis_names" in params:
             kwargs["axis_names"] = set(axis_names)
         check = check_vma if check_vma is not None else check_rep
         if check is not None:
-            kwargs["check_vma"] = check
+            if "check_vma" in params:
+                kwargs["check_vma"] = check
+            elif "check_rep" in params:
+                kwargs["check_rep"] = check
         return _NATIVE_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, **kwargs)
     return _EXPERIMENTAL_SHARD_MAP(f, mesh=mesh, in_specs=in_specs,

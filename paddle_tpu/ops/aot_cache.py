@@ -189,20 +189,25 @@ def op_key_canonical(key):
 # ---------------------------------------------------------------------------
 
 _fp_cache = None
-_fp_generation = -1       # framework.flags._GENERATION the memo was cut at
+_fp_generation = (-1, -1)  # (flags._GENERATION, mesh generation) of the memo
 _fp_lock = threading.Lock()
 
 
 def env_fingerprint() -> dict:
     """What must match for a stored executable to be trusted: serializer
     schema, jax/jaxlib/numpy versions, backend platform, device kind, the
-    PRNG-key export form, and the kernel-routing flags that steer which
-    implementation an op dispatches to. Memoized against the flag-store
-    mutation generation, so a mid-run set_flags re-fingerprints instead
-    of stamping new artifacts with stale routing state."""
+    PRNG-key export form, the kernel-routing flags that steer which
+    implementation an op dispatches to, AND the mesh topology (global
+    device count + axis layout of the global mesh) — a single-chip
+    artifact must never deserialize into a sharded process, and a dp=8
+    artifact must never deserialize into a dp=2×sharding=4 one. Memoized
+    against the flag-store AND mesh-generation counters, so a mid-run
+    set_flags/set_global_mesh re-fingerprints instead of stamping new
+    artifacts with stale state."""
     global _fp_cache, _fp_digest_cache, _fp_generation
     from ..framework import flags as _flags_mod
-    gen = _flags_mod._GENERATION
+    from ..distributed import mesh as _mesh_mod
+    gen = (_flags_mod._GENERATION, _mesh_mod.mesh_generation())
     if _fp_cache is not None and gen == _fp_generation:
         return _fp_cache
     with _fp_lock:
@@ -229,6 +234,7 @@ def env_fingerprint() -> dict:
             "platform": platform,
             "device_kind": kind,
             "key_form": export_key_form(),
+            "mesh": _mesh_mod.topology_token(),
             "flags": tuple(sorted(
                 (k, bool(_FLAGS.get(k)))
                 for k in ("FLAGS_use_flash_attention",

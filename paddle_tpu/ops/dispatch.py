@@ -53,7 +53,7 @@ from . import guardian as _guardian
 from . import aot_cache as _aot
 
 __all__ = ["call_op", "call_op_multi", "clear_dispatch_cache",
-           "dispatch_cache_info"]
+           "dispatch_cache_info", "mark_collective"]
 
 
 def _values(tensors):
@@ -176,6 +176,11 @@ def _classify_bypass(name):
     _keyctx.kind = None
     if kind == "tracer":
         return "tracer_input"
+    if kind == "collective":
+        # a collective op whose group/mesh could not be canonically keyed
+        # (distributed/collective.py mark_collective): the cycle can never
+        # promote around it — the doctor names this directly
+        return "collective_unkeyed"
     if kind in ("array", "tensor"):
         from ..framework.random import rng_epoch
         ep = rng_epoch()
@@ -252,10 +257,35 @@ def _stable_library_fn(fn):
         getattr(m, getattr(fn, "__qualname__", ""), None) is fn
 
 
+# Collective-op keying (distributed/collective.py): a collective's fn
+# closes over a compiled process-group callable — unkeyable by the closure
+# scan — but its IDENTITY is fully determined by (kind, reduce op, the
+# canonical mesh key of its group). mark_collective() stamps that identity
+# onto the fn; _fn_token honors it before any closure walk. A collective
+# whose mesh cannot be canonically keyed is stamped unkeyable and the
+# bypass classifies as `collective_unkeyed`.
+_COLLECTIVE_UNKEYABLE = object()
+
+
+def mark_collective(fn, key):
+    """Stamp a collective identity onto an op fn. `key` is a hashable
+    (kind, ...) tuple ending in the mesh key (distributed/mesh.mesh_key),
+    or None when the group has no canonically-keyable mesh."""
+    fn._collective_key = ("collective",) + tuple(key) \
+        if key is not None else _COLLECTIVE_UNKEYABLE
+    return fn
+
+
 def _fn_token(fn, depth=0):
     """Value-identity for an op implementation: code object plus closure
     cell / default tokens. Returns _UNKEYABLE when the fn cannot be keyed
     safely (→ the call bypasses the cache)."""
+    ck = getattr(fn, "_collective_key", None)
+    if ck is not None:
+        if ck is _COLLECTIVE_UNKEYABLE:
+            _keyctx.kind = "collective"
+            return _UNKEYABLE
+        return ck
     if depth > 4:
         return _UNKEYABLE
     if isinstance(fn, types.FunctionType) and _stable_library_fn(fn):

@@ -52,7 +52,8 @@ from ..framework.flags import _FLAGS
 from ..profiler.events import EVENTS as _EVENTS
 
 __all__ = [
-    "enabled", "skip_step_enabled", "finite_all", "flush", "maybe_flush",
+    "enabled", "skip_step_enabled", "finite_all", "finite_all_reduced",
+    "flush", "maybe_flush",
     "guardian_stats", "reset_guardian_stats", "update_scaler_state",
     "mark_scaler_active", "inject_fault", "clear_faults", "poll_fault",
     "faults_armed", "ChaosFault", "GUARD_STATS",
@@ -88,6 +89,19 @@ def finite_all(vals):
         f = jnp.isfinite(v).all()
         fin = f if fin is None else fin & f
     return jnp.asarray(True) if fin is None else fin
+
+
+def finite_all_reduced(vals, axis_names):
+    """`finite_all` made GLOBALLY consistent inside a shard_map region:
+    the scalar is all-reduced (min) over `axis_names`, so every shard of a
+    distributed fused step takes the same skip/keep branch — one shard's
+    blowup skips the step everywhere, keeping replicated parameters
+    bitwise-identical across the mesh (ops/spmd_fusion.py)."""
+    import jax
+    p = finite_all(vals)
+    if not axis_names:
+        return p
+    return jax.lax.pmin(p.astype(jnp.int32), tuple(axis_names)) > 0
 
 
 def update_scaler_state(scale, good, bad, found_inf, incr_ratio,
@@ -200,7 +214,14 @@ def mark_scaler_active():
 
 def enqueue_fwd(name, finite_scalar):
     """Queue a forward all-finite scalar (per-op or chain label). Called
-    from the dispatch/chain tiers with a device scalar — no sync here."""
+    from the dispatch/chain tiers with a device scalar — no sync here.
+    A TRACER scalar (the op ran inside an outer jit trace — a serving
+    prefill/decode build, jit.TrainStep) is dropped: it could never be
+    resolved at a later flush (the trace is gone by then) and the
+    enclosing compiled program carries its own checks."""
+    import jax
+    if isinstance(finite_scalar, jax.core.Tracer):
+        return
     GUARD_STATS.checks_enqueued += 1
     q = _tls.queue
     q.append(("fwd", name, finite_scalar))

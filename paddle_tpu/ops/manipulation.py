@@ -41,7 +41,20 @@ def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.numpy().tolist()
     shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
-    return unary("reshape", lambda v: jnp.reshape(v, shape), x)
+    tgt = tuple(shape)
+    xshape = tuple(x.shape)
+    if tgt and -1 not in tgt and xshape and all(tgt[1:]) and \
+            (tgt[0] == xshape[0]
+             or (len(xshape) >= 2 and tgt[0] == xshape[0] * xshape[1])):
+        # leading-dim passthrough (or a merge of the two leading dims):
+        # infer it with -1 so the recorded op replays on ANY leading-dim
+        # size — the SPMD step promoter (ops/spmd_fusion.py) replays
+        # recorded ops on per-device batch SHARDS, and a baked global
+        # batch size would shape-error inside shard_map. The call-time
+        # equality check keeps the inferred dim identical to the explicit
+        # one for THIS call, so numerics and error behavior are unchanged.
+        tgt = (-1,) + tgt[1:]
+    return unary("reshape", lambda v: jnp.reshape(v, tgt), x)
 
 
 def reshape_(x, shape, name=None):
@@ -164,9 +177,17 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     nd = x.ndim
     s = start_axis % nd if nd else 0
     e = stop_axis % nd if nd else 0
-    shape = x.shape
-    new_shape = shape[:s] + [int(np.prod(shape[s:e + 1]) or 1)] + shape[e + 1:]
-    return unary("flatten", lambda v: jnp.reshape(v, new_shape), x)
+
+    def fn(v):
+        # target computed from the RUNTIME shape (concrete inside any
+        # trace), so the recorded op is shape-polymorphic — an SPMD step
+        # replay (ops/spmd_fusion.py) feeds it per-device batch shards.
+        # -1 infers the flattened block; a zero-size block (where -1 is
+        # ambiguous) falls back to the concrete product.
+        block = v.shape[s:e + 1]
+        mid = -1 if all(block) else int(np.prod(block))
+        return jnp.reshape(v, v.shape[:s] + (mid,) + v.shape[e + 1:])
+    return unary("flatten", fn, x)
 
 
 @register_op("expand", "manipulation")

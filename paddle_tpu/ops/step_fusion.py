@@ -179,7 +179,7 @@ class _StepProgram:
                  "acc_names", "label", "n_launches", "baseline_ns",
                  "fail_streak", "dead", "_exe", "_shims", "donate_params",
                  "check", "scaler_ref", "scaler_consts", "aot_digest",
-                 "aot_stored")
+                 "aot_stored", "spmd_plan", "spmd_ok")
 
     def __init__(self):
         self.fail_streak = 0
@@ -195,6 +195,14 @@ class _StepProgram:
         self.check = False
         self.scaler_ref = None
         self.scaler_consts = None
+        # distributed lowering (ops/spmd_fusion.py): a MeshPlan makes
+        # _compile wrap the step in shard_map over the plan's mesh (grad
+        # psum + sharded update + all-reduced predicates fused in); the
+        # first fire then runs under PROBATION (spmd_ok False → eager
+        # results commit, fused-vs-eager compared; a divergence demotes the
+        # program to the plain jit lowering)
+        self.spmd_plan = None
+        self.spmd_ok = True
 
     def release_heavy(self):
         """A deactivated program stays in the library as a tombstone (so
@@ -246,6 +254,8 @@ class _StepProgram:
     def _compile(self):
         from ..jit.train_step import donation_argnums
         from . import guardian
+        from . import spmd_fusion as _spmd
+        plan = self.spmd_plan
         chain = self.chain
         pure = chain.pure_fn
         root = self.root_flat
@@ -285,32 +295,51 @@ class _StepProgram:
                     env[slot] = pv[k]
                 return pure(*env)[root]
 
-            root_val, vjp = jax.vjp(fwd, list(pvals))
+            # stored-sharded (ZeRO) params all-gather to full for the
+            # forward; grads come back full so p.grad parity holds
+            pvals_full = pvals if plan is None \
+                else _spmd.gather_params(plan, pvals)
+            root_val, vjp = jax.vjp(fwd, list(pvals_full))
             (grads,) = vjp(jnp.ones(seed_shape, seed_dtype))
+            if plan is not None:
+                # the gradient all-reduce + loss sync of the distributed
+                # lowering (ops/spmd_fusion.py): every grad rides ONE
+                # fused pmean region over the batch axes
+                root_val, grads = _spmd.sync_root_and_grads(
+                    plan, root_val, grads)
+            finite_of = guardian.finite_all if plan is None \
+                else (lambda vals: _spmd.global_finite(plan, vals))
             extras = ()
             if scaler_state is not None:
                 # check_finite_and_unscale + update_loss_scaling, folded
                 # in: grads leave the executable UNSCALED (exactly what
                 # the eager path leaves in p.grad after scaler.step), and
                 # the loss-scale transition is the same pure function the
-                # eager GradScaler.update() evaluates
+                # eager GradScaler.update() evaluates. Under a mesh plan
+                # found-inf is all-reduced, so the backoff is globally
+                # consistent even when one shard saw the blowup.
                 scale, good, bad = scaler_state
                 inv = jnp.asarray(1.0, jnp.float32) / scale
                 grads = [g * inv.astype(g.dtype) for g in grads]
-                found_inf = jnp.logical_not(guardian.finite_all(grads))
+                found_inf = jnp.logical_not(finite_of(grads))
                 (_en, _dyn, incr_ratio, decr_ratio,
                  incr_n, decr_n) = scaler_consts
                 scale2, good2, bad2 = guardian.update_scaler_state(
                     scale, good, bad, found_inf, incr_ratio, decr_ratio,
                     incr_n, decr_n)
                 extras = (found_inf, scale2, good2, bad2)
-            upd = self._grad_transform(pvals, grads)
+            upd = self._grad_transform(pvals_full, grads)
             opt = opt_ref()   # trace-time only; firing keeps it alive
             new_p, new_accs = [], []
-            for pv, gv, ac in zip(pvals, upd, accs):
+            for k, (pv, gv, ac) in enumerate(zip(pvals, upd, accs)):
                 acc_dict = dict(zip(acc_names, ac))
-                np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
-                                              step_count)
+                if plan is not None and plan.param_shard[k] is not None:
+                    # ZeRO-sharded slots: slice-update-allgather
+                    np_, na_ = _spmd.sharded_single_update(
+                        plan, k, opt, pv, gv, acc_dict, lr, step_count)
+                else:
+                    np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
+                                                  step_count)
                 new_p.append(np_)
                 new_accs.append([na_.get(n) for n in acc_names])
             if check:
@@ -322,10 +351,13 @@ class _StepProgram:
                 # still blow up the state (an LR spike overflowing
                 # `p - lr*g`, a momentum buffer saturating): gating on
                 # grads alone would wave the blowup through the gate.
+                # Under a mesh plan the predicate is ALL-REDUCED first:
+                # sharded slots make it device-varying, and every shard
+                # must take the same skip/keep branch.
                 new_state = list(new_p) + [v for row in new_accs
                                            for v in row if v is not None]
-                upd_finite = guardian.finite_all(list(upd) + new_state)
-                fwd_finite = guardian.finite_all([root_val])
+                upd_finite = finite_of(list(upd) + new_state)
+                fwd_finite = finite_of([root_val])
                 new_p = [jnp.where(upd_finite, nv, pv)
                          for nv, pv in zip(new_p, pvals)]
                 new_accs = [
@@ -343,9 +375,18 @@ class _StepProgram:
             def step_fn(pvals, ext, accs, lr, step_count):
                 return step_body(pvals, ext, accs, lr, step_count, None)
 
-        self._exe = jax.jit(
-            step_fn,
-            donate_argnums=donation_argnums(self.donate_params, 0, 2))
+        donate = donation_argnums(self.donate_params, 0, 2)
+        if plan is not None:
+            # the distributed lowering: shard_map over the plan's mesh,
+            # same outer signature and donation argnums as the plain path
+            n_scaler = 3 if scaler_consts is not None else 0
+            n_extras = (2 if check else 0) \
+                + (4 if scaler_consts is not None else 0)
+            self._exe = _spmd.compile_step(
+                plan, step_fn, len(self.param_refs), n_scaler, n_extras,
+                donate)
+            return self._exe
+        self._exe = jax.jit(step_fn, donate_argnums=donate)
         return self._exe
 
 
@@ -660,6 +701,15 @@ class _StepFusionManager:
                         verify_fail = self._verify_fire(program, pending,
                                                         opt)
                         if verify_fail is None:
+                            if program.spmd_plan is not None \
+                                    and not program.spmd_ok:
+                                # SPMD probation: this step commits EAGER
+                                # results (the caller proceeds); the fused
+                                # lowering is validated on the side
+                                self._probation(st, pending, opt)
+                                st.pending = None
+                                self._after_boundary(st)
+                                return False
                             if self._fire(st, pending, opt):
                                 self._after_boundary(st)
                                 return True
@@ -722,6 +772,14 @@ class _StepFusionManager:
                     pending.entry_pos += 1
                     verify_fail = self._verify_fire(program, pending, opt)
                     if verify_fail is None:
+                        if program.spmd_plan is not None \
+                                and not program.spmd_ok:
+                            # SPMD probation: eager scaler path proceeds
+                            self._probation(st, pending, opt,
+                                            scaler=scaler)
+                            st.pending = None
+                            self._after_boundary(st)
+                            return False
                         if self._fire(st, pending, opt, scaler=scaler):
                             fired = True
                             self._after_boundary(st)
@@ -847,6 +905,17 @@ class _StepFusionManager:
         if opt is not program.opt_ref():
             return "param_mismatch"
         params = pending.params
+        if program.spmd_plan is not None:
+            from . import spmd_fusion as _spmd
+            mm = _spmd.fire_mismatch(program.spmd_plan, pending.ext_vals,
+                                     params)
+            if mm is not None:
+                # the batch moved to another mesh/layout (or a parameter
+                # got sharded): the compiled collectives would run over
+                # the wrong axes — kill and let the loop re-promote with
+                # a fresh plan
+                self._kill(program, reason="mesh_mismatch")
+                return "mesh_mismatch"
         slot_items = program.param_slots.items()
         if any(pending.ext_vals[s] is not params[k]._value
                for s, k in slot_items):
@@ -1062,6 +1131,102 @@ class _StepFusionManager:
         pending.grad_phs = None
         pending.params = ()
 
+    def _probation(self, st, pending, opt, scaler=None):
+        """First fire of an SPMD-lowered program (ops/spmd_fusion.py): run
+        the shard_map executable on scratch copies of the donated buffers,
+        then replay the step EAGERLY through the transactional core — this
+        step's numerics stay bitwise-identical to unfused dispatch — and
+        compare loss + grads. A match validates the distributed lowering
+        (the next fire commits fused results); a divergence (a sum-reduced
+        loss, a batch-coupled op — anything outside the data-parallel
+        pmean contract) demotes the program to the plain jit lowering,
+        attributed as `spmd_divergence`. Callers hold pending.lock; the
+        caller must let the eager optimizer step proceed."""
+        import numpy as np
+        from ..jit.train_step import bake_decay_flags
+        from . import spmd_fusion as _spmd
+
+        def scratch(v):
+            # a DISTINCT buffer with the same value and placement, so the
+            # executable's donation can never consume live state
+            return v + jnp.zeros((), v.dtype)
+
+        program = pending.program
+        params = pending.params
+        acc_names = program.acc_names
+        fused = None
+        st.busy = True
+        try:
+            bake_decay_flags(opt, params)
+            pvals = [p._value for p in params]
+            if program.donate_params:
+                pvals = [scratch(v) for v in pvals]
+            ext = [pending.ext_vals[s] for s in program.ext_order]
+            accs = [[None if opt._accumulators[n].get(p.name) is None
+                     else scratch(opt._accumulators[n][p.name])
+                     for n in acc_names] for p in params]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_count = jnp.asarray(
+                getattr(opt, "_step_count", 0) + 1, jnp.int32)
+            if scaler is not None:
+                scale, good, bad = scaler._state_arrays()
+                fused = program.exe()(pvals, ext, accs, lr, step_count,
+                                      scratch(scale), scratch(good),
+                                      scratch(bad))
+            else:
+                fused = program.exe()(pvals, ext, accs, lr, step_count)
+        except Exception:
+            # the distributed lowering failed to trace/execute (a baked
+            # global shape, an op the manual mapping rejects): demote to
+            # the plain jit lowering — still ONE executable — and replay
+            # this step eagerly
+            fused = None
+        finally:
+            st.busy = False
+        self._replay_pending(pending)
+        ok = fused is not None
+        why = "trace_fail" if fused is None else None
+        if ok:
+            i, j = program.root_coord
+            root_ph = pending.placeholders[i][j]
+            eager_loss = np.asarray(_VALUE_SLOT.__get__(root_ph))
+            rtol, atol = _spmd.probation_tolerance(eager_loss.dtype)
+            ok = bool(np.allclose(np.asarray(fused[0]), eager_loss,
+                                  rtol=rtol, atol=atol, equal_nan=True))
+            scale_np = None
+            if ok and scaler is not None:
+                # fused grads are UNSCALED; the eager tape's (pre-
+                # scaler.step) grads still carry the loss scale
+                scale_np = np.asarray(scaler._state_arrays()[0])
+            if ok:
+                for ph, g in zip(pending.grad_phs, fused[1]):
+                    ev = _VALUE_SLOT.__get__(ph)
+                    if ev is _PENDING:
+                        continue
+                    ev = np.asarray(ev)
+                    gv = np.asarray(g)
+                    if scale_np is not None:
+                        gv = gv * scale_np.astype(gv.dtype)
+                    rt, at = _spmd.probation_tolerance(ev.dtype)
+                    if not np.allclose(gv, ev, rtol=rt, atol=at,
+                                       equal_nan=True):
+                        ok = False
+                        break
+            if not ok and why is None:
+                why = "numeric_divergence"
+        if ok:
+            program.spmd_ok = True
+            _EVENTS.emit("step.record", program.label,
+                         detail={"kind": "spmd_probation", "ok": True})
+        else:
+            program.spmd_plan = None
+            program.spmd_ok = True
+            program._exe = None
+            _EVENTS.emit("step.record", program.label,
+                         reason="spmd_divergence",
+                         detail={"kind": "spmd_probation", "ok": False,
+                                 "why": why})
+
     def resolve_pending(self, pending, escape):
         """Owner-protocol escape hatch (ops/fusion._DeferredTensor._force).
         Pre-fire: any touch of a pending placeholder splits the replay.
@@ -1105,17 +1270,15 @@ class _StepFusionManager:
         finally:
             st.busy = False
 
-    def _split(self, pending, escape, reason=None, blocked_op=None):
-        """Transactional fallback: the deferred prefix replays per-op; if
-        the backward event was already consumed, the real tape backward
-        runs so p.grad holds exactly what unfused dispatch would have
-        produced. Callers hold pending.lock. `reason` is the
-        flight-recorder attribution (a REASON_CODES entry); `blocked_op`
-        names the dispatch/event that broke the replay."""
+    def _replay_pending(self, pending):
+        """The bitwise transactional core: replay the deferred prefix
+        per-op and, if the backward event was already consumed, run the
+        real tape backward so p.grad holds exactly what unfused dispatch
+        would have produced. Shared by `_split` (failure fallback) and
+        `_probation` (the SPMD first-fire validation, which is not a
+        failure). Callers hold pending.lock."""
         st = self._tls
         program = pending.program
-        if pending.done:
-            return
         st.busy = True
         try:
             replay_ops_per_op(program.chain.ops, pending.ext_vals,
@@ -1142,6 +1305,22 @@ class _StepFusionManager:
                     else:
                         ph._pending_chain = None
             pending.done = True
+        finally:
+            st.busy = False
+
+    def _split(self, pending, escape, reason=None, blocked_op=None):
+        """Transactional fallback: the deferred prefix replays per-op; if
+        the backward event was already consumed, the real tape backward
+        runs so p.grad holds exactly what unfused dispatch would have
+        produced. Callers hold pending.lock. `reason` is the
+        flight-recorder attribution (a REASON_CODES entry); `blocked_op`
+        names the dispatch/event that broke the replay."""
+        st = self._tls
+        program = pending.program
+        if pending.done:
+            return
+        try:
+            self._replay_pending(pending)
             program.fail_streak += 1
             deactivated = False
             if program.fail_streak >= _MAX_FAIL_STREAK \
@@ -1169,7 +1348,6 @@ class _StepFusionManager:
                              reason="fail_streak")
             self._mark_dirty(st)
         finally:
-            st.busy = False
             if st.pending is pending:
                 st.pending = None
 
@@ -1377,11 +1555,29 @@ class _StepFusionManager:
         if scaler_obj is not None:
             program.scaler_ref = weakref.ref(scaler_obj)
             program.scaler_consts = scaler_es[0][2]
+        # distributed lowering (ops/spmd_fusion.py): when the cycle's
+        # inputs live sharded on a mesh, the step compiles through
+        # shard_map with the collectives fused in — validated by a
+        # probation fire before any fused result commits
+        from . import spmd_fusion as _spmd
+        plan, plan_reason = _spmd.plan_program(
+            chain, slot_inputs, program.ext_order, updated, opt,
+            program.acc_names, root_flat)
+        if plan_reason is not None:
+            # a mesh-level contradiction (inputs spanning meshes) is a
+            # first-class reason code, not an anonymous build detail
+            _EVENTS.emit("step.record", "", reason=plan_reason,
+                         detail={"kind": "build_fail"})
+        if plan is not None:
+            program.spmd_plan = plan
+            program.spmd_ok = False
         names = [op.name for op in ops]
         head = "→".join(names[:3]) + ("→…" if len(names) > 3 else "")
         program.label = (f"{head}[{len(ops)}ops]"
                          f"+{type(opt).__name__}"
-                         + ("+GradScaler" if scaler_obj is not None else ""))
+                         + ("+GradScaler" if scaler_obj is not None else "")
+                         + (f"@mesh[{plan.axes_label}]"
+                            if plan is not None else ""))
         program.n_launches = len(ops) + sum(
             1 for op in ops if op.diff_mask is not None) + 1 \
             + (2 if scaler_obj is not None else 0)
@@ -1389,15 +1585,24 @@ class _StepFusionManager:
         program.donate_params = bool(
             _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
         from . import aot_cache as _aot
-        if _aot.enabled():
+        if _aot.enabled() and plan is None:
+            # SPMD programs opt out of the AOT store for now: jax.export
+            # of manual-mesh programs is not round-trip-safe on every
+            # supported jax, and the mesh topology fingerprint already
+            # guards cross-topology reuse (ROADMAP follow-on)
             dg = st.aot_probe.get(sig, 0)
             program.aot_digest = dg if dg != 0 \
                 else _aot.step_digest(sig, opt, updated)
+        elif plan is not None:
+            program.aot_stored = True
         STEP_STATS.promoted(program.label)
         _EVENTS.emit("step.promote", program.label,
                      detail={"ops": len(ops), "params": len(updated),
                              "launches_estimate": program.n_launches,
-                             "warm_start": warm})
+                             "warm_start": warm,
+                             "spmd": plan is not None,
+                             "mesh": plan.axes_label if plan is not None
+                             else None})
         return program
 
     def _disable(self, st):
@@ -1431,7 +1636,9 @@ class _StepFusionManager:
             "programs": [
                 {"label": p.label, "ops": len(p.chain.ops),
                  "params": len(p.param_refs), "dead": p.dead,
-                 "launches_estimate": p.n_launches}
+                 "launches_estimate": p.n_launches,
+                 "spmd": (p.spmd_plan.axes_label
+                          if p.spmd_plan is not None else None)}
                 for p in st.library.values()
                 if isinstance(p, _StepProgram)],
         }

@@ -139,6 +139,13 @@ REASON_CODES = frozenset({
     "decode_fault",        # the compiled decode faulted/was poisoned;
                            # requests fell back to eager generate()
     "crash_resume",        # an in-flight request re-admitted after restart
+    # -- distributed step fusion (ops/spmd_fusion.py) ----------------------
+    "collective_unkeyed",  # a collective's group/mesh has no canonical key
+    "mesh_mismatch",       # cycle inputs span meshes, or a fired program's
+                           # inputs moved to another mesh/layout
+    "spmd_divergence",     # probation fire diverged from the eager step:
+                           # the cycle violates the data-parallel pmean
+                           # contract; demoted to the plain jit lowering
     # -- AOT executable store decisions (ops/aot_cache.py) -----------------
     "artifact_corrupt",    # torn/garbled artifact: quarantined + recompiled
     "version_skew",        # artifact built under another env fingerprint

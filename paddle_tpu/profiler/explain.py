@@ -168,6 +168,28 @@ REASON_HINTS = {
         "snapshot after a restart; resume re-prefills prompt + emitted "
         "tokens and continues byte-identically. Expected exactly once "
         "per interrupted request per restart."),
+    "collective_unkeyed": (
+        "a collective op's group has no canonically-keyable mesh (a "
+        "hand-built Group without a mesh-backed process group), so the "
+        "dispatch funnel cannot key it and every cycle containing it is "
+        "poisoned. Fix: create groups via new_group()/the default group "
+        "so the collective keys by (kind, reduce-op, mesh) — or, in the "
+        "single-controller sharded world, drop eager grad collectives "
+        "entirely and let the SPMD step promoter fuse the psum."),
+    "mesh_mismatch": (
+        "the cycle's sharded inputs span different meshes, or a promoted "
+        "program's inputs moved to another mesh/layout mid-run — the "
+        "compiled collectives would run over the wrong axes, so the "
+        "program was dropped to re-promote with a fresh mesh plan. "
+        "Expected once per deliberate re-mesh; persistent mismatches "
+        "mean the loop alternates placements."),
+    "spmd_divergence": (
+        "the distributed (shard_map) lowering's probation fire did not "
+        "match the eager step: the loss is not a per-sample mean over "
+        "the sharded batch (sum reduction, batch-coupled normalization), "
+        "so the pmean contract does not hold. The step still fused "
+        "through the plain jit lowering (GSPMD-exact); to get explicit "
+        "collectives, make the loss a mean over the batch."),
     "artifact_corrupt": (
         "an AOT store artifact failed its CRC/envelope check (torn "
         "write, bit rot, truncation) — it was quarantined as *.corrupt "

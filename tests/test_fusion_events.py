@@ -142,6 +142,8 @@ class TestPublicContract:
             "client_cancel", "deadline_expired", "queue_full",
             "deadline_infeasible", "step_hang", "decode_fault",
             "crash_resume",
+            # distributed step fusion (PR 10, ops/spmd_fusion.py)
+            "collective_unkeyed", "mesh_mismatch", "spmd_divergence",
             # AOT executable-store decisions (PR 9, ops/aot_cache.py)
             "artifact_corrupt", "version_skew",
         })

@@ -20,6 +20,8 @@ Usage:
     # built-in demos (acceptance fixtures): a tiny GPT-ish loop
     python tools/fusion_doctor.py --demo dropout   # never promotes: rng_rekey
     python tools/fusion_doctor.py --demo masked    # clean promotion
+    python tools/fusion_doctor.py --demo dp        # never promotes:
+                                                   # collective_unkeyed
 
     # machine-readable
     python tools/fusion_doctor.py --demo dropout --json
@@ -96,6 +98,51 @@ def _demo(variant, steps):
         logits = manip.reshape(paddle.matmul(h, w_out), [B * T, V])
         loss = F.cross_entropy(logits, labels)
         loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def _demo_dp(steps):
+    """Data-parallel acceptance fixture: a small sharded-batch loop whose
+    gradient sync calls `dist.all_reduce` over a hand-built Group WITHOUT a
+    mesh-backed process group — the collective cannot be keyed, every
+    cycle is poisoned `collective_unkeyed`, and the report reads "step
+    never promoted: `dist.all_reduce` collective_unkeyed ×N". The fix the
+    hint prescribes (mesh-backed groups, or dropping eager grad
+    collectives so the SPMD promoter fuses the psum) is exactly what
+    tests/test_spmd_fusion.py proves out."""
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.framework.flags import set_flags
+
+    set_flags({"FLAGS_eager_op_cache": True,
+               "FLAGS_eager_chain_fusion": True,
+               "FLAGS_eager_chain_fusion_min_count": 4,
+               "FLAGS_eager_step_fusion": True,
+               "FLAGS_eager_step_fusion_min_count": 5})
+    paddle.seed(0)
+    n = jax.device_count()
+    mesh = build_mesh(dp=n, pp=1, sharding=1, sep=1, mp=1)
+    set_global_mesh(mesh)
+    sharding = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(0)
+    w = paddle.to_tensor(
+        (rng.standard_normal((32, 8)) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[w])
+    group = dist.collective.Group(0, n, id=90, ranks=list(range(n)))
+    for _ in range(steps):
+        x = paddle.Tensor(jax.device_put(
+            rng.standard_normal((2 * n, 32)).astype(np.float32), sharding),
+            stop_gradient=True)
+        h = paddle.matmul(x, w)
+        loss = paddle.mean(paddle.multiply(h, h))
+        loss.backward()
+        dist.all_reduce(w.grad, group=group)   # unkeyable: pg-less group
         opt.step()
         opt.clear_grad()
 
@@ -208,10 +255,13 @@ def main(argv=None) -> int:
                     help="training script to run under the recorder")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script (after --)")
-    ap.add_argument("--demo", choices=("dropout", "masked", "serve"),
+    ap.add_argument("--demo", choices=("dropout", "masked", "serve", "dp"),
                     help="run a built-in tiny GPT-ish demo loop instead "
                          "of a script (`serve`: a continuous-batching "
-                         "serving run over a tight KV pool)")
+                         "serving run over a tight KV pool; `dp`: a "
+                         "sharded data-parallel loop whose unkeyable "
+                         "grad collective blocks promotion — "
+                         "collective_unkeyed)")
     ap.add_argument("--steps", type=int, default=20,
                     help="demo loop steps (requests, for --demo serve; "
                          "default 20)")
@@ -243,6 +293,8 @@ def main(argv=None) -> int:
     try:
         if args.demo == "serve":
             _demo_serve(args.steps)
+        elif args.demo == "dp":
+            _demo_dp(args.steps)
         elif args.demo:
             _demo(args.demo, args.steps)
         else:

@@ -48,7 +48,12 @@ stack silently regressed:
     retraces, no chain compiles, no whole-step retrace — everything
     deserializes) and measurably faster time-to-first-promoted-step
     than the cold subprocess that populated the store (a PR 9
-    regression).
+    regression);
+  * distributed step fusion — a dp=N sharded-batch loop over the
+    emulated device mesh must auto-promote into ONE shard_map-wrapped
+    executable (ops/spmd_fusion.py; zero retraces after promotion) and
+    beat the same loop on unfused eager dispatch (per-op GSPMD
+    collectives) by >= 1.3x (a PR 10 regression).
 
 Runs in a few seconds; wired into tier-1 as the `perf_smoke`-marked tests
 in tests/test_chain_fusion.py and tests/test_step_fusion.py — this CLI is
@@ -68,6 +73,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 WARMUP = 14
 MEASURE = 40
+# promoted DP step vs unfused eager collectives (ops/spmd_fusion.py)
+DP_SPEEDUP_GUARD = 1.3
 # warm-start guard: a warm store must reach the first PROMOTED FUSED step
 # in at most this fraction of the cold process's time-to-first-fire (the
 # cold path pays per-op traces + the whole-step trace + XLA compiles; the
@@ -124,6 +131,65 @@ def _loop(step_fused, check_numerics=False, use_scaler=False):
         # without it, one leg's enqueued-but-unexecuted work bleeds into
         # the next leg's timed window)
         w._value.block_until_ready()
+
+    step.sync = sync
+    return step
+
+
+def _dp_loop(step_fused):
+    """A dp=N data-parallel MLP loop: batch sharded over a mesh spanning
+    every device (8 emulated on CPU via tests/conftest-style XLA flags).
+    With step fusion on, the cycle must promote through the SPMD lowering
+    (ops/spmd_fusion.py) — ONE shard_map executable per step."""
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache
+
+    set_flags({"FLAGS_eager_op_cache": True,
+               "FLAGS_eager_chain_fusion": True,
+               "FLAGS_eager_chain_fusion_min_count": 4,
+               "FLAGS_eager_step_fusion": step_fused,
+               "FLAGS_eager_step_fusion_min_count": 5,
+               "FLAGS_check_numerics": False})
+    clear_dispatch_cache()
+
+    n = jax.device_count()
+    mesh = build_mesh(dp=n, pp=1, sharding=1, sep=1, mp=1)
+    set_global_mesh(mesh)
+    sharding = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(0)
+    x = paddle.Tensor(jax.device_put(
+        rng.standard_normal((8 * n, 32)).astype(np.float32), sharding),
+        stop_gradient=True)
+    y = paddle.Tensor(jax.device_put(
+        rng.standard_normal((8 * n, 16)).astype(np.float32), sharding),
+        stop_gradient=True)
+    w1 = paddle.to_tensor(
+        (rng.standard_normal((32, 64)) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    b1 = paddle.to_tensor(np.zeros(64, np.float32), stop_gradient=False)
+    w2 = paddle.to_tensor(
+        (rng.standard_normal((64, 16)) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    opt = paddle.optimizer.Momentum(learning_rate=1e-3, momentum=0.9,
+                                    parameters=[w1, b1, w2])
+
+    def step():
+        h = F.relu(paddle.add(paddle.matmul(x, w1), b1))
+        out = paddle.matmul(h, w2)
+        diff = paddle.subtract(out, y)
+        loss = paddle.mean(paddle.multiply(diff, diff))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    def sync():
+        w1._value.block_until_ready()
 
     step.sync = sync
     return step
@@ -587,6 +653,55 @@ def main() -> int:
     # time-to-first-promoted-step
     aot_cold, aot_warm = _aot_warm_start_leg(failures)
 
+    # ---- distributed step fusion leg (PR 10 guard) -----------------------
+    # (i) a dp=N sharded-batch loop must promote into ONE shard_map
+    # executable (zero retraces after promotion) and beat the same loop on
+    # unfused eager dispatch (per-op GSPMD collectives) by the guard ratio
+    import jax as _jax
+    dp_speedup = 0.0
+    dp_retraces = 0
+    dp_mesh = None
+    if _jax.device_count() >= 2:
+        dp_step = _dp_loop(step_fused=False)
+        for _ in range(WARMUP):
+            dp_step()
+        dp_step.sync()
+        t_dp_eager = timed(dp_step)
+        dp_step = _dp_loop(step_fused=True)
+        for _ in range(WARMUP):
+            dp_step()
+        dp_step.sync()
+        s0 = step_fusion_stats()
+        t_dp_fused = timed(dp_step)
+        s1 = step_fusion_stats()
+        from paddle_tpu.ops.step_fusion import step_cache_info
+        dp_mesh = next((p["spmd"] for p in step_cache_info()["programs"]
+                        if p["spmd"] and not p["dead"]), None)
+        dp_replays = min(s1["fused_steps"] - s0["fused_steps"], MEASURE)
+        dp_retraces = s1["retraces"] - s0["retraces"]
+        dp_speedup = t_dp_eager / t_dp_fused if t_dp_fused > 0 else 0.0
+        if dp_mesh is None:
+            failures.append(
+                "dp sharded-batch loop did not promote through the SPMD "
+                f"lowering (promoted={s1['steps_promoted']}, "
+                f"splits={s1['fallback_splits']}): the mesh plan was "
+                "refused or demoted (PR 10 regression)")
+        if dp_replays == 0:
+            failures.append(
+                "promoted DP step replay rate is zero "
+                "(PR 10 regression)")
+        if dp_retraces:
+            failures.append(
+                f"{dp_retraces} post-warmup retrace(s) in the promoted DP "
+                "step: the shard_map executable is re-tracing a stable "
+                "sharded cycle (PR 10 regression)")
+        if dp_replays and dp_speedup < DP_SPEEDUP_GUARD:
+            failures.append(
+                f"promoted DP step speedup {dp_speedup:.2f}x over unfused "
+                f"eager collectives is below the {DP_SPEEDUP_GUARD}x guard "
+                f"(eager {t_dp_eager*1e6:.0f}us vs fused "
+                f"{t_dp_fused*1e6:.0f}us) (PR 10 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -612,7 +727,9 @@ def main() -> int:
           f"cold={aot_cold['t_first_fire_s']:.2f}s "
           f"(warm hits={aot_warm['aot']['hits']} "
           f"retraces={aot_warm['dispatch_retraces']}"
-          f"+{aot_warm['step_retraces']})")
+          f"+{aot_warm['step_retraces']}), "
+          f"dp mesh={dp_mesh} speedup={dp_speedup:.2f}x "
+          f"(retraces={dp_retraces})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
@@ -622,6 +739,13 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    # the distributed leg needs the emulated multi-device mesh; must land
+    # before the first jax import (tests/conftest.py does the same for
+    # the pytest-marked legs)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = \
+            (_flags + " --xla_force_host_platform_device_count=8").strip()
     if "--aot-child" in sys.argv:
         import argparse
         ap = argparse.ArgumentParser()
