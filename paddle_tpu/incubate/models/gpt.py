@@ -174,32 +174,53 @@ class GPTAttention(Layer):
 
     def _paged_decode_step(self, q, k, v, cache, b, n):
         """Single-token attention against the paged block pool: write this
-        step's K/V at each slot's write position, gather that slot's blocks
-        by table, attend over positions <= seq_len. Shapes are fixed by
+        step's K/V at each slot's write position, stream that slot's blocks
+        by table (blockwise online softmax — or the dense gather oracle),
+        attend over positions <= seq_len. Shapes are fixed by
         (max_batch, max_blocks, block_size), so the serving engine compiles
         ONE program for every batch composition."""
         if n != 1:
             raise ValueError(
                 "paged decode is single-token; prefill goes through the "
                 f"dynamic-cache path (got a {n}-token chunk)")
-        from ...nn.functional.attention import paged_decode_attention
+        from ...nn.functional.attention import (paged_decode_attention,
+                                                resolve_paged_kernel)
         from ...ops._helpers import call_op_multi, ensure_tensor
         block_size = cache.block_size
+        # the RESOLVED variant is captured in the op fn's closure — that
+        # is what keys it into the per-op dispatch cache, so a
+        # FLAGS_serve_attention_kernel flip re-keys instead of replaying
+        # the previous variant's executable. An engine-owned cache view
+        # pins the variant it resolved at construction.
+        variant = cache.kernel
+        if variant is None:
+            variant = resolve_paged_kernel(head_dim=self.head_dim,
+                                           block_size=block_size)
 
-        def fn(qv, kv, vv, kp, vp, tab, lens, act):
-            return paged_decode_attention(qv, kv, vv, kp, vp, tab, lens,
-                                          act, block_size)
+        quantized = cache.k_scales is not None
 
-        out, new_k, new_v = call_op_multi(
-            "gpt_paged_decode_attention", fn,
-            (ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
-             ensure_tensor(cache.k_pool), ensure_tensor(cache.v_pool),
-             ensure_tensor(cache.block_tables),
-             ensure_tensor(cache.seq_lens), ensure_tensor(cache.active)),
-            num_outputs=3)
-        out = manip.reshape(out, [b, n, self.hidden_size])
+        def fn(qv, kv, vv, kp, vp, tab, lens, act, ksc=None, vsc=None):
+            return paged_decode_attention(
+                qv, kv, vv, kp, vp, tab, lens, act, block_size,
+                k_scales=ksc, v_scales=vsc, kernel=variant)
+
+        # int8 KV: the scale side-tables are dispatch INPUTS (never
+        # closure captures) and flow back out with the pools — the
+        # differing arity also keys the two modes apart in the cache
+        inputs = (ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
+                  ensure_tensor(cache.k_pool), ensure_tensor(cache.v_pool),
+                  ensure_tensor(cache.block_tables),
+                  ensure_tensor(cache.seq_lens), ensure_tensor(cache.active))
+        if quantized:
+            inputs += (ensure_tensor(cache.k_scales),
+                       ensure_tensor(cache.v_scales))
+        outs = call_op_multi("gpt_paged_decode_attention", fn, inputs,
+                             num_outputs=5 if quantized else 3)
+        out = manip.reshape(outs[0], [b, n, self.hidden_size])
         out = self.out_proj(out)
-        return out, cache.updated(new_k._value, new_v._value)
+        new_scales = (outs[3]._value, outs[4]._value) if quantized else ()
+        return out, cache.updated(outs[1]._value, outs[2]._value,
+                                  *new_scales)
 
 
 class GPTMLP(Layer):

@@ -1,0 +1,13 @@
+"""Pallas kernel tier for the serving hot path.
+
+Reference analog: the PHI fused-kernel layer (fluid/operators/fused/) —
+here the fusions target the continuous-batching decode step instead of
+training graphs: blockwise paged decode attention that consumes the
+block-pool KV cache (serving/cache.py) directly, with int8 dequant fused
+into the block loads (quantization/kv_cache.py).
+
+Modules import lazily from the routing layer
+(nn/functional/attention.py) so a CPU-only process never pays the Pallas
+import unless a kernel is actually requested.
+"""
+from . import paged_attention  # noqa: F401

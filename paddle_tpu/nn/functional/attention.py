@@ -135,14 +135,84 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return call_op("scaled_dot_product_attention", fn, (q, k, v))
 
 
+PAGED_KERNELS = ("pallas", "blockwise", "reference")
+
+
+def resolve_paged_kernel(kernel=None, head_dim=None, block_size=None,
+                         interpret=False):
+    """Resolve the serving attention variant: the request (explicit
+    `kernel` or FLAGS_serve_attention_kernel) -> the variant that will
+    actually run. An ineligible request falls back to `blockwise` (same
+    math, no Mosaic constraints) and is VISIBLE: a `kernel.fallback`
+    flight-recorder event attributes the demotion, never silent."""
+    from ...framework.flags import _FLAGS
+    from ...profiler.events import EVENTS as _EVENTS
+    req = kernel or str(_FLAGS.get("FLAGS_serve_attention_kernel")
+                        or "blockwise")
+    if req not in PAGED_KERNELS:
+        raise ValueError(
+            f"unknown paged attention kernel {req!r}; expected one of "
+            f"{PAGED_KERNELS}")
+    actual, why = req, None
+    if req == "pallas":
+        from ...kernels.pallas import paged_attention as _pk
+        if not _pk._HAS_PALLAS:
+            # interpret mode still needs the pallas import itself
+            actual, why = "blockwise", "no_pallas"
+        elif not interpret:
+            ok, why = _pk.is_eligible(head_dim, block_size)
+            if not ok:
+                actual = "blockwise"
+    if actual != req:
+        _EVENTS.emit("kernel.fallback", "paged_decode_attention",
+                     reason="kernel_fallback",
+                     detail={"requested": req, "actual": actual,
+                             "why": why, "head_dim": head_dim,
+                             "block_size": block_size})
+    return actual
+
+
+def _dense_gather_attention(qh, k_pool, v_pool, block_tables, lens,
+                            block_size, k_scales=None, v_scales=None):
+    """The reference oracle: gather-by-block-table into a dense
+    ``[S, T, H, D]`` context, full softmax. Scores and the softmax/PV
+    accumulation run in fp32 (matching `_plain_attention`) so bf16
+    serving keeps its tail tokens; only the output casts back."""
+    s, h, d = qh.shape
+    m = block_tables.shape[1]
+    t_max = m * block_size
+    kg = k_pool[block_tables]                          # [S, M, bs, H, D]
+    vg = v_pool[block_tables]
+    if k_scales is not None:
+        from ...quantization.kv_cache import dequantize
+        kg = dequantize(kg, k_scales[block_tables])
+        vg = dequantize(vg, v_scales[block_tables])
+    else:
+        kg = kg.astype(jnp.float32)
+        vg = vg.astype(jnp.float32)
+    keys = kg.reshape(s, t_max, h, d)
+    vals = vg.reshape(s, t_max, h, d)
+    scores = jnp.einsum("shd,sthd->sht", qh.astype(jnp.float32), keys) \
+        / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    valid = jnp.arange(t_max, dtype=jnp.int32)[None, :] <= lens[:, None]
+    scores = jnp.where(valid[:, None, :], scores,
+                       jnp.asarray(-1e30, jnp.float32))
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("sht,sthd->shd", probs, vals).astype(qh.dtype)
+
+
 def paged_decode_attention(q, k_new, v_new, k_pool, v_pool, block_tables,
-                           seq_lens, active, block_size):
+                           seq_lens, active, block_size,
+                           k_scales=None, v_scales=None, kernel=None,
+                           interpret=False):
     """One decode step of attention against a paged block-pool KV cache
     (the PagedAttention memory model; serving/cache.py).
 
     q/k_new/v_new: ``[S, 1, H, D]`` — this step's projections for every
     batch slot (S is the engine's fixed max-batch slot count).
-    k_pool/v_pool: ``[num_blocks, block_size, H, D]`` — one layer's pool.
+    k_pool/v_pool: ``[num_blocks, block_size, H, D]`` — one layer's pool
+    (fp, or int8 with per-block-per-head `k_scales`/`v_scales`
+    ``[num_blocks, H]``; quantization/kv_cache.py).
     block_tables: ``[S, max_blocks]`` int32 — per-slot ordered block ids;
     gathered position ``t`` of slot ``s`` is token position ``t`` of that
     sequence (tables are dense prefixes, padded with the null block).
@@ -152,13 +222,19 @@ def paged_decode_attention(q, k_new, v_new, k_pool, v_pool, block_tables,
     block and their outputs are garbage by design (the engine never reads
     them).
 
-    Pure jnp and shape-static: ONE compiled program serves every token of
-    every tenant mix — join/leave/evict is a table edit, never a retrace.
-    Returns ``(out [S, 1, H, D], new_k_pool, new_v_pool)``.
+    `kernel` selects the attention implementation (`pallas` |
+    `blockwise` | `reference`, default FLAGS_serve_attention_kernel);
+    every variant shares the SAME write path, masking, and fp32 softmax
+    numerics — only the schedule differs. Pure jnp and shape-static: ONE
+    compiled program serves every token of every tenant mix —
+    join/leave/evict is a table edit, never a retrace.
+
+    Returns ``(out [S, 1, H, D], new_k_pool, new_v_pool)`` — plus
+    ``(new_k_scales, new_v_scales)`` in int8 mode.
     """
     s = q.shape[0]
     head_dim = q.shape[-1]
-    n_blocks_per_seq = block_tables.shape[1]
+    quantized = k_scales is not None
     lens = jnp.where(active, seq_lens, 0).astype(jnp.int32)
     rows = jnp.arange(s, dtype=jnp.int32)
     # write the new token's K/V at (table[len // bs], len % bs); inactive
@@ -167,29 +243,42 @@ def paged_decode_attention(q, k_new, v_new, k_pool, v_pool, block_tables,
     write_block = jnp.where(
         active, block_tables[rows, lens // block_size], 0).astype(jnp.int32)
     write_off = lens % block_size
-    k_pool = k_pool.at[write_block, write_off].set(
-        k_new[:, 0].astype(k_pool.dtype))
-    v_pool = v_pool.at[write_block, write_off].set(
-        v_new[:, 0].astype(v_pool.dtype))
-    # gather-by-block-table: [S, M, bs, H, D] -> [S, T, H, D] where
-    # gathered index t IS token position t (tables are ordered)
-    t_max = n_blocks_per_seq * block_size
-    keys = k_pool[block_tables].reshape(s, t_max, *k_pool.shape[2:])
-    vals = v_pool[block_tables].reshape(s, t_max, *v_pool.shape[2:])
+    if quantized:
+        from ...quantization.kv_cache import quantize_block_write
+        k_pool, k_scales = quantize_block_write(
+            k_pool, k_scales, k_new[:, 0], write_block, write_off)
+        v_pool, v_scales = quantize_block_write(
+            v_pool, v_scales, v_new[:, 0], write_block, write_off)
+    else:
+        k_pool = k_pool.at[write_block, write_off].set(
+            k_new[:, 0].astype(k_pool.dtype))
+        v_pool = v_pool.at[write_block, write_off].set(
+            v_new[:, 0].astype(v_pool.dtype))
+
+    variant = resolve_paged_kernel(kernel, head_dim, block_size,
+                                   interpret=interpret)
     qh = q[:, 0]                                       # [S, H, D]
-    scores = jnp.einsum("shd,sthd->sht", qh,
-                        keys.astype(qh.dtype)) \
-        / jnp.sqrt(jnp.asarray(head_dim, qh.dtype))
-    valid = jnp.arange(t_max, dtype=jnp.int32)[None, :] <= lens[:, None]
-    scores = jnp.where(valid[:, None, :], scores,
-                       jnp.asarray(-1e9, qh.dtype))
-    probs = jax.nn.softmax(scores.astype(jnp.float32),
-                           axis=-1).astype(qh.dtype)
-    out = jnp.einsum("sht,sthd->shd", probs, vals.astype(qh.dtype))
+    if variant == "reference":
+        out = _dense_gather_attention(qh, k_pool, v_pool, block_tables,
+                                      lens, block_size, k_scales, v_scales)
+    elif variant == "blockwise":
+        from ...kernels.pallas.paged_attention import (
+            blockwise_paged_attention)
+        out = blockwise_paged_attention(qh, k_pool, v_pool, block_tables,
+                                        lens, block_size, k_scales,
+                                        v_scales)
+    else:
+        from ...kernels.pallas.paged_attention import pallas_paged_attention
+        out = pallas_paged_attention(qh, k_pool, v_pool, block_tables,
+                                     lens, block_size, k_scales, v_scales,
+                                     interpret=interpret)
+    if quantized:
+        return out[:, None], k_pool, v_pool, k_scales, v_scales
     return out[:, None], k_pool, v_pool
 
 
-__all__ += ["paged_decode_attention"]
+__all__ += ["paged_decode_attention", "resolve_paged_kernel",
+            "PAGED_KERNELS"]
 
 
 @register_op("sparse_attention", "attention",

@@ -236,10 +236,15 @@ def env_fingerprint() -> dict:
             "key_form": export_key_form(),
             "mesh": _mesh_mod.topology_token(),
             "flags": tuple(sorted(
-                (k, bool(_FLAGS.get(k)))
-                for k in ("FLAGS_use_flash_attention",
-                          "FLAGS_use_fused_layer_norm",
-                          "FLAGS_use_fused_cross_entropy"))),
+                [(k, bool(_FLAGS.get(k)))
+                 for k in ("FLAGS_use_flash_attention",
+                           "FLAGS_use_fused_layer_norm",
+                           "FLAGS_use_fused_cross_entropy")]
+                # the serving kernel tier is a string-valued routing flag:
+                # a blockwise artifact must never deserialize into a
+                # pallas (or reference) process
+                + [("FLAGS_serve_attention_kernel",
+                    str(_FLAGS.get("FLAGS_serve_attention_kernel")))])),
         }
         _fp_cache = fp
         return fp

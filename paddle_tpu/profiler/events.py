@@ -89,6 +89,13 @@ CATEGORIES = frozenset({
     # environment-fingerprint skew, size/age eviction
     "aot.hit", "aot.miss", "aot.store", "aot.corrupt",
     "aot.version_skew", "aot.evict",
+    # kernel tier (kernels/pallas/, nn/functional/attention.py): a
+    # requested attention kernel variant was ineligible and fell back
+    # (`kernel.fallback`, reason `kernel_fallback` — an ineligible shape
+    # is VISIBLE, not silent); an engine whose KV cache runs quantized
+    # stamps the informational `kernel.quantized` marker (reason
+    # `kv_quantized`) so the fallback stream stays demotions-only
+    "kernel.fallback", "kernel.quantized",
 })
 
 # Machine-readable causes. Stable across releases: the fusion doctor, the
@@ -149,6 +156,9 @@ REASON_CODES = frozenset({
     # -- AOT executable store decisions (ops/aot_cache.py) -----------------
     "artifact_corrupt",    # torn/garbled artifact: quarantined + recompiled
     "version_skew",        # artifact built under another env fingerprint
+    # -- kernel tier (kernels/pallas/, FLAGS_serve_attention_kernel) -------
+    "kernel_fallback",     # requested kernel variant ineligible; demoted
+    "kv_quantized",        # the engine's KV cache pool runs int8
 })
 
 

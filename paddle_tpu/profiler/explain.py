@@ -203,6 +203,21 @@ REASON_HINTS = {
         "the executable recompiled. Expected once per key after an "
         "upgrade; persistent skew means mixed worker versions share one "
         "store."),
+    "kernel_fallback": (
+        "the requested paged-attention kernel variant "
+        "(FLAGS_serve_attention_kernel) was ineligible here and the call "
+        "fell back to the blockwise path — see the event's `why` detail "
+        "(no_pallas / not_on_tpu / head_dim_unaligned / "
+        "block_size_unaligned). Same math, no silent wrong-kernel "
+        "serving; align head_dim/block_size or request 'blockwise' "
+        "explicitly to quiet the event."),
+    "kv_quantized": (
+        "the serving engine's KV cache pool runs int8 with "
+        "per-block-per-head scales (quantization/kv_cache.py): half the "
+        "bytes per cached token, ~2x the streams per pool before "
+        "kv_exhausted. Informational — greedy decode is guarded "
+        "token-identical (or top-1-equivalent) to fp32 KV; dequant is "
+        "fused into the attention kernels' block loads."),
 }
 
 
@@ -360,12 +375,25 @@ def explain(events=None):
             "reasons": aot_reasons,
         }
 
+    # kernel tier (kernel.* events, kernels/pallas/ + attention routing):
+    # which variant demotions happened, and whether the KV cache runs
+    # quantized — both must explain themselves, never silently
+    kernel_reasons = {}
+    if any(e["cat"].startswith("kernel.") for e in events):
+        kernel_reasons = _attr(events,
+                               lambda e: e["cat"].startswith("kernel.")
+                               and e.get("reason") is not None)
+        report["kernel"] = {
+            "fallbacks": n("kernel.fallback"),
+            "reasons": kernel_reasons,
+        }
+
     serve_reasons = (report.get("serving") or {}).get("reasons", {})
 
     findings = []
     unknown = sorted({r for src in (step_splits, poisons, chain_splits,
                                     bypasses, guardian_ev, serve_reasons,
-                                    aot_reasons)
+                                    aot_reasons, kernel_reasons)
                       for r in src
                       if r not in REASON_CODES and r != "unattributed"})
     if unknown:
@@ -452,6 +480,13 @@ def explain(events=None):
     report["verdict"] = verdict
     report["headline"] = headline
 
+    for r, rec in sorted(kernel_reasons.items(),
+                         key=lambda kv: -kv[1]["count"]):
+        ops = ", ".join(f"`{o}`×{c}" for o, c in
+                        sorted(rec["ops"].items(), key=lambda kv: -kv[1])[:4])
+        findings.append(
+            f"kernel tier {r} ×{rec['count']}" + (f" ({ops})" if ops else "")
+            + (f" — {REASON_HINTS[r]}" if r in REASON_HINTS else ""))
     for r, rec in sorted(aot_reasons.items(),
                          key=lambda kv: -kv[1]["count"]):
         ops = ", ".join(f"`{o}`×{c}" for o, c in
@@ -539,6 +574,11 @@ def format_report(report):
             f"aot   : hits={a['hits']} misses={a['misses']} "
             f"stores={a['stores']} corrupt={a['corrupt']} "
             f"skew={a['version_skew']} evicted={a['evicted']}")
+    k = report.get("kernel")
+    if k:
+        lines.append("kernel: fallbacks=" + str(k["fallbacks"]) + " "
+                     + " ".join(f"{r}={rec['count']}"
+                                for r, rec in sorted(k["reasons"].items())))
     sv = report.get("serving")
     if sv:
         lines.append(

@@ -19,10 +19,12 @@ from ..framework.core import Tensor
 from ..nn.layer_base import Layer
 from ..ops._helpers import ensure_tensor, call_op
 
+from . import kv_cache  # noqa: F401  (int8 serving KV cache, PR 11)
+
 __all__ = [
     "fake_quantize_abs_max", "fake_quantize_channel_wise_abs_max",
     "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
-    "MovingAverageAbsMaxObserver", "quant_post_dynamic",
+    "MovingAverageAbsMaxObserver", "quant_post_dynamic", "kv_cache",
 ]
 
 

@@ -34,7 +34,8 @@ the `fusion_doctor` serving section.
 from __future__ import annotations
 
 from .cache import (BlockAllocator, PagedKVCache, PagedCacheView,  # noqa: F401
-                    scatter_prefill, NULL_BLOCK)
+                    scatter_prefill, NULL_BLOCK, pool_bytes_per_block,
+                    num_blocks_for_bytes)
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING,  # noqa: F401
                         FINISHED, FAILED, CANCELLED, EXPIRED)
 from .resilience import ServeRefusal, StepHang  # noqa: F401
@@ -44,4 +45,5 @@ __all__ = ["LLMEngine", "ServeStats", "Request", "Scheduler",
            "PagedKVCache", "PagedCacheView", "BlockAllocator",
            "scatter_prefill", "NULL_BLOCK", "QUEUED", "RUNNING",
            "FINISHED", "FAILED", "CANCELLED", "EXPIRED",
-           "ServeRefusal", "StepHang"]
+           "ServeRefusal", "StepHang", "pool_bytes_per_block",
+           "num_blocks_for_bytes"]

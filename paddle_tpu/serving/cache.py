@@ -39,7 +39,8 @@ from collections import deque
 import jax.numpy as jnp
 
 __all__ = ["BlockAllocator", "PagedKVCache", "PagedCacheView",
-           "scatter_prefill", "NULL_BLOCK"]
+           "scatter_prefill", "NULL_BLOCK", "pool_bytes_per_block",
+           "num_blocks_for_bytes"]
 
 # block id 0 is never allocated: it is the write/read target for inactive
 # slots and out-of-range table entries (see module docstring)
@@ -90,23 +91,38 @@ class PagedCacheView:
     step: the layer's pools plus the batch's block tables / lengths /
     active mask (jnp arrays or tracers). `GPTAttention` detects this view
     by its `block_tables` attribute and routes to the paged decode path;
-    `updated()` threads the written pools back out of the model."""
+    `updated()` threads the written pools back out of the model.
+
+    int8 mode carries the per-block-per-head scale side-tables
+    (`k_scales`/`v_scales`, quantization/kv_cache.py); `kernel` pins the
+    attention variant the owning engine resolved at construction
+    (nn/functional/attention.resolve_paged_kernel), so a mid-run flag
+    flip never re-keys a live engine's compiled decode step."""
 
     __slots__ = ("k_pool", "v_pool", "block_tables", "seq_lens", "active",
-                 "block_size")
+                 "block_size", "k_scales", "v_scales", "kernel")
 
     def __init__(self, k_pool, v_pool, block_tables, seq_lens, active,
-                 block_size):
+                 block_size, k_scales=None, v_scales=None, kernel=None):
         self.k_pool = k_pool
         self.v_pool = v_pool
         self.block_tables = block_tables
         self.seq_lens = seq_lens
         self.active = active
         self.block_size = int(block_size)
+        self.k_scales = k_scales
+        self.v_scales = v_scales
+        self.kernel = kernel
 
-    def updated(self, k_pool, v_pool):
+    def updated(self, k_pool, v_pool, k_scales=None, v_scales=None):
         return PagedCacheView(k_pool, v_pool, self.block_tables,
-                              self.seq_lens, self.active, self.block_size)
+                              self.seq_lens, self.active, self.block_size,
+                              k_scales=k_scales, v_scales=v_scales,
+                              kernel=self.kernel)
+
+
+def _is_int8(dtype):
+    return dtype in ("int8", jnp.int8) or jnp.dtype(dtype) == jnp.int8
 
 
 class PagedKVCache:
@@ -116,6 +132,12 @@ class PagedKVCache:
     — so the compiled decode/prefill programs donate exactly two buffers
     regardless of depth. Sizing policy (blocks per context length, the
     admission budget) lives in ONE place: serving/scheduler.py.
+
+    ``dtype=jnp.int8`` turns on the quantized KV mode
+    (quantization/kv_cache.py): int8 pools plus fp32 per-block-per-head
+    scale side-tables ``[L, num_blocks, H]`` (`k_scales`/`v_scales`) —
+    each cached token costs 1 byte per element instead of 4, so the same
+    HBM watermark admits ~2x the streams before `kv_exhausted`.
     """
 
     def __init__(self, num_layers, num_heads, head_dim, num_blocks,
@@ -125,16 +147,48 @@ class PagedKVCache:
         self.head_dim = int(head_dim)
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
-        self.dtype = dtype
+        self.quantized = _is_int8(dtype)
+        self.dtype = jnp.int8 if self.quantized else dtype
         shape = (self.num_layers, self.num_blocks, self.block_size,
                  self.num_heads, self.head_dim)
-        self.k_pools = jnp.zeros(shape, dtype)
-        self.v_pools = jnp.zeros(shape, dtype)
+        self.k_pools = jnp.zeros(shape, self.dtype)
+        self.v_pools = jnp.zeros(shape, self.dtype)
+        if self.quantized:
+            sshape = (self.num_layers, self.num_blocks, self.num_heads)
+            self.k_scales = jnp.zeros(sshape, jnp.float32)
+            self.v_scales = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k_scales = None
+            self.v_scales = None
         self.allocator = BlockAllocator(self.num_blocks)
 
 
+def pool_bytes_per_block(num_layers, num_heads, head_dim, block_size,
+                         dtype=jnp.float32):
+    """Device bytes ONE pool block costs across k+v (and the int8 scale
+    side-tables) over every layer — the unit of the serving capacity
+    math: `pool bytes = num_blocks * pool_bytes_per_block(...)`."""
+    if _is_int8(dtype):
+        payload = block_size * num_heads * head_dim        # 1 byte/elem
+        scales = num_heads * 4
+        return 2 * num_layers * (payload + scales)
+    itemsize = jnp.dtype(dtype).itemsize
+    return 2 * num_layers * block_size * num_heads * head_dim * itemsize
+
+
+def num_blocks_for_bytes(budget_bytes, num_layers, num_heads, head_dim,
+                         block_size, dtype=jnp.float32):
+    """Blocks a byte budget buys (>= 2: the null block + one real one).
+    The int8 capacity win reads directly off this: the same budget buys
+    ~4x the fp32 blocks (~2x bf16), so the watermark admits ~2-4x the
+    concurrent streams before `kv_exhausted` refusals begin."""
+    per = pool_bytes_per_block(num_layers, num_heads, head_dim,
+                               block_size, dtype)
+    return max(2, int(budget_bytes) // per)
+
+
 def scatter_prefill(k_pools, v_pools, k_layers, v_layers, block_row,
-                    length, block_size):
+                    length, block_size, k_scales=None, v_scales=None):
     """Bulk-insert a prefilled prompt's K/V into the pools.
 
     k_layers/v_layers: ``[L, T_bucket, H, D]`` — the per-layer prompt KV
@@ -143,6 +197,11 @@ def scatter_prefill(k_pools, v_pools, k_layers, v_layers, block_row,
     length: scalar int32 — true prompt length; padded positions are
     routed to the null block (their values are garbage by construction
     and never read: gather masks by `seq_lens`).
+
+    With int8 pools, pass the scale side-tables (``[L, num_blocks, H]``):
+    each layer's tokens quantize under freshly computed per-block-per-head
+    scales (quantization/kv_cache.py `quantize_scatter`) and the call
+    returns ``(k_pools, v_pools, k_scales, v_scales)``.
 
     Traceable (runs inside the jitted prefill program). Returns the
     updated pools.
@@ -154,6 +213,20 @@ def scatter_prefill(k_pools, v_pools, k_layers, v_layers, block_row,
                        jnp.asarray(NULL_BLOCK, jnp.int32))
     offs = pidx % block_size
     num_layers = k_layers.shape[0]
+    if k_scales is not None:
+        from ..quantization.kv_cache import quantize_scatter
+        for layer in range(num_layers):
+            kp, ks = quantize_scatter(k_pools[layer], k_scales[layer],
+                                      k_layers[layer], blocks, offs,
+                                      block_row, length)
+            vp, vs = quantize_scatter(v_pools[layer], v_scales[layer],
+                                      v_layers[layer], blocks, offs,
+                                      block_row, length)
+            k_pools = k_pools.at[layer].set(kp)
+            v_pools = v_pools.at[layer].set(vp)
+            k_scales = k_scales.at[layer].set(ks)
+            v_scales = v_scales.at[layer].set(vs)
+        return k_pools, v_pools, k_scales, v_scales
     for layer in range(num_layers):
         k_pools = k_pools.at[layer, blocks, offs].set(
             k_layers[layer].astype(k_pools.dtype))

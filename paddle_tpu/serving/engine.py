@@ -28,7 +28,16 @@ millions of users"), combining:
     tables, join/leave at token boundaries;
   * **streaming detokenization**: per-request `on_token` callbacks fire
     the moment a token is produced (optionally through a tokenizer's
-    `decode`), not when the request completes.
+    `decode`), not when the request completes;
+  * a **kernel tier** (PR 11): the decode step's paged attention runs
+    blockwise streaming softmax over the block table
+    (kernels/pallas/paged_attention.py — Pallas on TPU, a `lax.scan`
+    twin elsewhere; `attention_kernel=` / FLAGS_serve_attention_kernel)
+    instead of gathering a dense `[S, T, H, D]` context, and
+    `kv_dtype="int8"` halves KV bytes per token via per-block-per-head
+    scales (quantization/kv_cache.py) so the same pool admits ~2x the
+    streams — both keyed into the dispatch cache and the AOT
+    fingerprint, attributed via `kernel.fallback` / `kv_quantized`.
 
 Resilience (PR 7, serving/resilience.py) rides every one of those layers:
 
@@ -76,7 +85,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..framework.autograd import set_grad_enabled
 from ..profiler.events import EVENTS as _EVENTS
-from .cache import PagedKVCache, PagedCacheView, scatter_prefill
+from .cache import PagedKVCache, PagedCacheView, scatter_prefill, _is_int8
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
                         FAILED, CANCELLED, EXPIRED)
 from .resilience import (ServeRefusal, MonitoredWait, StepHang,
@@ -202,7 +211,8 @@ class LLMEngine:
     def __init__(self, model, max_batch_size=8, block_size=16,
                  num_blocks=None, max_context=None, watermark_blocks=None,
                  dtype=None, tokenizer=None, max_queue_depth=None,
-                 aging_max_preemptions=3):
+                 aging_max_preemptions=3, kv_dtype=None,
+                 attention_kernel=None):
         cfg = model.config
         model.eval()
         self._model = model
@@ -222,9 +232,29 @@ class LLMEngine:
             dtype = params[0]._value.dtype if params else jnp.float32
         self._dtype = dtype
         head_dim = cfg.hidden_size // cfg.num_attention_heads
+        # kv_dtype="int8" stores the pool quantized (per-block-per-head
+        # scales, quantization/kv_cache.py) — half the bytes per cached
+        # token, so the same pool admits ~2x the streams
+        self._kv_dtype = dtype if kv_dtype is None else (
+            jnp.int8 if _is_int8(kv_dtype) else kv_dtype)
+        self._kv_quantized = _is_int8(self._kv_dtype)
+        # resolve the attention variant ONCE: the compiled decode step
+        # bakes it in (zero retraces under churn); a flag flip only
+        # affects engines built after it
+        from ..nn.functional.attention import resolve_paged_kernel
+        self._attn_kernel = resolve_paged_kernel(
+            attention_kernel, head_dim=head_dim, block_size=self.block_size)
+        if self._kv_quantized:
+            _EVENTS.emit("kernel.quantized", "serve.decode",
+                         reason="kv_quantized",
+                         detail={"kv_dtype": "int8",
+                                 "kernel": self._attn_kernel,
+                                 "num_blocks": int(num_blocks),
+                                 "block_size": self.block_size})
         self.cache = PagedKVCache(cfg.num_hidden_layers,
                                   cfg.num_attention_heads, head_dim,
-                                  num_blocks, self.block_size, dtype)
+                                  num_blocks, self.block_size,
+                                  self._kv_dtype)
         self.scheduler = Scheduler(self.max_batch_size,
                                    self.cache.allocator, self.block_size,
                                    watermark_blocks,
@@ -246,6 +276,8 @@ class LLMEngine:
         self._tokens = np.zeros(s, np.int32)
         self._k_pools = self.cache.k_pools
         self._v_pools = self.cache.v_pools
+        self._k_scales = self.cache.k_scales       # None unless int8 KV
+        self._v_scales = self.cache.v_scales
         self._decode_fn = None
         self._prefill_fns = {}
         # AOT warm start (ops/aot_cache.py): the decode digest is computed
@@ -565,6 +597,8 @@ class LLMEngine:
         snap["scheduler"] = self.scheduler.info()
         snap["kv_blocks"] = self.cache.num_blocks
         snap["block_size"] = self.block_size
+        snap["attention_kernel"] = self._attn_kernel
+        snap["kv_dtype"] = str(jnp.dtype(self._kv_dtype))
         return snap
 
     def reset_stats(self):
@@ -617,7 +651,9 @@ class LLMEngine:
         res = self._prefill_step(fn, padded, np.int32(len(ctx)), row, req)
         if res is None:
             return            # watchdog failed the request, slot is clear
-        nxt, self._k_pools, self._v_pools = res
+        nxt, self._k_pools, self._v_pools = res[0], res[1], res[2]
+        if self._kv_quantized:
+            self._k_scales, self._v_scales = res[3], res[4]
         req.cached_len = len(ctx)
         self._sync_slot(req)
         tok = int(np.asarray(nxt))
@@ -632,8 +668,8 @@ class LLMEngine:
         attempt = 1
         while True:
             try:
-                res = fn(padded, length, row, self._k_pools,
-                         self._v_pools)
+                res = fn(*self._kv_args(padded, length, row,
+                                        self._k_pools, self._v_pools))
                 self._monitor.wait(res, "prefill", attempt)
                 return res
             except StepHang:
@@ -658,6 +694,15 @@ class LLMEngine:
                 self._degrade("step_hang", {"rung": "retry",
                                             "phase": "prefill"})
                 attempt += 1
+
+    def _kv_args(self, *base):
+        """Positional args for the compiled decode/prefill programs:
+        `base` plus the int8 scale side-tables when the pool is
+        quantized — the single source of truth for the signatures'
+        optional trailing pair."""
+        if self._kv_quantized:
+            return base + (self._k_scales, self._v_scales)
+        return base
 
     def _sync_slot(self, req):
         slot = req.slot
@@ -748,9 +793,9 @@ class LLMEngine:
         attempt = 1
         while True:
             try:
-                res = self._decode_fn(
+                res = self._decode_fn(*self._kv_args(
                     self._tokens, self._tables, self._lens, self._active,
-                    self._k_pools, self._v_pools)
+                    self._k_pools, self._v_pools))
                 self._monitor.wait(res, "decode", attempt)
             except StepHang:
                 if not self._on_hang(attempt):
@@ -764,7 +809,7 @@ class LLMEngine:
                               {"organic": True, "error": str(e)[:200]})
                 self._recover_with_fallback(rebuild=True)
                 return None
-            nxt, new_k, new_v = res
+            nxt = res[0]
             if guardian.poll_fault("serve.decode",
                                    ("nan_output", "raise")) is not None:
                 # chaos-poisoned fused decode output: commit NOTHING from
@@ -775,7 +820,9 @@ class LLMEngine:
                 self._degrade("decode_fault", {"injected": True})
                 self._recover_with_fallback(rebuild=False)
                 return None
-            self._k_pools, self._v_pools = new_k, new_v
+            self._k_pools, self._v_pools = res[1], res[2]
+            if self._kv_quantized:
+                self._k_scales, self._v_scales = res[3], res[4]
             self._maybe_store_decode()
             return np.asarray(nxt)
 
@@ -871,7 +918,7 @@ class LLMEngine:
         self.cache = PagedKVCache(cfg.num_hidden_layers,
                                   cfg.num_attention_heads, head_dim,
                                   self._num_blocks, self.block_size,
-                                  self._dtype)
+                                  self._kv_dtype)
         self.scheduler.allocator = self.cache.allocator
         s, m = self.max_batch_size, self.max_blocks_per_seq
         self._tables = np.zeros((s, m), np.int32)
@@ -880,6 +927,8 @@ class LLMEngine:
         self._tokens = np.zeros(s, np.int32)
         self._k_pools = self.cache.k_pools
         self._v_pools = self.cache.v_pools
+        self._k_scales = self.cache.k_scales
+        self._v_scales = self.cache.v_scales
 
     # ------------------------------------------------------------------
     # crash-resume (serving/resilience.py + incubate.ServeCheckpointer)
@@ -966,7 +1015,11 @@ class LLMEngine:
                 ("decode", type(self._model).__qualname__,
                  tuple(sorted(cfg.items())), self.max_batch_size,
                  self.block_size, self._num_blocks,
-                 self.max_blocks_per_seq, str(self._dtype), crc))
+                 self.max_blocks_per_seq, str(self._dtype),
+                 # the kernel tier re-keys the artifact: a blockwise
+                 # executable must never replay as the pallas one, and an
+                 # int8 pool has a different signature entirely
+                 self._attn_kernel, str(jnp.dtype(self._kv_dtype)), crc))
         except Exception:
             dg = None
         self._aot_digest_cache = dg or ""
@@ -984,10 +1037,9 @@ class LLMEngine:
         if not _aot.enabled() or _aot.has_artifact("decode", digest):
             return
         try:
-            specs = tuple(_aot._spec_of(a)
-                          for a in (self._tokens, self._tables,
-                                    self._lens, self._active,
-                                    self._k_pools, self._v_pools))
+            specs = tuple(_aot._spec_of(a) for a in self._kv_args(
+                self._tokens, self._tables, self._lens, self._active,
+                self._k_pools, self._v_pools))
             blobs = [_aot.export_bytes(jitted, specs)]
         except Exception as e:
             from ..profiler.aot import STATS as _ASTATS
@@ -1005,12 +1057,17 @@ class LLMEngine:
         num_layers = model.config.num_hidden_layers
         block_size = self.block_size
         stats = self._stats
+        variant = self._attn_kernel
 
-        def decode(tokens, tables, lens, active, k_pools, v_pools):
+        def decode(tokens, tables, lens, active, k_pools, v_pools,
+                   k_scales=None, v_scales=None):
             stats.decode_compiles += 1   # runs only while tracing
-            views = [PagedCacheView(k_pools[l], v_pools[l], tables, lens,
-                                    active, block_size)
-                     for l in range(num_layers)]
+            views = [PagedCacheView(
+                k_pools[l], v_pools[l], tables, lens, active, block_size,
+                k_scales=None if k_scales is None else k_scales[l],
+                v_scales=None if v_scales is None else v_scales[l],
+                kernel=variant)
+                for l in range(num_layers)]
             with set_grad_enabled(False):
                 logits, new_views = model(
                     Tensor(tokens[:, None], stop_gradient=True),
@@ -1019,9 +1076,14 @@ class LLMEngine:
             new_v = jnp.stack([v.v_pool for v in new_views])
             nxt = jnp.argmax(logits._value[:, -1, :], axis=-1) \
                 .astype(jnp.int32)
+            if k_scales is not None:
+                new_ks = jnp.stack([v.k_scales for v in new_views])
+                new_vs = jnp.stack([v.v_scales for v in new_views])
+                return nxt, new_k, new_v, new_ks, new_vs
             return nxt, new_k, new_v
 
-        jitted = jax.jit(decode, donate_argnums=self._donate((4, 5)))
+        donate = (4, 5, 6, 7) if self._kv_quantized else (4, 5)
+        jitted = jax.jit(decode, donate_argnums=self._donate(donate))
         from ..ops import aot_cache as _aot
         if use_aot and _aot.enabled():
             # warm start: a restarted replica deserializes yesterday's
@@ -1034,7 +1096,7 @@ class LLMEngine:
                 exe = _aot.load_callable(
                     "decode", digest, "serve.decode",
                     fallback=lambda: jitted,
-                    donate_argnums=self._donate((4, 5)))
+                    donate_argnums=self._donate(donate))
                 if exe is not None:
                     return exe
                 self._aot_pending_store = (digest, jitted)
@@ -1051,7 +1113,8 @@ class LLMEngine:
         dt = params[0]._value.dtype if params else jnp.float32
         stats = self._stats
 
-        def prefill(ids, length, block_row, k_pools, v_pools):
+        def prefill(ids, length, block_row, k_pools, v_pools,
+                    k_scales=None, v_scales=None):
             stats.prefill_compiles += 1   # runs only while tracing
             empty = [(Tensor(jnp.zeros((1, 0, heads, head_dim), dt)),) * 2
                      for _ in range(num_layers)]
@@ -1060,12 +1123,13 @@ class LLMEngine:
                                        caches=[tuple(c) for c in empty])
             k_layers = jnp.stack([c[0]._value[0] for c in caches])
             v_layers = jnp.stack([c[1]._value[0] for c in caches])
-            k_pools, v_pools = scatter_prefill(
+            written = scatter_prefill(
                 k_pools, v_pools, k_layers, v_layers, block_row, length,
-                block_size)
+                block_size, k_scales=k_scales, v_scales=v_scales)
             last = jax.lax.dynamic_index_in_dim(
                 logits._value[0], length - 1, axis=0, keepdims=False)
             nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            return nxt, k_pools, v_pools
+            return (nxt,) + tuple(written)
 
-        return jax.jit(prefill, donate_argnums=self._donate((3, 4)))
+        donate = (3, 4, 5, 6) if self._kv_quantized else (3, 4)
+        return jax.jit(prefill, donate_argnums=self._donate(donate))

@@ -120,6 +120,8 @@ class TestPublicContract:
             # persistent AOT executable cache (PR 9, ops/aot_cache.py)
             "aot.hit", "aot.miss", "aot.store", "aot.corrupt",
             "aot.version_skew", "aot.evict",
+            # kernel tier (PR 11, kernels/pallas/ + int8 KV cache)
+            "kernel.fallback", "kernel.quantized",
         })
 
     def test_reason_codes_exact(self):
@@ -146,6 +148,8 @@ class TestPublicContract:
             "collective_unkeyed", "mesh_mismatch", "spmd_divergence",
             # AOT executable-store decisions (PR 9, ops/aot_cache.py)
             "artifact_corrupt", "version_skew",
+            # kernel tier (PR 11, FLAGS_serve_attention_kernel + int8 KV)
+            "kernel_fallback", "kv_quantized",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

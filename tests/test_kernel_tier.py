@@ -1,0 +1,524 @@
+"""Kernel tier suite (ISSUE 11): blockwise/Pallas paged decode attention
++ the int8 KV cache.
+
+Contracts pinned here:
+
+  * fused-vs-reference parity — the blockwise (lax.scan online-softmax)
+    and Pallas (interpret=True on CPU) variants match the dense
+    gather-by-block-table oracle to fp32 tolerance, share its exact
+    write path bitwise, and agree on every edge shape: seq_len at an
+    exact block boundary, a slot right after prefill (zero generated
+    tokens), and an inactive slot whose table still points at null
+    block 0;
+  * fp32 softmax numerics — bf16 serving computes scores/softmax/PV in
+    fp32 (the satellite fix), so the bf16 paged path tracks an all-fp32
+    computation to input-rounding error, not accumulation error;
+  * int8 KV — quantize->dequantize error is bounded by half a quant step
+    per element (per-block-per-head scales), greedy decode through the
+    int8 pool is token-identical to fp32 KV on the tiny-GPT fixture
+    (incl. under preemption churn), and the same byte budget admits
+    >= 1.8x the concurrent streams before the pool runs dry;
+  * keying — FLAGS_serve_attention_kernel is keyed into the per-op
+    dispatch cache (each variant is a distinct executable) and the AOT
+    env fingerprint / decode digest (kernel flips never deserialize a
+    stale artifact); kernel fallbacks are attributed `kernel.fallback`
+    events, never silent;
+  * perf floors (perf_smoke) — blockwise beats the dense gather at
+    seq >= 1k on CPU, and an int8 engine compiles decode exactly once
+    under churn.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.nn.functional.attention import (paged_decode_attention,
+                                                resolve_paged_kernel,
+                                                PAGED_KERNELS)
+from paddle_tpu.quantization.kv_cache import (QMAX, quantize_scatter,
+                                              quantize_block_write,
+                                              dequantize)
+from paddle_tpu.serving import LLMEngine, num_blocks_for_bytes
+from paddle_tpu.profiler.events import (clear_fusion_events, fusion_events,
+                                        EVENTS)
+
+VOCAB = 128
+
+VARIANTS = ("reference", "blockwise", "pallas")
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _prompt(length, seed=0):
+    rng = np.random.default_rng(seed * 1000 + length)
+    return rng.integers(0, VOCAB, length).tolist()
+
+
+_REF_CACHE = {}
+
+
+def _ref(model, prompt, n):
+    key = (tuple(prompt), n)
+    if key not in _REF_CACHE:
+        out = model.generate(paddle.Tensor(np.asarray([prompt], np.int64)),
+                             max_new_tokens=n, do_sample=False)
+        _REF_CACHE[key] = np.asarray(out._value)[0].tolist()
+    return _REF_CACHE[key]
+
+
+def _paged_state(S=4, H=3, D=16, bs=4, M=6, lens=(0, 4, 8, 23),
+                 active=(True, True, True, True), seed=0,
+                 dtype=jnp.float32):
+    """A filled paged-cache state: per-slot dense-prefix block tables over
+    disjoint pool blocks, pools populated with random history."""
+    rng = np.random.default_rng(seed)
+    nb = S * M + 1
+    mk = lambda sh: jnp.asarray(
+        rng.standard_normal(sh).astype(np.float32)).astype(dtype)
+    q, kn, vn = mk((S, 1, H, D)), mk((S, 1, H, D)), mk((S, 1, H, D))
+    kp, vp = mk((nb, bs, H, D)), mk((nb, bs, H, D))
+    tables = jnp.asarray(np.stack(
+        [1 + s * M + np.arange(M) for s in range(S)]).astype(np.int32))
+    return (q, kn, vn, kp, vp, tables,
+            jnp.asarray(np.asarray(lens, np.int32)),
+            jnp.asarray(np.asarray(active, bool)))
+
+
+def _run(variant, state, bs, **kw):
+    q, kn, vn, kp, vp, tables, lens, active = state
+    interpret = variant == "pallas"
+    return paged_decode_attention(q, kn, vn, kp, vp, tables, lens, active,
+                                  bs, kernel=variant, interpret=interpret,
+                                  **kw)
+
+
+# ---------------------------------------------------------------------------
+# fused-vs-reference parity + edge cases
+# ---------------------------------------------------------------------------
+
+class TestVariantParity:
+    def test_blockwise_and_pallas_match_dense_oracle(self):
+        """Core parity: identical semantics across the three variants to
+        fp32 tolerance (the Pallas kernel runs interpret=True on CPU),
+        and a BITWISE-identical pool write path."""
+        bs = 4
+        state = _paged_state(bs=bs)
+        o_ref, k_ref, v_ref = _run("reference", state, bs)
+        o_bw, k_bw, v_bw = _run("blockwise", state, bs)
+        o_pl, k_pl, v_pl = _run("pallas", state, bs)
+        act = np.asarray(state[-1])
+        for name, o in (("blockwise", o_bw), ("pallas", o_pl)):
+            np.testing.assert_allclose(
+                np.asarray(o)[act], np.asarray(o_ref)[act],
+                rtol=1e-5, atol=1e-5, err_msg=name)
+        for k in (k_bw, k_pl):
+            assert np.array_equal(np.asarray(k), np.asarray(k_ref))
+        for v in (v_bw, v_pl):
+            assert np.array_equal(np.asarray(v), np.asarray(v_ref))
+
+    @pytest.mark.parametrize("variant", VARIANTS)
+    def test_seq_len_at_exact_block_boundary(self, variant):
+        """len == k*block_size: the new token opens a FRESH block (write
+        at offset 0 of table entry k) and attention spans the boundary."""
+        bs = 4
+        for length in (bs, 2 * bs, 5 * bs):
+            state = _paged_state(S=2, M=6, bs=bs,
+                                 lens=(length, length - 1),
+                                 active=(True, True), seed=length)
+            o_ref, k_ref, _ = _run("reference", state, bs)
+            if variant == "reference":
+                # the boundary write must land at (table[len//bs], 0)
+                tables = np.asarray(state[5])
+                blk = tables[0, length // bs]
+                written = np.asarray(k_ref)[blk, 0]
+                expect = np.asarray(state[1])[0, 0]
+                np.testing.assert_allclose(written, expect, rtol=1e-6)
+                continue
+            out, k_pool, _ = _run(variant, state, bs)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(o_ref),
+                                       rtol=1e-5, atol=1e-5)
+            assert np.array_equal(np.asarray(k_pool), np.asarray(k_ref))
+
+    @pytest.mark.parametrize("variant", VARIANTS)
+    def test_inactive_slot_null_table_does_not_perturb_neighbors(
+            self, variant):
+        """An inactive slot whose table still points at null block 0:
+        its garbage stays in block 0, active slots' outputs equal the
+        solo computation, and no NaN leaks anywhere."""
+        bs = 4
+        state = _paged_state(S=3, M=4, bs=bs, lens=(7, 0, 5),
+                             active=(True, False, True))
+        q, kn, vn, kp, vp, tables, lens, active = state
+        # zero the inactive slot's table entirely (the engine's cleared
+        # slot shape)
+        tables = tables.at[1].set(0)
+        out, new_k, new_v = paged_decode_attention(
+            q, kn, vn, kp, vp, tables, lens, active, bs, kernel=variant,
+            interpret=(variant == "pallas"))
+        solo = paged_decode_attention(
+            q, kn, vn, kp, vp, tables,
+            lens, jnp.asarray([True, True, True]), bs, kernel="reference")
+        # active rows agree with a run where slot 1's table is unchanged
+        np.testing.assert_allclose(np.asarray(out)[[0, 2]],
+                                   np.asarray(solo[0])[[0, 2]],
+                                   rtol=1e-5, atol=1e-5)
+        assert np.isfinite(np.asarray(out)[[0, 2]]).all()
+        # only the null block and the two active write targets changed
+        diff = np.where(np.any(np.asarray(new_k) != np.asarray(kp),
+                               axis=(1, 2, 3)))[0]
+        tables_np = np.asarray(tables)
+        allowed = {0, int(tables_np[0, 7 // bs]), int(tables_np[2, 5 // bs])}
+        assert set(diff.tolist()) <= allowed
+
+    def test_zero_generated_tokens_right_after_prefill(self, model):
+        """The first decode step after admission (cached_len == prompt
+        len, nothing generated yet) produces exactly the reference's
+        first token — for every kernel variant and the int8 pool."""
+        p = _prompt(9, seed=11)
+        first = _ref(model, p, 1)[0]
+        for kw in ({"attention_kernel": "reference"},
+                   {"attention_kernel": "blockwise"},
+                   {"kv_dtype": "int8"}):
+            engine = LLMEngine(model, max_batch_size=2, block_size=4, **kw)
+            req = engine.add_request(p, max_new_tokens=3)
+            engine.step()
+            assert req.generated[:1] == [first], kw
+
+
+# ---------------------------------------------------------------------------
+# fp32 softmax numerics (bf16 serving keeps its tail tokens)
+# ---------------------------------------------------------------------------
+
+class TestBf16Numerics:
+    @pytest.mark.parametrize("variant", ("reference", "blockwise"))
+    def test_bf16_paged_attention_tracks_fp32(self, variant):
+        """Scores + softmax + PV accumulate in fp32 even for bf16
+        inputs: the bf16 path must track the all-fp32 computation to
+        INPUT-rounding error (~1e-2 for bf16), with a long history whose
+        tail would vanish under bf16 accumulation."""
+        bs = 4
+        st16 = _paged_state(S=2, H=2, D=8, M=16, bs=bs, lens=(60, 31),
+                            active=(True, True), dtype=jnp.bfloat16)
+        st32 = tuple(x.astype(jnp.float32)
+                     if x.dtype == jnp.bfloat16 else x for x in st16)
+        out16 = _run(variant, st16, bs)[0]
+        out32 = _run("reference", st32, bs)[0]
+        assert out16.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out16, np.float32), np.asarray(out32),
+            rtol=0.0, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache
+# ---------------------------------------------------------------------------
+
+class TestInt8KV:
+    def test_quantize_roundtrip_error_bound_per_block(self):
+        """quantize->dequantize error <= half a quant step per element,
+        where the step is that block's per-head scale / 127."""
+        rng = np.random.default_rng(3)
+        bs, H, D, nb = 4, 3, 8, 9
+        T = 24
+        vals = jnp.asarray(rng.standard_normal((T, H, D)).astype(np.float32)
+                           * rng.uniform(0.1, 10.0, (T, 1, 1)))
+        pool = jnp.zeros((nb, bs, H, D), jnp.int8)
+        scales = jnp.full((nb, H), 7.7, jnp.float32)  # stale tenant scale
+        block_row = jnp.asarray([1, 2, 3, 4, 5, 6, 0, 0], jnp.int32)
+        pidx = np.arange(T)
+        blocks = jnp.asarray(np.where(pidx < 22, block_row[pidx // bs], 0)
+                             .astype(np.int32))
+        offs = jnp.asarray((pidx % bs).astype(np.int32))
+        pool, scales = quantize_scatter(pool, scales, vals, blocks, offs,
+                                        block_row, jnp.int32(22))
+        deq = np.asarray(dequantize(pool, scales))
+        sc = np.asarray(scales)
+        for t in range(22):
+            b, o = int(blocks[t]), int(offs[t])
+            err = np.abs(deq[b, o] - np.asarray(vals)[t])
+            bound = sc[b][:, None] / QMAX * 0.5 + 1e-6
+            assert (err <= bound).all(), f"token {t}"
+
+    def test_block_write_requant_is_stable_and_bounded(self):
+        """Appending tokens one by one into a block: stored values stay
+        within half a quant step of the LAST-written fp values (requant
+        is exact while the scale does not grow), and the scale is the
+        running per-head amax."""
+        rng = np.random.default_rng(4)
+        bs, H, D = 8, 2, 4
+        pool = jnp.zeros((3, bs, H, D), jnp.int8)
+        scales = jnp.zeros((3, H), jnp.float32)
+        written = []
+        for i in range(bs):
+            vec = jnp.asarray(
+                rng.standard_normal((1, H, D)).astype(np.float32) * (i + 1))
+            written.append(np.asarray(vec)[0])
+            pool, scales = quantize_block_write(
+                pool, scales, vec, jnp.asarray([1], jnp.int32),
+                jnp.asarray([i], jnp.int32))
+        deq = np.asarray(dequantize(pool, scales))[1]       # [bs, H, D]
+        sc = np.asarray(scales)[1]                          # [H]
+        amax = np.abs(np.stack(written)).max(axis=(0, 2))
+        np.testing.assert_allclose(sc, amax, rtol=1e-5)
+        for i, vec in enumerate(written):
+            # requant error accrues only on scale-raising writes: each of
+            # the <= bs regrids adds at most half a (then-current <=
+            # final) quant step — this schedule raises the scale on EVERY
+            # write, the worst case
+            bound = sc[:, None] / QMAX * (0.5 * bs)
+            assert (np.abs(deq[i] - vec) <= bound + 1e-6).all(), i
+
+    def test_int8_greedy_decode_token_identical_to_fp32(self, model):
+        """End-to-end: the int8-KV engine reproduces the fp32 reference
+        stream token for token on the tiny-GPT fixture — including under
+        preemption churn (evict -> requeue -> re-prefill requantizes)."""
+        prompts = [_prompt(n, seed=21) for n in (11, 5, 17, 3)]
+        refs = [_ref(model, p, 10) for p in prompts]
+        engine = LLMEngine(model, max_batch_size=4, block_size=4,
+                           kv_dtype="int8")
+        outs = engine.generate(prompts, max_new_tokens=10)
+        assert outs == refs
+        st = engine.stats()
+        assert st["kv_dtype"] == "int8"
+        assert st["decode_compiles"] == 1
+        # tight pool: eviction + resume stays token-identical on int8
+        prompts2 = [_prompt(n, seed=22) for n in (11, 12, 10, 5)]
+        refs2 = [_ref(model, p, 10) for p in prompts2]
+        churn = LLMEngine(model, max_batch_size=3, block_size=4,
+                          num_blocks=10, watermark_blocks=1,
+                          kv_dtype="int8")
+        outs2 = churn.generate(prompts2, max_new_tokens=10)
+        st2 = churn.stats()
+        assert st2["evictions"] >= 1
+        assert outs2 == refs2
+        assert st2["decode_compiles"] == 1
+
+    def test_int8_admits_1p8x_streams_at_same_pool_bytes(self, model):
+        """The capacity win: with the SAME byte budget, the int8 pool
+        admits >= 1.8x the concurrent streams before it runs dry
+        (admission here is pure host-side block accounting — no
+        compiles)."""
+        cfg = model.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        budget = 96 * 1024
+
+        def admitted(kv_dtype, dt):
+            nb = num_blocks_for_bytes(budget, cfg.num_hidden_layers,
+                                      cfg.num_attention_heads, head_dim,
+                                      4, dt)
+            eng = LLMEngine(model, max_batch_size=96, block_size=4,
+                            num_blocks=nb, watermark_blocks=1,
+                            kv_dtype=kv_dtype)
+            for i in range(96):
+                eng.add_request(_prompt(8, seed=30 + i), max_new_tokens=8)
+            n = 0
+            while eng.scheduler.try_admit() is not None:
+                n += 1
+            return n
+
+        n_fp32 = admitted(None, jnp.float32)
+        n_int8 = admitted("int8", jnp.int8)
+        assert n_int8 >= 1.8 * n_fp32, (n_int8, n_fp32)
+
+
+# ---------------------------------------------------------------------------
+# keying: dispatch cache, AOT fingerprint, fallback attribution
+# ---------------------------------------------------------------------------
+
+class TestKernelKeying:
+    def test_variant_is_keyed_into_dispatch_cache(self, model):
+        """Flipping the kernel variant re-keys the paged attention op in
+        the per-op executable cache: each variant is a distinct MISS,
+        repeats are HITS — never a stale replay of the other variant."""
+        from paddle_tpu.framework.core import Tensor
+        from paddle_tpu.serving.cache import PagedCacheView
+
+        cfg = model.config
+        attn = model.gpt.h[0].attn
+        S, bs, M = 2, 4, 4
+        nb = S * M + 1
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        rng = np.random.default_rng(5)
+        x = Tensor(jnp.asarray(rng.standard_normal(
+            (S, 1, cfg.hidden_size)).astype(np.float32)),
+            stop_gradient=True)
+        pools = jnp.asarray(rng.standard_normal(
+            (nb, bs, cfg.num_attention_heads, head_dim)).astype(np.float32))
+        tables = jnp.asarray(np.stack(
+            [1 + s * M + np.arange(M) for s in range(S)]).astype(np.int32))
+        lens = jnp.asarray([3, 5], jnp.int32)
+        active = jnp.ones((S,), bool)
+
+        prev = get_flags(["FLAGS_profiler_events"])
+        set_flags({"FLAGS_profiler_events": True})
+        clear_fusion_events()
+        try:
+            for variant in ("reference", "blockwise",
+                            "reference", "blockwise"):
+                view = PagedCacheView(pools, pools, tables, lens, active,
+                                      bs, kernel=variant)
+                attn(x, cache=view)
+        finally:
+            set_flags(prev)
+        ev = [e for e in fusion_events("dispatch")
+              if e["op"] == "gpt_paged_decode_attention"]
+        misses = [e for e in ev if e["cat"] == "dispatch.miss"]
+        hits = [e for e in ev if e["cat"] == "dispatch.hit"]
+        assert len(misses) == 2, [e["cat"] for e in ev]
+        assert len(hits) == 2, [e["cat"] for e in ev]
+
+    def test_flag_keyed_into_aot_env_fingerprint(self):
+        """A kernel flip re-fingerprints the AOT store so a stale
+        artifact misses by construction."""
+        from paddle_tpu.ops import aot_cache
+        prev = get_flags(["FLAGS_serve_attention_kernel"])
+        try:
+            set_flags({"FLAGS_serve_attention_kernel": "blockwise"})
+            d_block = aot_cache.fingerprint_digest()
+            fp = aot_cache.env_fingerprint()
+            assert ("FLAGS_serve_attention_kernel", "blockwise") \
+                in fp["flags"]
+            set_flags({"FLAGS_serve_attention_kernel": "reference"})
+            d_ref = aot_cache.fingerprint_digest()
+            assert d_block != d_ref
+            set_flags({"FLAGS_serve_attention_kernel": "blockwise"})
+            assert aot_cache.fingerprint_digest() == d_block
+        finally:
+            set_flags(prev)
+
+    def test_decode_digest_rekeys_on_kernel_and_kv_dtype(self, model):
+        """The engine's AOT decode digest separates kernel variants and
+        KV dtypes — a blockwise/int8 artifact never replays elsewhere."""
+        digs = set()
+        for kw in ({"attention_kernel": "reference"},
+                   {"attention_kernel": "blockwise"},
+                   {"kv_dtype": "int8"}):
+            eng = LLMEngine(model, max_batch_size=2, block_size=4, **kw)
+            d = eng._aot_decode_digest()
+            assert d is not None
+            digs.add(d)
+        assert len(digs) == 3
+
+    def test_pallas_fallback_is_attributed_not_silent(self):
+        """Requesting the Pallas kernel off-TPU demotes to blockwise AND
+        emits a kernel.fallback event with the why."""
+        prev = get_flags(["FLAGS_profiler_events"])
+        set_flags({"FLAGS_profiler_events": True})
+        clear_fusion_events()
+        try:
+            got = resolve_paged_kernel("pallas", head_dim=64, block_size=16)
+        finally:
+            set_flags(prev)
+        assert got == "blockwise"
+        ev = [e for e in fusion_events("kernel.fallback")]
+        assert len(ev) == 1
+        assert ev[0]["reason"] == "kernel_fallback"
+        assert ev[0]["detail"]["requested"] == "pallas"
+        assert ev[0]["detail"]["actual"] == "blockwise"
+        assert ev[0]["detail"]["why"] == "not_on_tpu"
+
+    def test_kv_quantized_engine_is_attributed(self, model):
+        """Building an int8-KV engine leaves a kv_quantized marker in
+        the flight recorder and the doctor's kernel section/hints."""
+        from paddle_tpu.profiler.explain import explain, REASON_HINTS
+        prev = get_flags(["FLAGS_profiler_events"])
+        set_flags({"FLAGS_profiler_events": True})
+        clear_fusion_events()
+        try:
+            LLMEngine(model, max_batch_size=2, block_size=4,
+                      kv_dtype="int8")
+        finally:
+            set_flags(prev)
+        ev = fusion_events("kernel.quantized")
+        assert any(e["reason"] == "kv_quantized" for e in ev)
+        # the marker is informational: it must NOT pollute the fallback
+        # (demotion) stream
+        assert fusion_events("kernel.fallback") == []
+        report = explain(fusion_events())
+        assert "kernel" in report
+        assert "kv_quantized" in report["kernel"]["reasons"]
+        assert any("kv_quantized" in f for f in report["findings"])
+        assert "kv_quantized" in REASON_HINTS
+        assert "kernel_fallback" in REASON_HINTS
+
+    def test_unknown_variant_rejected(self):
+        with pytest.raises(ValueError, match="unknown paged attention"):
+            resolve_paged_kernel("warp")
+        assert set(PAGED_KERNELS) == {"pallas", "blockwise", "reference"}
+
+
+# ---------------------------------------------------------------------------
+# perf floors (mirrored in tools/perf_smoke.py leg j)
+# ---------------------------------------------------------------------------
+
+class TestPerfFloors:
+    @pytest.mark.perf_smoke
+    def test_blockwise_beats_dense_gather_at_seq_1k(self):
+        """The kernel tier's reason to exist on CPU: at seq >= 1k the
+        streaming path must beat materializing the [S, T, H, D] context
+        (best-of-windows against CI noise)."""
+        import time
+        S, H, D, bs, M = 8, 4, 32, 16, 64          # seq = 1024
+        nb = S * M + 1
+        state = _paged_state(S=S, H=H, D=D, bs=bs, M=M,
+                             lens=(1000,) * S, active=(True,) * S)
+        q, kn, vn, kp, vp, tables, lens, active = state
+        assert kp.shape[0] == nb
+
+        def jit_of(kernel):
+            @jax.jit
+            def f(q, kn, vn, kp, vp):
+                return paged_decode_attention(
+                    q, kn, vn, kp, vp, tables, lens, active, bs,
+                    kernel=kernel)[0]
+            f(q, kn, vn, kp, vp).block_until_ready()
+            return f
+
+        def window(f, iters=10):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                f(q, kn, vn, kp, vp).block_until_ready()
+            return (time.perf_counter() - t0) / iters
+
+        f_dense, f_block = jit_of("reference"), jit_of("blockwise")
+        # interleaved paired windows, guard the MAX ratio: a real
+        # regression deflates every pair, a load spike only some
+        ratios = []
+        for _ in range(6):
+            ratios.append(window(f_dense) / window(f_block))
+        assert max(ratios) > 1.0, (
+            f"blockwise never beat the dense gather at seq 1k: "
+            f"paired ratios {[round(r, 2) for r in ratios]}")
+
+    @pytest.mark.perf_smoke
+    def test_int8_decode_compiles_once_under_churn(self, model):
+        """int8 KV is value edits + two extra donated side-tables —
+        never a shape change: 24 churning streams, ONE decode trace."""
+        prompts = [_prompt(3 + (i % 9), seed=40) for i in range(24)]
+        engine = LLMEngine(model, max_batch_size=4, block_size=4,
+                           kv_dtype="int8")
+        engine.generate(prompts, max_new_tokens=5)
+        st = engine.stats()
+        assert st["decode_compiles"] == 1
+        assert st["completed"] == 24

@@ -152,8 +152,10 @@ def _demo_serve(steps):
     GPT over a deliberately tight KV pool AND a bounded queue, so the
     report shows the full serve.* lifecycle — kv_exhausted evictions plus
     the PR 7 resilience codes (queue_full refusal, client_cancel,
-    deadline_expired). `--steps` is the number of requests churned
-    through the batch."""
+    deadline_expired) — and the PR 11 kernel-tier codes: the engine
+    requests the Pallas kernel (demoted to blockwise off-TPU:
+    `kernel_fallback`) over an int8 KV pool (`kv_quantized`). `--steps`
+    is the number of requests churned through the batch."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
@@ -169,7 +171,8 @@ def _demo_serve(steps):
     model.eval()
     engine = LLMEngine(model, max_batch_size=3, block_size=4,
                        num_blocks=10, watermark_blocks=1,
-                       max_queue_depth=max(4, steps))
+                       max_queue_depth=max(4, steps),
+                       attention_kernel="pallas", kv_dtype="int8")
     rng = np.random.default_rng(0)
     base = (11, 12, 10, 5, 7, 9)
     prompts = [rng.integers(0, 128, base[i % len(base)]).tolist()

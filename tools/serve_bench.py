@@ -72,8 +72,13 @@ def _workload(streams, vocab, max_prompt, seed=0):
 
 
 def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
-                    model=None):
-    """One serving bench leg; returns a bench.py-style record dict."""
+                    model=None, kernel=None, kv_dtype=None):
+    """One serving bench leg; returns a bench.py-style record dict.
+
+    `kernel` pins the attention variant (default: the engine resolves
+    FLAGS_serve_attention_kernel); `kv_dtype="int8"` runs the quantized
+    KV pool. Both land in the record's extra so a bench trajectory always
+    says WHICH kernel tier produced its numbers."""
     import jax
     import numpy as np
     from paddle_tpu.framework.flags import get_flags, set_flags
@@ -91,19 +96,23 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
     # config); more streams than slots is the point — they churn through
     max_batch = min(streams, 8)
     max_prompt = 48 if on_tpu else 24
-    engine = LLMEngine(model, max_batch_size=max_batch,
-                       block_size=16 if on_tpu else 8,
-                       max_context=max_prompt + max_new_tokens + 8,
-                       # bounded queue sized generously for the leg: the
-                       # backpressure counters below stay 0 in a healthy
-                       # run and move in the trajectory when admission or
-                       # deadline behavior regresses
-                       max_queue_depth=4 * streams)
-
     clear_fusion_events()
     prev = get_flags(["FLAGS_profiler_events"])
     set_flags({"FLAGS_profiler_events": True})
     try:
+        # build the engine with the recorder already armed: construction
+        # is where the kernel-tier attribution fires (kernel.fallback on
+        # a demoted variant, kernel.quantized for an int8 pool) and the
+        # bench's event record must contain it
+        engine = LLMEngine(model, max_batch_size=max_batch,
+                           block_size=16 if on_tpu else 8,
+                           max_context=max_prompt + max_new_tokens + 8,
+                           # bounded queue sized generously for the leg:
+                           # the backpressure counters below stay 0 in a
+                           # healthy run and move in the trajectory when
+                           # admission or deadline behavior regresses
+                           max_queue_depth=4 * streams,
+                           attention_kernel=kernel, kv_dtype=kv_dtype)
         prompts = _workload(streams, cfg.vocab_size, max_prompt)
         # warmup: compile the decode program and every prefill bucket the
         # workload will hit (one representative prompt per bucket)
@@ -149,6 +158,11 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             "streams": streams,
             "max_batch": max_batch,
             "max_new_tokens": max_new_tokens,
+            # kernel tier (PR 11): which attention variant + KV dtype
+            # produced these numbers — a perf trajectory without this is
+            # uninterpretable once the flag matrix exists
+            "attention_kernel": snap["attention_kernel"],
+            "kv_dtype": snap["kv_dtype"],
             "p50_step_ms": round(snap["p50_step_ms"], 4),
             "p99_step_ms": round(snap["p99_step_ms"], 4),
             "decode_steps": snap["steps"],
@@ -188,6 +202,12 @@ def main(argv=None) -> int:
                     "(paddle_tpu.serving.LLMEngine)")
     ap.add_argument("--streams", type=int, default=8,
                     help="concurrent request streams (default 8)")
+    ap.add_argument("--kernel", default=None,
+                    choices=("pallas", "blockwise", "reference"),
+                    help="attention kernel variant (default: "
+                         "FLAGS_serve_attention_kernel)")
+    ap.add_argument("--kv-dtype", default=None, choices=("int8",),
+                    help="quantized KV cache mode (default: model dtype)")
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trace", default=None,
                     help="directory for a jax profiler trace of a few "
@@ -201,13 +221,15 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     rec = run_serve_bench(args.streams, on_tpu,
                           max_new_tokens=args.max_new_tokens,
-                          trace_dir=args.trace)
+                          trace_dir=args.trace, kernel=args.kernel,
+                          kv_dtype=args.kv_dtype)
     rec["elapsed_s"] = round(time.perf_counter() - t0, 1)
     if args.json:
         print(json.dumps(rec, indent=2))
     else:
         ex = rec["extra"]
         print(f"serve_bench: {args.streams} stream(s) on {rec['platform']} "
+              f"[{ex['attention_kernel']}, kv {ex['kv_dtype']}] "
               f"-> {rec['value']} tok/s, p50 {ex['p50_step_ms']} ms, "
               f"p99 {ex['p99_step_ms']} ms, "
               f"occupancy {ex['occupancy_mean']} "
