@@ -87,19 +87,11 @@ def with_retry(fn, name, attempts=3, delays=(10, 30), deadline=None):
 
 
 def peak_flops_per_chip():
-    """bf16 peak for the local chip. TPU v5 lite (v5e): 197 TFLOP/s."""
-    import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v4" in kind:
-        return 275e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v6" in kind or "trillium" in kind:
-        return 918e12
-    return 197e12  # conservative default
+    """bf16 peak for the local chip — the goodput accountant's table
+    (profiler/goodput.py) is the single source of truth, so the bench's
+    MFU and the live registry's MFU divide by the same denominator."""
+    from paddle_tpu.profiler.goodput import peak_flops_per_chip as peak
+    return peak()
 
 
 def _trace(config_name, platform, fn):
@@ -140,8 +132,14 @@ def _gpt_train_record(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     # leave the recorder armed, nor may a finished run disarm a user's
     # globally-enabled recorder
     clear_fusion_events()
-    prev = get_flags(["FLAGS_profiler_events"])
-    set_flags({"FLAGS_profiler_events": True})
+    # telemetry plane armed for the run (PR 12): the headline's MFU /
+    # tokens-per-second are READ BACK from the goodput accountant +
+    # metrics registry — bench numbers and production numbers are the
+    # same computation by construction
+    from paddle_tpu.profiler.metrics import reset_metrics
+    reset_metrics()
+    prev = get_flags(["FLAGS_profiler_events", "FLAGS_metrics"])
+    set_flags({"FLAGS_profiler_events": True, "FLAGS_metrics": True})
     try:
         return _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu,
                                    trace_tag)
@@ -177,16 +175,30 @@ def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
     x = paddle.Tensor(ids, stop_gradient=True)
     y = paddle.Tensor(labels, stop_gradient=True)
 
+    from paddle_tpu.profiler.goodput import ACCOUNTANT as _acct
+    flops_per_token = model.flops_per_token(seq, training=True)
+
     float(step(x, y))                   # warmup / compile
+    # fresh accountant window over exactly the measured steps: the
+    # registry's rolling MFU/tokens-per-second below IS the headline
+    _acct.reset(warm=True)
+    _acct.set_flops_per_step(flops_per_token * batch * seq,
+                             tokens=batch * seq,
+                             peak=peak_flops_per_chip())
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(x, y)
     final = float(loss)                 # blocks on the last step
+    _acct.finalize()                    # tail device time joins the window
     elapsed = time.perf_counter() - t0
 
-    tokens_per_sec = batch * seq * steps / elapsed
-    flops_per_token = model.flops_per_token(seq, training=True)
-    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    goodput = _acct.snapshot()
+    tokens_per_sec = goodput["tokens_per_sec"]
+    mfu = goodput["mfu"]
+    # offline cross-check (the pre-PR 12 computation): the live registry
+    # number must stay within a few percent of it — tests assert 2%
+    offline_tps = batch * seq * steps / elapsed
+    mfu_offline = offline_tps * flops_per_token / peak_flops_per_chip()
 
     platform = jax.devices()[0].platform
     tdir = _trace(trace_tag, platform, lambda: float(step(x, y)))
@@ -212,6 +224,13 @@ def _gpt_train_measured(metric, cfg, batch, steps, seq, on_tpu, trace_tag):
         "vs_baseline": round(mfu / 0.45, 4),
         "platform": platform,
         "extra": {"mfu": round(mfu, 4), "loss": round(final, 3),
+                  # offline cross-check of the registry-read MFU (same
+                  # formula bench used before the telemetry plane)
+                  "mfu_offline": round(mfu_offline, 4),
+                  "tokens_per_sec_offline": round(offline_tps, 1),
+                  # live accountant view: goodput + wall-time buckets +
+                  # step-time percentiles for this exact window
+                  "goodput": goodput,
                   "batch": batch, "seq": seq, "params": n_params,
                   "platform": platform, "trace": tdir,
                   "dispatch_cache": dispatch_cache_stats(),

@@ -347,6 +347,11 @@ def _dispatch_collective(name, fn, tensor, key):
     funnel (no-grad: collectives are data-plane ops, not tape nodes)."""
     from ..ops.dispatch import call_op, mark_collective
     from ..framework.autograd import no_grad
+    from ..profiler import metrics as _metrics
+    if _metrics.enabled():
+        # telemetry plane: per-kind collective dispatch counter (the
+        # per-mesh fused-step timing lives in goodput's spmd histogram)
+        _metrics.TRAIN.collectives.labels(kind=name).inc()
     mark_collective(fn, key)
     with no_grad():
         return call_op(name, fn, [tensor])

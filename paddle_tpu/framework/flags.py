@@ -258,6 +258,29 @@ define_flag("FLAGS_profiler_events_capacity", 65536,
             "ring is (re)created — clear_fusion_events() picks up a "
             "changed value")
 
+# Production telemetry plane (profiler/metrics.py + profiler/goodput.py):
+# a typed, thread-safe metrics registry (counters, gauges, bounded
+# log-bucket streaming histograms with labels) plus a live training
+# accountant deriving rolling MFU / tokens-per-second / goodput from the
+# step stream. Follows the flight recorder's cost discipline: when off,
+# every instrumentation site degenerates to a single flag check; when on,
+# an observation is O(1) work against preallocated bucket arrays — memory
+# never grows with run length. Exposed via registry.exposition()
+# (Prometheus text format), tools/metrics_export.py (crash-safe JSONL
+# sink, mergeable across processes), and `fusion_doctor --metrics`.
+define_flag("FLAGS_metrics", False,
+            "record production metrics (counters/gauges/histograms) into "
+            "the in-process registry (profiler/metrics.py) and run the "
+            "live MFU/goodput accountant (profiler/goodput.py). Off by "
+            "default: every site is one flag check "
+            "(tools/perf_smoke.py guards <3%/step off, <5%/step on)")
+define_flag("FLAGS_metrics_window", 100_000,
+            "sliding-window size (observations) of the registry's "
+            "streaming histograms: percentiles are computed over the "
+            "current + previous window bands, so a long-running process "
+            "reports FRESH p50/p99 instead of an all-of-history average "
+            "that froze hours ago. 0 = cumulative (never rotate)")
+
 # Persistent AOT executable cache (ops/aot_cache.py): content-addressed
 # on-disk store of `jax.export`-serialized fused executables — per-op
 # forward / forward+vjp pairs, fused chains, promoted whole-step programs,

@@ -168,4 +168,8 @@ class TrainStep:
                     opt._accumulators[n][p.name] = v
         for b, v in zip(self._buffers, new_b):
             b._value = v
+        # goodput accountant (profiler/goodput.py): the explicit fused
+        # TrainStep never crosses Optimizer.step, so the boundary is here
+        from ..profiler import goodput as _goodput
+        _goodput.on_step(opt)
         return Tensor(loss)

@@ -1100,6 +1100,11 @@ class _StepFusionManager:
             elapsed = time.perf_counter_ns() - pending.t0
             STEP_STATS.replay(program.label, program.n_launches,
                               program.baseline_ns - elapsed)
+            # telemetry plane (profiler/goodput.py): per-mesh SPMD step
+            # labeling + cycle-derived analytic FLOPs/step; one flag
+            # check when FLAGS_metrics is off
+            from ..profiler import goodput as _goodput
+            _goodput.on_fused_fire(program)
             _EVENTS.emit("step.fire", program.label,
                          detail={"ops": len(program.chain.ops),
                                  "launches_saved": program.n_launches - 1})
@@ -1144,7 +1149,11 @@ class _StepFusionManager:
         caller must let the eager optimizer step proceed."""
         import numpy as np
         from ..jit.train_step import bake_decay_flags
+        from ..profiler import goodput as _goodput
         from . import spmd_fusion as _spmd
+        # goodput: this interval is a probation replay (fused + bitwise
+        # eager both run), not a normal productive step
+        _goodput.mark("probation")
 
         def scratch(v):
             # a DISTINCT buffer with the same value and placement, so the

@@ -93,8 +93,13 @@ class Optimizer:
         # do. In observation mode the hook just delimits the step cycle.
         from ..ops.step_fusion import STEP as _step_fusion
         from ..ops import guardian
+        from ..profiler import goodput as _goodput
         if _step_fusion.on_optimizer_step(self):
             guardian.maybe_flush()
+            # goodput accountant (profiler/goodput.py): every training
+            # step — fused replay or eager — crosses this boundary; one
+            # flag check when FLAGS_metrics is off
+            _goodput.on_step(self)
             return
         params = [p for p in self._parameter_list
                   if not p.stop_gradient or p.grad is not None]
@@ -108,6 +113,7 @@ class Optimizer:
                              "params": len(params_grads)})
         if not params_grads:
             guardian.maybe_flush()
+            _goodput.on_step(self)
             return
         if self.regularization is not None:
             params_grads = [
@@ -120,6 +126,7 @@ class Optimizer:
         # (one batched device->host transfer); a no-op when the queue is
         # empty (FLAGS_check_numerics off)
         guardian.maybe_flush()
+        _goodput.on_step(self)
 
     def _apply_optimize(self, params_grads):
         from ..ops import guardian

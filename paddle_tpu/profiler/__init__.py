@@ -26,6 +26,12 @@ from .aot import (AotCacheStats, aot_cache_stats, reset_aot_cache_stats)
 from .events import (EVENTS, CATEGORIES, REASON_CODES, FusionEventLog,
                      fusion_events, clear_fusion_events,
                      fusion_events_enabled, events_summary)
+from .metrics import (Counter, Gauge, LogHistogram, MetricsRegistry,
+                      REGISTRY, METRIC_NAMES, metrics_snapshot,
+                      merge_snapshots, reset_metrics,
+                      format_metrics_summary)
+from .goodput import (GoodputAccountant, ACCOUNTANT, goodput_snapshot,
+                      estimate_cycle_flops, peak_flops_per_chip)
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
@@ -38,7 +44,12 @@ __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "AotCacheStats", "aot_cache_stats", "reset_aot_cache_stats",
            "CATEGORIES", "REASON_CODES", "FusionEventLog", "fusion_events",
            "clear_fusion_events", "fusion_events_enabled", "events_summary",
-           "LoadedProfilerResult"]
+           "LoadedProfilerResult",
+           "Counter", "Gauge", "LogHistogram", "MetricsRegistry",
+           "REGISTRY", "METRIC_NAMES", "metrics_snapshot",
+           "merge_snapshots", "reset_metrics", "format_metrics_summary",
+           "GoodputAccountant", "ACCOUNTANT", "goodput_snapshot",
+           "estimate_cycle_flops", "peak_flops_per_chip"]
 
 
 class SortedKeys(Enum):
@@ -354,14 +365,65 @@ class Profiler:
 # synthetic chrome-trace tids for the fusion lifecycle lanes; thread_name
 # metadata labels them in perfetto. High values keep clear of real tids.
 _FUSION_LANE_TID = {"dispatch": 0x7F5E0001, "chain": 0x7F5E0002,
-                    "step": 0x7F5E0003}
+                    "step": 0x7F5E0003, "serve": 0x7F5E0004,
+                    "aot": 0x7F5E0005, "kernel": 0x7F5E0006}
+
+# serve.* categories that begin / end one request's async span (the
+# per-request serving trace: enqueue -> admit -> decode ticks ->
+# complete/evict/cancel/expire, rendered as an async track in perfetto)
+_SERVE_SPAN_BEGIN = "serve.enqueue"
+_SERVE_SPAN_END = frozenset({"serve.complete", "serve.cancel",
+                             "serve.expire"})
+# (refusals never open a span — serve.refuse fires before serve.enqueue
+# — so they render as plain serve-lane instants, not span marks)
+_SERVE_SPAN_MARK = frozenset({"serve.admit", "serve.evict",
+                              "serve.resume"})
+
+
+def _serve_request_spans(fusion_events, pid):
+    """Per-request async spans beside the fusion lanes: each request id
+    opens an async 'b' event at serve.enqueue, records admission /
+    eviction / resume as nested 'n' instants, and closes with 'e' at its
+    terminal event — so perfetto shows every request's enqueue -> admit
+    -> decode -> complete lifetime as one bar under the serve lane."""
+    out = []
+    open_spans = {}
+    tid = _FUSION_LANE_TID["serve"]
+    for e in fusion_events:
+        cat = e["cat"]
+        if not cat.startswith("serve."):
+            continue
+        rid = e.get("op")
+        if not rid or rid == "engine":
+            continue
+        ts = e["ts_ns"] / 1000.0
+        base = {"cat": "serve.request", "id": rid, "pid": pid, "tid": tid}
+        if cat == _SERVE_SPAN_BEGIN:
+            open_spans[rid] = ts
+            out.append({**base, "name": f"request {rid}", "ph": "b",
+                        "ts": ts,
+                        "args": {k: v for k, v in
+                                 (e.get("detail") or {}).items()}})
+        elif cat in _SERVE_SPAN_END and rid in open_spans:
+            out.append({**base, "name": f"request {rid}", "ph": "e",
+                        "ts": ts,
+                        "args": {"outcome": cat.split(".", 1)[1],
+                                 "reason": e.get("reason")}})
+            del open_spans[rid]
+        elif cat in _SERVE_SPAN_MARK and rid in open_spans:
+            out.append({**base, "name": cat.split(".", 1)[1], "ph": "n",
+                        "ts": ts,
+                        "args": {"reason": e.get("reason"),
+                                 "detail": e.get("detail")}})
+    return out
 
 
 def _fusion_trace_events(fusion_events):
     """Project flight-recorder event dicts into chrome-trace instant
-    events: one lane (synthetic tid) per fusion tier so perfetto shows the
-    dispatch / chain / step lifecycles as parallel tracks under the host
-    timeline."""
+    events: one lane (synthetic tid) per tier (dispatch / chain / step /
+    serve / aot / kernel) plus per-request async spans, so perfetto shows
+    the fusion lifecycles and every serving request's lifetime as
+    parallel tracks under the host timeline."""
     if not fusion_events:
         return []
     pid = os.getpid()
@@ -381,6 +443,7 @@ def _fusion_trace_events(fusion_events):
                                           "reason", "detail")
                         if e.get(k) is not None}}
         out.append(rec)
+    out.extend(_serve_request_spans(fusion_events, pid))
     return out
 
 

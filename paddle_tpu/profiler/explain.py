@@ -350,6 +350,16 @@ def explain(events=None):
                              lambda e: e["cat"].startswith("serve.")
                              and e.get("reason") is not None),
         }
+        # live registry view (profiler/metrics.py): when the telemetry
+        # plane is armed, the doctor cites CURRENT p99 latency, TTFT and
+        # refusal rates — not just how many events the window held
+        try:
+            from .metrics import serve_live_summary
+            live = serve_live_summary()
+        except Exception:
+            live = None
+        if live is not None:
+            report["serving"]["live"] = live
 
     # AOT executable store (aot.* events, ops/aot_cache.py): how much of
     # the warmup came off disk, and whether any artifact was corrupt or
@@ -456,11 +466,17 @@ def explain(events=None):
                        if sv["occupancy_mean"] is not None else ""))
         if sv["hangs"] or sv["degraded"]:
             # a watchdog firing / degraded-mode transition is the lead
-            # story of a serving window, not a footnote
+            # story of a serving window, not a footnote — and with the
+            # telemetry plane armed, the headline cites the LIVE p99 and
+            # refusal rate the degradation is costing users right now
             verdict = "serving_degraded"
             headline = (f"serving DEGRADED: {sv['hangs']} hang(s), "
                         f"{sv['degraded']} degrade transition(s) — "
                         + headline)
+            live = sv.get("live")
+            if live:
+                headline += (f" [live: p99 {live['p99_step_ms']} ms/step, "
+                             f"refusal rate {live['refusal_rate']}]")
     elif poisons:
         verdict = "never_promoted"
         r, rec = max(poisons.items(), key=lambda kv: kv[1]["count"])
@@ -593,6 +609,10 @@ def format_report(report):
         if resil:
             lines.append("resil : " + " ".join(
                 f"{k}={v}" for k, v in sorted(resil.items())))
+        live = sv.get("live")
+        if live:
+            lines.append("live  : " + " ".join(
+                f"{k}={v}" for k, v in sorted(live.items())))
     if report["findings"]:
         lines.append("")
         lines.append("findings:")

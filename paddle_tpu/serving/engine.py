@@ -84,7 +84,11 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework.autograd import set_grad_enabled
+from ..framework.flags import _FLAGS
 from ..profiler.events import EVENTS as _EVENTS
+from ..profiler.metrics import LogHistogram, SERVE as _M, \
+    enabled as _metrics_on
+from ..profiler import goodput as _goodput
 from .cache import PagedKVCache, PagedCacheView, scatter_prefill, _is_int8
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
                         FAILED, CANCELLED, EXPIRED)
@@ -100,10 +104,17 @@ _MIN_BUCKET = 8
 
 
 class ServeStats:
-    """Engine counters + step-latency samples. `decode_compiles` is
+    """Engine counters + step-latency histograms. `decode_compiles` is
     incremented INSIDE the traced decode function (the side effect runs
     only while tracing), so it counts real XLA traces — the zero-retrace
-    guard reads it directly."""
+    guard reads it directly.
+
+    Latency percentiles come from bounded log-bucket streaming
+    histograms (profiler/metrics.py LogHistogram): O(1) memory however
+    long the engine runs, and FRESH — the old raw `step_times_s` list
+    stopped appending at 100k samples, silently freezing p50/p99 for the
+    rest of the process's life. `step_times_s` survives as a short
+    recent-sample list (the admission-time wait estimate reads it)."""
 
     def __init__(self):
         self.reset()
@@ -134,7 +145,13 @@ class ServeStats:
         self.occupancy_sum = 0.0
         self.saturated_steps = 0
         self.saturated_occupancy_sum = 0.0
+        # recent raw samples only (the admission wait estimate averages
+        # the tail); percentiles live in the windowed histograms below
         self.step_times_s = []
+        self.step_hist = LogHistogram()
+        self.ttft_hist = LogHistogram()
+        self.inter_token_hist = LogHistogram()
+        self.queue_wait_hist = LogHistogram()
         self.wall_t0 = None
         self.wall_t1 = None
 
@@ -145,16 +162,14 @@ class ServeStats:
         if demand >= num_slots:
             self.saturated_steps += 1
             self.saturated_occupancy_sum += occ
-        if len(self.step_times_s) < 100_000:
-            self.step_times_s.append(dt_s)
+        self.step_times_s.append(dt_s)
+        if len(self.step_times_s) > 4 * _EST_WINDOW:
+            del self.step_times_s[:-_EST_WINDOW]
+        self.step_hist.observe(dt_s)
 
     def snapshot(self):
-        times = sorted(self.step_times_s)
-
         def pct(p):
-            if not times:
-                return 0.0
-            return times[min(len(times) - 1, int(p / 100.0 * len(times)))]
+            return self.step_hist.percentile(p)
 
         elapsed = None
         if self.wall_t0 is not None and self.wall_t1 is not None:
@@ -184,6 +199,19 @@ class ServeStats:
                 if self.saturated_steps else 0.0),
             "p50_step_ms": pct(50) * 1e3,
             "p99_step_ms": pct(99) * 1e3,
+            # request-latency percentiles (PR 12): TTFT (enqueue ->
+            # first token), inter-token gap, and admission queue wait,
+            # all from the same bounded windowed histograms
+            "ttft_p50_ms": self.ttft_hist.percentile(50) * 1e3,
+            "ttft_p99_ms": self.ttft_hist.percentile(99) * 1e3,
+            "inter_token_p50_ms":
+                self.inter_token_hist.percentile(50) * 1e3,
+            "inter_token_p99_ms":
+                self.inter_token_hist.percentile(99) * 1e3,
+            "queue_wait_p50_ms":
+                self.queue_wait_hist.percentile(50) * 1e3,
+            "queue_wait_p99_ms":
+                self.queue_wait_hist.percentile(99) * 1e3,
             "elapsed_s": elapsed,
             "tokens_per_sec": (self.tokens_generated / elapsed
                                if elapsed else 0.0),
@@ -408,6 +436,8 @@ class LLMEngine:
             self._stats.refused_queue_full += 1
         elif reason == "deadline_infeasible":
             self._stats.refused_deadline += 1
+        if _metrics_on():
+            _M.refusals.labels(reason=reason).inc()
         _EVENTS.emit("serve.refuse", req.rid, reason=reason, detail=detail)
         raise ServeRefusal(reason, message, detail)
 
@@ -441,6 +471,8 @@ class LLMEngine:
         req.error = "client_cancel"
         req.finish_ns = time.perf_counter_ns()
         self._stats.cancelled += 1
+        if _metrics_on():
+            _M.requests.labels(outcome="cancelled").inc()
         _EVENTS.emit("serve.cancel", req.rid, reason="client_cancel",
                      detail={"was_running": slot is not None,
                              "tokens": len(req.generated)})
@@ -458,6 +490,8 @@ class LLMEngine:
         req.error = "deadline_expired"
         req.finish_ns = time.perf_counter_ns()
         self._stats.expired += 1
+        if _metrics_on():
+            _M.requests.labels(outcome="expired").inc()
         _EVENTS.emit("serve.expire", req.rid, reason="deadline_expired",
                      detail={"where": where,
                              "tokens": len(req.generated)})
@@ -540,11 +574,22 @@ class LLMEngine:
         toks = self._decode_step()
         if toks is None:
             # ladder rung 3 / eager fallback retired the batch; the
-            # engine stays serviceable for queued + new work
+            # engine stays serviceable for queued + new work. Any stall
+            # booked inside the abandoned step must not be subtracted
+            # from the NEXT (unrelated) productive step's time
+            if _metrics_on():
+                _goodput.ACCOUNTANT.drop_stall_carry()
             self._stats.wall_t1 = time.perf_counter()
             return bool(sched.running or sched.waiting)
         dt = time.perf_counter() - t0
         self._stats.observe_step(n_active, self.max_batch_size, demand, dt)
+        if _metrics_on():
+            _M.step_s.observe(dt)
+            _M.occupancy.set(n_active / self.max_batch_size)
+            # productive serving time: the goodput fraction stays
+            # meaningful in a process that never crosses an optimizer
+            # boundary (stall time lands via the watchdog's note_stall)
+            _goodput.ACCOUNTANT.note_productive(dt)
         _EVENTS.emit("serve.step", "engine",
                      detail={"active": n_active,
                              "occupancy": round(
@@ -644,6 +689,13 @@ class LLMEngine:
                      detail={"context_len": len(ctx), "bucket": bucket,
                              "blocks": len(req.blocks),
                              "resumed": bool(req.generated)})
+        now = time.perf_counter_ns()
+        if req.admit_ns is None:
+            req.admit_ns = now
+            wait_s = (now - req.enqueue_ns) / 1e9
+            self._stats.queue_wait_hist.observe(wait_s)
+            if _metrics_on():
+                _M.queue_wait_s.observe(wait_s)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(ctx)] = ctx
         row = np.zeros(self.max_blocks_per_seq, np.int32)
@@ -674,6 +726,11 @@ class LLMEngine:
                 return res
             except StepHang:
                 self._stats.hangs += 1
+                self._note_hang()
+                if _metrics_on():
+                    # prefill time is not measured as a productive step,
+                    # so there is no later interval to subtract from
+                    _goodput.ACCOUNTANT.drop_stall_carry()
                 _EVENTS.emit("serve.hang", req.rid, reason="step_hang",
                              detail={"phase": "prefill",
                                      "attempt": attempt})
@@ -724,8 +781,23 @@ class LLMEngine:
     def _emit_token(self, req, tok):
         req.generated.append(tok)
         self._stats.tokens_generated += 1
+        now = time.perf_counter_ns()
+        mon = _metrics_on()
         if req.first_token_ns is None:
-            req.first_token_ns = time.perf_counter_ns()
+            req.first_token_ns = now
+            ttft_s = (now - req.enqueue_ns) / 1e9
+            self._stats.ttft_hist.observe(ttft_s)
+            if mon:
+                _M.ttft_s.observe(ttft_s)
+        elif req.last_token_ns is not None:
+            gap_s = (now - req.last_token_ns) / 1e9
+            self._stats.inter_token_hist.observe(gap_s)
+            if mon:
+                _M.inter_token_s.observe(gap_s)
+        req.last_token_ns = now
+        req.token_ns.append(now)
+        if mon:
+            _M.tokens.inc()
         if req.on_token is not None:
             text = None
             if self._tokenizer is not None:
@@ -748,6 +820,8 @@ class LLMEngine:
         req.state = FINISHED
         req.finish_ns = time.perf_counter_ns()
         self._stats.completed += 1
+        if _metrics_on():
+            _M.requests.labels(outcome="completed").inc()
         _EVENTS.emit("serve.complete", req.rid,
                      detail={"tokens": len(req.generated),
                              "preemptions": req.preemptions})
@@ -761,6 +835,8 @@ class LLMEngine:
         req.error = why
         req.finish_ns = time.perf_counter_ns()
         self._stats.failed += 1
+        if _metrics_on():
+            _M.requests.labels(outcome="failed").inc()
         _EVENTS.emit("serve.complete", req.rid, reason=why,
                      detail={"failed": True,
                              "tokens": len(req.generated)})
@@ -770,6 +846,8 @@ class LLMEngine:
         requeue at its arrival position; resume re-prefills."""
         slot = victim.slot
         self._stats.evictions += 1
+        if _metrics_on():
+            _M.preemptions.inc()
         _EVENTS.emit("serve.evict", victim.rid, reason="kv_exhausted",
                      detail={"freed_blocks": len(victim.blocks),
                              "cached_tokens": victim.cached_len,
@@ -833,6 +911,18 @@ class LLMEngine:
         deleted = getattr(self._v_pools, "is_deleted", None)
         return deleted is not None and deleted()
 
+    def _note_hang(self):
+        """Metrics-side view of one watchdog firing: the wedged wall
+        time (the armed budget the monitor just burned) lands in the
+        goodput `stalled` bucket and the hang counter."""
+        if not _metrics_on():
+            return
+        _M.hangs.inc()
+        budget_s = float(_FLAGS.get("FLAGS_serve_step_timeout_ms")
+                         or 0) / 1e3
+        if budget_s > 0:
+            _goodput.ACCOUNTANT.note_stall(budget_s, kind="step_hang")
+
     def _degrade(self, reason, detail):
         """Enter (or deepen) degraded mode with an attributed
         transition."""
@@ -845,6 +935,7 @@ class LLMEngine:
         Returns True to retry the step (rungs 1-2), False after rung 3
         (active requests failed, engine reset for new work)."""
         self._stats.hangs += 1
+        self._note_hang()
         _EVENTS.emit("serve.hang", "engine", reason="step_hang",
                      detail={"attempt": attempt,
                              "active": len(self.scheduler.running)})

@@ -80,7 +80,8 @@ class Request:
                  "on_token", "state", "generated", "blocks", "slot",
                  "cached_len", "arrival_seq", "admit_seq", "preemptions",
                  "error", "enqueue_ns", "first_token_ns", "finish_ns",
-                 "deadline_ns", "cancel_requested")
+                 "deadline_ns", "cancel_requested", "admit_ns",
+                 "last_token_ns", "token_ns")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
                  on_token=None, ttl_s=None):
@@ -101,6 +102,13 @@ class Request:
         self.enqueue_ns = time.perf_counter_ns()
         self.first_token_ns = None
         self.finish_ns = None
+        # latency accounting (PR 12): first admission time (queue wait =
+        # admit_ns - enqueue_ns) and per-token emission timestamps
+        # (bounded by max_new_tokens) so a completed handle can report
+        # its own TTFT / inter-token percentiles
+        self.admit_ns = None
+        self.last_token_ns = None
+        self.token_ns = []
         # absolute deadline on the perf_counter_ns clock (None = no TTL);
         # checked by the ENGINE at admission and at iteration boundaries
         self.deadline_ns = (None if ttl_s is None
@@ -134,6 +142,31 @@ class Request:
         if now_ns is None:
             now_ns = time.perf_counter_ns()
         return now_ns >= self.deadline_ns
+
+    def latency(self):
+        """Per-request latency summary off the emission timestamps:
+        TTFT (enqueue -> first token), queue wait (enqueue -> first
+        admission), and inter-token p50/p99 over this request's own
+        token stream. Valid any time; most useful on a completed
+        handle. Times in milliseconds; None where not yet observed."""
+        out = {
+            "ttft_ms": (None if self.first_token_ns is None
+                        else (self.first_token_ns - self.enqueue_ns)
+                        / 1e6),
+            "queue_wait_ms": (None if self.admit_ns is None
+                              else (self.admit_ns - self.enqueue_ns)
+                              / 1e6),
+            "tokens": len(self.generated),
+            "inter_token_p50_ms": None,
+            "inter_token_p99_ms": None,
+        }
+        if len(self.token_ns) >= 2:
+            gaps = sorted((b - a) / 1e6 for a, b in
+                          zip(self.token_ns, self.token_ns[1:]))
+            out["inter_token_p50_ms"] = gaps[len(gaps) // 2]
+            out["inter_token_p99_ms"] = gaps[
+                min(len(gaps) - 1, int(0.99 * len(gaps)))]
+        return out
 
     def ttl_remaining_s(self, now_ns=None):
         """Seconds until the deadline (None without one; may be <= 0).

@@ -191,6 +191,35 @@ def _demo_serve(steps):
     engine.run()
 
 
+def _demo_metrics(steps):
+    """Telemetry-plane acceptance fixture: the masked GPT-ish loop run
+    with FLAGS_metrics armed AND a guardian skip-step injected mid-run
+    (FLAGS_check_numerics + guardian.inject_fault), so the doctor's
+    `--metrics` summary shows a live registry with train_step_seconds
+    percentiles, a goodput below 1.0, and the skipped-step wall time
+    attributed to the `skipped` bucket."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops import guardian
+    from paddle_tpu.profiler.metrics import reset_metrics
+
+    set_flags({"FLAGS_metrics": True, "FLAGS_check_numerics": True,
+               # warn-don't-raise: the injected NaN must flow into the
+               # gradients so the guardian's skip-step rescue (not the
+               # forward raise) is what the goodput report attributes
+               "FLAGS_check_numerics_level": 1})
+    reset_metrics()
+    try:
+        # fire while the loop is still eager (pre-promotion) so the NaN
+        # poisons one step's grads and the update skips bitwise
+        guardian.inject_fault("nan_output", op="matmul", after=8, times=1)
+        _demo("masked", steps)
+        guardian.flush()
+    finally:
+        guardian.clear_faults()
+        set_flags({"FLAGS_check_numerics": False,
+                   "FLAGS_check_numerics_level": 0})
+
+
 def _cache_report(args) -> int:
     """`fusion_doctor --cache`: list the AOT executable store (kind,
     digest, size, age, environment-fingerprint match, label), report
@@ -258,18 +287,25 @@ def main(argv=None) -> int:
                     help="training script to run under the recorder")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script (after --)")
-    ap.add_argument("--demo", choices=("dropout", "masked", "serve", "dp"),
+    ap.add_argument("--demo", choices=("dropout", "masked", "serve", "dp",
+                                       "metrics"),
                     help="run a built-in tiny GPT-ish demo loop instead "
                          "of a script (`serve`: a continuous-batching "
                          "serving run over a tight KV pool; `dp`: a "
                          "sharded data-parallel loop whose unkeyable "
                          "grad collective blocks promotion — "
-                         "collective_unkeyed)")
+                         "collective_unkeyed; `metrics`: the telemetry "
+                         "plane armed over a promoting loop with an "
+                         "injected guardian skip — live goodput/MFU)")
     ap.add_argument("--steps", type=int, default=20,
                     help="demo loop steps (requests, for --demo serve; "
                          "default 20)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as JSON instead of text")
+    ap.add_argument("--metrics", action="store_true",
+                    help="arm the telemetry plane (FLAGS_metrics) for "
+                         "the run and append the live registry summary "
+                         "+ goodput accounting to the report")
     ap.add_argument("--cache", action="store_true",
                     help="inspect the persistent AOT executable store "
                          "(ops/aot_cache.py) instead of running a script: "
@@ -293,11 +329,18 @@ def main(argv=None) -> int:
 
     clear_fusion_events()
     set_flags({"FLAGS_profiler_events": True})
+    want_metrics = args.metrics or args.demo == "metrics"
+    if want_metrics:
+        from paddle_tpu.profiler.metrics import reset_metrics
+        reset_metrics()
+        set_flags({"FLAGS_metrics": True})
     try:
         if args.demo == "serve":
             _demo_serve(args.steps)
         elif args.demo == "dp":
             _demo_dp(args.steps)
+        elif args.demo == "metrics":
+            _demo_metrics(args.steps)
         elif args.demo:
             _demo(args.demo, args.steps)
         else:
@@ -319,10 +362,22 @@ def main(argv=None) -> int:
         set_flags({"FLAGS_profiler_events": False})
 
     report = explain(EVENTS.snapshot())
+    if want_metrics:
+        from paddle_tpu.profiler.metrics import (format_metrics_summary,
+                                                 metrics_snapshot)
+        from paddle_tpu.profiler.goodput import goodput_snapshot
+        report["metrics"] = metrics_snapshot()
+        report["goodput"] = goodput_snapshot()
+        set_flags({"FLAGS_metrics": False})
     if args.json:
         print(json.dumps(report, indent=2))
     else:
         print(format_report(report))
+        if want_metrics:
+            print(format_metrics_summary(report["metrics"]))
+            g = report["goodput"]
+            print(f"goodput : {g['goodput']} over {g['steps']} step(s) "
+                  f"(p50 {g['step_ms_p50']} ms, buckets {g['buckets_s']})")
     return 0
 
 

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Metrics export: crash-safe JSONL sink + cross-process merge + render.
+
+The registry (paddle_tpu/profiler/metrics.py) lives in one process; a
+fleet has many. This tool is the boundary between them:
+
+  * :class:`MetricsSink` — one file per process
+    (``metrics-<pid>.jsonl``), one JSON line per snapshot, written
+    through the shared atomic-write helpers (framework/io.py: tmp +
+    fsync + rename + CRC-32 trailer) so a kill-9 mid-write can NEVER
+    leave a torn file: the reader either sees the previous complete
+    sink or the new one. ``write()`` is one-shot; ``start(interval_s)``
+    runs a daemon thread for the periodic mode. History is bounded
+    (``max_lines``, oldest dropped) so a week-long process keeps a
+    week-long file from growing without bound.
+  * :func:`read_sink` / :func:`merge_files` — parse sink files
+    (CRC-verified when the trailer is present) and merge the LAST
+    snapshot of each process's file into one fleet view: counters and
+    histogram buckets add, gauges take the max
+    (profiler/metrics.merge_snapshots).
+  * CLI — merge sinks and render the result as Prometheus text
+    exposition or the one-screen summary:
+
+        python tools/metrics_export.py --merge /tmp/m/*.jsonl --prom
+        python tools/metrics_export.py --merge a.jsonl b.jsonl
+        python tools/metrics_export.py --snapshot out.jsonl   # this proc
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+__all__ = ["MetricsSink", "read_sink", "merge_files", "default_sink_path"]
+
+
+def default_sink_path(root=None):
+    root = root or os.environ.get("PADDLE_TPU_METRICS_DIR") \
+        or "/tmp/paddle_tpu_metrics"
+    return os.path.join(root, f"metrics-{os.getpid()}.jsonl")
+
+
+class MetricsSink:
+    """Periodic/one-shot JSONL sink for one process's registry."""
+
+    def __init__(self, path=None, registry=None, max_lines=512):
+        from paddle_tpu.profiler import metrics as _metrics
+        self.path = path or default_sink_path()
+        self._registry = registry or _metrics.REGISTRY
+        self._max_lines = int(max_lines)
+        self._lines = []
+        self._thread = None
+        self._stop = threading.Event()
+
+    def write(self):
+        """Append one snapshot line and atomically rewrite the file.
+        The whole file goes through _write_atomic (CRC trailer), so the
+        sink survives kill -9 at any instant without torn content."""
+        from paddle_tpu.framework.io import _write_atomic
+        from paddle_tpu.profiler import goodput as _goodput
+        row = {"ts": time.time(), "pid": os.getpid(),
+               "metrics": self._registry.snapshot(),
+               "goodput": _goodput.ACCOUNTANT.snapshot()}
+        self._lines.append(json.dumps(row, sort_keys=True))
+        if len(self._lines) > self._max_lines:
+            del self._lines[:-self._max_lines]
+        _write_atomic(self.path,
+                      ("\n".join(self._lines) + "\n").encode())
+        return self.path
+
+    # -- periodic mode ------------------------------------------------------
+    def start(self, interval_s=15.0):
+        """Write every `interval_s` seconds from a daemon thread until
+        `stop()` (or process exit — the last atomic write stays
+        complete)."""
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.write()
+                except Exception:
+                    pass        # the sink must never take the server down
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="metrics-sink")
+        self._thread.start()
+        return self
+
+    def stop(self, final_write=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_write:
+            try:
+                self.write()
+            except Exception:
+                pass
+
+
+def read_sink(path):
+    """Parse one sink file into its snapshot rows (oldest first). The
+    CRC trailer is verified when present (files written by MetricsSink
+    always carry one); unparsable lines are skipped, never fatal."""
+    from paddle_tpu.framework.io import read_verified_payload
+    data = read_verified_payload(path, require_trailer=False)
+    rows = []
+    for line in data.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            continue
+    return rows
+
+
+def merge_files(paths):
+    """Fleet view: merge the LAST snapshot of every process sink."""
+    from paddle_tpu.profiler.metrics import merge_snapshots
+    snaps = []
+    for p in paths:
+        rows = read_sink(p)
+        if rows:
+            snaps.append(rows[-1].get("metrics") or {})
+    return merge_snapshots(snaps)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="metrics_export",
+        description="merge per-process metrics sinks / render exposition")
+    ap.add_argument("--merge", nargs="+", default=None,
+                    help="sink files (globs ok) to merge into one view")
+    ap.add_argument("--prom", action="store_true",
+                    help="render Prometheus text exposition instead of "
+                         "the one-screen summary")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged snapshot as JSON")
+    ap.add_argument("--snapshot", default=None, metavar="PATH",
+                    help="write one snapshot of THIS process's registry "
+                         "to PATH and exit (smoke/debug)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.profiler import metrics as _metrics
+
+    if args.snapshot:
+        sink = MetricsSink(path=args.snapshot)
+        print(sink.write())
+        return 0
+    if not args.merge:
+        ap.error("--merge or --snapshot is required")
+    paths = []
+    for pat in args.merge:
+        hit = sorted(glob.glob(pat))
+        paths.extend(hit if hit else [pat])
+    merged = merge_files(paths)
+    if args.json:
+        print(json.dumps(merged, indent=2, sort_keys=True))
+    elif args.prom:
+        sys.stdout.write(_metrics.exposition(merged))
+    else:
+        print(_metrics.format_metrics_summary(merged))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

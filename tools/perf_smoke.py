@@ -54,6 +54,12 @@ stack silently regressed:
     gather at seq >= 1k on the serve-shaped CPU microbench, and a
     serving engine with the int8 KV cache must still compile its decode
     step exactly once under stream churn (a PR 11 regression);
+  * telemetry plane — the metrics registry (profiler/metrics.py) must
+    record NOTHING with FLAGS_metrics off at one-flag-check cost
+    (<3%/step at the observed sites-per-step rate), stay within 5%/step
+    armed on BOTH the fused train loop and the serve_8 workload
+    (interleaved min-of-ratios), and its histogram hot path must never
+    grow memory with observations (a PR 12 regression);
   * distributed step fusion — a dp=N sharded-batch loop over the
     emulated device mesh must auto-promote into ONE shard_map-wrapped
     executable (ops/spmd_fusion.py; zero retraces after promotion) and
@@ -722,6 +728,115 @@ def main() -> int:
             "side-tables leaked into the compiled shapes "
             "(PR 11 regression)")
 
+    # ---- telemetry plane legs (PR 12 guards) -----------------------------
+    # (k) the metrics registry must honor the flight recorder's cost
+    # discipline: with FLAGS_metrics OFF every site is one flag check
+    # (<3%/step at the observed sites-per-step rate, and NOTHING is
+    # recorded); with it ON, the fused train loop and the serve_8-style
+    # workload must stay within 5%/step (interleaved min-of-paired-ratio
+    # windows, the guardian leg's statistic); and the histogram hot path
+    # must not grow memory with observations (bounded bucket bands)
+    from paddle_tpu.profiler import metrics as _pm
+
+    _pm.reset_metrics()
+    mh = _pm.TRAIN.step_s
+    mc = _pm.SERVE.tokens
+    N_OBS = 100_000
+    t0 = time.perf_counter()
+    for _ in range(N_OBS):
+        mh.observe(0.001)
+        mc.inc()
+    obs_off_ns = (time.perf_counter() - t0) / (2 * N_OBS) * 1e9
+    if mh.count != 0 or mc.value != 0:
+        failures.append(
+            f"metrics recorded with FLAGS_metrics off (hist count="
+            f"{mh.count}, counter={mc.value}): the gate is broken "
+            "(PR 12 regression)")
+    # ~6 instrumented sites fire per fused train step (boundary + step
+    # hist + gauges); be generous and budget 10
+    m_overhead_off = obs_off_ns * 10 / max(t_step * 1e9, 1.0)
+    if m_overhead_off >= 0.03:
+        failures.append(
+            f"metrics-off site cost {obs_off_ns:.0f}ns x 10 sites/step is "
+            f"{m_overhead_off * 100:.2f}% of a fused step (>=3%): the "
+            "disabled path got expensive (PR 12 regression)")
+
+    # histogram hot path: zero allocation growth (bounded bucket bands)
+    set_flags({"FLAGS_metrics": True})
+    gh = _pm.LogHistogram(window=5_000)
+    gh.observe(0.001)
+    import sys as _sys
+    band_len0 = len(gh._cur)
+    size0 = _sys.getsizeof(gh._cur)
+    for i in range(50_000):
+        gh.observe(0.0001 * (1 + (i % 97)))
+    if len(gh._cur) != band_len0 or _sys.getsizeof(gh._cur) != size0 \
+            or (gh._prev is not None and len(gh._prev) != band_len0):
+        failures.append(
+            "histogram hot path grew its bucket storage under sustained "
+            "observation: the bands are no longer preallocated/bounded "
+            "(PR 12 regression)")
+
+    # metrics-on cost, fused train loop: interleaved paired windows
+    m_step = _loop(step_fused=True)
+    for _ in range(WARMUP):
+        m_step()
+    set_flags({"FLAGS_metrics": False})
+    for _ in range(WARMUP):
+        m_step()
+    mratios = []
+    for _ in range(6):
+        set_flags({"FLAGS_metrics": False})
+        m_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            m_step()
+        m_step.sync()
+        t_moff = time.perf_counter() - t0
+        set_flags({"FLAGS_metrics": True})
+        m_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            m_step()
+        m_step.sync()
+        t_mon = time.perf_counter() - t0
+        mratios.append(t_mon / t_moff if t_moff > 0 else float("inf"))
+    set_flags({"FLAGS_metrics": False})
+    m_overhead_on = min(mratios) - 1.0
+    if m_overhead_on >= 0.05:
+        failures.append(
+            f"FLAGS_metrics costs {m_overhead_on * 100:.1f}%/step on the "
+            "fused train loop (>=5%): the armed telemetry plane stopped "
+            "being cheap (PR 12 regression)")
+
+    # metrics-on cost, serve_8-style workload (same engine pattern as
+    # the resilience leg; programs warm before the windows)
+    mengine = LLMEngine(smodel, max_batch_size=4, block_size=4)
+    mengine.generate(sprompts8, max_new_tokens=6)
+    msratios = []
+    for _ in range(6):
+        set_flags({"FLAGS_metrics": False})
+        t0 = time.perf_counter()
+        for p in sprompts8:
+            mengine.add_request(p, max_new_tokens=6)
+        mengine.run()
+        t_soff = time.perf_counter() - t0
+        set_flags({"FLAGS_metrics": True})
+        t0 = time.perf_counter()
+        for p in sprompts8:
+            mengine.add_request(p, max_new_tokens=6)
+        mengine.run()
+        t_son = time.perf_counter() - t0
+        msratios.append(t_son / t_soff if t_soff > 0 else float("inf"))
+    set_flags({"FLAGS_metrics": False})
+    ms_overhead_on = min(msratios) - 1.0
+    if ms_overhead_on >= 0.05:
+        failures.append(
+            f"FLAGS_metrics costs {ms_overhead_on * 100:.1f}%/step on the "
+            "serve_8 loop (>=5%): the serving instrumentation stopped "
+            "being cheap (PR 12 regression)")
+    _pm.reset_metrics()
+
     # ---- AOT warm-start leg (PR 9 guard) ---------------------------------
     # (h) a fresh subprocess with a warm executable store must promote its
     # fused step with zero compile activity and beat the cold subprocess's
@@ -800,6 +915,10 @@ def main() -> int:
           f"refused={refused} resumed={len(resumed)}), "
           f"paged blockwise-vs-dense={paged_speedup:.2f}x "
           f"(int8 decode compiles={int8_stats['decode_compiles']}), "
+          f"metrics off={obs_off_ns:.0f}ns/site "
+          f"({m_overhead_off * 100:.2f}%/step) "
+          f"on={m_overhead_on * 100:.1f}%/step train "
+          f"{ms_overhead_on * 100:.1f}%/step serve, "
           f"aot warm-start={aot_warm['t_first_fire_s']:.2f}s vs "
           f"cold={aot_cold['t_first_fire_s']:.2f}s "
           f"(warm hits={aot_warm['aot']['hits']} "

@@ -85,6 +85,8 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
     from paddle_tpu.profiler.events import clear_fusion_events
     from paddle_tpu.profiler import events_summary, fusion_events
     from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.profiler.metrics import (reset_metrics,
+                                             serve_live_summary)
     from paddle_tpu.serving import LLMEngine
 
     if model is None:
@@ -97,8 +99,12 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
     max_batch = min(streams, 8)
     max_prompt = 48 if on_tpu else 24
     clear_fusion_events()
-    prev = get_flags(["FLAGS_profiler_events"])
-    set_flags({"FLAGS_profiler_events": True})
+    # telemetry plane armed (PR 12): the p50/p99/TTFT numbers below come
+    # off the engine's bounded histograms — the same computation a
+    # production scrape of the registry reports
+    reset_metrics()
+    prev = get_flags(["FLAGS_profiler_events", "FLAGS_metrics"])
+    set_flags({"FLAGS_profiler_events": True, "FLAGS_metrics": True})
     try:
         # build the engine with the recorder already armed: construction
         # is where the kernel-tier attribution fires (kernel.fallback on
@@ -141,6 +147,7 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
                                   "error": str(e)[:200]}), flush=True)
         ev = fusion_events()
         doctor = explain(ev)
+        live = serve_live_summary()
     finally:
         set_flags(prev)
 
@@ -165,6 +172,15 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             "kv_dtype": snap["kv_dtype"],
             "p50_step_ms": round(snap["p50_step_ms"], 4),
             "p99_step_ms": round(snap["p99_step_ms"], 4),
+            # per-request latency story (PR 12): TTFT / inter-token /
+            # queue-wait percentiles from the bounded windowed histograms
+            "ttft_p50_ms": round(snap["ttft_p50_ms"], 4),
+            "ttft_p99_ms": round(snap["ttft_p99_ms"], 4),
+            "inter_token_p50_ms": round(snap["inter_token_p50_ms"], 4),
+            "inter_token_p99_ms": round(snap["inter_token_p99_ms"], 4),
+            "queue_wait_p99_ms": round(snap["queue_wait_p99_ms"], 4),
+            # live registry view — same numbers a production scrape sees
+            "metrics_live": live,
             "decode_steps": snap["steps"],
             # decode traces INSIDE the measured window — must stay 0
             "decode_compiles": snap["decode_compiles"],
@@ -232,6 +248,8 @@ def main(argv=None) -> int:
               f"[{ex['attention_kernel']}, kv {ex['kv_dtype']}] "
               f"-> {rec['value']} tok/s, p50 {ex['p50_step_ms']} ms, "
               f"p99 {ex['p99_step_ms']} ms, "
+              f"ttft p50 {ex['ttft_p50_ms']} ms, "
+              f"inter-token p50 {ex['inter_token_p50_ms']} ms, "
               f"occupancy {ex['occupancy_mean']} "
               f"(saturated {ex['occupancy_saturated']}), "
               f"decode_compiles {ex['decode_compiles']} (window), "
