@@ -627,6 +627,12 @@ def _child_config(name, platform, budget_s):
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
+    # live observability for the hang-proof harness: the parent seeded
+    # FLAGS_telemetry_port in our environment, so a wedged backend init
+    # or compile leaves a scrapable /healthz heartbeat + goodput
+    # snapshot for the parent's timeout autopsy
+    from paddle_tpu.profiler.telemetry_server import maybe_start_from_flags
+    maybe_start_from_flags()
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     deadline = time.monotonic() + budget_s
     rec = with_retry(lambda: CONFIG_FNS[name](on_tpu), name,
@@ -634,20 +640,81 @@ def _child_config(name, platform, budget_s):
     print(json.dumps(rec), flush=True)
 
 
+def _alloc_port():
+    """A free loopback port for the child's telemetry server (bind-0
+    probe; the tiny race against another allocator is acceptable for a
+    diagnostics channel)."""
+    import socket
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _probe_child_health(port):
+    """Timeout autopsy: ask the (still-alive, about-to-be-killed) child's
+    telemetry server what it was doing. The blind `timeout -k` kills of
+    bench rounds 3-4 left NOTHING to diagnose a tunnel hang with; the
+    /healthz heartbeat age + live goodput snapshot say whether the child
+    was stepping, compiling, or wedged — and for how long.
+
+    Deliberately NOT telemetry_server.probe_endpoint: the parent
+    orchestrator never imports the framework (importing paddle_tpu pulls
+    jax, and a wedged backend is exactly what this code runs during), so
+    this stays a stdlib-only re-read of the same endpoint contract."""
+    import urllib.error
+    import urllib.request
+    out = {}
+    for ep in ("healthz", "goodput"):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/{ep}", timeout=3) as r:
+                out[ep] = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:      # 503 = unhealthy, still data
+            try:
+                out[ep] = json.loads(e.read().decode())
+            except Exception:
+                out[ep] = {"unreachable": f"http {e.code}"}
+        except Exception as e:
+            out[ep] = {"unreachable": str(e)[:160]}
+    return out
+
+
 def _run_child(argv, timeout):
     """Run a bench child; return (record_dict | None, rc, note). Forwards
-    the child's non-record stdout lines for observability."""
+    the child's non-record stdout lines for observability. The child gets
+    FLAGS_telemetry_port in its environment (flags seed from env) and
+    arms the telemetry server in _child_config — on a hard timeout the
+    parent scrapes /healthz + /goodput BEFORE killing, so a hung config
+    leaves a heartbeat-age autopsy instead of a bare rc=124."""
+    port = _alloc_port()
     cmd = [sys.executable, os.path.abspath(__file__)] + argv
+    env = {**os.environ, "FLAGS_telemetry_port": str(port)}
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout)
-        out, rc, note = proc.stdout, proc.returncode, ""
+        out, err = proc.communicate(timeout=timeout)
+        rc, note = proc.returncode, ""
         if rc != 0:
-            note = (proc.stderr or "")[-400:]
-    except subprocess.TimeoutExpired as e:
-        out = e.stdout if isinstance(e.stdout, str) else \
-            (e.stdout or b"").decode(errors="replace")
-        rc, note = 124, f"killed after {timeout:.0f}s hard timeout"
+            note = (err or "")[-400:]
+    except subprocess.TimeoutExpired:
+        autopsy = _probe_child_health(port)      # child is still alive here
+        proc.kill()
+        try:
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            out = ""
+        rc = 124
+        hb = (autopsy.get("healthz") or {}).get("last_heartbeat_age_s")
+        note = (f"killed after {timeout:.0f}s hard timeout; "
+                f"last_heartbeat_age_s={hb}")
+        print(json.dumps({"event": "timeout_autopsy", "argv": argv[:2],
+                          "last_heartbeat_age_s": hb,
+                          "healthz": autopsy.get("healthz"),
+                          "goodput": autopsy.get("goodput")},
+                         default=str), flush=True)
     record = None
     for line in (out or "").splitlines():
         line = line.strip()

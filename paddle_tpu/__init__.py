@@ -155,3 +155,10 @@ def summary(net, input_size=None, dtypes=None):
 from . import hapi  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
+
+# Live HTTP observability plane (profiler/telemetry_server.py): a process
+# launched with FLAGS_telemetry_port set (env-seeded like every flag)
+# answers /metrics, /goodput, /doctor, /healthz, /readyz from the moment
+# the framework imports. One dict lookup when the flag is 0 (default).
+from .profiler import telemetry_server as _telemetry_server  # noqa: E402
+_telemetry_server.maybe_start_from_flags()

@@ -292,6 +292,31 @@ define_flag("FLAGS_metrics_window", 100_000,
 # checkpoint writer); torn or corrupt artifacts are detected on load,
 # quarantined, and transparently recompiled — the store can never crash a
 # training or serving process, only make its warmup cheaper.
+# Live HTTP observability plane (profiler/telemetry_server.py). Off by
+# default: 0 means no server thread, no socket, and every heartbeat site
+# costs one module-bool check. A nonzero port starts the stdlib
+# ThreadingHTTPServer at import (paddle_tpu/__init__) / engine build and
+# serves /metrics, /metrics.json, /goodput, /doctor, /events, /healthz,
+# /readyz on 127.0.0.1.
+define_flag("FLAGS_telemetry_port", 0,
+            "port for the zero-dependency telemetry HTTP server "
+            "(profiler/telemetry_server.py). 0 (default) = off: no "
+            "thread, no socket, heartbeats are one bool check. Seeded "
+            "from the environment like every flag, so "
+            "`FLAGS_telemetry_port=9100 python train.py` arms a live "
+            "/metrics scrape surface")
+define_flag("FLAGS_telemetry_host", "127.0.0.1",
+            "bind address for the telemetry HTTP server. The loopback "
+            "default keeps the surface node-local; set 0.0.0.0 (or a "
+            "NIC address) for a cross-host Prometheus / fleet_metrics "
+            "scrape")
+define_flag("FLAGS_telemetry_stale_s", 120.0,
+            "liveness window for /healthz heartbeat sources when the "
+            "serving watchdog is disarmed: an open (un-finalized) "
+            "training accountant or a busy engine whose last step is "
+            "older than this reports unhealthy. Armed serving engines "
+            "use the FLAGS_serve_step_timeout_ms budget instead")
+
 define_flag("FLAGS_aot_cache", False,
             "persist fused executables (per-op/chain/whole-step/serving "
             "decode) to a content-addressed on-disk store via jax.export "

@@ -472,7 +472,17 @@ def inject_fault(kind, op=None, after=0, times=1):
                          never completed (serving watchdog sites and the
                          fused tiers consult this via `poll_fault`; plain
                          dispatches ignore it — an eager op cannot "hang"
-                         without wedging the harness itself).
+                         without wedging the harness itself). The
+                         StepHang is raised WITHOUT burning real time,
+                         so recovery-ladder chaos stays fast;
+          "stall"      — a hang that DOES burn the real watchdog budget
+                         before the StepHang (serving/resilience.py
+                         sleeps it out). The wall-clock variant exists
+                         for the liveness plane: /healthz
+                         (profiler/telemetry_server.py) must flip
+                         unhealthy within one watchdog window of a
+                         wedged step, which requires the wedge to
+                         occupy real time.
     op:   op name to match (None = any dispatched op). Non-dispatch
           sites use reserved names: "serve.decode" / "serve.prefill"
           (engine step futures), "fused_chain" / "fused_step" (the
@@ -482,7 +492,7 @@ def inject_fault(kind, op=None, after=0, times=1):
 
     Returns the injector; call .remove() to disarm early.
     """
-    if kind not in ("nan_output", "raise", "hang"):
+    if kind not in ("nan_output", "raise", "hang", "stall"):
         raise ValueError(f"unknown fault kind {kind!r}")
     inj = _Injector(kind, op, int(after), int(times))
     _INJECTORS.append(inj)
@@ -533,9 +543,10 @@ def maybe_inject(name, out_vals, multi):
     for inj in list(_INJECTORS):
         if inj.fired >= inj.times:
             continue
-        if inj.kind == "hang":
-            # hang faults are only meaningful at monitored-completion
-            # sites (poll_fault); a plain dispatch ignores them
+        if inj.kind in ("hang", "stall"):
+            # hang/stall faults are only meaningful at monitored-
+            # completion sites (poll_fault); a plain dispatch ignores
+            # them
             continue
         if inj.op is not None and inj.op != name:
             continue

@@ -360,6 +360,19 @@ def explain(events=None):
             live = None
         if live is not None:
             report["serving"]["live"] = live
+            try:
+                # per-step attribution (PR 13): WHICH decode steps the
+                # watchdog stalled, straight off the accountant's
+                # bounded ring — "stalled at steps 4096-4103", not just
+                # a hang count
+                from .goodput import ACCOUNTANT, format_step_ranges
+                with ACCOUNTANT._ring_lock:     # /doctor HTTP thread
+                    stalled = list(
+                        ACCOUNTANT.step_indices.get("stalled") or ())
+                if stalled:
+                    live["stalled_steps"] = format_step_ranges(stalled)
+            except Exception:
+                pass
 
     # AOT executable store (aot.* events, ops/aot_cache.py): how much of
     # the warmup came off disk, and whether any artifact was corrupt or

@@ -43,14 +43,39 @@ from collections import deque
 
 from ..framework.flags import _FLAGS
 from . import metrics as _metrics
+from . import telemetry_server as _telemetry
 
 __all__ = ["GoodputAccountant", "ACCOUNTANT", "on_step", "on_fused_fire",
            "mark", "note_stall", "estimate_cycle_flops",
-           "peak_flops_per_chip", "goodput_snapshot"]
+           "peak_flops_per_chip", "goodput_snapshot",
+           "format_step_ranges"]
 
 # rolling throughput window (steps): big enough to smooth scheduler
 # jitter, small enough that the gauge tracks LR-phase slowdowns live
 _ROLL_WINDOW = 64
+# per-bucket step-index attribution ring (PR 13): WHICH steps were
+# skipped/stalled/recompiled, bounded so a week of flapping cannot grow
+# the accountant — the newest indices win, the counts stay in buckets_s
+_ATTR_RING = 64
+
+
+def format_step_ranges(indices):
+    """Render step indices compactly: [1032, 2048, 4096, 4097, 4098]
+    -> "1032, 2048, 4096-4098" (the doctor/runbook presentation)."""
+    out = []
+    run = []
+    for i in sorted(set(int(i) for i in indices)):
+        if run and i == run[-1] + 1:
+            run.append(i)
+            continue
+        if run:
+            out.append(str(run[0]) if len(run) == 1
+                       else f"{run[0]}-{run[-1]}")
+        run = [i]
+    if run:
+        out.append(str(run[0]) if len(run) == 1
+                   else f"{run[0]}-{run[-1]}")
+    return ", ".join(out)
 
 
 def peak_flops_per_chip():
@@ -158,6 +183,14 @@ class GoodputAccountant:
     """
 
     def __init__(self):
+        # guards the deques (_roll + step_indices rings) against the
+        # telemetry server's HTTP threads: snapshot()/publish() iterate
+        # them while the training thread appends, and CPython raises
+        # "deque mutated during iteration" on that race. Mutations and
+        # reads take this lock; scalar bucket sums stay lock-free (GIL
+        # float adds, same contract as every counter struct here).
+        import threading
+        self._ring_lock = threading.Lock()
         self.reset()
 
     def reset(self, warm=False):
@@ -172,6 +205,9 @@ class GoodputAccountant:
         self._warmup_pending = not warm
         self.steps = 0
         self.buckets = {b: 0.0 for b in _metrics.GOODPUT_BUCKETS}
+        # bounded per-bucket step-index rings: WHICH steps landed in a
+        # non-productive bucket (created on first attribution)
+        self.step_indices = {}
         self._marks = set()
         self._stalled_extra = 0.0
         self._flops_per_step = None
@@ -247,12 +283,28 @@ class GoodputAccountant:
         """Tag the CURRENT inter-boundary interval (e.g. 'probation')."""
         self._marks.add(kind)
 
-    def note_stall(self, dt_s, kind="step_hang"):
+    def _attribute_step(self, bucket, index):
+        """Record WHICH step landed in a non-productive bucket (bounded
+        ring per bucket — the counts live in buckets_s, the indices make
+        the report actionable: "steps 1032, 2048 skipped")."""
+        with self._ring_lock:
+            ring = self.step_indices.get(bucket)
+            if ring is None:
+                ring = self.step_indices[bucket] = \
+                    deque(maxlen=_ATTR_RING)
+            if index is not None and (not ring or ring[-1] != index):
+                ring.append(int(index))
+
+    def note_stall(self, dt_s, kind="step_hang", step=None):
         """Attribute `dt_s` of wall time to the stalled bucket NOW (the
         watchdog knows exactly how long it waited; the interval diff
-        must not double-count it)."""
+        must not double-count it). `step` names the stalled step index —
+        the serving engine passes its decode-step counter; a training
+        caller defaults to the in-flight boundary."""
         self.buckets["stalled"] += float(dt_s)
         self._stalled_extra += float(dt_s)
+        self._attribute_step("stalled",
+                             step if step is not None else self.steps + 1)
         self.mark("stalled")
 
     def drop_stall_carry(self):
@@ -321,8 +373,11 @@ class GoodputAccountant:
             bucket = "productive"
         self._marks.clear()
         self.buckets[bucket] += dt_left
+        if bucket != "productive":
+            self._attribute_step(bucket, self.steps)
         if bucket == "productive":
-            self._roll.append((now, dt_left))
+            with self._ring_lock:
+                self._roll.append((now, dt_left))
             _metrics.TRAIN.step_s.observe(dt_left)
             if self._mesh:
                 _metrics.TRAIN.spmd_step_s.labels(
@@ -340,21 +395,24 @@ class GoodputAccountant:
         dt = now - self._t_last
         if dt > 0 and self.steps:
             self.buckets["productive"] += dt
-            if self._roll:
-                t_end, last = self._roll.pop()
-                self._roll.append((now, last + dt))
+            with self._ring_lock:
+                if self._roll:
+                    t_end, last = self._roll.pop()
+                    self._roll.append((now, last + dt))
         self._t_last = now
         self._t_final = now
 
     # -- publishing / reading ----------------------------------------------
     def _rolling(self):
         """(steps/s over the rolling window, window span s)."""
-        if len(self._roll) < 1:
+        with self._ring_lock:
+            roll = list(self._roll)
+        if len(roll) < 1:
             return 0.0, 0.0
-        span = sum(dt for _, dt in self._roll)
+        span = sum(dt for _, dt in roll)
         if span <= 0:
             return 0.0, 0.0
-        return len(self._roll) / span, span
+        return len(roll) / span, span
 
     def publish(self):
         """Refresh the registry gauges from the current state (run as a
@@ -378,6 +436,16 @@ class GoodputAccountant:
                 self.buckets["productive"] / total)
         for b, v in self.buckets.items():
             T.goodput_s.labels(bucket=b).set_raw(v)
+        # per-step attribution reaches the exposition as a high-water
+        # gauge: the LAST step index attributed per bucket ("the
+        # guardian most recently skipped step N"); the full bounded
+        # rings ride the JSON snapshot / the /goodput endpoint
+        with self._ring_lock:
+            last_by_bucket = {b: ring[-1]
+                              for b, ring in self.step_indices.items()
+                              if ring}
+        for b, last in last_by_bucket.items():
+            T.step_index.labels(bucket=b).set_raw(last)
 
     def snapshot(self):
         """JSON-able accountant view (bench.py embeds this; the MFU/
@@ -386,6 +454,9 @@ class GoodputAccountant:
         T = _metrics.TRAIN
         sps, span = self._rolling()
         total = sum(self.buckets.values())
+        with self._ring_lock:
+            indices = {b: list(ring)
+                       for b, ring in self.step_indices.items() if ring}
         return {
             "steps": self.steps,
             "wall_s": round((self._t_final or time.perf_counter())
@@ -403,6 +474,12 @@ class GoodputAccountant:
             if total > 0 else 0.0,
             "buckets_s": {b: round(v, 4)
                           for b, v in self.buckets.items()},
+            # WHICH steps landed in each non-productive bucket (bounded
+            # rings, newest last) + the compact human rendering the
+            # doctor prints ("1032, 2048, 4096-4103")
+            "step_indices": indices,
+            "step_indices_pretty": {b: format_step_ranges(ring)
+                                    for b, ring in indices.items()},
         }
 
 
@@ -415,7 +492,12 @@ ACCOUNTANT = GoodputAccountant()
 
 def on_step(opt=None, tokens=None):
     """Optimizer-step boundary (optimizer/optimizer.py + the fused
-    replay + jit/train_step.py)."""
+    replay + jit/train_step.py). The telemetry server's liveness
+    heartbeat fires BEFORE the metrics gate — /healthz must work on a
+    process that never armed FLAGS_metrics (one module-bool check when
+    no server runs; the beat keeps its own step counter so the number
+    moves even with the accountant disarmed)."""
+    _telemetry.beat("train")
     if not _FLAGS.get("FLAGS_metrics"):
         return
     ACCOUNTANT.step_boundary(tokens=tokens)
@@ -449,10 +531,10 @@ def mark(kind):
     ACCOUNTANT.mark(kind)
 
 
-def note_stall(dt_s, kind="step_hang"):
+def note_stall(dt_s, kind="step_hang", step=None):
     if not _FLAGS.get("FLAGS_metrics"):
         return
-    ACCOUNTANT.note_stall(dt_s, kind)
+    ACCOUNTANT.note_stall(dt_s, kind, step=step)
 
 
 def goodput_snapshot():

@@ -44,7 +44,8 @@ import threading
 from ..framework.flags import _FLAGS
 
 __all__ = ["Counter", "Gauge", "LogHistogram", "MetricsRegistry",
-           "REGISTRY", "METRIC_NAMES", "enabled", "counter", "gauge",
+           "REGISTRY", "METRIC_NAMES", "METRIC_MERGE", "merge_policy",
+           "enabled", "counter", "gauge",
            "histogram", "metrics_snapshot", "exposition",
            "merge_snapshots", "reset_metrics", "serve_live_summary",
            "format_metrics_summary"]
@@ -546,10 +547,28 @@ def exposition(snapshot):
     return "\n".join(lines) + "\n"
 
 
+def merge_policy(name, kind="gauge"):
+    """The cross-process merge rule for one metric family: an explicit
+    ``METRIC_MERGE`` entry when the name is on the contract, else the
+    kind default (occurrence mass — counters/histograms — always adds;
+    an unknown gauge keeps the conservative alarm-side max)."""
+    pol = METRIC_MERGE.get(name)
+    if pol is not None:
+        return pol
+    return "sum" if kind in ("counter", "histogram") else "max"
+
+
 def merge_snapshots(snaps):
-    """Merge registry snapshots from N processes: counters and histogram
-    buckets ADD; gauges take the max (a fleet-level gauge has no single
-    truthful aggregation — max is the conservative alarm-side choice)."""
+    """Merge registry snapshots from N processes. Histogram buckets
+    always ADD; scalar series honor the per-metric ``METRIC_MERGE``
+    policy — `sum` for occurrence mass and fleet-additive gauges
+    (tokens/s, occupancy), `max` for watermarks (step indices, MFU,
+    FLOPs/step), `last` for configuration-style values (the last
+    snapshot in merge order wins; pass snapshots oldest-first). The
+    old blanket gauge-max was wrong fleet-wide for
+    occupancy/tokens-style gauges (a fleet of 8 engines at 0.9 occupancy
+    reported 0.9, not 7.2); the policy map makes the semantics explicit
+    per metric and tests/test_metrics.py freezes it."""
     out = {}
     for snap in snaps:
         for name, fam in snap.items():
@@ -573,12 +592,16 @@ def merge_snapshots(snaps):
                     merged["labels"] = have.get("labels") or {}
                     have.clear()
                     have.update(merged)
-                elif fam["type"] == "gauge":
-                    have["value"] = max(have.get("value") or 0.0,
-                                        row.get("value") or 0.0)
                 else:
-                    have["value"] = (have.get("value") or 0.0) \
-                        + (row.get("value") or 0.0)
+                    pol = merge_policy(name, fam["type"])
+                    if pol == "max":
+                        have["value"] = max(have.get("value") or 0.0,
+                                            row.get("value") or 0.0)
+                    elif pol == "last":
+                        have["value"] = row.get("value") or 0.0
+                    else:
+                        have["value"] = (have.get("value") or 0.0) \
+                            + (row.get("value") or 0.0)
     return out
 
 
@@ -631,6 +654,7 @@ METRIC_NAMES = frozenset({
     "train_tokens_per_second",
     "train_goodput",
     "goodput_seconds_total",        # labels: bucket (productive/...)
+    "goodput_step_index",           # labels: bucket — last attributed step
     # serving engine (paddle_tpu/serving/engine.py)
     "serve_step_seconds",
     "serve_ttft_seconds",
@@ -648,6 +672,48 @@ METRIC_NAMES = frozenset({
 # the wall clock go? Also a public contract.
 GOODPUT_BUCKETS = ("productive", "compile", "skipped", "stalled",
                    "warmup", "probation", "other")
+
+# Cross-process merge policy per METRIC_NAMES entry — a public contract
+# like the names themselves (tests freeze the map; tools/metrics_export
+# --merge and tools/fleet_metrics.py both merge through it). Counters
+# and histograms are occurrence mass: always `sum`. Gauges get explicit
+# semantics: `sum` when the fleet total is the meaningful number
+# (throughput, occupied slots), `max` for watermarks (MFU best-chip,
+# FLOPs/step, last attributed step index), `last` where the newest
+# writer wins. ("last" = the LAST snapshot in the caller's merge order
+# — callers pass snapshots oldest-first; no contract metric uses it
+# today, it exists so a future config-style gauge has a named policy
+# instead of inheriting a wrong sum/max.) Fleet-truthful goodput/MFU
+# are DERIVED from the summed goodput_seconds_total buckets by
+# tools/fleet_metrics.py — the merged train_goodput gauge is only the
+# best-host watermark.
+METRIC_MERGE = {
+    "dispatch_events_total": "sum",
+    "chain_events_total": "sum",
+    "step_fusion_events_total": "sum",
+    "aot_events_total": "sum",
+    "guardian_events_total": "sum",
+    "collectives_total": "sum",
+    "train_step_seconds": "sum",
+    "spmd_step_seconds": "sum",
+    "train_tokens_total": "sum",
+    "train_flops_per_step": "max",
+    "train_mfu": "max",
+    "train_tokens_per_second": "sum",
+    "train_goodput": "max",
+    "goodput_seconds_total": "sum",
+    "goodput_step_index": "max",
+    "serve_step_seconds": "sum",
+    "serve_ttft_seconds": "sum",
+    "serve_inter_token_seconds": "sum",
+    "serve_queue_wait_seconds": "sum",
+    "serve_tokens_total": "sum",
+    "serve_occupancy": "sum",
+    "serve_requests_total": "sum",
+    "serve_refusals_total": "sum",
+    "serve_hangs_total": "sum",
+    "serve_preemptions_total": "sum",
+}
 
 
 class _Namespace:
@@ -676,6 +742,10 @@ def _install_default_metrics(reg):
     t.goodput_s = reg.counter(
         "goodput_seconds_total",
         "wall time attributed per goodput bucket", ("bucket",))
+    t.step_index = reg.gauge(
+        "goodput_step_index",
+        "last step index attributed to a non-productive goodput bucket",
+        ("bucket",))
     t.collectives = reg.counter(
         "collectives_total",
         "keyed collective dispatches through the eager funnel", ("kind",))

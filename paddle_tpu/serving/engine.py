@@ -89,6 +89,7 @@ from ..profiler.events import EVENTS as _EVENTS
 from ..profiler.metrics import LogHistogram, SERVE as _M, \
     enabled as _metrics_on
 from ..profiler import goodput as _goodput
+from ..profiler import telemetry_server as _telemetry
 from .cache import PagedKVCache, PagedCacheView, scatter_prefill, _is_int8
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
                         FAILED, CANCELLED, EXPIRED)
@@ -324,6 +325,19 @@ class LLMEngine:
         # next iteration boundary instead of editing the layout under
         # the loop's feet
         self._stepping = False
+        # liveness heartbeat (profiler/telemetry_server.py /healthz):
+        # stamped at step entry and after every clean decode step, so a
+        # busy engine whose heartbeat goes stale past the watchdog
+        # window reads as wedged — even when the wedge is a blind C++
+        # hang the watchdog itself cannot interrupt
+        self._hb_ns = None
+        # stamped whenever a fresh executable is about to trace (first
+        # decode build, a new prefill bucket, watchdog rebuilds):
+        # /healthz widens its staleness window during the compile so a
+        # supervisor never kills a replica for legitimately compiling
+        self._compile_grace_ns = None
+        _telemetry.maybe_start_from_flags()
+        _telemetry.register_engine(self)
 
     # ------------------------------------------------------------------
     # public API
@@ -519,6 +533,7 @@ class LLMEngine:
         waiting."""
         if self._stats.wall_t0 is None:
             self._stats.wall_t0 = time.perf_counter()
+        self._hb_ns = time.perf_counter_ns()
         sched = self.scheduler
         self._stepping = True
         try:
@@ -583,6 +598,12 @@ class LLMEngine:
             return bool(sched.running or sched.waiting)
         dt = time.perf_counter() - t0
         self._stats.observe_step(n_active, self.max_batch_size, demand, dt)
+        self._hb_ns = time.perf_counter_ns()
+        # a completed step means any pending compile finished: the
+        # /healthz grace window closes and staleness reverts to the
+        # watchdog budget
+        self._compile_grace_ns = None
+        _telemetry.beat("decode", step=self._stats.steps)
         if _metrics_on():
             _M.step_s.observe(dt)
             _M.occupancy.set(n_active / self.max_batch_size)
@@ -680,6 +701,9 @@ class LLMEngine:
         fn = self._prefill_fns.get(bucket)
         new_bucket = fn is None
         if new_bucket:
+            # the XLA trace runs on this bucket's FIRST call below —
+            # grace the liveness window for it
+            self._compile_grace_ns = time.perf_counter_ns()
             fn = self._build_prefill(bucket)
             self._prefill_fns[bucket] = fn
         self._stats.admitted += 1
@@ -867,6 +891,7 @@ class LLMEngine:
         either way."""
         from ..ops import guardian
         if self._decode_fn is None:
+            self._compile_grace_ns = time.perf_counter_ns()
             self._decode_fn = self._build_decode()
         attempt = 1
         while True:
@@ -921,7 +946,11 @@ class LLMEngine:
         budget_s = float(_FLAGS.get("FLAGS_serve_step_timeout_ms")
                          or 0) / 1e3
         if budget_s > 0:
-            _goodput.ACCOUNTANT.note_stall(budget_s, kind="step_hang")
+            # the stalled decode step is the one ABOUT to commit — its
+            # index lands in the goodput attribution ring so /goodput
+            # and the doctor can say WHICH steps stalled
+            _goodput.ACCOUNTANT.note_stall(budget_s, kind="step_hang",
+                                           step=self._stats.steps + 1)
 
     def _degrade(self, reason, detail):
         """Enter (or deepen) degraded mode with an attributed
@@ -950,6 +979,7 @@ class LLMEngine:
                 self._fail(req, "step_hang")
             if consumed:
                 self._reset_kv_state()
+            self._compile_grace_ns = time.perf_counter_ns()
             self._decode_fn = self._build_decode(use_aot=False)
             return False
         if attempt == 1:
@@ -961,6 +991,7 @@ class LLMEngine:
             # (the retrace is honest: decode_compiles counts it, the
             # degrade event explains it)
             self._degrade("step_hang", {"rung": "rebuild"})
+            self._compile_grace_ns = time.perf_counter_ns()
             self._decode_fn = self._build_decode(use_aot=False)
         return True
 
@@ -974,6 +1005,7 @@ class LLMEngine:
         if self._pools_consumed():
             self._reset_kv_state()
         if rebuild:
+            self._compile_grace_ns = time.perf_counter_ns()
             self._decode_fn = self._build_decode(use_aot=False)
 
     def _fallback_eager(self, req):

@@ -103,7 +103,10 @@ class MonitoredWait:
     `StepHang` when the budget elapses first. An armed chaos "hang"
     injector for `op=f"serve.{phase}"` trips the hang path
     deterministically without consuming the budget in real time — each
-    ladder rung re-polls, so `times=N` hangs exactly N attempts.
+    ladder rung re-polls, so `times=N` hangs exactly N attempts. A
+    "stall" injector is the wall-clock variant: it sleeps the REAL
+    budget before the StepHang, so the telemetry server's /healthz can
+    observe the wedge (tools/chaos.py `telemetry` scenario).
     """
 
     def __init__(self, budget_s=None):
@@ -118,9 +121,22 @@ class MonitoredWait:
         from ..ops import guardian
         budget = (self._budget_s if self._budget_s is not None
                   else watchdog_budget_s())
-        if guardian.faults_armed() and guardian.poll_fault(
-                f"serve.{phase}", ("hang",)) is not None:
-            raise StepHang(phase, (budget or 0) * 1e3, attempt)
+        if guardian.faults_armed():
+            kind = guardian.poll_fault(f"serve.{phase}",
+                                       ("hang", "stall"))
+            if kind == "stall":
+                # the wall-clock hang variant: burn the REAL budget
+                # before the StepHang so the liveness plane (/healthz,
+                # profiler/telemetry_server.py) observes a genuinely
+                # wedged step — with the watchdog disarmed, model a
+                # slow-but-alive step and return normally
+                time.sleep(budget if budget is not None
+                           else _ESCALATE_S * 10)
+                if budget is None:
+                    return
+                raise StepHang(phase, budget * 1e3, attempt)
+            if kind is not None:
+                raise StepHang(phase, (budget or 0) * 1e3, attempt)
         if budget is None:
             return
         start = time.perf_counter()

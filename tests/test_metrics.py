@@ -92,7 +92,7 @@ class TestContract:
             "train_step_seconds", "spmd_step_seconds",
             "train_tokens_total", "train_flops_per_step", "train_mfu",
             "train_tokens_per_second", "train_goodput",
-            "goodput_seconds_total",
+            "goodput_seconds_total", "goodput_step_index",
             "serve_step_seconds", "serve_ttft_seconds",
             "serve_inter_token_seconds", "serve_queue_wait_seconds",
             "serve_tokens_total", "serve_occupancy",
@@ -104,6 +104,31 @@ class TestContract:
         assert pm.GOODPUT_BUCKETS == ("productive", "compile", "skipped",
                                       "stalled", "warmup", "probation",
                                       "other")
+
+    def test_merge_policy_map_frozen(self):
+        """PR 13 satellite: the per-metric fleet-merge policy is a
+        public contract like the names — a policy change silently
+        re-means every fleet dashboard. Every METRIC_NAMES entry has an
+        explicit policy; occurrence mass (counters/histograms) always
+        sums; the gauges that were wrong under the old blanket max
+        (occupancy, tokens/s) are explicitly additive; watermarks stay
+        max."""
+        assert set(pm.METRIC_MERGE) == set(pm.METRIC_NAMES)
+        assert set(pm.METRIC_MERGE.values()) <= {"sum", "max", "last"}
+        # occurrence mass: every counter/histogram family sums
+        snap = pm.metrics_snapshot()
+        for name, fam in snap.items():
+            if fam["type"] in ("counter", "histogram"):
+                assert pm.METRIC_MERGE[name] == "sum", name
+        # the gauge semantics the satellite fixes / preserves
+        assert pm.METRIC_MERGE["serve_occupancy"] == "sum"
+        assert pm.METRIC_MERGE["train_tokens_per_second"] == "sum"
+        assert pm.METRIC_MERGE["train_mfu"] == "max"
+        assert pm.METRIC_MERGE["train_flops_per_step"] == "max"
+        assert pm.METRIC_MERGE["goodput_step_index"] == "max"
+        # unknown names keep the kind defaults
+        assert pm.merge_policy("_not_a_metric", "counter") == "sum"
+        assert pm.merge_policy("_not_a_metric", "gauge") == "max"
 
     def test_registry_preinstalls_exactly_the_contract(self):
         snap = pm.metrics_snapshot()
@@ -276,6 +301,29 @@ class TestExposition:
         # merged snapshots render through the same exposition path
         assert "paddle_tpu_serve_tokens_total 14" \
             in pm.exposition(merged).splitlines()
+
+    def test_merge_honors_per_metric_policy(self):
+        """PR 13 satellite: merge_snapshots follows METRIC_MERGE — a
+        fleet of engines at 0.9 occupancy reports summed occupied
+        capacity (1.8 across two hosts), NOT the old blanket max (0.9);
+        fleet tokens/s adds; the step-index watermark maxes. Both
+        metrics_export --merge and fleet_metrics flow through this one
+        implementation."""
+        set_flags({"FLAGS_metrics": True})
+        pm.SERVE.occupancy.set(0.9)
+        pm.TRAIN.tokens_per_s._default.set_raw(100.0)
+        pm.TRAIN.step_index.labels(bucket="skipped").set_raw(40)
+        snap = pm.metrics_snapshot()
+        other = json.loads(json.dumps(snap))
+        other["serve_occupancy"]["series"][0]["value"] = 0.7
+        other["train_tokens_per_second"]["series"][0]["value"] = 50.0
+        other["goodput_step_index"]["series"][0]["value"] = 90
+        merged = pm.merge_snapshots([snap, other])
+        assert merged["serve_occupancy"]["series"][0]["value"] \
+            == pytest.approx(1.6)
+        assert merged["train_tokens_per_second"]["series"][0]["value"] \
+            == pytest.approx(150.0)
+        assert merged["goodput_step_index"]["series"][0]["value"] == 90
 
 
 # ---------------------------------------------------------------------------

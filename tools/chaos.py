@@ -65,6 +65,14 @@ Serving scenarios (PR 7), the same methodology against LLMEngine:
                     restores every in-flight request and finishes each
                     stream BYTE-identically to an uninterrupted run.
 
+  telemetry         PR 13: a "stall" fault (the wall-clock hang variant)
+                    wedges two decode steps under an armed telemetry
+                    server. Must hold: /healthz flips 503 within one
+                    watchdog window, /readyz is 503 while the degraded
+                    latch holds, both recover after the first clean
+                    step, streams stay token-identical, and /goodput
+                    names the stalled step indices.
+
 Every decision flows through the PR 4 fusion flight recorder, so each
 scenario's report embeds the doctor's verdict.
 
@@ -400,6 +408,116 @@ def scenario_serve_fused_fault():
     return {"ok": not failures, "failures": failures,
             "guardian": guardian.guardian_stats(),
             "doctor": rep["headline"]}
+
+
+def scenario_telemetry():
+    """PR 13: the live observability plane under an injected wedge. A
+    serving engine runs with the telemetry server armed while a chaos
+    "stall" fault (guardian.inject_fault — the wall-clock hang variant)
+    wedges two consecutive decode steps for the full watchdog budget.
+    Must hold: a scraper polling /healthz at ~100 Hz observes the flip
+    to unhealthy (503) within one watchdog window of the hang, /readyz
+    reads 503 while the degraded latch is set, BOTH recover after the
+    first clean decode step, every stream still finishes
+    token-identically, and /goodput names the stalled step indices."""
+    import threading
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops import guardian
+    from paddle_tpu.profiler import telemetry_server
+    from paddle_tpu.profiler.metrics import reset_metrics
+    from paddle_tpu.serving import LLMEngine, FINISHED
+
+    _arm_serve()
+    budget_ms = 150
+    set_flags({"FLAGS_serve_step_timeout_ms": budget_ms,
+               "FLAGS_metrics": True})
+    reset_metrics()
+    model, prompts = _serve_setup()
+    refs = _serve_refs(model, prompts, 8)
+    failures = []
+    srv = telemetry_server.start(port=0)
+    samples = []                    # (t, endpoint, status, body)
+    stop = threading.Event()
+
+    def probe(ep):
+        return telemetry_server.probe_endpoint(f"{srv.url}/{ep}",
+                                               timeout=5)
+
+    def scraper():
+        while not stop.is_set():
+            for ep in ("healthz", "readyz"):
+                try:
+                    st, body = probe(ep)
+                    samples.append((time.perf_counter(), ep, st, body))
+                except Exception:
+                    pass
+            time.sleep(0.01)        # ~100 Hz across both endpoints
+
+    try:
+        engine = LLMEngine(model, max_batch_size=2, block_size=4)
+        reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            engine.step()           # warm + heartbeat established
+        st0, _ = probe("healthz")
+        if st0 != 200:
+            failures.append("healthz not 200 on a healthy stepping "
+                            "engine")
+        thr = threading.Thread(target=scraper, daemon=True)
+        thr.start()
+        t_hang = time.perf_counter()
+        guardian.inject_fault("stall", op="serve.decode", times=2)
+        engine.run()                # wedges ~2x budget, then recovers
+        guardian.clear_faults()
+        stop.set()
+        thr.join(timeout=10)
+        # -- liveness flipped within one watchdog window ----------------
+        bad_health = [t for t, ep, st, _ in samples
+                      if ep == "healthz" and st == 503]
+        if not bad_health:
+            failures.append("healthz never flipped unhealthy during the "
+                            "injected stall")
+        else:
+            # scrape cadence (~20ms across endpoints) rides on top of
+            # the one-window bound; allow it as slack
+            flip_s = min(bad_health) - t_hang
+            if flip_s > 2 * budget_ms / 1e3 + 0.25:
+                failures.append(
+                    f"healthz took {flip_s:.3f}s to flip (watchdog "
+                    f"window {budget_ms}ms)")
+        if not any(ep == "readyz" and st == 503
+                   for _, ep, st, _ in samples):
+            failures.append("readyz never reported the degraded latch")
+        # -- recovery ---------------------------------------------------
+        st_h, body_h = probe("healthz")
+        st_r, body_r = probe("readyz")
+        if st_h != 200:
+            failures.append(f"healthz did not recover (still {st_h}: "
+                            f"{body_h})")
+        if st_r != 200:
+            failures.append(f"readyz did not recover (still {st_r})")
+        for r, ref in zip(reqs, refs):
+            if r.state != FINISHED or r.generated != ref:
+                failures.append(
+                    f"stream {r.rid} not token-identical through the "
+                    f"stall (state {r.state})")
+        _, good = probe("goodput")
+        stalled = (good.get("step_indices") or {}).get("stalled") or []
+        if len(stalled) < 1:
+            failures.append("goodput did not attribute the stalled step "
+                            "indices")
+        hangs = engine.stats()["hangs"]
+        if hangs < 2:
+            failures.append(f"expected 2 watchdog firings, saw {hangs}")
+        return {"ok": not failures, "failures": failures,
+                "hangs": hangs, "scrapes": len(samples),
+                "unhealthy_scrapes": len(bad_health),
+                "stalled_steps": stalled}
+    finally:
+        stop.set()
+        guardian.clear_faults()
+        telemetry_server.stop()
+        set_flags({"FLAGS_serve_step_timeout_ms": 0,
+                   "FLAGS_metrics": False})
 
 
 def serve_child_main(args):
@@ -856,7 +974,8 @@ SCENARIOS = {"nan": scenario_nan, "exception": scenario_exception,
              "kill": scenario_kill, "warm_restart": scenario_warm_restart,
              "serve_hang": scenario_serve_hang,
              "serve_fused_fault": scenario_serve_fused_fault,
-             "serve_kill": scenario_serve_kill}
+             "serve_kill": scenario_serve_kill,
+             "telemetry": scenario_telemetry}
 
 
 def main(argv=None):

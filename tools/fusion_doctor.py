@@ -31,6 +31,11 @@ Usage:
     # and collect it manually
     python tools/fusion_doctor.py --cache [--cache-dir DIR] [--gc]
 
+    # diagnose a RUNNING process without attaching: pull the report from
+    # its telemetry server's /doctor endpoint (FLAGS_telemetry_port,
+    # profiler/telemetry_server.py) — same JSON schema as --json
+    python tools/fusion_doctor.py --url http://host:9100 [--json]
+
 The doctor only ARMS the recorder (FLAGS_profiler_events); it does not
 change the fusion configuration of a user script — if the script runs with
 caching/fusion off, the report says so instead of inventing activity.
@@ -220,6 +225,45 @@ def _demo_metrics(steps):
                    "FLAGS_check_numerics_level": 0})
 
 
+def _print_goodput(g):
+    """One-line goodput rendering shared by --metrics and --url: the
+    fraction, the buckets, and WHICH steps each non-productive bucket
+    claimed (the PR 13 per-step attribution rings)."""
+    print(f"goodput : {g['goodput']} over {g['steps']} step(s) "
+          f"(p50 {g['step_ms_p50']} ms, buckets {g['buckets_s']})")
+    for b, pretty in sorted((g.get("step_indices_pretty") or {}).items()):
+        print(f"          {b} at step(s) {pretty}")
+
+
+def _url_report(args) -> int:
+    """`fusion_doctor --url http://host:port`: fetch the live /doctor
+    report from a running process's telemetry server and render it
+    exactly like a local run (JSON schema identical to --json, metrics/
+    goodput sections present when the process has FLAGS_metrics armed)."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/doctor"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            report = json.loads(r.read().decode())
+    except Exception as e:
+        print(f"fusion_doctor: could not reach {url}: {e}\n"
+              "is the process running with FLAGS_telemetry_port set?",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    from paddle_tpu.profiler.explain import format_report
+    print(format_report(report))
+    if report.get("metrics"):
+        from paddle_tpu.profiler.metrics import format_metrics_summary
+        print(format_metrics_summary(report["metrics"]))
+    if report.get("goodput"):
+        _print_goodput(report["goodput"])
+    return 0
+
+
 def _cache_report(args) -> int:
     """`fusion_doctor --cache`: list the AOT executable store (kind,
     digest, size, age, environment-fingerprint match, label), report
@@ -314,14 +358,21 @@ def main(argv=None) -> int:
     ap.add_argument("--cache-dir", default=None,
                     help="AOT store root (default: the configured "
                          "FLAGS_aot_cache_dir / $PADDLE_TPU_CACHE_DIR/aot)")
+    ap.add_argument("--url", default=None, metavar="http://host:port",
+                    help="pull the report from a RUNNING process's "
+                         "telemetry server /doctor endpoint "
+                         "(FLAGS_telemetry_port) instead of running "
+                         "anything locally")
     ap.add_argument("--gc", action="store_true",
                     help="with --cache: run the size/age eviction now "
                          "(also removes quarantined *.corrupt files)")
     args = ap.parse_args(argv)
+    if args.url:
+        return _url_report(args)
     if args.cache:
         return _cache_report(args)
     if not args.demo and not args.script:
-        ap.error("either a script or --demo is required")
+        ap.error("either a script, --demo, --cache, or --url is required")
 
     from paddle_tpu.framework.flags import set_flags
     from paddle_tpu.profiler.events import EVENTS, clear_fusion_events
@@ -375,9 +426,7 @@ def main(argv=None) -> int:
         print(format_report(report))
         if want_metrics:
             print(format_metrics_summary(report["metrics"]))
-            g = report["goodput"]
-            print(f"goodput : {g['goodput']} over {g['steps']} step(s) "
-                  f"(p50 {g['step_ms_p50']} ms, buckets {g['buckets_s']})")
+            _print_goodput(report["goodput"])
     return 0
 
 

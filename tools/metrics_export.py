@@ -15,9 +15,12 @@ fleet has many. This tool is the boundary between them:
     week-long file from growing without bound.
   * :func:`read_sink` / :func:`merge_files` — parse sink files
     (CRC-verified when the trailer is present) and merge the LAST
-    snapshot of each process's file into one fleet view: counters and
-    histogram buckets add, gauges take the max
-    (profiler/metrics.merge_snapshots).
+    snapshot of each process's file into one fleet view through the
+    per-metric ``METRIC_MERGE`` policy (profiler/metrics.py: counters
+    and histogram buckets add; gauges sum, max, or last-wins per their
+    contract entry — occupancy/tokens gauges ADD fleet-wide, watermarks
+    take the max). Each row carries ``host`` + ``pid`` so
+    tools/fleet_metrics.py can label per-host series.
   * CLI — merge sinks and render the result as Prometheus text
     exposition or the one-screen summary:
 
@@ -58,6 +61,13 @@ class MetricsSink:
         self._lines = []
         self._thread = None
         self._stop = threading.Event()
+        # resolved once: the host label cannot change mid-file (the
+        # fleet merge keys per-host series on host:pid)
+        try:
+            import socket
+            self._host = socket.gethostname()
+        except Exception:
+            self._host = ""
 
     def write(self):
         """Append one snapshot line and atomically rewrite the file.
@@ -65,7 +75,7 @@ class MetricsSink:
         sink survives kill -9 at any instant without torn content."""
         from paddle_tpu.framework.io import _write_atomic
         from paddle_tpu.profiler import goodput as _goodput
-        row = {"ts": time.time(), "pid": os.getpid(),
+        row = {"ts": time.time(), "pid": os.getpid(), "host": self._host,
                "metrics": self._registry.snapshot(),
                "goodput": _goodput.ACCOUNTANT.snapshot()}
         self._lines.append(json.dumps(row, sort_keys=True))

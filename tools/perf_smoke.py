@@ -60,6 +60,13 @@ stack silently regressed:
     armed on BOTH the fused train loop and the serve_8 workload
     (interleaved min-of-ratios), and its histogram hot path must never
     grow memory with observations (a PR 12 regression);
+  * telemetry server — the live HTTP observability plane
+    (profiler/telemetry_server.py) must cost one module-bool check per
+    heartbeat site with no server running (<3%/step, nothing recorded),
+    and with the server armed plus a scraper hitting /metrics +
+    /healthz every 100 ms, the fused train loop and the serve_8
+    workload must stay within 5%/step while every scrape is answered
+    (a PR 13 regression);
   * distributed step fusion — a dp=N sharded-batch loop over the
     emulated device mesh must auto-promote into ONE shard_map-wrapped
     executable (ops/spmd_fusion.py; zero retraces after promotion) and
@@ -837,6 +844,128 @@ def main() -> int:
             "being cheap (PR 12 regression)")
     _pm.reset_metrics()
 
+    # ---- telemetry server leg (PR 13 guard) ------------------------------
+    # (l) the live HTTP observability plane: with NO server running,
+    # every heartbeat site must be one module-bool check (<3%/step at a
+    # generous 4 sites/step) that records NOTHING; with the server armed
+    # AND a scraper hitting /metrics + /healthz every 100 ms, the fused
+    # train loop and the serve_8 workload must stay within 5%/step
+    # (interleaved scraper-paused vs scraping windows, min-of-ratios —
+    # the guardian leg's statistic)
+    import threading
+    import urllib.error
+    import urllib.request
+    from paddle_tpu.profiler import telemetry_server as _tsrv
+
+    N_BEAT = 200_000
+    t0 = time.perf_counter()
+    for _ in range(N_BEAT):
+        _tsrv.beat("train")
+    beat_off_ns = (time.perf_counter() - t0) / N_BEAT * 1e9
+    if _tsrv._HEART:
+        failures.append(
+            "telemetry heartbeat recorded with no server running: the "
+            "module-bool gate is broken (PR 13 regression)")
+    tel_overhead_off = beat_off_ns * 4 / max(t_step * 1e9, 1.0)
+    if tel_overhead_off >= 0.03:
+        failures.append(
+            f"server-off heartbeat cost {beat_off_ns:.0f}ns x 4 "
+            f"sites/step is {tel_overhead_off * 100:.2f}% of a fused "
+            "step (>=3%): the disarmed liveness path got expensive "
+            "(PR 13 regression)")
+
+    srv = _tsrv.start(port=0)
+    scrape_on = threading.Event()
+    scrape_stop = threading.Event()
+    scrape_errs = []
+    scrape_n = [0]
+
+    def _scraper():
+        while not scrape_stop.is_set():
+            if not scrape_on.is_set():
+                time.sleep(0.005)
+                continue
+            for ep in ("/metrics", "/healthz"):
+                try:
+                    with urllib.request.urlopen(srv.url + ep,
+                                                timeout=5) as r:
+                        r.read()
+                    scrape_n[0] += 1
+                except urllib.error.HTTPError:
+                    scrape_n[0] += 1   # 503 healthz is a served scrape
+                except Exception as e:
+                    scrape_errs.append(repr(e)[:120])
+            time.sleep(0.1)
+
+    _sthr = threading.Thread(target=_scraper, daemon=True)
+    _sthr.start()
+    set_flags({"FLAGS_metrics": True})
+    ts_step = _loop(step_fused=True)
+    for _ in range(WARMUP):
+        ts_step()
+    tratios = []
+    for _ in range(6):
+        scrape_on.clear()
+        ts_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            ts_step()
+        ts_step.sync()
+        t_plain = time.perf_counter() - t0
+        scrape_on.set()
+        ts_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            ts_step()
+        ts_step.sync()
+        t_scraped = time.perf_counter() - t0
+        tratios.append(t_scraped / t_plain if t_plain > 0
+                       else float("inf"))
+    tel_train_overhead = min(tratios) - 1.0
+    if tel_train_overhead >= 0.05:
+        failures.append(
+            f"a 100ms-cadence scraper costs "
+            f"{tel_train_overhead * 100:.1f}%/step on the fused train "
+            "loop (>=5%): the scrape path is taxing the step it watches "
+            "(PR 13 regression)")
+    tsratios = []
+    for _ in range(6):
+        scrape_on.clear()
+        t0 = time.perf_counter()
+        for p in sprompts8:
+            mengine.add_request(p, max_new_tokens=6)
+        mengine.run()
+        t_plain = time.perf_counter() - t0
+        scrape_on.set()
+        t0 = time.perf_counter()
+        for p in sprompts8:
+            mengine.add_request(p, max_new_tokens=6)
+        mengine.run()
+        t_scraped = time.perf_counter() - t0
+        tsratios.append(t_scraped / t_plain if t_plain > 0
+                        else float("inf"))
+    tel_serve_overhead = min(tsratios) - 1.0
+    if tel_serve_overhead >= 0.05:
+        failures.append(
+            f"a 100ms-cadence scraper costs "
+            f"{tel_serve_overhead * 100:.1f}%/step on the serve_8 loop "
+            "(>=5%) (PR 13 regression)")
+    scrape_stop.set()
+    scrape_on.set()
+    _sthr.join(timeout=10)
+    _tsrv.stop()
+    set_flags({"FLAGS_metrics": False})
+    if scrape_n[0] == 0:
+        failures.append(
+            "the telemetry scraper never completed a scrape — the leg "
+            "guarded nothing (PR 13 guard bug)")
+    if len(scrape_errs) > 5:
+        failures.append(
+            f"{len(scrape_errs)} scrape failures under churn (first: "
+            f"{scrape_errs[0]}): the server stopped answering while the "
+            "process works (PR 13 regression)")
+    _pm.reset_metrics()
+
     # ---- AOT warm-start leg (PR 9 guard) ---------------------------------
     # (h) a fresh subprocess with a warm executable store must promote its
     # fused step with zero compile activity and beat the cold subprocess's
@@ -919,6 +1048,10 @@ def main() -> int:
           f"({m_overhead_off * 100:.2f}%/step) "
           f"on={m_overhead_on * 100:.1f}%/step train "
           f"{ms_overhead_on * 100:.1f}%/step serve, "
+          f"telemetry beat-off={beat_off_ns:.0f}ns "
+          f"scraped={tel_train_overhead * 100:.1f}%/step train "
+          f"{tel_serve_overhead * 100:.1f}%/step serve "
+          f"({scrape_n[0]} scrapes), "
           f"aot warm-start={aot_warm['t_first_fire_s']:.2f}s vs "
           f"cold={aot_cold['t_first_fire_s']:.2f}s "
           f"(warm hits={aot_warm['aot']['hits']} "

@@ -228,9 +228,22 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", default=None,
                     help="directory for a jax profiler trace of a few "
                          "steady-state decode steps")
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    help="arm the live HTTP observability plane "
+                         "(profiler/telemetry_server.py) on this port "
+                         "for the run — scrape /metrics /goodput "
+                         "/healthz while the bench churns (0 = an "
+                         "ephemeral port, printed)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw record as JSON")
     args = ap.parse_args(argv)
+
+    if args.telemetry_port is not None:
+        from paddle_tpu.profiler import telemetry_server
+        srv = telemetry_server.start(port=args.telemetry_port)
+        print(f"serve_bench: telemetry server at {srv.url} "
+              "(/metrics /goodput /doctor /healthz /readyz)",
+              file=sys.stderr)
 
     import jax
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
