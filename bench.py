@@ -294,6 +294,130 @@ def bench_gpt2_355m(on_tpu):
                              cfg, batch, steps, seq, on_tpu, "gpt2_355m")
 
 
+def bench_accum4(on_tpu):
+    """Grad-accumulation train leg (universal promotion): a dropout>0 GPT
+    trained EAGERLY with k=4 micro-batches per optimizer step — the exact
+    shape that used to fall off the fast path twice over (rng_rekey +
+    multi_backward). The loop auto-promotes to the super-cycle executable
+    pair (ops/step_fusion.py); tokens/s + MFU are READ BACK from the
+    metrics registry like every other train leg, so the accumulation win
+    lands in the BENCH trajectory."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.incubate.models import (GPTConfig, GPTForCausalLM,
+                                            GPTPretrainingCriterion,
+                                            gpt2_124m)
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache
+    from paddle_tpu.profiler import (reset_dispatch_cache_stats,
+                                     reset_chain_fusion_stats,
+                                     reset_step_fusion_stats,
+                                     step_fusion_stats, clear_fusion_events,
+                                     fusion_events, events_summary)
+    from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.profiler.metrics import reset_metrics
+    from paddle_tpu.profiler.goodput import ACCOUNTANT as _acct
+
+    k = 4
+    if on_tpu:
+        seq, batch, warmup, steps = 1024, 4, 8, 10
+        cfg = gpt2_124m(hidden_dropout_prob=0.1,
+                        attention_probs_dropout_prob=0.0,
+                        max_position_embeddings=seq)
+    else:
+        seq, batch, warmup, steps = 128, 2, 8, 4
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128,
+                        max_position_embeddings=seq,
+                        hidden_dropout_prob=0.1,
+                        attention_probs_dropout_prob=0.0)
+    reset_dispatch_cache_stats()
+    reset_chain_fusion_stats()
+    reset_step_fusion_stats()
+    clear_fusion_events()
+    reset_metrics()
+    prev = get_flags(["FLAGS_profiler_events", "FLAGS_metrics"])
+    set_flags({"FLAGS_profiler_events": True, "FLAGS_metrics": True,
+               "FLAGS_eager_op_cache": True,
+               "FLAGS_eager_chain_fusion": True,
+               "FLAGS_eager_chain_fusion_min_count": 4,
+               "FLAGS_eager_step_fusion": True,
+               "FLAGS_eager_step_fusion_min_count": 3})
+    try:
+        clear_dispatch_cache()
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        n_params = model.num_params()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                                     parameters=model.parameters())
+        criterion = GPTPretrainingCriterion()
+        rng = np.random.default_rng(0)
+        micro = [
+            (paddle.Tensor(jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+                stop_gradient=True),
+             paddle.Tensor(jnp.asarray(
+                 rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+                 stop_gradient=True))
+            for _ in range(k)]
+
+        def cycle():
+            for x, y in micro:
+                loss = criterion(model(x), y)
+                loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        for _ in range(warmup):
+            cycle()
+        jax.block_until_ready(
+            next(iter(model.parameters()))._value)
+        flops_per_token = model.flops_per_token(seq, training=True)
+        _acct.reset(warm=True)
+        _acct.set_flops_per_step(flops_per_token * batch * seq * k,
+                                 tokens=batch * seq * k,
+                                 peak=peak_flops_per_chip())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = cycle()
+        final = float(loss.numpy())
+        _acct.finalize()
+        elapsed = time.perf_counter() - t0
+
+        goodput = _acct.snapshot()
+        offline_tps = batch * seq * k * steps / elapsed
+        mfu_offline = offline_tps * flops_per_token / peak_flops_per_chip()
+        sf = step_fusion_stats()
+        ev = fusion_events()
+        doctor = explain(ev)
+        platform = jax.devices()[0].platform
+        return {
+            "metric": "gpt2_accum4_train_tokens_per_sec_per_chip",
+            "value": round(goodput["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "extra": {"mfu": round(goodput["mfu"], 4),
+                      "mfu_offline": round(mfu_offline, 4),
+                      "tokens_per_sec_offline": round(offline_tps, 1),
+                      "loss": round(final, 3),
+                      "k_micro_batches": k,
+                      "batch": batch, "seq": seq, "params": n_params,
+                      "goodput": goodput,
+                      "step_fusion": sf,
+                      "fused_steps": sf["fused_steps"],
+                      "retraces": sf["retraces"],
+                      "fusion_events": events_summary(ev),
+                      "fusion_doctor": {"verdict": doctor["verdict"],
+                                        "headline": doctor["headline"]},
+                      "platform": platform},
+        }
+    finally:
+        set_flags(prev)
+
+
 def bench_flash4096(on_tpu):
     """Long-context case: GPT-2 124M at seq 4096 through the Pallas flash
     fwd+bwd kernel (attention is ~30% of model FLOPs here, so this is the
@@ -594,6 +718,7 @@ CONFIG_FNS = {
     "flash4096": bench_flash4096,
     "gpt2_355m": bench_gpt2_355m,
     "gpt2_train": bench_gpt2_train,
+    "accum4": bench_accum4,
     "dp8": bench_dp8,
 }
 
@@ -601,7 +726,7 @@ CONFIG_FNS = {
 # versions are tiny and get a flat cap
 TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
             "serve_64": 150, "flash4096": 210, "gpt2_355m": 240,
-            "gpt2_train": 280, "dp8": 180}
+            "gpt2_train": 280, "accum4": 240, "dp8": 180}
 CPU_CAP = 150
 HEADLINE = "gpt2_train"
 HEADLINE_RESERVE = 300      # wall-clock held back for the headline config

@@ -1,10 +1,25 @@
 """Global RNG management over jax PRNG keys.
 
 Reference analog: paddle/phi/core/generator.h (global Generator per device) and
-python/paddle/fluid/framework.py seed handling. TPU-first: a functional PRNG key
-is split per sampling call; under `to_static`/jit tracing, keys come from a
-traced key context (threaded by the jitted step) so compiled random ops do not
-bake in a constant key.
+python/paddle/fluid/framework.py seed handling. TPU-first: randomness is a
+*stream* over a fixed base key — consumption i draws
+`jax.random.fold_in(base_key, i)` — so a stream position is pure data:
+
+  * eager sampling derives the key on the spot (one tiny fold_in),
+  * the whole-step fusion promoter (ops/step_fusion.py) hoists
+    (base-key data, first stream position) into the ONE fused executable as
+    device scalar arguments — exactly the LR-scalar pattern — and derives
+    every key IN-GRAPH, so dropout>0 loops promote with a per-step-advancing
+    key whose bits match the eager stream exactly,
+  * checkpoints snapshot (base key, position) and resume the stream
+    bit-for-bit (incubate/checkpoint.py).
+
+RNG-consuming ops request a stream position via `rng_key_input()`, which
+returns a LAZY key tensor: the uint32 key data materializes only if some
+non-fused path actually reads it, so a fused replay advances the stream
+without launching anything. Under `to_static`/jit tracing, keys come from a
+traced key context (threaded by the jitted step) so compiled random ops do
+not bake in a constant key.
 """
 from __future__ import annotations
 
@@ -14,43 +29,68 @@ import jax
 
 __all__ = ["seed", "get_rng_key", "split_key", "default_generator",
            "tracing_key_scope", "RNGKeyContext", "rng_epoch",
-           "rng_checkpoint_state", "set_rng_checkpoint_state"]
+           "rng_checkpoint_state", "set_rng_checkpoint_state",
+           "rng_key_input", "derive_key_data", "stream_base_data",
+           "HoistedKeyTensor"]
 
 
 class _GlobalGenerator:
-    """Stateful generator: holds a jax PRNG key, splits off a fresh subkey
-    per use. The key materializes LAZILY on first use — creating it at
-    import would initialize the jax backend as a side effect of
-    `import paddle_tpu` (launch helpers and shell tools must be able to
-    import the package without touching an accelerator)."""
+    """Stateful generator: a fixed base jax PRNG key plus a monotonically
+    advancing stream position; consumption i yields `fold_in(base, i)`.
+    The base key materializes LAZILY on first use — creating it at import
+    would initialize the jax backend as a side effect of `import
+    paddle_tpu` (launch helpers and shell tools must be able to import the
+    package without touching an accelerator)."""
 
     def __init__(self, seed_val: int = 0):
         self._lock = threading.Lock()
-        self._key = None
+        self._key = None            # the BASE key (fixed between seedings)
         self.initial_seed = seed_val
-        # bumped on every key split: dispatch reads this to attribute an
-        # un-keyable op to fresh randomness (`rng_rekey` in the fusion
-        # flight recorder) instead of a generic un-keyable closure
+        # total stream positions consumed (any path); checkpointed so a
+        # resumed run continues the interrupted stream bit-for-bit
         self.epoch = 0
+        # positions consumed through the STATEFUL next_key() path only:
+        # dispatch reads this to attribute an un-keyable op to fresh
+        # randomness (`rng_rekey` in the fusion flight recorder). Hoisted
+        # consumption (rng_key_input) never bumps it — those ops key
+        # cleanly and must not smear rng_rekey onto unrelated bypasses.
+        self.legacy_epoch = 0
         # whether the user explicitly seeded (paddle.seed): consumers that
         # want "deterministic iff seeded" semantics (DataLoader worker
         # seeding) check this instead of guessing from the value
         self.seeded = False
 
+    def _base(self):
+        # callers hold self._lock
+        if self._key is None:
+            self._key = jax.random.key(self.initial_seed)
+        return self._key
+
     def manual_seed(self, seed_val: int):
         with self._lock:
             self._key = jax.random.key(int(seed_val))
             self.initial_seed = int(seed_val)
+            self.epoch = 0
             self.seeded = True
         return self
 
     def next_key(self):
         with self._lock:
-            if self._key is None:
-                self._key = jax.random.key(self.initial_seed)
-            self._key, sub = jax.random.split(self._key)
+            base = self._base()
+            ep = self.epoch
             self.epoch += 1
-        return sub
+            self.legacy_epoch += 1
+        return jax.random.fold_in(base, ep)
+
+    def reserve(self):
+        """Reserve one stream position without deriving the key: (base
+        key, position). The hoisted-consumption path — derivation happens
+        lazily on read, or in-graph inside a fused step."""
+        with self._lock:
+            base = self._base()
+            ep = self.epoch
+            self.epoch += 1
+        return base, ep
 
 
 default_generator = _GlobalGenerator(0)
@@ -91,21 +131,24 @@ class tracing_key_scope:
 
 
 def rng_epoch():
-    """Monotonic count of keys split off the global generator. An op whose
-    fn is un-keyable AND whose dispatch follows an epoch advance consumed
-    fresh randomness this call — the `rng_rekey` signature (dropout et
-    al.) in ops/dispatch.py bypass attribution."""
-    return default_generator.epoch
+    """Monotonic count of keys drawn through the STATEFUL path (an op
+    closing over `get_rng_key()` output). An op whose fn is un-keyable AND
+    whose dispatch follows an advance here consumed fresh un-hoistable
+    randomness — the `rng_rekey` signature in ops/dispatch.py bypass
+    attribution. Hoisted consumption (`rng_key_input`) does not count:
+    those ops key on structure and promote."""
+    return default_generator.legacy_epoch
 
 
 def seed(seed_val: int):
-    """`paddle.seed` equivalent — reseed the global generator."""
+    """`paddle.seed` equivalent — reseed the global generator (the stream
+    restarts at position 0 over the new base key)."""
     return default_generator.manual_seed(seed_val)
 
 
 def get_rng_key():
     """Return a fresh PRNG key: from the innermost tracing scope if active,
-    else from the global stateful generator."""
+    else the next position of the global stream."""
     stack = getattr(_tracing_ctx, "stack", None)
     if stack:
         return stack[-1].next_key()
@@ -116,18 +159,177 @@ def split_key(n: int):
     return jax.random.split(get_rng_key(), n)
 
 
+# ---------------------------------------------------------------------------
+# hoisted consumption: stream positions as lazy dispatch inputs
+# ---------------------------------------------------------------------------
+
+# resolved lazily: framework/__init__ imports .core before .random, but a
+# direct `import paddle_tpu.framework.random` must not force the order
+_Tensor = None
+_VALUE_SLOT = None
+_NODE_SLOT = None
+_IDX_SLOT = None
+_UNMATERIALIZED = object()
+_KD_AVAL = None     # (shape, dtype, weak_type) of key data, impl-dependent
+
+
+def _tensor_cls():
+    global _Tensor, _VALUE_SLOT, _NODE_SLOT, _IDX_SLOT
+    if _Tensor is None:
+        from .core import Tensor
+        _Tensor = Tensor
+        _VALUE_SLOT = Tensor.__dict__["_value"]
+        _NODE_SLOT = Tensor.__dict__["_grad_node"]
+        _IDX_SLOT = Tensor.__dict__["_out_index"]
+    return _Tensor
+
+
+def _key_data_aval():
+    """Aval of the raw key data the default PRNG impl produces (threefry:
+    uint32[2]); answered without deriving any stream key."""
+    global _KD_AVAL
+    if _KD_AVAL is None:
+        kd = jax.random.key_data(jax.random.key(0))
+        _KD_AVAL = (tuple(kd.shape), kd.dtype, False)
+    return _KD_AVAL
+
+
+def derive_key_data(base_data, epoch):
+    """Key data for stream position `epoch` from raw base-key data — pure
+    and traceable: the fused step derives every hoisted key IN-GRAPH from
+    (base data, first position) device arguments with exactly these ops,
+    so fused and eager key streams agree bit-for-bit."""
+    key = jax.random.fold_in(jax.random.wrap_key_data(base_data), epoch)
+    return jax.random.key_data(key)
+
+
+def stream_base_data():
+    """Raw uint32 data of the current base key (a device value suitable as
+    a hoisted executable argument). The base is fixed between seedings, so
+    the returned array stays valid for the life of a promoted program."""
+    g = default_generator
+    with g._lock:
+        base = g._base()
+    return jax.random.key_data(base)
+
+
+def _make_hoisted_cls():
+    Tensor = _tensor_cls()
+
+    class HoistedKeyTensor(Tensor):
+        """One reserved stream position as a dispatch input.
+
+        The uint32 key data derives LAZILY on first `_value` read (eager
+        dispatch, chain-tier replay, transactional splits); a fused
+        whole-step replay never reads it — the executable derives the key
+        in-graph from hoisted (base data, position) args — so promoted
+        dropout loops launch nothing per key. `_fusion_aval` answers
+        keying queries without forcing, the same contract as chain
+        placeholders (ops/fusion.py)."""
+
+        __slots__ = ("_rng_base", "_rng_epoch")
+
+        def __init__(self, base, epoch):
+            _VALUE_SLOT.__set__(self, _UNMATERIALIZED)
+            _NODE_SLOT.__set__(self, None)
+            _IDX_SLOT.__set__(self, 0)
+            self.stop_gradient = True
+            self.grad = None
+            self.name = f"rng_key@{epoch}"
+            self.persistable = False
+            self._hooks = []
+            self._rng_base = base
+            self._rng_epoch = epoch
+
+        @property
+        def _value(self):
+            v = _VALUE_SLOT.__get__(self)
+            if v is _UNMATERIALIZED:
+                v = derive_key_data(jax.random.key_data(self._rng_base),
+                                    self._rng_epoch)
+                _VALUE_SLOT.__set__(self, v)
+            return v
+
+        @_value.setter
+        def _value(self, v):
+            _VALUE_SLOT.__set__(self, v)
+
+        @property
+        def _fusion_aval(self):
+            """Aval while lazy, else None — read by dispatch keying and
+            the cycle recorder; never derives the key."""
+            if _VALUE_SLOT.__get__(self) is _UNMATERIALIZED:
+                return _key_data_aval()
+            return None
+
+        @property
+        def shape(self):
+            if _VALUE_SLOT.__get__(self) is _UNMATERIALIZED:
+                return list(_key_data_aval()[0])
+            return list(_VALUE_SLOT.__get__(self).shape)
+
+        @property
+        def ndim(self):
+            return len(self.shape)
+
+    return HoistedKeyTensor
+
+
+HoistedKeyTensor = None     # resolved on first rng_key_input()
+
+
+def rng_key_input():
+    """A key-data tensor for ONE fresh stream position, to be passed as a
+    dispatch INPUT of an RNG-consuming op (the op wraps it back into a
+    typed key with `jax.random.wrap_key_data` inside its fn). Keyed on
+    structure — the op's cache key carries only the stable key-data aval —
+    so RNG consumption no longer bypasses the executable cache or poisons
+    fusion cycles (`rng_rekey`). Under an active tracing scope the key
+    comes from the traced context instead (a tracer value: the op is
+    absorbed into the enclosing trace, exactly as before)."""
+    global HoistedKeyTensor
+    stack = getattr(_tracing_ctx, "stack", None)
+    if stack:
+        Tensor = _tensor_cls()
+        return Tensor(jax.random.key_data(stack[-1].next_key()),
+                      stop_gradient=True)
+    if HoistedKeyTensor is None:
+        HoistedKeyTensor = _make_hoisted_cls()
+    base, ep = default_generator.reserve()
+    return HoistedKeyTensor(base, ep)
+
+
 def get_rng_state():
-    """Snapshot of the global generator state (list-of-states for parity
-    with the reference's per-device GeneratorState list)."""
-    with default_generator._lock:
-        return [default_generator._key]
+    """Snapshot of the global generator state: (base key, stream position)
+    — list-of-states for parity with the reference's per-device
+    GeneratorState list."""
+    g = default_generator
+    with g._lock:
+        return [(g._key, g.epoch)]
+
+
+def _is_state_pair(s):
+    """(key, position) pair vs a legacy list of bare per-device keys."""
+    import numbers
+    return isinstance(s, (list, tuple)) and len(s) == 2 \
+        and isinstance(s[1], numbers.Integral)
 
 
 def set_rng_state(state):
-    if isinstance(state, (list, tuple)):
+    # accept every historical shape: [(key, pos)] (current get_rng_state),
+    # (key, pos), [key] / [key, key, ...] (legacy list of per-device
+    # GeneratorStates), a bare key, or None/[]
+    if isinstance(state, (list, tuple)) and not _is_state_pair(state):
         state = state[0] if state else None
-    with default_generator._lock:
-        default_generator._key = state
+    g = default_generator
+    with g._lock:
+        if _is_state_pair(state):
+            g._key = state[0]
+            g.epoch = int(state[1])
+        else:
+            # a bare key (legacy callers): restart its stream
+            g._key = state
+            g.epoch = 0
 
 
 # CUDA-named aliases kept for API parity (there is one logical generator
@@ -138,10 +340,11 @@ set_cuda_rng_state = set_rng_state
 
 def rng_checkpoint_state():
     """Pickle-safe snapshot of the global generator for crash-safe
-    checkpoints (incubate/checkpoint.py): the raw key bits as numpy (typed
-    jax keys don't pickle portably), the epoch counter (so `rng_rekey`
-    attribution and any epoch-derived seeding resume exactly), and the
-    seed bookkeeping."""
+    checkpoints (incubate/checkpoint.py): the BASE key bits as numpy
+    (typed jax keys don't pickle portably), the stream position (so a
+    restored run resumes the interrupted key stream bit-for-bit — fused
+    and eager alike, since both derive position i as fold_in(base, i)),
+    and the seed bookkeeping."""
     import numpy as np
     g = default_generator
     with g._lock:
@@ -149,6 +352,7 @@ def rng_checkpoint_state():
         key_data = None if key is None \
             else np.asarray(jax.random.key_data(key))
         return {"key_data": key_data, "epoch": g.epoch,
+                "legacy_epoch": g.legacy_epoch,
                 "initial_seed": g.initial_seed, "seeded": g.seeded}
 
 
@@ -160,5 +364,7 @@ def set_rng_checkpoint_state(state):
     with g._lock:
         g._key = None if kd is None else jax.random.wrap_key_data(kd)
         g.epoch = int(state.get("epoch", 0))
+        g.legacy_epoch = int(state.get("legacy_epoch",
+                                       state.get("epoch", 0)))
         g.initial_seed = int(state.get("initial_seed", 0))
         g.seeded = bool(state.get("seeded", False))

@@ -115,23 +115,38 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                            scale=scale)
         return call_op("flash_attention", fn, (q, k, v))
 
-    drop_key = None
-    if dropout_p and training:
-        from ...framework.random import get_rng_key
-        drop_key = get_rng_key()
+    # the mask AND the dropout key are dispatch INPUTS (not closure
+    # captures): closing over a per-batch array — or a per-call PRNG key —
+    # would make every masked/regularized attention un-keyable, bypassing
+    # the per-op cache and poisoning chain/step fusion cycles. The key is a
+    # hoisted stream position (framework/random.rng_key_input), so dropout
+    # attention promotes to the fused whole-step executable.
+    eff_p = dropout_p if training else 0.0
+    kd = None
+    if eff_p:
+        from ...framework.random import rng_key_input
+        kd = rng_key_input()
 
-    # the mask is a dispatch INPUT (not a closure capture): closing over
-    # the per-batch array would make every masked attention un-keyable,
-    # bypassing the per-op cache and poisoning chain/step fusion cycles
     if mask_t is not None:
+        if kd is not None:
+            def fn(qq, kk, vv, mm, key_data):
+                return _plain_attention(
+                    qq, kk, vv, mm, is_causal, scale, eff_p,
+                    jax.random.wrap_key_data(key_data))
+            return call_op("scaled_dot_product_attention", fn,
+                           (q, k, v, mask_t, kd))
         def fn(qq, kk, vv, mm):
-            return _plain_attention(qq, kk, vv, mm, is_causal, scale,
-                                    dropout_p if training else 0.0, drop_key)
+            return _plain_attention(qq, kk, vv, mm, is_causal, scale)
         return call_op("scaled_dot_product_attention", fn, (q, k, v, mask_t))
 
+    if kd is not None:
+        def fn(qq, kk, vv, key_data):
+            return _plain_attention(qq, kk, vv, None, is_causal, scale,
+                                    eff_p, jax.random.wrap_key_data(key_data))
+        return call_op("scaled_dot_product_attention", fn, (q, k, v, kd))
+
     def fn(qq, kk, vv):
-        return _plain_attention(qq, kk, vv, None, is_causal, scale,
-                                dropout_p if training else 0.0, drop_key)
+        return _plain_attention(qq, kk, vv, None, is_causal, scale)
     return call_op("scaled_dot_product_attention", fn, (q, k, v))
 
 

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor
-from ...framework.random import get_rng_key
+from ...framework.random import rng_key_input
 from ...framework.dtype import to_jax_dtype
 from ...ops._helpers import ensure_tensor, unary, binary, nary, call_op
 from ...ops.registry import register_op
@@ -43,21 +43,27 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return x.clone() if isinstance(x, Tensor) else x
     if p == 1.0:
         return unary("dropout", lambda v: jnp.zeros_like(v), x)
-    key = get_rng_key()
     shape = list(x.shape)     # aval-answerable: never forces a fused chain
     if axis is not None:
         axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        mask_shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
     else:
-        mask_shape = shape
-    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        mask_shape = tuple(shape)
+    # the key is a dispatch INPUT (one reserved stream position), not a
+    # closure capture: the op keys on structure — dropout no longer
+    # bypasses the executable cache or poisons fusion cycles (rng_rekey),
+    # and the whole-step promoter derives the key in-graph from hoisted
+    # (base, position) scalars so dropout loops fuse to ONE executable
+    kd = rng_key_input()
 
-    def fn(v):
+    def fn(v, key_data):
+        keep = jax.random.bernoulli(jax.random.wrap_key_data(key_data),
+                                    1.0 - p, mask_shape)
         m = keep.astype(v.dtype)
         if mode == "upscale_in_train":
             return v * m / jnp.asarray(1.0 - p, v.dtype)
         return v * m
-    return unary("dropout", fn, x)
+    return call_op("dropout", fn, (x, kd))
 
 
 def _dropout_nd(x, p, training, data_format, spatial_dims, name=None):
@@ -66,14 +72,16 @@ def _dropout_nd(x, p, training, data_format, spatial_dims, name=None):
         return x.clone()
     shape = list(x.shape)     # aval-answerable: never forces a fused chain
     if data_format.endswith("C"):  # NHWC / NDHWC: channel last
-        mask_shape = [shape[0]] + [1] * spatial_dims + [shape[-1]]
+        mask_shape = tuple([shape[0]] + [1] * spatial_dims + [shape[-1]])
     else:
-        mask_shape = [shape[0], shape[1]] + [1] * spatial_dims
-    keep = jax.random.bernoulli(get_rng_key(), 1.0 - p, mask_shape)
+        mask_shape = tuple([shape[0], shape[1]] + [1] * spatial_dims)
+    kd = rng_key_input()
 
-    def fn(v):
+    def fn(v, key_data):
+        keep = jax.random.bernoulli(jax.random.wrap_key_data(key_data),
+                                    1.0 - p, mask_shape)
         return v * keep.astype(v.dtype) / jnp.asarray(1.0 - p, v.dtype)
-    return unary("dropout_nd", fn, x)
+    return call_op("dropout_nd", fn, (x, kd))
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -91,14 +99,16 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    keep = jax.random.bernoulli(get_rng_key(), 1.0 - p, x._value.shape)
+    mask_shape = tuple(x.shape)   # aval-answerable
     a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
     b = -a * alpha_p * p
+    kd = rng_key_input()
 
-    def fn(v):
-        m = keep
+    def fn(v, key_data):
+        m = jax.random.bernoulli(jax.random.wrap_key_data(key_data),
+                                 1.0 - p, mask_shape)
         return a * jnp.where(m, v, jnp.asarray(alpha_p, v.dtype)) + b
-    return unary("alpha_dropout", fn, x)
+    return call_op("alpha_dropout", fn, (x, kd))
 
 
 @register_op("embedding", "nn", ref="phi/kernels/embedding_kernel.h")

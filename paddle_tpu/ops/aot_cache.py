@@ -983,30 +983,48 @@ def load_chain(chain, grad):
 # whole-step tier (ops/step_fusion.py hooks)
 # ---------------------------------------------------------------------------
 
+def _canon_cycle_entries(sig):
+    entries = []
+    for e in sig:
+        if e[0] == "op":
+            # trailing components past the canonical five are stable
+            # value tuples (hoisted-RNG stream marks): digest as-is
+            entries.append(("op", op_key_canonical(e[1]), e[2], e[3],
+                            e[4]) + tuple(e[5:]))
+        elif e[0] == "bwd":
+            entries.append(("bwd", e[1]))
+        elif e[0] == "cg":
+            entries.append(("cg",))
+        elif e[0] == "scaler":
+            entries.append(("scaler", _canon(e[2], 1)))
+        elif e[0] == "step":
+            entries.append(("step", len(e[2])))
+        else:
+            raise Undigestable(f"cycle entry {e[0]!r}")
+    return tuple(entries)
+
+
 def step_digest(sig, opt, updated):
     """Digest of a promoted-step identity: the cycle signature (op keys +
     wiring + backward/clear_grad/scaler/step events, process-local ids
     erased) plus every constant `_build` bakes into the traced program —
     optimizer type and hyper-param key, accumulator structure, clip/
-    regularizer snapshots, parameter binding, donation flag. Returns None
-    when any component has no stable form (the step opts out)."""
+    regularizer snapshots, parameter binding, donation flag. A canonical
+    super-cycle signature (ops/step_fusion._super_sig) digests its ONE
+    segment plus the event frame — k-independent, like the programs it
+    addresses. Returns None when any component has no stable form (the
+    step opts out)."""
     from .step_fusion import _snapshot_obj
     try:
-        entries = []
-        for e in sig:
-            if e[0] == "op":
-                entries.append(("op", op_key_canonical(e[1]), e[2], e[3],
-                                e[4]))
-            elif e[0] == "bwd":
-                entries.append(("bwd", e[1]))
-            elif e[0] == "cg":
-                entries.append(("cg",))
-            elif e[0] == "scaler":
-                entries.append(("scaler", _canon(e[2], 1)))
-            elif e[0] == "step":
-                entries.append(("step", len(e[2])))
-            else:
-                raise Undigestable(f"cycle entry {e[0]!r}")
+        if sig and sig[0] == "super":
+            _tag, cg_e, seg_entries, scaler_e, step_e = sig
+            entries = ("super", _canon_cycle_entries(tuple(seg_entries)),
+                       cg_e is not None,
+                       None if scaler_e is None
+                       else ("scaler", _canon(scaler_e[2], 1)),
+                       ("step", len(step_e[2])))
+        else:
+            entries = _canon_cycle_entries(sig)
         accs = tuple(sorted(getattr(opt, "_accumulators", {}).keys()))
         canonical = (
             "step", tuple(entries),
@@ -1061,3 +1079,59 @@ def load_step(program, fallback, donate_argnums):
     re-applied at the wrapper), or None."""
     return load_callable("step", program.aot_digest, program.label,
                          fallback, donate_argnums)
+
+
+def store_super_step(program, sub_args, upd_args):
+    """Persist a super-cycle program's executable PAIR — the micro-batch
+    sub-executable and the boundary update executable — as one two-blob
+    artifact, right after the first successful boundary fire. A restarting
+    worker then replays its accumulation loop with zero fresh compiles at
+    any k."""
+    digest = program.aot_digest
+    if digest is None or has_artifact("step", digest):
+        return
+    sub, upd = program._sub_exe, program._upd_exe
+    if sub is None or upd is None \
+            or isinstance(sub, _Healing) or isinstance(upd, _Healing):
+        return
+    try:
+        blobs = [export_bytes(sub, tuple(_specs_of(a) for a in sub_args)),
+                 export_bytes(upd, tuple(_specs_of(a) for a in upd_args))]
+    except Exception as e:
+        _STATS.store_failures += 1
+        _EVENTS.emit("aot.store", program.label,
+                     detail={"kind": "step", "super": True,
+                             "failed": repr(e)[:200]})
+        return
+    store_artifact("step", digest, program.label, blobs,
+                   meta={"super": True, "ops": len(program.chain.ops),
+                         "params": len(program.param_names),
+                         "check": program.check,
+                         "scaler": program.scaler_consts is not None})
+
+
+def load_super_step(program, sub_fallback, upd_fallback, upd_donate):
+    """Restore the (sub, update) executable pair of a super-cycle
+    program as healing callables, or (None, None)."""
+    art = load_artifact("step", program.aot_digest, program.label)
+    if art is None or len(art.get("blobs", ())) != 2 \
+            or not (art.get("meta") or {}).get("super"):
+        return None, None
+    path = _artifact_path("step", program.aot_digest)
+    try:
+        sub = _deserialize_callable(art["blobs"][0])
+        upd = _deserialize_callable(art["blobs"][1], upd_donate)
+    except Exception as e:
+        _STATS.corrupt += 1
+        _EVENTS.emit("aot.corrupt", program.label,
+                     reason="artifact_corrupt",
+                     detail={"kind": "step", "stage": "deserialize",
+                             "error": repr(e)[:200]})
+        _quarantine(path)
+        return None, None
+    _STATS.hits += 1
+    _EVENTS.emit("aot.hit", program.label,
+                 detail={"kind": "step", "digest": program.aot_digest[:12],
+                         "super": True})
+    return (_Healing(sub, sub_fallback, path, program.label),
+            _Healing(upd, upd_fallback, path, program.label))

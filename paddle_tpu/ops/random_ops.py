@@ -11,9 +11,9 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..framework.dtype import to_jax_dtype, get_default_dtype
-from ..framework.random import get_rng_key
+from ..framework.random import get_rng_key, rng_key_input
 from .registry import register_op
-from ._helpers import ensure_tensor, scalar_or_value
+from ._helpers import ensure_tensor, scalar_or_value, call_op
 
 __all__ = ["rand", "randn", "randint", "randint_like", "uniform", "normal",
            "standard_normal", "randperm", "bernoulli", "multinomial",
@@ -99,8 +99,15 @@ def randperm(n, dtype="int64", name=None):
 @register_op("bernoulli", "random", differentiable=False)
 def bernoulli(x, name=None):
     x = ensure_tensor(x)
-    return Tensor(jax.random.bernoulli(get_rng_key(), x._value)
-                  .astype(x._value.dtype))
+    # the key rides as a dispatch input (a hoisted stream position), so
+    # sampling inside a training cycle stays keyable and promotable —
+    # see framework/random.rng_key_input
+    kd = rng_key_input()
+
+    def fn(v, key_data):
+        return jax.random.bernoulli(
+            jax.random.wrap_key_data(key_data), v).astype(v.dtype)
+    return call_op("bernoulli", fn, (x, kd))
 
 
 @register_op("multinomial", "random", differentiable=False)

@@ -57,6 +57,7 @@ from ..framework.flags import _FLAGS
 
 __all__ = ["MeshPlan", "plan_program", "enabled", "sync_root_and_grads",
            "global_finite", "sharded_single_update", "compile_step",
+           "compile_accum", "compile_update", "zero_accum",
            "fire_mismatch", "probation_tolerance"]
 
 
@@ -351,6 +352,115 @@ def compile_step(plan, step_fn, n_params, n_scaler, n_extras,
         flat = tuple(a for row in accs for a in row if a is not None)
         return smapped(tuple(pvals), tuple(ext), flat, lr, step_count,
                        *sargs)
+
+    return jax.jit(wrapper, donate_argnums=donate_argnums)
+
+
+# ---------------------------------------------------------------------------
+# super-cycle (grad accumulation) lowering: the sub-executable accumulates
+# LOCAL gradients — no collective per micro-batch — and the update
+# executable fires ONE fused pmean over the accumulated sums before the
+# optimizer update: k× less gradient traffic than per-micro-batch sync,
+# numerically pmean(Σ local) == Σ pmean(local) (linearity; probation
+# verifies within single-program tolerance).
+#
+# A device-varying accumulator must cross launch boundaries as a real
+# global array: it carries ONE stacked leading dim of size
+# Π|data axes|, sharded over those axes — each device owns its [1, ...]
+# slab of local gradient sums.
+# ---------------------------------------------------------------------------
+
+def _stack_spec(plan):
+    """PartitionSpec of the stacked-accumulator leading dim."""
+    axes = plan.data_axes
+    return P(axes[0] if len(axes) == 1 else tuple(axes))
+
+
+def stack_devices(plan):
+    import math
+    return math.prod(int(plan.mesh.shape[a]) for a in plan.data_axes)
+
+
+def zero_accum(plan, shapes):
+    """Zero grad accumulators for one super-cycle program: per param a
+    [n_dev, *shape] array sharded over the data axes on dim 0."""
+    from jax.sharding import NamedSharding
+    n = stack_devices(plan)
+    sharding = NamedSharding(plan.mesh, _stack_spec(plan))
+    return [jax.device_put(jnp.zeros((n,) + tuple(s), d), sharding)
+            for s, d in shapes]
+
+
+def compile_accum(plan, sub_fn, n_params, n_tail):
+    """shard_map lowering of the micro-batch sub-executable: per-device
+    fwd+vjp on the local batch shard, local gradient sums into the stacked
+    accumulator, NO gradient collective (only the scalar loss pmean the
+    sub body emits). `n_tail` counts replicated scalar tail args (hoisted
+    RNG + the running fwd-finite predicate)."""
+    from ..framework.jax_compat import shard_map
+    P0 = P()
+    sspec = _stack_spec(plan)
+    in_specs = (
+        tuple(plan.param_specs),
+        tuple(plan.ext_specs),
+        (sspec,) * n_params,
+    ) + (P0,) * n_tail
+    def local(pv_t, ext_t, acc_t, *tail):
+        acc_in = [a[0] for a in acc_t]
+        out = sub_fn(list(pv_t), list(ext_t), acc_in, *tail)
+        new_acc = tuple(a[None] for a in out[1])
+        return (out[0], new_acc) + tuple(out[2:])
+
+    # the sub body returns (loss, new_acc[, fwd_ok]) — fwd_ok present iff
+    # the program checks, signalled by the builder via an fn attribute
+    n_extra = 1 if getattr(sub_fn, "_returns_fwd_ok", False) else 0
+    specs = (P0, (sspec,) * n_params) + (P0,) * n_extra
+    m = shard_map(local, mesh=plan.mesh, in_specs=in_specs,
+                  out_specs=specs)
+
+    def wrapper(pvals, ext, acc, *tail):
+        return m(tuple(pvals), tuple(ext), tuple(acc), *tail)
+    return jax.jit(wrapper)
+
+
+def compile_update(plan, upd_fn, n_params, n_tail, n_extras,
+                   donate_argnums):
+    """shard_map lowering of the boundary update executable: ONE fused
+    pmean region over the accumulated gradient sums (inside `upd_fn`),
+    then the same clip/update/guardian/scaler weave as the whole-step
+    lowering — sharded (ZeRO) slots update their local 1/Nth."""
+    from ..framework.jax_compat import shard_map
+    P0 = P()
+    sspec = _stack_spec(plan)
+    acc_layout = plan.acc_layout
+    in_specs = (
+        tuple(plan.param_specs),
+        tuple(plan.accf_specs),
+        (sspec,) * n_params,
+        P0, P0,
+    ) + (P0,) * n_tail
+    out_specs = (
+        (P0,) * n_params,            # grads (post-pmean, replicated)
+        tuple(plan.param_specs),
+        tuple(plan.acc_out_specs),
+    ) + (P0,) * n_extras
+
+    def local(pv_t, accf_t, gsum_t, lr, step_count, *tail):
+        it = iter(accf_t)
+        accs = [[next(it) if pres else None for pres in row]
+                for row in acc_layout]
+        gsum = [g[0] for g in gsum_t]
+        out = upd_fn(list(pv_t), accs, gsum, lr, step_count, *tail)
+        return (tuple(out[0]), tuple(out[1]),
+                tuple(tuple(r) for r in out[2])) + tuple(out[3:])
+
+    smapped = shard_map(local, mesh=plan.mesh, in_specs=in_specs,
+                        out_specs=out_specs)
+
+    def wrapper(pvals, accs, gsum, lr, step_count, *tail):
+        flat = tuple(a for row in accs for a in row if a is not None)
+        return smapped(tuple(pvals), flat, tuple(gsum), lr, step_count,
+                       *tail)
 
     return jax.jit(wrapper, donate_argnums=donate_argnums)
 

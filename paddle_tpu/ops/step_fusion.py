@@ -137,7 +137,7 @@ class _Cycle:
     """Observation state for one training iteration."""
 
     __slots__ = ("entries", "ops", "produced", "dirty", "t0", "n_backward",
-                 "scaler")
+                 "scaler", "rng_epoch0")
 
     def __init__(self):
         self.entries = []
@@ -147,6 +147,11 @@ class _Cycle:
         self.t0 = time.perf_counter_ns()
         self.n_backward = 0
         self.scaler = None     # GradScaler seen by on_scaler_step, if any
+        # absolute stream position of the cycle's FIRST hoisted RNG input
+        # (framework/random.rng_key_input): per-input positions enter the
+        # signature as DELTAS from it, so a loop whose randomness advances
+        # every step still records the identical structural signature
+        self.rng_epoch0 = None
 
     def poison(self):
         """The cycle cannot promote: drop every recorded detail NOW so a
@@ -158,6 +163,7 @@ class _Cycle:
         self.ops.clear()
         self.produced.clear()
         self.scaler = None
+        self.rng_epoch0 = None
 
 
 class _ParamShim:
@@ -179,7 +185,8 @@ class _StepProgram:
                  "acc_names", "label", "n_launches", "baseline_ns",
                  "fail_streak", "dead", "_exe", "_shims", "donate_params",
                  "check", "scaler_ref", "scaler_consts", "aot_digest",
-                 "aot_stored", "spmd_plan", "spmd_ok")
+                 "aot_stored", "spmd_plan", "spmd_ok", "rng_slots",
+                 "super", "seg_start", "_sub_exe", "_upd_exe", "_zero_acc")
 
     def __init__(self):
         self.fail_streak = 0
@@ -203,6 +210,21 @@ class _StepProgram:
         # program to the plain jit lowering)
         self.spmd_plan = None
         self.spmd_ok = True
+        # hoisted RNG consumption: ((ext slot, stream delta), ...) — these
+        # ext slots are DERIVED in-graph from the hoisted (base key data,
+        # first position) device args instead of being fed values
+        self.rng_slots = ()
+        # super-cycle (grad accumulation): the program's chain is ONE
+        # micro-batch segment; replay loops it k times, firing the SUB
+        # executable (fwd+vjp, grads added into a device accumulator) at
+        # each backward and the UPDATE executable (clip/reg + optimizer +
+        # guardian/scaler on the ACCUMULATED grads) at the step boundary —
+        # ≤2 executables and zero retraces at ANY k
+        self.super = False
+        self.seg_start = 0      # entry index of the segment's first entry
+        self._sub_exe = None
+        self._upd_exe = None
+        self._zero_acc = None   # (zero grad accumulators, True scalar)
 
     def release_heavy(self):
         """A deactivated program stays in the library as a tombstone (so
@@ -212,6 +234,9 @@ class _StepProgram:
         through them."""
         self._exe = None
         self._shims = None
+        self._sub_exe = None
+        self._upd_exe = None
+        self._zero_acc = None
 
     # -- the fused executable ----------------------------------------------
     def _grad_transform(self, pvals, grads):
@@ -272,22 +297,26 @@ class _StepProgram:
         acc_names = self.acc_names
         check = self.check
         scaler_consts = self.scaler_consts
-        if self._shims is None:
-            shims = []
-            for nm, nc, pr in zip(self.param_names, self.need_clip,
-                                  self.param_regs):
-                s = _ParamShim()
-                s.name = nm
-                s.need_clip = nc
-                s.regularizer = pr
-                shims.append(s)
-            self._shims = shims
+        rng_items = tuple(sorted(self.rng_slots.items())) \
+            if self.rng_slots else ()
+        self._ensure_shims()
 
-        def step_body(pvals, ext, accs, lr, step_count, scaler_state):
+        def step_body(pvals, ext, accs, lr, step_count, rng_state,
+                      scaler_state):
             STEP_STATS.retraces += 1   # side effect: runs only while tracing
             full = [None] * n_ext
             for pos, slot in enumerate(ext_order):
                 full[slot] = ext[pos]
+            if rng_state is not None:
+                # hoisted RNG: every key derives IN-GRAPH from (base key
+                # data, first stream position) — the same fold_in the
+                # eager path applies, so the fused key stream is
+                # bit-identical to eager's
+                from ..framework import random as _random
+                base_kd, ep0 = rng_state
+                for slot, delta in rng_items:
+                    full[slot] = _random.derive_key_data(base_kd,
+                                                         ep0 + delta)
 
             def fwd(pv):
                 env = list(full)
@@ -367,13 +396,18 @@ class _StepProgram:
                 extras = (upd_finite, fwd_finite) + extras
             return (root_val, grads, new_p, new_accs) + extras
 
-        if scaler_consts is not None:
-            def step_fn(pvals, ext, accs, lr, step_count, scale, good, bad):
-                return step_body(pvals, ext, accs, lr, step_count,
-                                 (scale, good, bad))
-        else:
-            def step_fn(pvals, ext, accs, lr, step_count):
-                return step_body(pvals, ext, accs, lr, step_count, None)
+        n_rng = 2 if rng_items else 0
+
+        def step_fn(pvals, ext, accs, lr, step_count, *tail):
+            # tail layout: [base_key_data, epoch0] when the program has
+            # hoisted RNG slots, then [scale, good, bad] for a folded
+            # GradScaler — both ride as device args so neither randomness
+            # nor loss-scale dynamics ever retrace the program
+            rng_state = tail[:2] if n_rng else None
+            sc = tail[n_rng:]
+            scaler_state = tuple(sc) if sc else None
+            return step_body(pvals, ext, accs, lr, step_count, rng_state,
+                             scaler_state)
 
         donate = donation_argnums(self.donate_params, 0, 2)
         if plan is not None:
@@ -383,11 +417,218 @@ class _StepProgram:
             n_extras = (2 if check else 0) \
                 + (4 if scaler_consts is not None else 0)
             self._exe = _spmd.compile_step(
-                plan, step_fn, len(self.param_refs), n_scaler, n_extras,
-                donate)
+                plan, step_fn, len(self.param_refs), n_rng + n_scaler,
+                n_extras, donate)
             return self._exe
         self._exe = jax.jit(step_fn, donate_argnums=donate)
         return self._exe
+
+    # -- the super-cycle pair (grad accumulation) --------------------------
+    def _ensure_shims(self):
+        if self._shims is None:
+            shims = []
+            for nm, nc, pr in zip(self.param_names, self.need_clip,
+                                  self.param_regs):
+                s = _ParamShim()
+                s.name = nm
+                s.need_clip = nc
+                s.regularizer = pr
+                shims.append(s)
+            self._shims = shims
+
+    def sub_exe(self):
+        """The reusable micro-batch sub-executable: fwd + vjp over the
+        param slots, gradients ADDED into the running accumulator. Fired
+        once per `loss.backward()` of the accumulation loop — the same
+        compiled program at any k."""
+        if self._sub_exe is None:
+            self._maybe_load_super()
+        if self._sub_exe is None:
+            self._sub_exe = self._compile_sub()
+        return self._sub_exe
+
+    def upd_exe(self):
+        """The boundary update executable: clip/regularizer + optimizer
+        update + guardian skip predicate + GradScaler transition, all
+        evaluated on the ACCUMULATED grads. Fired once per `opt.step()`."""
+        if self._upd_exe is None:
+            self._maybe_load_super()
+        if self._upd_exe is None:
+            self._upd_exe = self._compile_update()
+        return self._upd_exe
+
+    def _maybe_load_super(self):
+        """AOT warm start for the super-cycle pair: deserialize both
+        stored executables (zero fresh traces); corrupt or mismatched
+        artifacts heal through the live compilers transparently."""
+        from ..jit.train_step import donation_argnums
+        from . import aot_cache as _aot
+        if not (_aot.enabled() and self.aot_digest is not None):
+            return
+        sub, upd = _aot.load_super_step(
+            self, self._compile_sub, self._compile_update,
+            donation_argnums(self.donate_params, 0, 1))
+        if sub is not None:
+            self._sub_exe = sub
+            self._upd_exe = upd
+
+    def zero_state(self):
+        """(zero grad accumulators, all-finite True scalar): the round-0
+        inputs of the sub executable. Never donated or mutated — one
+        allocation per program, reused every cycle."""
+        if self._zero_acc is None:
+            from . import spmd_fusion as _spmd
+            shapes = []
+            for r in self.param_refs:
+                v = r()._value     # grads share the param aval
+                shapes.append((tuple(v.shape), v.dtype))
+            if self.spmd_plan is not None:
+                accs = _spmd.zero_accum(self.spmd_plan, shapes)
+            else:
+                accs = [jnp.zeros(s, d) for s, d in shapes]
+            self._zero_acc = (accs, jnp.asarray(True))
+        return self._zero_acc
+
+    def _compile_sub(self):
+        from . import guardian
+        from . import spmd_fusion as _spmd
+        plan = self.spmd_plan
+        chain = self.chain
+        pure = chain.pure_fn
+        root = self.root_flat
+        seed_shape, seed_dtype = chain.flat_avals[root][:2]
+        param_slots = tuple(sorted(self.param_slots.items()))
+        ext_order = self.ext_order
+        n_ext = chain.n_ext
+        rng_items = tuple(sorted(self.rng_slots.items())) \
+            if self.rng_slots else ()
+        n_rng = 2 if rng_items else 0
+        check = self.check
+
+        def sub_fn(pvals, ext, acc, *tail):
+            STEP_STATS.retraces += 1   # side effect: runs only while tracing
+            # tail layout: [base_key_data, epoch0] when the segment
+            # consumes hoisted RNG, then [fwd_ok] under the guardian —
+            # the running all-rounds-finite predicate threads through
+            full = [None] * n_ext
+            for pos, slot in enumerate(ext_order):
+                full[slot] = ext[pos]
+            if n_rng:
+                from ..framework import random as _random
+                base_kd, ep0 = tail[0], tail[1]
+                for slot, delta in rng_items:
+                    full[slot] = _random.derive_key_data(base_kd,
+                                                         ep0 + delta)
+
+            def fwd(pv):
+                env = list(full)
+                for slot, k in param_slots:
+                    env[slot] = pv[k]
+                return pure(*env)[root]
+
+            pvals_full = pvals if plan is None \
+                else _spmd.gather_params(plan, pvals)
+            root_val, vjp = jax.vjp(fwd, list(pvals_full))
+            (grads,) = vjp(jnp.ones(seed_shape, seed_dtype))
+            if plan is not None and plan.data_axes:
+                # the per-round LOSS syncs (one scalar pmean — it may be
+                # served to the caller); the GRADIENTS do not: local sums
+                # accumulate, and ONE fused pmean fires in the update
+                # executable — k× less collective traffic than syncing
+                # every micro-batch
+                root_val = jax.lax.pmean(root_val, plan.data_axes)
+            new_acc = [a + g for a, g in zip(acc, grads)]
+            if check:
+                fwd_ok = jnp.logical_and(tail[n_rng],
+                                         guardian.finite_all([root_val]))
+                return (root_val, new_acc, fwd_ok)
+            return (root_val, new_acc)
+
+        if plan is not None:
+            sub_fn._returns_fwd_ok = check
+            return _spmd.compile_accum(plan, sub_fn, len(self.param_refs),
+                                       n_rng + (1 if check else 0))
+        return jax.jit(sub_fn)
+
+    def _compile_update(self):
+        from ..jit.train_step import donation_argnums
+        from . import guardian
+        from . import spmd_fusion as _spmd
+        plan = self.spmd_plan
+        opt_ref = self.opt_ref
+        acc_names = self.acc_names
+        check = self.check
+        scaler_consts = self.scaler_consts
+        self._ensure_shims()
+
+        def upd_fn(pvals, accs, gsum, lr, step_count, *tail):
+            STEP_STATS.retraces += 1
+            # tail layout: [fwd_ok] under the guardian, then
+            # [scale, good, bad] for a folded GradScaler. The body mirrors
+            # the post-gradient half of _compile's step_body, evaluated on
+            # the ACCUMULATED grads — guardian skip and scaler backoff see
+            # exactly what the eager path sees in p.grad after k backwards.
+            grads = list(gsum)
+            if plan is not None and plan.data_axes:
+                # the ONE fused gradient collective of the super-cycle
+                grads = [jax.lax.pmean(g, plan.data_axes) for g in grads]
+            finite_of = guardian.finite_all if plan is None \
+                else (lambda vals: _spmd.global_finite(plan, vals))
+            i_tail = 0
+            fwd_ok = None
+            if check:
+                fwd_ok = tail[0]
+                i_tail = 1
+            extras = ()
+            sc = tail[i_tail:]
+            if sc:
+                scale, good, bad = sc
+                inv = jnp.asarray(1.0, jnp.float32) / scale
+                grads = [g * inv.astype(g.dtype) for g in grads]
+                found_inf = jnp.logical_not(finite_of(grads))
+                (_en, _dyn, incr_ratio, decr_ratio,
+                 incr_n, decr_n) = scaler_consts
+                scale2, good2, bad2 = guardian.update_scaler_state(
+                    scale, good, bad, found_inf, incr_ratio, decr_ratio,
+                    incr_n, decr_n)
+                extras = (found_inf, scale2, good2, bad2)
+            pvals_full = pvals if plan is None \
+                else _spmd.gather_params(plan, pvals)
+            upd = self._grad_transform(pvals_full, grads)
+            opt = opt_ref()   # trace-time only; firing keeps it alive
+            new_p, new_accs = [], []
+            for k, (pv, gv, ac) in enumerate(zip(pvals, upd, accs)):
+                acc_dict = dict(zip(acc_names, ac))
+                if plan is not None and plan.param_shard[k] is not None:
+                    np_, na_ = _spmd.sharded_single_update(
+                        plan, k, opt, pv, gv, acc_dict, lr, step_count)
+                else:
+                    np_, na_ = opt._single_update(pv, gv, acc_dict, lr,
+                                                  step_count)
+                new_p.append(np_)
+                new_accs.append([na_.get(n) for n in acc_names])
+            if check:
+                new_state = list(new_p) + [v for row in new_accs
+                                           for v in row if v is not None]
+                upd_finite = finite_of(list(upd) + new_state)
+                new_p = [jnp.where(upd_finite, nv, pv)
+                         for nv, pv in zip(new_p, pvals)]
+                new_accs = [
+                    [None if nv is None else jnp.where(upd_finite, nv, ov)
+                     for nv, ov in zip(row, ac)]
+                    for row, ac in zip(new_accs, accs)]
+                extras = (upd_finite, fwd_ok) + extras
+            return (grads, new_p, new_accs) + extras
+
+        donate = donation_argnums(self.donate_params, 0, 1)
+        if plan is not None:
+            n_tail = (1 if check else 0) \
+                + (3 if scaler_consts is not None else 0)
+            n_extras = (2 if check else 0) \
+                + (4 if scaler_consts is not None else 0)
+            return _spmd.compile_update(plan, upd_fn, len(self.param_refs),
+                                        n_tail, n_extras, donate)
+        return jax.jit(upd_fn, donate_argnums=donate)
 
 
 class _PendingStep:
@@ -395,7 +636,9 @@ class _PendingStep:
 
     __slots__ = ("program", "owner", "entry_pos", "op_pos", "ext_vals",
                  "ext_edges", "placeholders", "params", "grad_phs",
-                 "backward_done", "fired", "done", "lock", "t0")
+                 "backward_done", "fired", "done", "lock", "t0",
+                 "rng_epoch0", "rng_base", "rounds", "round_losses",
+                 "acc_vals", "fwd_ok", "sub_args")
 
     def __init__(self, program, params, owner):
         self.program = program
@@ -412,6 +655,22 @@ class _PendingStep:
         self.done = False
         self.lock = threading.RLock()
         self.t0 = time.perf_counter_ns()
+        # hoisted RNG: absolute stream position of this cycle's first
+        # consumption (the epoch0 device arg of the fused fire) and the
+        # BASE KEY the round's tensors were reserved against — the fire
+        # must derive from that base, not whatever the global generator
+        # holds at boundary time (a mid-cycle reseed swaps it)
+        self.rng_epoch0 = None
+        self.rng_base = None
+        # super-cycle replay (grad accumulation): archived micro-batch
+        # rounds [(ext_vals, ext_edges, placeholders, rng_epoch0), ...],
+        # the per-round losses from sub-executable fires, the running
+        # donated grad accumulator, and the running fwd-finite predicate
+        self.rounds = []
+        self.round_losses = []
+        self.acc_vals = None
+        self.fwd_ok = None
+        self.sub_args = None    # last sub fire's args (AOT export specs)
 
 
 class _TLS(threading.local):
@@ -556,7 +815,23 @@ class _StepFusionManager:
         except Exception:
             self._poison(st, "tracer_input", op=name)
             return
-        cyc.entries.append(("op", key, wiring, diff_mask, num_outputs))
+        # hoisted RNG inputs (framework/random.rng_key_input): note each
+        # one's stream position as a DELTA from the cycle's first — the
+        # sig stays identical across steps while the stream advances, and
+        # _build hoists (base key, first position) into the executable so
+        # replay derives every key in-graph
+        rng_marks = ()
+        for k, t in enumerate(inputs):
+            ep = getattr(t, "_rng_epoch", None)
+            if ep is None:
+                continue
+            if cyc.rng_epoch0 is None:
+                cyc.rng_epoch0 = ep
+            rng_marks += ((k, ep - cyc.rng_epoch0),)
+        entry = ("op", key, wiring, diff_mask, num_outputs)
+        if rng_marks:
+            entry += (rng_marks,)
+        cyc.entries.append(entry)
         cyc.ops.append(_OpRec(
             name, key, fn, wiring, diff_mask, num_outputs, out_avals,
             tuple(t.stop_gradient for t in outs), tuple(inputs),
@@ -596,12 +871,45 @@ class _StepFusionManager:
                     st.pending = None
                     return False
                 entry = program.entries[pending.entry_pos]
-                if entry[0] == "bwd" and grad_tensor is None \
-                        and not retain_graph \
-                        and not _autograd._saved_tensor_hooks \
-                        and self._is_root(pending, tensor) \
-                        and all(p.grad is None and not p._hooks
-                                for p in pending.params):
+                clean = entry[0] == "bwd" and grad_tensor is None \
+                    and not retain_graph \
+                    and not _autograd._saved_tensor_hooks \
+                    and self._is_root(pending, tensor)
+                if program.super:
+                    # super-cycle: this backward closes ONE micro-batch
+                    # round — fire the reusable sub-executable (grads
+                    # accumulate on device) and keep matching: the next
+                    # event is either another round or the boundary
+                    if clean and pending.op_pos == len(program.chain.ops):
+                        if pending.rounds:
+                            clean = all(
+                                p.grad is ph and not p._hooks
+                                for p, ph in zip(pending.params,
+                                                 pending.grad_phs))
+                        else:
+                            clean = all(p.grad is None and not p._hooks
+                                        for p in pending.params)
+                    else:
+                        clean = False
+                    if clean:
+                        if not pending.rounds:
+                            self._install_grad_placeholders(pending)
+                        pending.backward_done = True
+                        if self._fire_sub(st, pending):
+                            return True
+                        # the sub fire split transactionally: the caller
+                        # runs the real backward on the replayed graph
+                        return False
+                    if entry[0] != "bwd" \
+                            or not self._is_root(pending, tensor):
+                        reason = "event_mismatch"
+                    else:
+                        reason = "hook_present"
+                    self._split(pending, escape=False, reason=reason,
+                                blocked_op="backward")
+                    return False
+                if clean and all(p.grad is None and not p._hooks
+                                 for p in pending.params):
                     pending.entry_pos += 1
                     pending.backward_done = True
                     self._install_grad_placeholders(pending)
@@ -625,15 +933,17 @@ class _StepFusionManager:
         cyc.n_backward += 1
         coord = cyc.produced.get(id(tensor))
         if coord is None or grad_tensor is not None or retain_graph \
-                or _autograd._saved_tensor_hooks or cyc.n_backward > 1:
-            if cyc.n_backward > 1:
-                reason = "multi_backward"
-            elif coord is None:
+                or _autograd._saved_tensor_hooks:
+            if coord is None:
                 reason = "event_mismatch"   # root not in the recorded cycle
             else:
                 reason = "hook_present"
             self._poison(st, reason, op="backward")
             return False
+        # multiple backwards per cycle are NO LONGER a poison: the
+        # boundary tries to canonicalize k×(fwd+bwd)+step into a
+        # super-cycle signature (grad accumulation) — unrecognizable
+        # multi-backward shapes attribute `unpromotable_cycle` there
         cyc.entries.append(("bwd", coord))
         _EVENTS.emit("step.record", "backward",
                      detail={"kind": "bwd", "pos": len(cyc.ops)})
@@ -693,11 +1003,22 @@ class _StepFusionManager:
                 else:
                     entry = program.entries[pending.entry_pos]
                     split_reason = "event_mismatch"
-                    if entry[0] == "step" \
+                    if program.super:
+                        # boundary of a matched accumulation loop: every
+                        # round archived (entry_pos back at the segment
+                        # start), and a scaler-folded program must arrive
+                        # through on_scaler_step instead
+                        terminal = program.scaler_ref is None \
+                            and bool(pending.rounds) \
+                            and pending.op_pos == 0 \
+                            and pending.entry_pos == program.seg_start
+                    else:
+                        terminal = entry[0] == "step" \
                             and pending.entry_pos \
                             == len(program.entries) - 1 \
                             and pending.backward_done \
-                            and pending.op_pos == len(program.chain.ops):
+                            and pending.op_pos == len(program.chain.ops)
+                    if terminal:
                         verify_fail = self._verify_fire(program, pending,
                                                         opt)
                         if verify_fail is None:
@@ -706,11 +1027,17 @@ class _StepFusionManager:
                                 # SPMD probation: this step commits EAGER
                                 # results (the caller proceeds); the fused
                                 # lowering is validated on the side
-                                self._probation(st, pending, opt)
+                                if program.super:
+                                    self._probation_super(st, pending, opt)
+                                else:
+                                    self._probation(st, pending, opt)
                                 st.pending = None
                                 self._after_boundary(st)
                                 return False
-                            if self._fire(st, pending, opt):
+                            fired = self._fire_super(st, pending, opt) \
+                                if program.super \
+                                else self._fire(st, pending, opt)
+                            if fired:
                                 self._after_boundary(st)
                                 return True
                             split_reason = None   # _fire already split
@@ -753,11 +1080,51 @@ class _StepFusionManager:
                 if pending.done:
                     st.pending = None
                     return False
+                if program.super:
+                    if program.scaler_ref is None:
+                        # recorded without this scaler: eager path runs,
+                        # its grad reads split the replay
+                        return False
+                    split_reason = "event_mismatch"
+                    if program.scaler_ref() is not scaler \
+                            or scaler._consts() != program.scaler_consts:
+                        self._kill(program)
+                        split_reason = "optimizer_state_change"
+                    elif pending.rounds and pending.op_pos == 0 \
+                            and pending.entry_pos == program.seg_start:
+                        verify_fail = self._verify_fire(program, pending,
+                                                        opt)
+                        if verify_fail is None:
+                            if program.spmd_plan is not None \
+                                    and not program.spmd_ok:
+                                self._probation_super(st, pending, opt,
+                                                      scaler=scaler)
+                                st.pending = None
+                                self._after_boundary(st)
+                                return False
+                            if self._fire_super(st, pending, opt,
+                                                scaler=scaler):
+                                fired = True
+                                self._after_boundary(st)
+                            else:
+                                split_reason = None
+                        else:
+                            split_reason = verify_fail
+                    if not fired and not pending.done \
+                            and split_reason is not None:
+                        self._split(pending, escape=False,
+                                    reason=split_reason,
+                                    blocked_op="scaler_step")
+                    if fired:
+                        return True
+                    st.pending = None
+                    self._boundary(st, opt, dirty=True)
+                    return False
                 entry = program.entries[pending.entry_pos]
                 if entry[0] != "scaler":
                     # the program was recorded without this scaler (legacy
                     # mode / changed loop): let the eager path run — its
-                    # grad reads split the replay as mid_step_peek
+                    # grad reads split the replay
                     return False
                 split_reason = "event_mismatch"
                 if program.scaler_ref() is not scaler \
@@ -859,13 +1226,42 @@ class _StepFusionManager:
                     # the slot must be fed by the SAME parameter object the
                     # program was built against — identity is the binding
                     return "param_mismatch"
+                delta = program.rng_slots.get(slots[k]) \
+                    if program.rng_slots else None
+                if delta is not None:
+                    # hoisted RNG slot: the incoming key must sit at the
+                    # recorded stream offset from this cycle's first
+                    # consumption — a shifted stream (an extra consumer
+                    # interleaved, a mid-cycle reseed) cannot replay
+                    ep = getattr(t, "_rng_epoch", None)
+                    if ep is None:
+                        return "rng_rekey"
+                    if pending.rng_epoch0 is None:
+                        pending.rng_epoch0 = ep - delta
+                        pending.rng_base = getattr(t, "_rng_base", None)
+                    elif ep - pending.rng_epoch0 != delta \
+                            or getattr(t, "_rng_base", None) \
+                            is not pending.rng_base:
+                        # a shifted position OR a different base key (a
+                        # reseed between this round's consumptions): the
+                        # recorded derivation would sample wrong
+                        return "rng_rekey"
         return None
 
     def _defer(self, st, pending, inputs, num_outputs):
         program = pending.program
         op = program.chain.ops[pending.op_pos]
+        slots = program.chain.ext_of[pending.op_pos]
         for k, t in enumerate(inputs):
             if op.wiring[k][0] != "ext":
+                continue
+            if program.rng_slots and slots[k] in program.rng_slots:
+                # hoisted RNG slot: keep the LAZY key tensor — the fused
+                # fire derives the key in-graph (nothing launches), and a
+                # transactional split forces it then (bitwise the same
+                # key, so the eager fallback samples identically)
+                pending.ext_vals.append(t)
+                pending.ext_edges.append(None)
                 continue
             pending.ext_vals.append(t._value)
             if op.diff_mask is not None and op.diff_mask[k]:
@@ -884,6 +1280,37 @@ class _StepFusionManager:
         if num_outputs is not None:
             return list(outs)
         return outs[0]
+
+    @staticmethod
+    def _force_rng_ext(program, ext_vals):
+        """A transactional fallback is about to replay per-op: materialize
+        the lazy hoisted-key ext slots. Each derives its reserved stream
+        position's exact key (fold_in(base, position)), so the eager
+        fallback samples bit-identically to what the fused program would
+        have computed in-graph."""
+        for s in (program.rng_slots or ()):
+            if s >= len(ext_vals):
+                continue    # prefix split: the slot was never deferred
+            t = ext_vals[s]
+            if isinstance(t, Tensor):
+                ext_vals[s] = t._value
+
+    @staticmethod
+    def _rng_base_data(base):
+        """Raw key data of the base the cycle's keys were RESERVED
+        against. Never read the live generator here: a reseed between
+        dispatch and fire would make the fused derivation diverge from
+        what eager (and the transactional split) samples."""
+        from ..framework import random as _random
+        if base is None:
+            return _random.stream_base_data()
+        return jax.random.key_data(base)
+
+    def _rng_fire_args(self, pending):
+        """The hoisted RNG device args of a fused fire: (base key data,
+        this cycle's first stream position)."""
+        return (self._rng_base_data(pending.rng_base),
+                jnp.asarray(pending.rng_epoch0 or 0, jnp.int32))
 
     def _install_grad_placeholders(self, pending):
         program = pending.program
@@ -905,24 +1332,26 @@ class _StepFusionManager:
         if opt is not program.opt_ref():
             return "param_mismatch"
         params = pending.params
+        ext_lists = [r[0] for r in pending.rounds] if program.super \
+            else [pending.ext_vals]
         if program.spmd_plan is not None:
             from . import spmd_fusion as _spmd
-            mm = _spmd.fire_mismatch(program.spmd_plan, pending.ext_vals,
-                                     params)
-            if mm is not None:
-                # the batch moved to another mesh/layout (or a parameter
-                # got sharded): the compiled collectives would run over
-                # the wrong axes — kill and let the loop re-promote with
-                # a fresh plan
-                self._kill(program, reason="mesh_mismatch")
-                return "mesh_mismatch"
+            for evals in ext_lists:
+                mm = _spmd.fire_mismatch(program.spmd_plan, evals, params)
+                if mm is not None:
+                    # the batch moved to another mesh/layout (or a
+                    # parameter got sharded): the compiled collectives
+                    # would run over the wrong axes — kill and let the
+                    # loop re-promote with a fresh plan
+                    self._kill(program, reason="mesh_mismatch")
+                    return "mesh_mismatch"
         slot_items = program.param_slots.items()
-        if any(pending.ext_vals[s] is not params[k]._value
-               for s, k in slot_items):
-            # a parameter buffer was swapped mid-cycle (in-place mutation):
-            # the forward consumed the captured value, the update would use
-            # the new one — not fusable
-            return "param_mismatch"
+        for evals in ext_lists:
+            if any(evals[s] is not params[k]._value for s, k in slot_items):
+                # a parameter buffer was swapped mid-cycle (in-place
+                # mutation): the forward consumed the captured value, the
+                # update would use the new one — not fusable
+                return "param_mismatch"
         for p, nm, nc, pr in zip(params, program.param_names,
                                  program.need_clip, program.param_regs):
             if p._hooks:
@@ -1009,19 +1438,21 @@ class _StepFusionManager:
                     for p in params]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_count = jnp.asarray(opt._step_count, jnp.int32)
+            rng_tail = self._rng_fire_args(pending) \
+                if program.rng_slots else ()
             if scaler is not None:
                 scale_before, good, bad = scaler._state_arrays()
-                fire_args = (pvals, ext, accs, lr, step_count,
+                fire_args = (pvals, ext, accs, lr, step_count, *rng_tail,
                              scale_before, good, bad)
                 (root_val, grads, new_p, new_accs, upd_finite, fwd_finite,
                  found_inf, scale_after, good2, bad2) = \
                     program.exe()(*fire_args)
             elif check:
-                fire_args = (pvals, ext, accs, lr, step_count)
+                fire_args = (pvals, ext, accs, lr, step_count, *rng_tail)
                 (root_val, grads, new_p, new_accs, upd_finite,
                  fwd_finite) = program.exe()(*fire_args)
             else:
-                fire_args = (pvals, ext, accs, lr, step_count)
+                fire_args = (pvals, ext, accs, lr, step_count, *rng_tail)
                 root_val, grads, new_p, new_accs = program.exe()(
                     *fire_args)
         except jax.errors.JaxRuntimeError:
@@ -1114,6 +1545,330 @@ class _StepFusionManager:
             st.pending = None
         return True
 
+    # -- super-cycle replay internals (grad accumulation) ------------------
+    @classmethod
+    def _sub_fire_args(cls, program, ext_vals, rng_epoch0, acc, fwd_ok):
+        """Concrete arguments of one sub-executable fire: params and side
+        inputs from the round's captured ext values, the running grad
+        accumulator (program zeros on round 0), and the scalar tail
+        (hoisted RNG state — the base the round's keys were reserved
+        against, read off the still-lazy key tensors — plus the running
+        fwd-finite predicate)."""
+        pvals = [None] * len(program.param_refs)
+        for s, k in program.param_slots.items():
+            pvals[k] = ext_vals[s]
+        ext = [ext_vals[s] for s in program.ext_order]
+        if acc is None:
+            zeros, true = program.zero_state()
+            acc = list(zeros)
+            fwd_ok = true
+        tail = ()
+        if program.rng_slots:
+            base = None
+            for s in program.rng_slots:
+                if s < len(ext_vals):
+                    base = getattr(ext_vals[s], "_rng_base", None)
+                    if base is not None:
+                        break
+            tail += (cls._rng_base_data(base),
+                     jnp.asarray(rng_epoch0 or 0, jnp.int32))
+        if program.check:
+            tail += (fwd_ok,)
+        return (pvals, ext, acc) + tail
+
+    @staticmethod
+    def _archive_round(pending):
+        """The current micro-batch round matched completely: archive its
+        captured state and reset the per-round cursors so the next event
+        may open another round or hit the boundary."""
+        pending.rounds.append([pending.ext_vals, pending.ext_edges,
+                               pending.placeholders, pending.rng_epoch0])
+        pending.ext_vals = []
+        pending.ext_edges = []
+        pending.placeholders = []
+        pending.rng_epoch0 = None
+        pending.rng_base = None
+        pending.op_pos = 0
+        pending.entry_pos = pending.program.seg_start
+
+    def _fire_sub(self, st, pending):
+        """Fire the micro-batch sub-executable for the just-completed
+        round (gradients add into the running device accumulator) and
+        archive the round. Under SPMD probation nothing fused may commit
+        — the fires are deferred to the boundary — but the round archives
+        either way. Returns False after a transactional split (the caller
+        must run the real backward)."""
+        from . import guardian as _guardian
+        program = pending.program
+        if _guardian.faults_armed() and _guardian.poll_fault(
+                "fused_step", ("raise", "nan_output")) is not None:
+            self._split(pending, escape=False, reason="injected_fault",
+                        blocked_op="chaos")
+            return False
+        probation = program.spmd_plan is not None and not program.spmd_ok
+        if not probation:
+            st.busy = True
+            try:
+                args = self._sub_fire_args(program, pending.ext_vals,
+                                           pending.rng_epoch0,
+                                           pending.acc_vals,
+                                           pending.fwd_ok)
+                out = program.sub_exe()(*args)
+            except jax.errors.JaxRuntimeError:
+                self._split(pending, escape=False, reason="exec_fault",
+                            blocked_op="backward")
+                return False
+            except Exception:
+                self._kill(program, reason="trace_fail")
+                self._split(pending, escape=False, reason="trace_fail",
+                            blocked_op="backward")
+                return False
+            finally:
+                st.busy = False
+            pending.round_losses.append(out[0])
+            pending.acc_vals = list(out[1])
+            if program.check:
+                pending.fwd_ok = out[2]
+            pending.sub_args = args
+        self._archive_round(pending)
+        return True
+
+    def _fire_super(self, st, pending, opt, scaler=None):
+        """The boundary of a matched super-cycle: every round's sub fire
+        already accumulated the gradient sum; run the ONE update
+        executable (clip/reg + optimizer + guardian skip + scaler
+        transition, all on the ACCUMULATED grads) and commit — params and
+        slots in place, each round's loss placeholder from its sub
+        output, p.grad from the accumulated grads. Same transactional
+        contract as _fire."""
+        from ..jit.train_step import bake_decay_flags
+        from . import guardian as _guardian
+        program = pending.program
+        params = pending.params
+        acc_names = program.acc_names
+        check = program.check
+        upd_finite = fwd_finite = scale_before = scale_after = None
+        if _guardian.faults_armed() and _guardian.poll_fault(
+                "fused_step", ("raise", "nan_output")) is not None:
+            self._split(pending, escape=False, reason="injected_fault",
+                        blocked_op="chaos")
+            return False
+        st.busy = True
+        if not hasattr(opt, "_step_count"):
+            opt._step_count = 0
+        opt._step_count += 1
+        try:
+            bake_decay_flags(opt, params)
+            pvals = [p._value for p in params]
+            accs = [[opt._accumulators[n].get(p.name) for n in acc_names]
+                    for p in params]
+            gsum = pending.acc_vals
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_count = jnp.asarray(opt._step_count, jnp.int32)
+            tail = ()
+            if check:
+                tail += (pending.fwd_ok,)
+            if scaler is not None:
+                scale_before, good, bad = scaler._state_arrays()
+                tail += (scale_before, good, bad)
+                (grads, new_p, new_accs, upd_finite, fwd_finite,
+                 found_inf, scale_after, good2, bad2) = program.upd_exe()(
+                    pvals, accs, gsum, lr, step_count, *tail)
+            elif check:
+                (grads, new_p, new_accs, upd_finite,
+                 fwd_finite) = program.upd_exe()(pvals, accs, gsum, lr,
+                                                 step_count, *tail)
+            else:
+                grads, new_p, new_accs = program.upd_exe()(
+                    pvals, accs, gsum, lr, step_count)
+        except jax.errors.JaxRuntimeError:
+            opt._step_count -= 1
+            consumed = any(
+                getattr(a, "is_deleted", lambda: False)()
+                for row in accs for a in row if a is not None)
+            if program.donate_params and not consumed:
+                consumed = any(
+                    getattr(v, "is_deleted", lambda: False)()
+                    for v in pvals)
+            if consumed:
+                st.busy = False
+                st.pending = None
+                self._kill(program, reason="exec_fault")
+                raise
+            st.busy = False
+            self._split(pending, escape=False, reason="exec_fault")
+            return False
+        except Exception:
+            opt._step_count -= 1
+            st.busy = False
+            self._kill(program, reason="trace_fail")
+            self._split(pending, escape=False, reason="trace_fail")
+            return False
+        try:
+            for p, v in zip(params, new_p):
+                p._value = v
+            for p, ac in zip(params, new_accs):
+                for n, v in zip(acc_names, ac):
+                    if v is not None:
+                        opt._accumulators[n][p.name] = v
+            # each round's loss: served from its sub-executable output,
+            # tape-marked consumed (one FusedStepNode per micro-batch)
+            i, j = program.root_coord
+            for r, (evals, eedges, rows, ep0) in enumerate(pending.rounds):
+                root_ph = rows[i][j]
+                rv = pending.round_losses[r]
+                if _VALUE_SLOT.__get__(root_ph) is _PENDING:
+                    _VALUE_SLOT.__set__(root_ph, rv)
+                node = FusedStepNode(program.label, (rv.shape, rv.dtype))
+                _NODE_SLOT.__set__(root_ph, node)
+                _IDX_SLOT.__set__(root_ph, 0)
+                root_ph._pending_chain = None
+            # accumulated grads land in the placeholders installed at the
+            # first round's backward (scaler programs emit them UNSCALED,
+            # exactly what the eager path leaves in p.grad)
+            for ph, g in zip(pending.grad_phs, grads):
+                if _VALUE_SLOT.__get__(ph) is _PENDING:
+                    _VALUE_SLOT.__set__(ph, g)
+                ph._pending_chain = None
+            if scaler is not None:
+                scaler._found_inf = found_inf
+                scaler._fused_next = (found_inf, scale_after, good2, bad2)
+            if check:
+                from . import guardian
+                guardian.note_step(program.label, upd_finite, fwd_finite,
+                                   scale_before, scale_after,
+                                   step_index=opt._step_count)
+            pending.fired = True
+            program.fail_streak = 0
+            if not program.aot_stored and pending.sub_args is not None:
+                from . import aot_cache as _aot
+                if _aot.enabled():
+                    # persist the proven PAIR once (store-if-absent; a
+                    # restored pair never re-exports)
+                    program.aot_stored = True
+                    _aot.store_super_step(
+                        program, pending.sub_args,
+                        (pvals, accs, gsum, lr, step_count) + tail)
+            elapsed = time.perf_counter_ns() - pending.t0
+            STEP_STATS.replay(program.label, program.n_launches,
+                              program.baseline_ns - elapsed)
+            from ..profiler import goodput as _goodput
+            _goodput.on_fused_fire(program, rounds=len(pending.rounds))
+            _EVENTS.emit("step.fire", program.label,
+                         detail={"ops": len(program.chain.ops),
+                                 "rounds": len(pending.rounds),
+                                 "launches_saved": program.n_launches
+                                 - len(pending.rounds) - 1})
+            self._demote(pending)
+        finally:
+            st.busy = False
+            st.pending = None
+        return True
+
+    def _probation_super(self, st, pending, opt, scaler=None):
+        """First fire of an SPMD-lowered super-cycle: run every archived
+        round's sub fire plus the update on SCRATCH state, replay the
+        whole accumulation eagerly (bitwise, through the transactional
+        core), and compare per-round losses + accumulated grads. A
+        divergence or trace failure demotes to the plain jit lowering,
+        attributed `spmd_divergence`. The caller lets the eager
+        optimizer/scaler step proceed."""
+        import numpy as np
+        from ..jit.train_step import bake_decay_flags
+        from ..profiler import goodput as _goodput
+        from . import spmd_fusion as _spmd
+        _goodput.mark("probation")
+
+        def scratch(v):
+            return v + jnp.zeros((), v.dtype)
+
+        program = pending.program
+        params = pending.params
+        acc_names = program.acc_names
+        fused = None
+        losses = []
+        st.busy = True
+        try:
+            bake_decay_flags(opt, params)
+            zeros, fwd_ok = program.zero_state()
+            acc = [scratch(z) for z in zeros]
+            for evals, eedges, rows, ep0 in pending.rounds:
+                args = self._sub_fire_args(program, evals, ep0, acc,
+                                           fwd_ok)
+                out = program.sub_exe()(*args)
+                losses.append(out[0])
+                acc = list(out[1])
+                if program.check:
+                    fwd_ok = out[2]
+            pvals = [p._value for p in params]
+            if program.donate_params:
+                pvals = [scratch(v) for v in pvals]
+            accs = [[None if opt._accumulators[n].get(p.name) is None
+                     else scratch(opt._accumulators[n][p.name])
+                     for n in acc_names] for p in params]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_count = jnp.asarray(
+                getattr(opt, "_step_count", 0) + 1, jnp.int32)
+            tail = ()
+            if program.check:
+                tail += (fwd_ok,)
+            if scaler is not None:
+                scale, good, bad = scaler._state_arrays()
+                tail += (scratch(scale), scratch(good), scratch(bad))
+            fused = program.upd_exe()(pvals, accs, acc, lr, step_count,
+                                      *tail)
+        except Exception:
+            fused = None
+        finally:
+            st.busy = False
+        self._replay_pending(pending)
+        ok = fused is not None
+        why = "trace_fail" if fused is None else None
+        if ok:
+            i, j = program.root_coord
+            for r, (evals, eedges, rows, ep0) in enumerate(pending.rounds):
+                ev = np.asarray(_VALUE_SLOT.__get__(rows[i][j]))
+                rt, at = _spmd.probation_tolerance(ev.dtype)
+                if not np.allclose(np.asarray(losses[r]), ev, rtol=rt,
+                                   atol=at, equal_nan=True):
+                    ok = False
+                    break
+            scale_np = None
+            if ok and scaler is not None:
+                scale_np = np.asarray(scaler._state_arrays()[0])
+            if ok:
+                for ph, g in zip(pending.grad_phs, fused[0]):
+                    ev = _VALUE_SLOT.__get__(ph)
+                    if ev is _PENDING:
+                        continue
+                    ev = np.asarray(ev)
+                    gv = np.asarray(g)
+                    if scale_np is not None:
+                        gv = gv * scale_np.astype(gv.dtype)
+                    rt, at = _spmd.probation_tolerance(ev.dtype)
+                    if not np.allclose(gv, ev, rtol=rt, atol=at,
+                                       equal_nan=True):
+                        ok = False
+                        break
+            if not ok and why is None:
+                why = "numeric_divergence"
+        if ok:
+            program.spmd_ok = True
+            _EVENTS.emit("step.record", program.label,
+                         detail={"kind": "spmd_probation", "ok": True,
+                                 "super": True})
+        else:
+            program.spmd_plan = None
+            program.spmd_ok = True
+            program._exe = None
+            program._sub_exe = None
+            program._upd_exe = None
+            program._zero_acc = None
+            _EVENTS.emit("step.record", program.label,
+                         reason="spmd_divergence",
+                         detail={"kind": "spmd_probation", "ok": False,
+                                 "why": why, "super": True})
+
     @staticmethod
     def _demote(pending):
         """Release the fired step's retention (ROADMAP item 4(c)): swap
@@ -1130,11 +1885,16 @@ class _StepFusionManager:
         recompute needs, no more."""
         pending.placeholders = [[weakref.ref(t) for t in row]
                                 for row in pending.placeholders]
+        for rnd in pending.rounds:
+            rnd[2] = [[weakref.ref(t) for t in row] for row in rnd[2]]
         # grads were committed to p.grad and the loss to its own handle;
         # the pending's strong duplicates would pin those buffers past
         # clear_grad()
         pending.grad_phs = None
         pending.params = ()
+        pending.round_losses = []
+        pending.acc_vals = None
+        pending.fwd_ok = None
 
     def _probation(self, st, pending, opt, scaler=None):
         """First fire of an SPMD-lowered program (ops/spmd_fusion.py): run
@@ -1177,13 +1937,16 @@ class _StepFusionManager:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_count = jnp.asarray(
                 getattr(opt, "_step_count", 0) + 1, jnp.int32)
+            rng_tail = self._rng_fire_args(pending) \
+                if program.rng_slots else ()
             if scaler is not None:
                 scale, good, bad = scaler._state_arrays()
                 fused = program.exe()(pvals, ext, accs, lr, step_count,
-                                      scratch(scale), scratch(good),
-                                      scratch(bad))
+                                      *rng_tail, scratch(scale),
+                                      scratch(good), scratch(bad))
             else:
-                fused = program.exe()(pvals, ext, accs, lr, step_count)
+                fused = program.exe()(pvals, ext, accs, lr, step_count,
+                                      *rng_tail)
         except Exception:
             # the distributed lowering failed to trace/execute (a baked
             # global shape, an op the manual mapping rejects): demote to
@@ -1263,17 +2026,34 @@ class _StepFusionManager:
         st = self._tls
         st.busy = True
         try:
-            rows = []
-            for row in pending.placeholders:
-                live = []
-                for ref in row:
-                    t = ref()
-                    if t is None:
-                        t = _DeferredTensor(None, True, None, None)
-                    live.append(t)
-                rows.append(live)
-            replay_ops_per_op(pending.program.chain.ops, pending.ext_vals,
-                              pending.ext_edges, rows,
+            program = pending.program
+
+            def revive(store):
+                rows = []
+                for row in store:
+                    live = []
+                    for ref in row:
+                        t = ref()
+                        if t is None:
+                            t = _DeferredTensor(None, True, None, None)
+                        live.append(t)
+                    rows.append(live)
+                return rows
+
+            if program.super:
+                # a fired super-cycle's intermediates: every round
+                # replays from its own captured inputs
+                for evals, eedges, store, _ep in pending.rounds:
+                    self._force_rng_ext(program, evals)
+                    replay_ops_per_op(program.chain.ops, evals, eedges,
+                                      revive(store),
+                                      len(program.chain.ops),
+                                      skip_materialized=True)
+                pending.done = True
+                return
+            self._force_rng_ext(program, pending.ext_vals)
+            replay_ops_per_op(program.chain.ops, pending.ext_vals,
+                              pending.ext_edges, revive(pending.placeholders),
                               pending.op_pos, skip_materialized=True)
             pending.done = True
         finally:
@@ -1288,8 +2068,11 @@ class _StepFusionManager:
         failure). Callers hold pending.lock."""
         st = self._tls
         program = pending.program
+        if program.super:
+            return self._replay_pending_super(pending)
         st.busy = True
         try:
+            self._force_rng_ext(program, pending.ext_vals)
             replay_ops_per_op(program.chain.ops, pending.ext_vals,
                               pending.ext_edges, pending.placeholders,
                               pending.op_pos)
@@ -1313,6 +2096,67 @@ class _StepFusionManager:
                         p.grad = ph
                     else:
                         ph._pending_chain = None
+            pending.done = True
+        finally:
+            st.busy = False
+
+    def _replay_pending_super(self, pending):
+        """The super-cycle transactional core: replay every archived
+        round per-op AND run its real tape backward (p.grad accumulates
+        across rounds exactly as unfused dispatch would), then replay the
+        current round's deferred prefix. Nothing fused ever committed —
+        the sub fires only touched scratch accumulators — so the result
+        is bitwise-identical to eager execution. Callers hold
+        pending.lock."""
+        st = self._tls
+        program = pending.program
+        n_ops = len(program.chain.ops)
+        st.busy = True
+        try:
+            params = pending.params
+            i, j = program.root_coord
+            if pending.rounds:
+                # the cycle began with fresh grads (verified at round 0's
+                # backward): re-accumulate from scratch
+                for p in params:
+                    p.grad = None
+            for evals, eedges, rows, _ep in pending.rounds:
+                self._force_rng_ext(program, evals)
+                replay_ops_per_op(program.chain.ops, evals, eedges, rows,
+                                  n_ops)
+                root = rows[i][j]
+                node = _NODE_SLOT.__get__(root)
+                if node is not None:
+                    seed = _autograd._one_cotangent(
+                        _VALUE_SLOT.__get__(root).shape,
+                        _VALUE_SLOT.__get__(root).dtype)
+                    run_backward(node, _IDX_SLOT.__get__(root), seed)
+            # current round's deferred prefix (its backward — if one is in
+            # flight — is run by the caller on the replayed real graph)
+            self._force_rng_ext(program, pending.ext_vals)
+            replay_ops_per_op(program.chain.ops, pending.ext_vals,
+                              pending.ext_edges, pending.placeholders,
+                              pending.op_pos)
+            if pending.grad_phs is not None:
+                if not pending.rounds:
+                    # split before any round committed (a round-0 sub
+                    # fault): grads are None exactly as eager would have
+                    # them — withdraw the installed placeholders
+                    for p, ph in zip(params, pending.grad_phs):
+                        if p.grad is ph:
+                            p.grad = None
+                        ph._pending_chain = None
+                    pending.grad_phs = None
+                else:
+                    for p, ph in zip(params, pending.grad_phs):
+                        real = p.grad
+                        if real is not None and real is not ph:
+                            if _VALUE_SLOT.__get__(ph) is _PENDING:
+                                _VALUE_SLOT.__set__(ph, real._value)
+                            ph._pending_chain = None
+                            p.grad = ph
+                        else:
+                            ph._pending_chain = None
             pending.done = True
         finally:
             st.busy = False
@@ -1395,6 +2239,13 @@ class _StepFusionManager:
         updated = [p for p in opt._parameter_list if p.grad is not None]
         cyc.entries.append(("step", id(opt), tuple(id(p) for p in updated)))
         sig = tuple(cyc.entries)
+        if cyc.n_backward > 1:
+            # grad accumulation: canonicalize k×(fwd+bwd)+step into the
+            # k-INDEPENDENT super-cycle signature, so a k=4 warm-up
+            # promotes a program that replays at any k without recompiling
+            ssig = self._super_sig(sig)
+            if ssig is not None:
+                sig = ssig
         if sig == st.prev_sig:
             st.streak += 1
         else:
@@ -1429,6 +2280,69 @@ class _StepFusionManager:
                 st.active = program
         self._after_boundary(st)
 
+    @staticmethod
+    def _super_sig(entries):
+        """Canonical k-independent signature of a grad-accumulation
+        super-cycle, or None when the shape is not recognizable.
+        Recognized: [cg?] + k×(ops…, bwd) + [scaler?] + step with k ≥ 2,
+        all k segments structurally identical after rebasing wiring, bwd
+        coords, and hoisted-RNG stream deltas to segment-local form, and
+        NO dataflow crossing a segment boundary."""
+        step_e = entries[-1]
+        body = list(entries[:-1])
+        cg = None
+        if body and body[0][0] == "cg":
+            cg = body.pop(0)
+        scaler_e = None
+        if body and body[-1][0] == "scaler":
+            scaler_e = body.pop()
+        if not body or any(e[0] not in ("op", "bwd") for e in body):
+            return None
+        cuts = [i for i, e in enumerate(body) if e[0] == "bwd"]
+        k = len(cuts)
+        if k < 2 or cuts[-1] != len(body) - 1:
+            return None
+        seg_len = cuts[0] + 1
+        if len(body) != k * seg_len \
+                or any(cuts[s] != (s + 1) * seg_len - 1 for s in range(k)):
+            return None
+        canon = []
+        for s in range(k):
+            seg = body[s * seg_len:(s + 1) * seg_len]
+            base = s * (seg_len - 1)       # recorded ops per segment
+            rebased = []
+            rng0 = None
+            for e in seg[:-1]:
+                wiring = []
+                for w in e[2]:
+                    if w[0] == "prev":
+                        i2 = w[1] - base
+                        if i2 < 0:
+                            return None    # cross-segment dataflow
+                        wiring.append(("prev", i2, w[2]))
+                    else:
+                        wiring.append(w)
+                ent = ("op", e[1], tuple(wiring), e[3], e[4])
+                if len(e) > 5:
+                    marks = []
+                    for ki, d in e[5]:
+                        if rng0 is None:
+                            rng0 = d   # segment-local stream anchor
+                        marks.append((ki, d - rng0))
+                    ent += (tuple(marks),)
+                rebased.append(ent)
+            bcoord = seg[-1][1]
+            if bcoord is None:
+                return None
+            bi = bcoord[0] - base
+            if bi < 0 or bi >= seg_len - 1:
+                return None
+            rebased.append(("bwd", (bi, bcoord[1])))
+            canon.append(tuple(rebased))
+        if any(c != canon[0] for c in canon[1:]):
+            return None
+        return ("super", cg, canon[0], scaler_e, step_e)
+
     def _aot_step_digest(self, st, sig, opt, updated):
         """The warm-start probe: this cycle's AOT step digest when the
         store holds a matching artifact, else None. The digest computation
@@ -1456,6 +2370,9 @@ class _StepFusionManager:
         promotes still explains itself."""
         from ..jit.train_step import bake_decay_flags
 
+        if sig and sig[0] == "super":
+            return self._build_super(st, cyc, sig, opt, updated, warm=warm)
+
         def unbuildable(why, op=""):
             _EVENTS.emit("step.record", op, reason="unpromotable_cycle",
                          detail={"kind": "build_fail", "why": why})
@@ -1463,6 +2380,11 @@ class _StepFusionManager:
 
         entries = []
         bwd_entries = [e for e in cyc.entries if e[0] == "bwd"]
+        if len(bwd_entries) > 1:
+            # a multi-backward cycle that _super_sig could NOT
+            # canonicalize (irregular segments, cross-micro-batch
+            # dataflow): name the real blocker instead of a generic fail
+            return unbuildable("irregular_accum", op="backward")
         if len(bwd_entries) != 1 or bwd_entries[0][1] is None \
                 or not cyc.ops or not updated:
             return unbuildable("no_backward_or_params")
@@ -1527,6 +2449,20 @@ class _StepFusionManager:
             param_slots[s] = k
         if {k for k in param_slots.values()} != set(range(len(updated))):
             return unbuildable("param_set_mismatch")
+        # hoisted RNG slots: {ext slot -> stream delta} from the recorded
+        # per-op marks — these slots are derived in-graph at fire time
+        rng_slots = {}
+        op_i = 0
+        for e in cyc.entries:
+            if e[0] != "op":
+                continue
+            if len(e) > 5:
+                for k, delta in e[5]:
+                    s = chain.ext_of[op_i][k]
+                    if s is None or s in param_slots:
+                        return unbuildable("rng_wiring")
+                    rng_slots[s] = delta
+            op_i += 1
         # events with per-op entries collapsed to ("op",) markers, in order
         # (the trailing ("step", ...) sig entry becomes the terminal event)
         op_iter = 0
@@ -1550,8 +2486,10 @@ class _StepFusionManager:
         program.need_clip = tuple(
             getattr(p, "need_clip", True) for p in updated)
         program.param_slots = param_slots
+        program.rng_slots = rng_slots
         program.ext_order = tuple(
-            s for s in range(chain.n_ext) if s not in param_slots)
+            s for s in range(chain.n_ext)
+            if s not in param_slots and s not in rng_slots)
         program.opt_ref = weakref.ref(opt)
         program.clip_ref = opt._grad_clip
         program.clip_snapshot = _snapshot_obj(opt._grad_clip)
@@ -1607,6 +2545,178 @@ class _StepFusionManager:
         STEP_STATS.promoted(program.label)
         _EVENTS.emit("step.promote", program.label,
                      detail={"ops": len(ops), "params": len(updated),
+                             "launches_estimate": program.n_launches,
+                             "warm_start": warm,
+                             "spmd": plan is not None,
+                             "mesh": plan.axes_label if plan is not None
+                             else None})
+        return program
+
+    def _build_super(self, st, cyc, sig, opt, updated, warm=False):
+        """Super-cycle qualification + program construction. `sig` is the
+        canonical ("super", cg, segment entries, scaler, step) form from
+        _super_sig; `cyc` holds the k identically-recorded segments. The
+        program's chain is ONE segment — the sub/update executable pair
+        replays it at any k."""
+        from ..jit.train_step import bake_decay_flags
+
+        def unbuildable(why, op=""):
+            _EVENTS.emit("step.record", op, reason="unpromotable_cycle",
+                         detail={"kind": "build_fail", "why": why,
+                                 "super": True})
+            return None
+
+        _tag, cg_e, seg_entries, scaler_e, _step_e = sig
+        seg_ops = len(seg_entries) - 1
+        k = cyc.n_backward
+        if not cyc.ops or not updated:
+            return unbuildable("no_backward_or_params")
+        if any(p._hooks or p.stop_gradient for p in updated):
+            return unbuildable("param_hooks")
+        for p in updated:
+            node = p._grad_node
+            if node is not None and node.out_hooks:
+                return unbuildable("param_hooks")
+        recs = cyc.ops[:seg_ops]
+        # segment 0's recorded wiring is already segment-local (its op
+        # indices start at 0), so the recs translate directly
+        ops = [
+            _ChainOp(r.name, r.key, r.fn, r.wiring, r.diff_mask,
+                     r.num_outputs, r.out_avals, r.out_stop_grads)
+            for r in recs]
+        chain = Chain(sig, ops, 0)
+        if not chain.grad_mode:
+            return unbuildable("no_grad_ops")
+        scaler_obj = cyc.scaler
+        if scaler_e is not None:
+            if scaler_obj is None or id(scaler_obj) != scaler_e[1]:
+                return unbuildable("scaler_gone")
+            if not chain.check:
+                return unbuildable("scaler_without_guardian")
+        else:
+            scaler_obj = None
+        root_coord = seg_entries[-1][1]
+        root_flat = None
+        for flat, owner in enumerate(chain.owners):
+            if owner == root_coord:
+                root_flat = flat
+                break
+        if root_flat is None:
+            return unbuildable("root_not_in_chain")
+        param_idx = {id(p): kk for kk, p in enumerate(updated)}
+        slot_inputs = {}
+        for i, rec in enumerate(recs):
+            slots = chain.ext_of[i]
+            for k2, s in enumerate(slots):
+                if s is not None:
+                    slot_inputs[s] = rec.ins[k2]
+        param_slots = {}
+        for s in chain.diff_ext_idx:
+            kk = param_idx.get(id(slot_inputs[s]))
+            if kk is None:
+                return unbuildable("nonparam_diff_input")
+            param_slots[s] = kk
+        if {v for v in param_slots.values()} != set(range(len(updated))):
+            return unbuildable("param_set_mismatch")
+        # every segment must feed the SAME param objects into the param
+        # slots — micro-batches vary the data, never the binding
+        for seg in range(1, k):
+            base = seg * seg_ops
+            for i in range(seg_ops):
+                slots = chain.ext_of[i]
+                for k2, s in enumerate(slots):
+                    if s in param_slots and \
+                            cyc.ops[base + i].ins[k2] is not recs[i].ins[k2]:
+                        return unbuildable("accum_param_mismatch")
+        # hoisted RNG slots (segment-relative stream deltas)
+        rng_slots = {}
+        for i, e in enumerate(seg_entries[:-1]):
+            if len(e) > 5:
+                for k2, delta in e[5]:
+                    s = chain.ext_of[i][k2]
+                    if s is None or s in param_slots:
+                        return unbuildable("rng_wiring")
+                    rng_slots[s] = delta
+        entries = []
+        if cg_e is not None:
+            entries.append(cg_e)
+        seg_start = len(entries)
+        for i in range(seg_ops):
+            entries.append(("op", i))
+        entries.append(("bwd",))
+        if scaler_e is not None:
+            entries.append(scaler_e)
+        entries.append(("step",))
+        program = _StepProgram()
+        program.super = True
+        program.seg_start = seg_start
+        program.sig = sig
+        program.chain = chain
+        program.entries = tuple(entries)
+        program.root_coord = root_coord
+        program.root_flat = root_flat
+        program.param_refs = tuple(weakref.ref(p) for p in updated)
+        program.param_names = tuple(p.name for p in updated)
+        program.param_regs = tuple(
+            getattr(p, "regularizer", None) for p in updated)
+        program.need_clip = tuple(
+            getattr(p, "need_clip", True) for p in updated)
+        program.param_slots = param_slots
+        program.rng_slots = rng_slots
+        program.ext_order = tuple(
+            s for s in range(chain.n_ext)
+            if s not in param_slots and s not in rng_slots)
+        program.opt_ref = weakref.ref(opt)
+        program.clip_ref = opt._grad_clip
+        program.clip_snapshot = _snapshot_obj(opt._grad_clip)
+        program.reg_ref = opt.regularization
+        program.reg_snapshot = _snapshot_obj(opt.regularization)
+        bake_decay_flags(opt, updated)
+        program.extra_key = tuple(opt._extra_cache_key())
+        opt._create_accumulators(updated)
+        program.acc_names = tuple(sorted(opt._accumulators.keys()))
+        program.check = chain.check
+        if scaler_obj is not None:
+            program.scaler_ref = weakref.ref(scaler_obj)
+            program.scaler_consts = scaler_e[2]
+        from . import spmd_fusion as _spmd
+        plan, plan_reason = _spmd.plan_program(
+            chain, slot_inputs, program.ext_order, updated, opt,
+            program.acc_names, root_flat)
+        if plan_reason is not None:
+            _EVENTS.emit("step.record", "", reason=plan_reason,
+                         detail={"kind": "build_fail"})
+        if plan is not None and not plan.data_axes:
+            # no batch axis to defer the gradient pmean over: the plain
+            # GSPMD lowering already does the right thing
+            plan = None
+        if plan is not None:
+            program.spmd_plan = plan
+            program.spmd_ok = False
+        names = [op.name for op in ops]
+        head = "→".join(names[:3]) + ("→…" if len(names) > 3 else "")
+        program.label = (f"{head}[{len(ops)}ops×k]"
+                         f"+{type(opt).__name__}+accum"
+                         + ("+GradScaler" if scaler_obj is not None else "")
+                         + (f"@mesh[{plan.axes_label}]"
+                            if plan is not None else ""))
+        program.n_launches = k * (len(ops) + sum(
+            1 for op in ops if op.diff_mask is not None) + 1) + 1 \
+            + (2 if scaler_obj is not None else 0)
+        program.baseline_ns = time.perf_counter_ns() - cyc.t0
+        program.donate_params = bool(
+            _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
+        from . import aot_cache as _aot
+        if _aot.enabled() and plan is None:
+            dg = st.aot_probe.get(sig, 0)
+            program.aot_digest = dg if dg != 0 \
+                else _aot.step_digest(sig, opt, updated)
+        else:
+            program.aot_stored = True
+        STEP_STATS.promoted(program.label)
+        _EVENTS.emit("step.promote", program.label,
+                     detail={"ops": len(ops), "params": len(updated),
+                             "super": True, "rounds_seen": k,
                              "launches_estimate": program.n_launches,
                              "warm_start": warm,
                              "spmd": plan is not None,

@@ -104,7 +104,8 @@ CATEGORIES = frozenset({
 REASON_CODES = frozenset({
     # -- why a dispatch bypassed the executable cache ----------------------
     "unkeyable_closure",   # fn closes over an array/Tensor/stateful object
-    "rng_rekey",           # op consumed fresh global RNG; re-keys per call
+    "rng_rekey",           # stateful RNG closure re-key, or a hoisted-key
+                           # replay saw a shifted stream position
     "tracer_input",        # input is a jax tracer (inside an outer trace)
     "cache_disabled",      # cache flag off or size 0
     "unjittable",          # negative-cached: the op cannot be jitted
@@ -125,7 +126,8 @@ REASON_CODES = frozenset({
     "flag_off",            # a fusion flag flipped off mid-run
     # -- why a cycle could not promote (observation side) ------------------
     "uncached_dispatch",   # an op took the uncached path inside the cycle
-    "multi_backward",      # >1 backward per cycle (grad accumulation)
+    "multi_backward",      # irregular multi-backward cycle (regular grad
+                           # accumulation promotes as a super-cycle)
     "cycle_too_long",      # cycle exceeded the recording cap
     "unpromotable_cycle",  # build-time qualification failed (see detail)
     "fail_streak",         # deactivated after repeated failed replays

@@ -22,10 +22,14 @@ __all__ = ["explain", "format_report", "REASON_HINTS"]
 # ROADMAP-backed fix. Keyed on the public REASON_CODES contract.
 REASON_HINTS = {
     "rng_rekey": (
-        "the op consumes fresh global randomness every call (dropout "
-        "family), so its closure re-keys per dispatch and every cycle is "
-        "poisoned. Fix: hoist the PRNG key to a step argument (ROADMAP "
-        "follow-on (b)) or run with dropout disabled to promote."),
+        "the op consumes STATEFUL global randomness (a fresh key baked "
+        "into its closure per call) — or a hoisted-key replay saw a "
+        "shifted stream position (an extra RNG consumer interleaved, a "
+        "mid-cycle reseed). The dropout family, sdpa dropout, and "
+        "bernoulli already key on structure via hoisted stream positions "
+        "(framework/random.rng_key_input) and promote; route custom "
+        "random ops through rng_key_input() the same way, or make the "
+        "interleaved consumption per-step-deterministic."),
     "unkeyable_closure": (
         "a per-batch array/Tensor is baked into the op's closure instead "
         "of being a dispatch input. Fix: thread it through the op's "
@@ -92,15 +96,22 @@ REASON_HINTS = {
         "persistent occurrences mean cache thrash (check "
         "FLAGS_eager_op_cache_size / evictions)."),
     "multi_backward": (
-        "more than one backward() per cycle (gradient accumulation); "
-        "the step recorder requires exactly one (ROADMAP open item)."),
+        "more than one backward() per cycle. Regular gradient "
+        "accumulation — k identical (fwd+bwd) micro-batches then one "
+        "step() — now promotes automatically as a SUPER-CYCLE (two "
+        "executables, any k); this cycle's backwards were irregular "
+        "(differing micro-batch structure, dataflow crossing "
+        "micro-batches, a backward outside the recorded ops)."),
     "cycle_too_long": (
         "the cycle exceeded the recording cap (_MAX_CYCLE_OPS); a "
         "whole-step compile would not amortize."),
     "unpromotable_cycle": (
         "build-time qualification failed — see the `why` detail "
         "(no_backward_or_params / param_hooks / nonparam_diff_input / "
-        "...)."),
+        "irregular_accum = multi-backward cycle whose micro-batches are "
+        "not k identical segments / ...). With RNG hoisting and "
+        "super-cycle promotion in place this verdict should be RARE "
+        "enough to page on."),
     "fail_streak": (
         "the promoted step was deactivated after repeated failed "
         "replays — look at the step.split reasons right before it."),

@@ -503,10 +503,15 @@ def on_step(opt=None, tokens=None):
     ACCOUNTANT.step_boundary(tokens=tokens)
 
 
-def on_fused_fire(program):
+def on_fused_fire(program, rounds=1):
     """A fused whole-step executable fired (ops/step_fusion.py): record
     its mesh label for the per-mesh SPMD histogram and auto-derive
-    FLOPs/step from the recorded cycle when nothing better is pinned."""
+    FLOPs/step from the recorded cycle when nothing better is pinned.
+    `rounds` is the micro-batch count of a super-cycle fire (grad
+    accumulation): one optimizer step spans rounds× the segment's
+    FLOPs. The derivation is memoized per program, so a later k change
+    keeps the first fire's estimate — bench legs pin exact FLOPs when
+    that matters."""
     if not _FLAGS.get("FLAGS_metrics"):
         return
     plan = getattr(program, "spmd_plan", None)
@@ -518,7 +523,7 @@ def on_fused_fire(program):
     # full dispatch keys (op name + input avals) live on its chain's ops
     chain = getattr(program, "chain", None)
     if chain is not None and getattr(chain, "ops", None):
-        entries = [("op", op.key) for op in chain.ops]
+        entries = [("op", op.key) for op in chain.ops] * max(1, rounds)
         if any(e[0] == "bwd" for e in getattr(program, "entries", ())):
             entries.append(("bwd", None))
         ACCOUNTANT.maybe_set_cycle_flops(entries,

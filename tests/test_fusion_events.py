@@ -9,8 +9,9 @@ Covers the PR 4 observability contract end to end:
     FLAGS_profiler_events is off;
   * the three fusion tiers emit their lifecycle (dispatch hit/miss/bypass,
     chain detect/fire/split, step promote/fire/split/record) with reason
-    attribution — dropout loops blame `rng_rekey`, masked attention and
-    nll_loss no longer bypass at all (PR 4 satellite);
+    attribution — dropout now PROMOTES (hoisted stream keys; only a
+    stateful key baked into a closure still blames `rng_rekey`), masked
+    attention and nll_loss no longer bypass at all (PR 4 satellite);
   * profiler/explain.py turns the timeline into the right verdicts;
   * Profiler windows auto-arm the recorder, export chrome traces with
     fusion lanes, and `load_profiler_result` round-trips them losslessly.
@@ -71,8 +72,16 @@ def _fresh():
     reset_step_fusion_stats()
 
 
-def _train_loop(steps, dropout_p=0.0, with_mask=False, b=4, d=16):
-    """Tiny fwd+bwd+SGD loop; optional dropout / masked attention."""
+def _train_loop(steps, dropout_p=0.0, with_mask=False, b=4, d=16,
+                legacy_rng=False):
+    """Tiny fwd+bwd+SGD loop; optional dropout / masked attention /
+    a deliberately STATEFUL-RNG op (a fresh key baked into its closure
+    every call — the shape the hoisted-key path retired, kept here as the
+    rng_rekey attribution fixture)."""
+    import jax
+    from paddle_tpu.framework.random import get_rng_key
+    from paddle_tpu.ops._helpers import unary
+
     rng = np.random.default_rng(3)
     x = paddle.to_tensor(rng.standard_normal((b, d)).astype(np.float32))
     w = paddle.to_tensor(rng.standard_normal((d, d)).astype(np.float32),
@@ -87,6 +96,10 @@ def _train_loop(steps, dropout_p=0.0, with_mask=False, b=4, d=16):
         h = F.gelu(paddle.add(paddle.matmul(x, w), bias))
         if dropout_p:
             h = F.dropout(h, dropout_p)
+        if legacy_rng:
+            noise = jax.random.normal(get_rng_key(), (b, d)) * 0.01
+            h = unary("legacy_noise", lambda v: v + noise.astype(v.dtype),
+                      h)
         if with_mask:
             q = manip.reshape(h, [1, b, 1, d])
             h = manip.reshape(
@@ -234,14 +247,33 @@ class TestLifecycleEvents:
                          "step.promote", "step.fire", "step.record"):
             assert cats.get(expected, 0) > 0, (expected, cats)
 
-    def test_dropout_blames_rng_rekey(self):
+    def test_dropout_promotes_with_hoisted_keys(self):
+        """Universal promotion: dropout keys on a hoisted stream
+        position now — zero rng_rekey poisons, zero dispatch bypasses,
+        and the cycle PROMOTES (the exact loop that used to be the
+        never-promotes fixture)."""
         set_flags({"FLAGS_profiler_events": True})
         clear_fusion_events()
         _train_loop(10, dropout_p=0.2)
         poisons = [e for e in fusion_events("step.record")
                    if e["reason"] == "rng_rekey"]
+        assert poisons == []
+        bypass_ops = [e["op"] for e in fusion_events("dispatch.bypass")]
+        assert "dropout" not in bypass_ops
+        cats = events_summary()["by_category"]
+        assert cats.get("step.promote", 0) >= 1
+        assert cats.get("step.fire", 0) >= 1
+
+    def test_stateful_rng_closure_blames_rng_rekey(self):
+        """The rng_rekey attribution survives for ops that still bake a
+        STATEFUL fresh key into their closure (the legacy shape)."""
+        set_flags({"FLAGS_profiler_events": True})
+        clear_fusion_events()
+        _train_loop(10, legacy_rng=True)
+        poisons = [e for e in fusion_events("step.record")
+                   if e["reason"] == "rng_rekey"]
         assert len(poisons) >= 8
-        assert {e["op"] for e in poisons} == {"dropout"}
+        assert {e["op"] for e in poisons} == {"legacy_noise"}
         assert events_summary()["by_category"].get("step.promote", 0) == 0
 
     def test_masked_attention_and_nll_do_not_bypass(self):
@@ -306,11 +338,11 @@ class TestExplain:
     def test_never_promoted_names_the_op(self):
         set_flags({"FLAGS_profiler_events": True})
         clear_fusion_events()
-        _train_loop(10, dropout_p=0.2)
+        _train_loop(10, legacy_rng=True)
         rep = explain()
         assert rep["verdict"] == "never_promoted"
         assert "rng_rekey" in rep["headline"]
-        assert "dropout" in rep["headline"]
+        assert "legacy_noise" in rep["headline"]
         text = format_report(rep)
         assert "never_promoted" in text and "rng_rekey" in text
 
@@ -380,7 +412,9 @@ class TestProfilerIntegration:
 
 class TestDoctorCLI:
     @pytest.mark.perf_smoke
-    def test_demo_dropout_names_rng_rekey(self):
+    def test_demo_dropout_promotes_cleanly(self):
+        """Universal promotion acceptance: the dropout GPT demo — the
+        historical rng_rekey fixture — now reports clean_promotion."""
         import subprocess
         import sys
         out = subprocess.run(
@@ -392,9 +426,28 @@ class TestDoctorCLI:
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert out.returncode == 0, out.stderr
         rep = json.loads(out.stdout)
-        assert rep["verdict"] == "never_promoted"
-        assert "rng_rekey" in rep["headline"]
-        assert "dropout" in rep["headline"]
+        assert rep["verdict"] == "clean_promotion", rep["headline"]
+
+    @pytest.mark.perf_smoke
+    def test_demo_accum_promotes_cleanly(self):
+        """Universal promotion acceptance: the k=4 grad-accumulation GPT
+        demo promotes as a super-cycle with no rng_rekey /
+        unpromotable_cycle findings."""
+        import subprocess
+        import sys
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                          "fusion_doctor.py"),
+             "--demo", "accum", "--steps", "12", "--json"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        rep = json.loads(out.stdout)
+        assert rep["verdict"] == "clean_promotion", rep["headline"]
+        text = json.dumps(rep)
+        assert "rng_rekey" not in text
+        assert "unpromotable_cycle" not in text
 
     @pytest.mark.perf_smoke
     def test_demo_masked_promotes_cleanly(self):

@@ -1,0 +1,198 @@
+"""RNG stream durability for universal promotion (framework/random.py).
+
+The global generator is a fold_in STREAM over a fixed base key: position i
+yields `fold_in(base, i)`, whether the key is drawn eagerly, materialized
+lazily by a transactional split, or derived IN-GRAPH by a promoted step's
+hoisted (base data, position) scalars. These tests pin the contract:
+
+  * derivation equivalence — `derive_key_data(base_data, i)` is bit-equal
+    to the eager draw at position i (the fused/eager parity bedrock);
+  * checkpoint exactness — `rng_checkpoint_state` round-trips (base,
+    position) so a restored run continues the interrupted stream
+    bit-for-bit;
+  * kill-9 durability (the PR 5 chaos pattern extended to hoisted keys):
+    a StepCheckpointer-ticked, PROMOTED dropout loop killed mid-run and
+    restored reproduces the uninterrupted run's loss trajectory — the
+    dropout masks after restore are the ones the unkilled run drew.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework import random as frandom
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.ops.dispatch import clear_dispatch_cache
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    clear_dispatch_cache()
+    yield
+    clear_dispatch_cache()
+
+
+class TestStreamContract:
+    def test_hoisted_derivation_matches_eager_draw(self):
+        """rng_key_input() reserves position i; in-graph derivation from
+        (base data, i) yields the SAME key data bit-for-bit."""
+        paddle.seed(123)
+        base_data = frandom.stream_base_data()
+        pos0 = frandom.default_generator.epoch
+        kd_tensor = frandom.rng_key_input()
+        assert kd_tensor._rng_epoch == pos0
+        derived = frandom.derive_key_data(base_data, pos0)
+        np.testing.assert_array_equal(np.asarray(kd_tensor._value),
+                                      np.asarray(derived))
+        # the traced form (an int32 scalar position) derives identically
+        traced = jax.jit(frandom.derive_key_data)(
+            base_data, np.int32(pos0))
+        np.testing.assert_array_equal(np.asarray(traced),
+                                      np.asarray(derived))
+
+    def test_lazy_key_answers_aval_without_deriving(self):
+        paddle.seed(0)
+        t = frandom.rng_key_input()
+        assert t._fusion_aval is not None      # keyable pre-derivation
+        shape, dtype, _ = t._fusion_aval
+        v = t._value                           # forces
+        assert tuple(v.shape) == tuple(shape) and v.dtype == dtype
+        assert t._fusion_aval is None          # materialized now
+
+    def test_checkpoint_roundtrip_resumes_stream(self):
+        paddle.seed(7)
+        _ = [frandom.get_rng_key() for _ in range(5)]
+        snap = frandom.rng_checkpoint_state()
+        a = [np.asarray(jax.random.key_data(frandom.get_rng_key()))
+             for _ in range(4)]
+        frandom.set_rng_checkpoint_state(snap)
+        b = [np.asarray(jax.random.key_data(frandom.get_rng_key()))
+             for _ in range(4)]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_hoisted_consumption_does_not_bump_legacy_epoch(self):
+        """Only STATEFUL draws feed the rng_rekey bypass heuristic;
+        hoisted consumption advances the stream, not the legacy count."""
+        paddle.seed(0)
+        leg0 = frandom.rng_epoch()
+        pos0 = frandom.default_generator.epoch
+        frandom.rng_key_input()
+        assert frandom.default_generator.epoch == pos0 + 1
+        assert frandom.rng_epoch() == leg0
+        frandom.get_rng_key()
+        assert frandom.rng_epoch() == leg0 + 1
+
+
+_CHILD = r"""
+import json, os, signal, sys
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.incubate.checkpoint import StepCheckpointer
+
+ck_dir, log_path, n_steps, kill_at = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+set_flags({"FLAGS_eager_op_cache": True,
+           "FLAGS_eager_chain_fusion": True,
+           "FLAGS_eager_chain_fusion_min_count": 3,
+           "FLAGS_eager_step_fusion": True,
+           "FLAGS_eager_step_fusion_min_count": 3})
+paddle.seed(42)
+model = paddle.nn.Linear(16, 16)
+rng = np.random.default_rng(5)
+x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+opt = paddle.optimizer.SGD(learning_rate=0.05,
+                           parameters=model.parameters())
+ck = StepCheckpointer(ck_dir, save_every_n_steps=1, run_id="rngchaos")
+start = ck.restore(model=model, optimizer=opt)
+for step in range(start + 1, n_steps):
+    y = F.dropout(F.gelu(model(x)), 0.3)
+    loss = y.sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    with open(log_path, "a") as f:
+        f.write(json.dumps({"step": step,
+                            "loss": float(loss.numpy())}) + "\n")
+    ck.tick(step, model=model, optimizer=opt)
+    if kill_at >= 0 and step == kill_at:
+        os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_child(ck_dir, log_path, n_steps, kill_at):
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, ck_dir, log_path, str(n_steps),
+         str(kill_at)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": _REPO_ROOT + os.pathsep
+             + os.environ.get("PYTHONPATH", "")})
+
+
+def _read_log(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            out[rec["step"]] = rec["loss"]
+    return out
+
+
+class TestKill9Durability:
+    def test_kill9_restore_reproduces_dropout_trajectory(self):
+        """SIGKILL mid-promoted-dropout-loop; the restored process
+        continues the SAME hoisted key stream: the union of pre-kill and
+        resumed losses matches the uninterrupted run step for step."""
+        n = 18
+        with tempfile.TemporaryDirectory() as tmp:
+            ref_log = os.path.join(tmp, "ref.jsonl")
+            r = _run_child(os.path.join(tmp, "ck_ref"), ref_log, n, -1)
+            assert r.returncode == 0, r.stderr[-800:]
+            ref = _read_log(ref_log)
+
+            ck = os.path.join(tmp, "ck_kill")
+            kill_log = os.path.join(tmp, "kill.jsonl")
+            r1 = _run_child(ck, kill_log, n, 11)
+            assert r1.returncode == -signal.SIGKILL, r1.stderr[-500:]
+            r2 = _run_child(ck, kill_log, n, -1)
+            assert r2.returncode == 0, r2.stderr[-800:]
+            got = _read_log(kill_log)
+        assert set(got) == set(ref)
+        for step in sorted(ref):
+            assert abs(got[step] - ref[step]) <= 1e-4 * abs(ref[step]) \
+                + 1e-6, (step, got[step], ref[step])
+
+
+class TestLegacyStateShapes:
+    def test_set_rng_state_accepts_every_historical_shape(self):
+        """Pre-stream get_rng_state() returned a bare [key]; every shape
+        — [(key, pos)], (key, pos), [key], bare key, [] — must restore
+        without crashing, and a bare key restarts its stream."""
+        paddle.seed(3)
+        st_new = frandom.get_rng_state()       # [(key, pos)]
+        a = paddle.rand([2]).numpy()
+        frandom.set_rng_state(st_new)
+        np.testing.assert_allclose(a, paddle.rand([2]).numpy())
+        frandom.set_rng_state(st_new[0])       # bare (key, pos) pair
+        np.testing.assert_allclose(a, paddle.rand([2]).numpy())
+        k = jax.random.key(3)
+        frandom.set_rng_state([k])             # legacy list-of-keys
+        b = paddle.rand([2]).numpy()
+        frandom.set_rng_state(k)               # bare key
+        np.testing.assert_allclose(b, paddle.rand([2]).numpy())
+        frandom.set_rng_state([])              # empty list: no crash
+        paddle.seed(3)

@@ -681,3 +681,98 @@ class TestPerfGuards:
         assert rep["verdict"] == "never_promoted"
         assert "collective_unkeyed" in rep["headline"]
         assert "dist.all_reduce" in rep["headline"]
+
+
+class TestSuperCycleSPMD:
+    """Universal promotion: a sharded k-micro-batch accumulation loop
+    promotes under the SPMD path — the sub-executable accumulates LOCAL
+    gradient sums with NO per-micro-batch collective, and the update
+    executable fires ONE fused pmean over the accumulated sums (k× less
+    gradient traffic), probation-validated against the bitwise eager
+    replay."""
+
+    def test_dp8_accum_promotes_with_parity(self):
+        xs, _ = _batches(60)
+        it = iter(xs)
+
+        def run(fused, shard):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            STEP.clear()
+            paddle.seed(0)
+            params = _mlp_params()
+            w1, b1, w2 = params
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.05, momentum=0.9, parameters=params)
+            losses = []
+            src = iter(xs)
+            for _ in range(14):
+                for _m in range(3):
+                    xv = next(src)
+                    x = paddle.Tensor(
+                        jax.device_put(xv, shard) if shard is not None
+                        else jnp.asarray(xv), stop_gradient=True)
+                    h = F.relu(paddle.add(paddle.matmul(x, w1), b1))
+                    loss = paddle.mean(
+                        paddle.multiply(paddle.matmul(h, w2),
+                                        paddle.matmul(h, w2)))
+                    loss.backward()
+                opt.step()
+                opt.clear_grad()
+                # post-step read: served from the sub-executable output
+                losses.append(float(loss.numpy()))
+            return np.asarray(losses), w1.numpy().copy()
+
+        base_l, base_w = run(False, None)
+        _, sharding = _dp_mesh()
+        clear_fusion_events()
+        fused_l, fused_w = run(True, sharding)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] == 1
+        assert s["fused_steps"] >= 8, s
+        assert s["fallback_splits"] == 0, s
+        promo = [e for e in fusion_events("step.promote")]
+        assert promo and promo[-1]["detail"]["spmd"] \
+            and promo[-1]["detail"]["super"], promo
+        prob = [e for e in fusion_events("step.record")
+                if e.get("detail", {}).get("kind") == "spmd_probation"]
+        assert prob and prob[-1]["detail"]["ok"], prob
+        np.testing.assert_allclose(fused_l, base_l, rtol=2e-3, atol=1e-5)
+        np.testing.assert_allclose(fused_w, base_w, rtol=2e-3, atol=1e-4)
+
+    def test_dp8_accum_zero_retraces_any_k(self):
+        """After the probation fire, k changes replay on the SAME two
+        shard_map executables — zero fresh retraces."""
+        _, sharding = _dp_mesh()
+        set_flags({"FLAGS_eager_step_fusion": True})
+        clear_dispatch_cache()
+        STEP.clear()
+        reset_step_fusion_stats()
+        paddle.seed(0)
+        params = _mlp_params()
+        w1, b1, w2 = params
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=params)
+        rng = np.random.default_rng(0)
+
+        def cycle(k):
+            for _ in range(k):
+                xv = rng.standard_normal((16, 32)).astype(np.float32)
+                x = paddle.Tensor(jax.device_put(xv, sharding),
+                                  stop_gradient=True)
+                h = F.relu(paddle.add(paddle.matmul(x, w1), b1))
+                loss = paddle.mean(paddle.matmul(h, w2))
+                loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        for _ in range(8):
+            cycle(2)
+        s0 = step_fusion_stats()
+        assert s0["steps_promoted"] == 1
+        assert s0["fused_steps"] >= 2, s0
+        for k in (4, 3, 6):
+            cycle(k)
+        s1 = step_fusion_stats()
+        assert s1["retraces"] == s0["retraces"], (s0["retraces"],
+                                                 s1["retraces"])
+        assert s1["fallback_splits"] == 0

@@ -494,3 +494,345 @@ class TestMicroBenchmark:
         assert max(ratios) >= 1.3, \
             f"fused step below 1.3x: {[round(r, 2) for r in ratios]}"
         assert step_fusion_stats()["fused_steps"] > 0
+
+
+def _dropout_cycle(x, w, b, opt, p=0.3):
+    y = F.dropout(F.gelu(paddle.add(paddle.matmul(x, w), b)), p)
+    loss = y.sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+class TestRNGHoisting:
+    """Universal promotion part (a): dropout>0 loops promote to ONE fused
+    executable — the PRNG key/epoch rides as hoisted device scalars and
+    every key derives in-graph, bit-identical to the eager stream."""
+
+    def test_dropout_promotes_with_parity(self):
+        """The dropout loop fuses, with fused-vs-eager trajectory parity
+        given the SAME seed (the key stream is bitwise shared; remaining
+        deltas are single-program layout noise)."""
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            paddle.seed(11)
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            return np.asarray([_dropout_cycle(x, w, b, opt)
+                               for _ in range(25)]), w.numpy().copy()
+
+        unfused, w0 = run(False)
+        fused, w1 = run(True)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] >= 1
+        assert s["fused_steps"] >= 15, s
+        assert s["fallback_splits"] == 0, s
+        np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-6)
+
+    def test_dropout_zero_steady_state_retraces(self):
+        paddle.seed(3)
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+        retraces_at = []
+        for _ in range(20):
+            _dropout_cycle(x, w, b, opt)
+            retraces_at.append(step_fusion_stats()["retraces"])
+        assert step_fusion_stats()["fused_steps"] >= 10
+        assert retraces_at[-1] == retraces_at[7], retraces_at
+
+    def test_dropout_split_is_bitwise(self):
+        """A mid-step peek in a dropout loop splits BITWISE: the lazy key
+        tensors materialize the exact stream keys the fused program would
+        have derived, so the per-op fallback samples identically."""
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            paddle.seed(5)
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            out = []
+            for _ in range(12):
+                y = F.dropout(F.gelu(paddle.add(paddle.matmul(x, w), b)),
+                              0.4)
+                loss = y.sum()
+                loss.backward()
+                peek = loss.numpy().copy()     # mid-step peek → split
+                opt.step()
+                opt.clear_grad()
+                out.append((peek, w.numpy().copy()))
+            return out
+
+        unfused = run(False)
+        fused = run(True)
+        assert step_fusion_stats()["fused_steps"] == 0
+        assert step_fusion_stats()["fallback_splits"] > 0
+        for u, f in zip(unfused, fused):
+            np.testing.assert_array_equal(u[0], f[0])
+            np.testing.assert_array_equal(u[1], f[1])
+
+    def test_mid_cycle_stateful_consumption_splits(self):
+        """An EXTRA stateful key drawn between the cycle's dropouts
+        shifts the recorded stream deltas: the replay must split
+        (rng_rekey), never silently sample from the wrong position."""
+        from paddle_tpu.framework.random import get_rng_key
+        paddle.seed(7)
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+        for _ in range(10):
+            _dropout_cycle(x, w, b, opt)
+        assert step_fusion_stats()["fused_steps"] >= 4
+        fired_before = step_fusion_stats()["fused_steps"]
+        y = F.dropout(paddle.matmul(x, w), 0.3)
+        get_rng_key()                       # interloper consumption
+        y2 = F.dropout(F.gelu(paddle.add(paddle.matmul(x, w), b)), 0.3)
+        loss = y2.sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # the shifted stream cannot have produced a fused fire for this
+        # cycle's recorded positions
+        s = step_fusion_stats()
+        assert s["fused_steps"] == fired_before \
+            or s["fallback_splits"] > 0
+
+    def test_checkpoint_resumes_stream_exactly(self):
+        """EpochRange-style snapshot/restore mid-promoted-dropout-loop:
+        the restored run reproduces the uninterrupted loss trajectory
+        EXACTLY — the hoisted stream is (base key, position), both
+        checkpointed."""
+        from paddle_tpu.framework import random as frandom
+
+        paddle.seed(21)
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w, b])
+        for _ in range(10):                 # promote and run fused
+            _dropout_cycle(x, w, b, opt)
+        assert step_fusion_stats()["fused_steps"] >= 4
+        rng_snap = frandom.rng_checkpoint_state()
+        w_snap, b_snap = w.numpy().copy(), b.numpy().copy()
+        tail_a = [_dropout_cycle(x, w, b, opt) for _ in range(6)]
+        # "restore": wind state back and replay — same stream, same losses
+        frandom.set_rng_checkpoint_state(rng_snap)
+        w._value = jnp.asarray(w_snap)
+        b._value = jnp.asarray(b_snap)
+        tail_b = [_dropout_cycle(x, w, b, opt) for _ in range(6)]
+        np.testing.assert_allclose(tail_a, tail_b, rtol=1e-6, atol=1e-7)
+
+
+class TestSuperCycle:
+    """Universal promotion part (b): k×(fwd+bwd)+step micro-batch
+    accumulation promotes to ≤2 executables (a reusable sub-executable +
+    one update executable), zero retraces at ANY k."""
+
+    def _accum_run(self, fused, n=18, k=4, kind="momentum", seed=0):
+        set_flags({"FLAGS_eager_step_fusion": fused})
+        clear_dispatch_cache()
+        paddle.seed(seed)
+        rng = np.random.default_rng(9)
+        xs = [paddle.to_tensor(
+            rng.standard_normal((8, 16)).astype(np.float32))
+            for _ in range(k)]
+        w = paddle.to_tensor(
+            rng.standard_normal((16, 16)).astype(np.float32),
+            stop_gradient=False)
+        b = paddle.to_tensor(rng.standard_normal(16).astype(np.float32),
+                             stop_gradient=False)
+        opt = _make_opt(kind, [w, b])
+        losses = []
+        for _ in range(n):
+            per = []
+            for m in range(k):
+                y = F.gelu(paddle.add(paddle.matmul(xs[m], w), b))
+                loss = y.sum()
+                loss.backward()
+                per.append(loss)
+            opt.step()
+            opt.clear_grad()
+            # post-step reads are served from the sub-executable outputs
+            losses.append([float(l.numpy()) for l in per])
+        return np.asarray(losses), w.numpy().copy()
+
+    @pytest.mark.parametrize("kind", ["sgd", "adam"])
+    def test_accum_parity(self, kind):
+        unfused, w0 = self._accum_run(False, kind=kind)
+        fused, w1 = self._accum_run(True, kind=kind)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] >= 1
+        assert s["fused_steps"] >= 10, s
+        assert s["fallback_splits"] == 0, s
+        np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+
+    def test_any_k_without_recompiling(self):
+        """After warmup at k=2, k=4/8/3 replay with ZERO fresh retraces
+        (the canonical signature is k-independent)."""
+        paddle.seed(0)
+        rng = np.random.default_rng(9)
+        x = paddle.to_tensor(
+            rng.standard_normal((8, 16)).astype(np.float32))
+        w = paddle.to_tensor(
+            rng.standard_normal((16, 16)).astype(np.float32),
+            stop_gradient=False)
+        b = paddle.to_tensor(rng.standard_normal(16).astype(np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+
+        def cycle(k):
+            for _ in range(k):
+                y = F.dropout(
+                    F.gelu(paddle.add(paddle.matmul(x, w), b)), 0.2)
+                y.sum().backward()
+            opt.step()
+            opt.clear_grad()
+
+        for _ in range(8):
+            cycle(2)
+        s0 = step_fusion_stats()
+        assert s0["steps_promoted"] == 1
+        # ≤2 executables: exactly one sub trace + one update trace
+        assert s0["retraces"] == 2, s0["retraces"]
+        for k in (4, 8, 3, 4):
+            cycle(k)
+        s1 = step_fusion_stats()
+        assert s1["retraces"] == s0["retraces"]
+        assert s1["fallback_splits"] == 0
+        assert s1["fused_steps"] - s0["fused_steps"] == 4
+
+    def test_mid_cycle_grad_peek_splits_bitwise(self):
+        """Reading p.grad between micro-batches escapes the pending
+        super-cycle: the replay runs every archived round's tape backward
+        eagerly — accumulated grads BITWISE match unfused dispatch."""
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            paddle.seed(2)
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            peeks = []
+            for _ in range(12):
+                for m in range(3):
+                    y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                    y.sum().backward()
+                    if m == 1:
+                        peeks.append(w.grad.numpy().copy())  # escape
+                opt.step()
+                opt.clear_grad()
+            return peeks, w.numpy().copy()
+
+        (pu, wu) = run(False)
+        (pf, wf) = run(True)
+        assert step_fusion_stats()["fused_steps"] == 0
+        for u, f in zip(pu, pf):
+            np.testing.assert_array_equal(u, f)
+        np.testing.assert_array_equal(wu, wf)
+
+    def test_guardian_skip_on_accumulated_grads(self):
+        """FLAGS_check_numerics: a NaN poisoning ONE micro-batch makes
+        the whole accumulated update a bitwise no-op — fused and eager
+        agree on params AND the skip accounting."""
+        from paddle_tpu.ops import guardian
+
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused,
+                       "FLAGS_check_numerics": True,
+                       "FLAGS_check_numerics_level": 1})
+            clear_dispatch_cache()
+            paddle.seed(4)
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            try:
+                for i in range(14):
+                    for m in range(3):
+                        y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                        loss = y.sum()
+                        if i == 10 and m == 1:
+                            loss = loss * paddle.to_tensor(
+                                np.float32("nan"))
+                        loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                guardian.flush()
+            finally:
+                set_flags({"FLAGS_check_numerics": False,
+                           "FLAGS_check_numerics_level": 0})
+            return w.numpy().copy(), b.numpy().copy()
+
+        wu, bu = run(False)
+        wf, bf = run(True)
+        s = step_fusion_stats()
+        assert s["fused_steps"] >= 6, s
+        np.testing.assert_allclose(wf, wu, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(bf, bu, rtol=1e-4, atol=1e-6)
+        assert np.isfinite(wf).all()
+
+    @pytest.mark.perf_smoke
+    def test_perf_smoke_dropout_and_accum_promote(self):
+        """perf_smoke mirror of tools/perf_smoke.py leg (m): the dropout
+        loop promotes with zero steady-state retraces; the k=4
+        accumulation loop runs ≤2 executables with zero retraces."""
+        paddle.seed(0)
+        x, w, b = _params()
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+        for _ in range(12):
+            _dropout_cycle(x, w, b, opt)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] == 1 and s["fused_steps"] >= 6
+        r0 = s["retraces"]
+        for _ in range(4):
+            _dropout_cycle(x, w, b, opt)
+        assert step_fusion_stats()["retraces"] == r0
+        # accumulation leg
+        clear_dispatch_cache()
+        reset_step_fusion_stats()
+        opt2 = paddle.optimizer.SGD(learning_rate=0.01,
+                                    parameters=[w, b])
+        for _ in range(10):
+            for m in range(4):
+                y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                y.sum().backward()
+            opt2.step()
+            opt2.clear_grad()
+        s = step_fusion_stats()
+        assert s["steps_promoted"] == 1
+        assert s["retraces"] == 2, s["retraces"]     # sub + update ONLY
+        assert s["fallback_splits"] == 0
+        assert s["fused_steps"] >= 4
+
+    def test_reseed_between_backward_and_step_stays_eager_exact(self):
+        """A reseed BETWEEN backward and step swaps the global base key
+        mid-cycle: the fused fire must derive this cycle's keys from the
+        base they were RESERVED against (what eager sampled), and the
+        next cycle re-anchors on the new base — trajectories match."""
+        def run(fused):
+            set_flags({"FLAGS_eager_step_fusion": fused})
+            clear_dispatch_cache()
+            paddle.seed(5)
+            x, w, b = _params()
+            opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=[w, b])
+            out = []
+            for i in range(16):
+                y = F.dropout(F.gelu(paddle.add(paddle.matmul(x, w), b)),
+                              0.4)
+                loss = y.sum()
+                loss.backward()
+                if i == 10:
+                    paddle.seed(777)       # mid-cycle reseed
+                opt.step()
+                opt.clear_grad()
+                out.append(float(loss.numpy()))
+            return np.asarray(out), w.numpy().copy()
+
+        unfused, wu = run(False)
+        fused, wf = run(True)
+        s = step_fusion_stats()
+        assert s["fused_steps"] >= 8, s
+        np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(wf, wu, rtol=1e-4, atol=1e-5)

@@ -7,10 +7,9 @@ report: which op poisoned the step cycle, with which reason code, how many
 times — e.g.
 
     verdict : never_promoted
-    headline: step never promoted: `dropout` rng_rekey ×40
+    headline: step never promoted: `dist.all_reduce` collective_unkeyed ×40
     findings:
-      - cycle poison rng_rekey ×40 (`dropout`×40) — the op consumes fresh
-        global randomness every call ...
+      - cycle poison collective_unkeyed ×40 ...
 
 Usage:
 
@@ -18,13 +17,18 @@ Usage:
     JAX_PLATFORMS=cpu python tools/fusion_doctor.py train.py -- --epochs 1
 
     # built-in demos (acceptance fixtures): a tiny GPT-ish loop
-    python tools/fusion_doctor.py --demo dropout   # never promotes: rng_rekey
+    python tools/fusion_doctor.py --demo dropout   # clean promotion: the
+                                                   # PRNG key is HOISTED
+                                                   # (rng_rekey is gone)
+    python tools/fusion_doctor.py --demo accum     # clean promotion of a
+                                                   # k=4 grad-accumulation
+                                                   # SUPER-cycle
     python tools/fusion_doctor.py --demo masked    # clean promotion
     python tools/fusion_doctor.py --demo dp        # never promotes:
                                                    # collective_unkeyed
 
     # machine-readable
-    python tools/fusion_doctor.py --demo dropout --json
+    python tools/fusion_doctor.py --demo accum --json
 
     # the persistent AOT executable store (ops/aot_cache.py): list
     # artifacts (kind, digest, size, age, fingerprint match, corruption),
@@ -54,9 +58,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 def _demo(variant, steps):
     """Tiny single-head GPT-ish loop (embedding → attention → [dropout] →
-    projection → cross_entropy → SGD). `dropout` never promotes (the
-    rng_rekey acceptance fixture); `masked` feeds an attention mask — now
-    a dispatch input — and promotes cleanly."""
+    projection → cross_entropy → SGD). `dropout` promotes CLEANLY since
+    the PRNG key became a hoisted stream position (the universal-promotion
+    acceptance fixture — it used to be the rng_rekey fixture); `masked`
+    feeds an attention mask — a dispatch input — and promotes cleanly;
+    `accum` runs the masked variant as a k=4 micro-batch gradient
+    accumulation loop that promotes as a SUPER-cycle (one reusable
+    fwd+bwd+accumulate sub-executable + one update executable, zero
+    steady-state retraces at any k)."""
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
@@ -71,8 +80,10 @@ def _demo(variant, steps):
     paddle.seed(0)
     rng = np.random.default_rng(0)
     B, T, D, V = 2, 8, 16, 32
-    ids = paddle.to_tensor(rng.integers(0, V, (B, T)))
-    labels = paddle.to_tensor(rng.integers(0, V, (B * T,)))
+    k_micro = 4 if variant == "accum" else 1
+    micro = [(paddle.to_tensor(rng.integers(0, V, (B, T))),
+              paddle.to_tensor(rng.integers(0, V, (B * T,))))
+             for _ in range(k_micro)]
     emb_w = paddle.to_tensor(
         (rng.standard_normal((V, D)) * 0.1).astype(np.float32),
         stop_gradient=False)
@@ -91,18 +102,19 @@ def _demo(variant, steps):
     opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=params)
 
     for _ in range(steps):
-        h = F.embedding(ids, emb_w)                       # [B, T, D]
-        q = manip.reshape(paddle.matmul(h, wq), [B, T, 1, D])
-        k = manip.reshape(paddle.matmul(h, wk), [B, T, 1, D])
-        v = manip.reshape(paddle.matmul(h, wv), [B, T, 1, D])
-        a = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=mask, is_causal=(mask is None))
-        h = paddle.matmul(manip.reshape(a, [B, T, D]), wo)
-        if variant == "dropout":
-            h = F.dropout(h, 0.1)
-        logits = manip.reshape(paddle.matmul(h, w_out), [B * T, V])
-        loss = F.cross_entropy(logits, labels)
-        loss.backward()
+        for ids, labels in micro:
+            h = F.embedding(ids, emb_w)                   # [B, T, D]
+            q = manip.reshape(paddle.matmul(h, wq), [B, T, 1, D])
+            k = manip.reshape(paddle.matmul(h, wk), [B, T, 1, D])
+            v = manip.reshape(paddle.matmul(h, wv), [B, T, 1, D])
+            a = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, is_causal=(mask is None))
+            h = paddle.matmul(manip.reshape(a, [B, T, D]), wo)
+            if variant in ("dropout", "accum"):
+                h = F.dropout(h, 0.1)
+            logits = manip.reshape(paddle.matmul(h, w_out), [B * T, V])
+            loss = F.cross_entropy(logits, labels)
+            loss.backward()
         opt.step()
         opt.clear_grad()
 
@@ -331,13 +343,16 @@ def main(argv=None) -> int:
                     help="training script to run under the recorder")
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script (after --)")
-    ap.add_argument("--demo", choices=("dropout", "masked", "serve", "dp",
-                                       "metrics"),
+    ap.add_argument("--demo", choices=("dropout", "masked", "accum",
+                                       "serve", "dp", "metrics"),
                     help="run a built-in tiny GPT-ish demo loop instead "
-                         "of a script (`serve`: a continuous-batching "
-                         "serving run over a tight KV pool; `dp`: a "
-                         "sharded data-parallel loop whose unkeyable "
-                         "grad collective blocks promotion — "
+                         "of a script (`dropout`: hoisted-key dropout "
+                         "promotes cleanly; `accum`: a k=4 grad-"
+                         "accumulation loop promotes as a super-cycle; "
+                         "`serve`: a continuous-batching serving run "
+                         "over a tight KV pool; `dp`: a sharded "
+                         "data-parallel loop whose unkeyable grad "
+                         "collective blocks promotion — "
                          "collective_unkeyed; `metrics`: the telemetry "
                          "plane armed over a promoting loop with an "
                          "injected guardian skip — live goodput/MFU)")

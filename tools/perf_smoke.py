@@ -1021,6 +1021,117 @@ def main() -> int:
                 f"(eager {t_dp_eager*1e6:.0f}us vs fused "
                 f"{t_dp_fused*1e6:.0f}us) (PR 10 regression)")
 
+    # ---- universal promotion leg (PR 14 guards) --------------------------
+    # (m) dropout>0 must promote with ZERO steady-state retraces (the
+    # hoisted-key path) and beat the chain tier like any promoted step;
+    # a k=4 micro-batch accumulation loop must run as a super-cycle —
+    # exactly TWO executables (one sub trace + one update trace), zero
+    # retraces at steady state, zero splits
+    import numpy as _np
+    import paddle_tpu as _pd
+    import paddle_tpu.nn.functional as _F
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache as _cdc
+    from paddle_tpu.profiler import reset_step_fusion_stats as _rsfs
+
+    def _drop_loop(step_fused):
+        set_flags({"FLAGS_eager_step_fusion": step_fused,
+                   "FLAGS_eager_step_fusion_min_count": 5})
+        _cdc()
+        _pd.seed(0)
+        _rng = _np.random.default_rng(0)
+        x = _pd.to_tensor(_rng.standard_normal((16, 32))
+                          .astype(_np.float32))
+        w = _pd.to_tensor(_rng.standard_normal((32, 32))
+                          .astype(_np.float32), stop_gradient=False)
+        b = _pd.to_tensor(_rng.standard_normal(32).astype(_np.float32),
+                          stop_gradient=False)
+        opt = _pd.optimizer.SGD(learning_rate=1e-3, parameters=[w, b])
+
+        def step():
+            y = _F.dropout(_F.gelu(_pd.add(_pd.matmul(x, w), b)), 0.2)
+            y.sum().backward()
+            opt.step()
+            opt.clear_grad()
+
+        step.sync = lambda: w._value.block_until_ready()
+        return step
+
+    drop_chain = _drop_loop(step_fused=False)
+    for _ in range(WARMUP):
+        drop_chain()
+    t_drop_chain = timed(drop_chain)
+    drop_step = _drop_loop(step_fused=True)
+    for _ in range(WARMUP):
+        drop_step()
+    s0 = step_fusion_stats()
+    t_drop_step = timed(drop_step)
+    s1 = step_fusion_stats()
+    drop_replays = min(s1["fused_steps"] - s0["fused_steps"], MEASURE)
+    drop_retraces = s1["retraces"] - s0["retraces"]
+    drop_speedup = t_drop_chain / t_drop_step if t_drop_step > 0 else 0.0
+    if drop_replays == 0:
+        failures.append(
+            "the dropout>0 loop never promoted (hoisted-key regression: "
+            f"promoted={s1['steps_promoted']}, "
+            f"splits={s1['fallback_splits']}) (PR 14)")
+    if drop_retraces:
+        failures.append(
+            f"{drop_retraces} post-warmup retrace(s) in the promoted "
+            "dropout step: the hoisted key is re-tracing (PR 14)")
+    if drop_replays and drop_speedup < STEP_SPEEDUP_GUARD:
+        failures.append(
+            f"promoted dropout step speedup {drop_speedup:.2f}x below "
+            f"the {STEP_SPEEDUP_GUARD}x guard (chain "
+            f"{t_drop_chain*1e6:.0f}us vs fused "
+            f"{t_drop_step*1e6:.0f}us) (PR 14)")
+
+    set_flags({"FLAGS_eager_step_fusion": True,
+               "FLAGS_eager_step_fusion_min_count": 5})
+    _cdc()
+    _rsfs()
+    _pd.seed(0)
+    _rng = _np.random.default_rng(0)
+    ax = _pd.to_tensor(_rng.standard_normal((16, 32)).astype(_np.float32))
+    aw = _pd.to_tensor(_rng.standard_normal((32, 32)).astype(_np.float32),
+                       stop_gradient=False)
+    ab = _pd.to_tensor(_rng.standard_normal(32).astype(_np.float32),
+                       stop_gradient=False)
+    aopt = _pd.optimizer.SGD(learning_rate=1e-3, parameters=[aw, ab])
+
+    def _accum_cycle(k=4):
+        for _ in range(k):
+            y = _F.gelu(_pd.add(_pd.matmul(ax, aw), ab))
+            y.sum().backward()
+        aopt.step()
+        aopt.clear_grad()
+
+    for _ in range(12):
+        _accum_cycle()
+    sa = step_fusion_stats()
+    accum_fused0 = sa["fused_steps"]
+    accum_retraces = sa["retraces"]
+    for _ in range(8):
+        _accum_cycle()
+    sb = step_fusion_stats()
+    if sa["steps_promoted"] != 1 or sb["fused_steps"] - accum_fused0 < 8:
+        failures.append(
+            "the k=4 accumulation loop did not promote as a super-cycle "
+            f"(promoted={sb['steps_promoted']}, "
+            f"fused={sb['fused_steps']}, splits={sb['fallback_splits']}) "
+            "(PR 14)")
+    if accum_retraces > 2:
+        failures.append(
+            f"the super-cycle compiled {accum_retraces} executables "
+            "(> 2: sub + update) (PR 14)")
+    if sb["retraces"] != accum_retraces:
+        failures.append(
+            f"{sb['retraces'] - accum_retraces} steady-state retrace(s) "
+            "in the super-cycle (PR 14)")
+    if sb["fallback_splits"]:
+        failures.append(
+            f"{sb['fallback_splits']} split(s) in the steady accumulation "
+            "loop (PR 14)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -1058,7 +1169,11 @@ def main() -> int:
           f"retraces={aot_warm['dispatch_retraces']}"
           f"+{aot_warm['step_retraces']}), "
           f"dp mesh={dp_mesh} speedup={dp_speedup:.2f}x "
-          f"(retraces={dp_retraces})")
+          f"(retraces={dp_retraces}), "
+          f"dropout fused={drop_replays}/{MEASURE} "
+          f"speedup={drop_speedup:.2f}x (retraces={drop_retraces}), "
+          f"accum super-cycle fused={sb['fused_steps']} "
+          f"executables={accum_retraces} splits={sb['fallback_splits']}")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
