@@ -1,0 +1,49 @@
+"""Promotion-safety static analyzer: the fusion linter.
+
+Every promotion-poisoning bug class this repo has shipped so far —
+unkeyable closure captures (PRs 3-4 threaded masks/labels/ids as dispatch
+inputs one at a time), stateful RNG outside the fold_in stream (PR 14),
+unkeyed collectives (PR 10), tracer leaks into the guardian queue, and
+host-sync peeks that split cycles — was discovered at RUNTIME by the
+flight recorder, usually after a whole PR of debugging. The reference
+stack gets the same guarantee from its static-graph compiler passes (PHI
+kernel registration + pass infrastructure); this package is the
+TPU-native, eager-first equivalent: an AST pass over the op/nn/serving
+layers that proves the promotion contracts hold at CI time, speaking the
+SAME `REASON_CODES` vocabulary the fusion doctor already speaks — a
+static finding and a runtime flight-recorder attribution are one
+taxonomy.
+
+Layout:
+
+  analyzer.py   shared AST machinery: project loading, scope/closure
+                resolution (free-variable computation + a light taint
+                pass classifying names as Tensor/array/scalar), dispatch
+                call-site discovery, the Finding record
+  rules/        one module per rule (R1-R6), registered via @rule
+  baseline.py   checked-in suppression file (add / match / expire)
+  report.py     findings as {rule, file:line, reason_code, hint} dicts,
+                JSON schema + text rendering, contract validation
+                against the live REASON_CODES / REASON_HINTS
+
+CLI: ``python tools/fusion_lint.py [--json] [--baseline] [--fix-hints]``
+— non-zero exit on unsuppressed findings; wired into tier-1 via
+tests/test_fusion_lint.py. `fusion_doctor --lint` cross-references
+runtime split reasons with static findings ("this rng_rekey split was
+statically predicted at ops/random_ops.py:NN").
+"""
+from .analyzer import Finding, Project, load_project, run_rules, RULE_DOCS
+from .baseline import Baseline
+from .report import (findings_to_dicts, render_text, render_json,
+                     validate_findings)
+
+__all__ = ["Finding", "Project", "load_project", "run_rules", "RULE_DOCS",
+           "Baseline", "findings_to_dicts", "render_text", "render_json",
+           "validate_findings", "analyze"]
+
+
+def analyze(root=None, paths=None, rules=None):
+    """One-call convenience: load the project and run the rule set.
+    Returns a sorted list of Finding records."""
+    project = load_project(root=root, paths=paths)
+    return run_rules(project, rules=rules)

@@ -1,0 +1,114 @@
+"""Baseline suppressions for the fusion linter.
+
+A suppression acknowledges a KNOWN, commented finding without hiding the
+rule: the linter still sees the violation, the baseline just stops it
+from failing CI. Keys are (rule, file, symbol) — line numbers drift with
+every edit above them, so a suppression pinned to the enclosing function
+qualname survives refactors that do not move the offending code between
+functions.
+
+Baseline hygiene is two-sided and both sides are tested:
+
+  * `match` — a finding covered by an entry is suppressed;
+  * `stale` — an entry matching NO current finding is expired (the bug
+    it acknowledged was fixed); `fusion_lint --baseline` prints expired
+    entries so the file never accumulates dead weight, and
+    `--write-baseline` regenerates it from the live findings.
+
+File format: JSON with a mandatory human `note` per entry — a
+suppression without a recorded justification is how "temporary" becomes
+"forever".
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["Baseline", "DEFAULT_BASELINE"]
+
+# the checked-in repo baseline (tools/fusion_lint.py --baseline default)
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tools", "fusion_lint_baseline.json")
+
+_VERSION = 1
+
+
+@dataclass
+class Baseline:
+    entries: list = field(default_factory=list)   # [dict]
+
+    # -- persistence --------------------------------------------------------
+    @classmethod
+    def load(cls, path):
+        if not os.path.exists(path):
+            return cls()
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != _VERSION:
+            raise ValueError(
+                f"baseline {path}: unsupported version "
+                f"{data.get('version')!r} (expected {_VERSION})")
+        return cls(entries=list(data.get("suppressions") or []))
+
+    def save(self, path):
+        data = {"version": _VERSION, "suppressions": self.entries}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    # -- editing ------------------------------------------------------------
+    def add(self, finding, note=""):
+        """Suppress one finding (idempotent)."""
+        entry = {"rule": finding.rule, "file": finding.file,
+                 "symbol": finding.symbol,
+                 "reason_code": finding.reason_code,
+                 "note": note or "suppressed without justification "
+                                 "(fill me in)"}
+        key = (entry["rule"], entry["file"], entry["symbol"])
+        for e in self.entries:
+            if (e.get("rule"), e.get("file"), e.get("symbol")) == key:
+                return e
+        self.entries.append(entry)
+        return entry
+
+    # -- matching -----------------------------------------------------------
+    def _covers(self, entry, finding):
+        if entry.get("rule") != finding.rule \
+                or entry.get("file") != finding.file:
+            return False
+        sym = entry.get("symbol", "")
+        return sym == "*" or sym == finding.symbol
+
+    def match(self, finding):
+        """The entry suppressing `finding`, or None."""
+        for e in self.entries:
+            if self._covers(e, finding):
+                return e
+        return None
+
+    def split(self, findings):
+        """(unsuppressed, suppressed) partition of `findings`."""
+        live, muted = [], []
+        for f in findings:
+            (muted if self.match(f) else live).append(f)
+        return live, muted
+
+    def stale(self, findings):
+        """Entries that cover NO current finding — expired suppressions
+        whose underlying violation was fixed; prune them."""
+        out = []
+        for e in self.entries:
+            if not any(self._covers(e, f) for f in findings):
+                out.append(e)
+        return out
+
+    def expire(self, findings):
+        """Drop stale entries in place; returns the removed entries."""
+        dead = self.stale(findings)
+        if dead:
+            self.entries = [e for e in self.entries if e not in dead]
+        return dead

@@ -1,0 +1,104 @@
+"""Finding rendering + contract validation for the fusion linter.
+
+The JSON shape is a small public contract of its own (the CI gate and
+`fusion_doctor --lint` both consume it; tests/test_fusion_lint.py
+freezes the schema):
+
+  {
+    "version": 1,
+    "findings": [{"rule", "file", "line", "symbol", "reason_code",
+                  "message", "hint"}],
+    "suppressed": [...same shape...],
+    "stale_suppressions": [baseline entries],
+    "rules": {"R1": {"title", "reason_code", "hint"}, ...},
+    "summary": {"findings": N, "suppressed": N, "by_rule": {...}}
+  }
+
+Every finding's reason_code is validated against the LIVE
+REASON_CODES / REASON_HINTS contracts (profiler/events.py,
+profiler/explain.py) — a static finding and a runtime flight-recorder
+attribution must remain one taxonomy, so a rule emitting an off-contract
+code is itself a hard error.
+"""
+from __future__ import annotations
+
+import json
+
+from .analyzer import RULE_DOCS
+
+__all__ = ["findings_to_dicts", "render_text", "render_json",
+           "validate_findings", "REPORT_VERSION"]
+
+REPORT_VERSION = 1
+
+
+def _rule_hint(rule_id):
+    doc = RULE_DOCS.get(rule_id) or {}
+    return doc.get("hint", "")
+
+
+def findings_to_dicts(findings):
+    return [{"rule": f.rule, "file": f.file, "line": f.line,
+             "symbol": f.symbol, "reason_code": f.reason_code,
+             "message": f.message, "hint": _rule_hint(f.rule)}
+            for f in findings]
+
+
+def validate_findings(findings):
+    """Every finding must carry a valid REASON_CODES entry that also has
+    a REASON_HINTS doctor hint. Returns the offending codes (empty =
+    valid); the CLI treats a non-empty answer as an internal error."""
+    from ..profiler.events import REASON_CODES
+    from ..profiler.explain import REASON_HINTS
+    bad = sorted({f.reason_code for f in findings
+                  if f.reason_code not in REASON_CODES
+                  or f.reason_code not in REASON_HINTS})
+    return bad
+
+
+def render_json(findings, suppressed=(), stale=(), indent=2):
+    from . import rules  # ensure RULE_DOCS is populated
+    _ = rules
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    doc = {
+        "version": REPORT_VERSION,
+        "findings": findings_to_dicts(findings),
+        "suppressed": findings_to_dicts(suppressed),
+        "stale_suppressions": list(stale),
+        "rules": dict(sorted(RULE_DOCS.items())),
+        "summary": {"findings": len(findings),
+                    "suppressed": len(suppressed),
+                    "by_rule": dict(sorted(by_rule.items()))},
+    }
+    return json.dumps(doc, indent=indent)
+
+
+def render_text(findings, suppressed=(), stale=(), fix_hints=False):
+    lines = []
+    for f in findings:
+        lines.append(f"{f.file}:{f.line}: {f.rule} [{f.reason_code}] "
+                     f"{f.message}"
+                     + (f"  (in `{f.symbol}`)" if f.symbol else ""))
+        if fix_hints:
+            hint = _rule_hint(f.rule)
+            if hint:
+                lines.append(f"    fix: {hint}")
+    if suppressed:
+        lines.append(f"{len(suppressed)} finding(s) suppressed by "
+                     "baseline:")
+        for f in suppressed:
+            lines.append(f"  - {f.file}:{f.line}: {f.rule} {f.message}")
+    for e in stale:
+        lines.append(
+            f"STALE suppression ({e.get('rule')} {e.get('file')} "
+            f"`{e.get('symbol')}`): no matching finding — the violation "
+            "was fixed; remove the entry (or --write-baseline)")
+    n = len(findings)
+    lines.append(f"fusion_lint: {n} unsuppressed finding(s)"
+                 + (f", {len(suppressed)} suppressed" if suppressed
+                    else "")
+                 + (f", {len(stale)} stale suppression(s)" if stale
+                    else ""))
+    return "\n".join(lines)

@@ -1,0 +1,33 @@
+"""Rule registry: one module per rule, registered via the @rule
+decorator at import. Each rule is a singleton with:
+
+  id           "R1".."R6"
+  title        short human name
+  reason_code  the REASON_CODES entry its findings carry (static findings
+               and runtime flight-recorder attributions are ONE taxonomy)
+  hint         the actionable fix, rendered by `fusion_lint --fix-hints`
+  run(project) -> iterable of Finding
+"""
+from ..analyzer import RULE_DOCS
+
+RULES = []
+
+
+def rule(cls):
+    inst = cls()
+    RULES.append(inst)
+    RULES.sort(key=lambda r: r.id)
+    RULE_DOCS[inst.id] = {"title": inst.title,
+                          "reason_code": inst.reason_code,
+                          "hint": inst.hint}
+    return cls
+
+
+from . import r1_unkeyable_closure   # noqa: E402,F401
+from . import r2_stateful_rng        # noqa: E402,F401
+from . import r3_host_sync           # noqa: E402,F401
+from . import r4_unkeyed_collective  # noqa: E402,F401
+from . import r5_contract_coverage   # noqa: E402,F401
+from . import r6_lock_discipline     # noqa: E402,F401
+
+__all__ = ["RULES", "rule"]

@@ -1,0 +1,86 @@
+"""R1 unkeyable-closure: an op fn passed into the dispatch funnel
+captures a Tensor / raw array (or reads module-level mutable state) that
+never enters the dispatch-input list.
+
+This is the PR 3/4 bug class verbatim: embedding ids, cross_entropy
+labels, and attention masks were baked into op closures one at a time,
+each silently poisoning every training cycle as `unkeyable_closure`
+until the flight recorder caught it at runtime. Statically, the
+signature is exact: diff the fn's free variables against the wrapper's
+dispatch args; any capture with Tensor/array taint that is not also a
+dispatch input cannot be value-keyed by `_fn_token`
+(ops/dispatch.py) and will bypass the executable cache on every call.
+
+Scalars, shapes, dtypes and module-level functions key by value and are
+fine to capture — the taint pass (analyzer.TaintPass) only classifies
+the assignment forms the corpus actually uses, so an unknown name is
+never flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..analyzer import (Finding, TaintPass, dispatch_sites, free_loads,
+                        qualname_of)
+from . import rule
+
+
+@rule
+class UnkeyableClosure:
+    id = "R1"
+    title = "unkeyable closure capture"
+    reason_code = "unkeyable_closure"
+    hint = ("thread the captured Tensor/array through the op's dispatch "
+            "inputs (the embedding-ids / cross_entropy-labels / "
+            "attention-mask fix of PRs 3-4): the value becomes part of "
+            "the cache key's avals and the op keys on structure instead "
+            "of bypassing on every call")
+
+    def run(self, project):
+        for module in project.modules:
+            parents = module.parents()
+            mutable_globals = _mutable_globals(module.tree)
+            for site in dispatch_sites(module):
+                if site.fn_node is None:
+                    continue
+                enclosing = site.enclosing
+                if not hasattr(enclosing, "body") or \
+                        not isinstance(enclosing.body, list):
+                    continue
+                taint = TaintPass(enclosing)
+                captured = free_loads(site.fn_node)
+                for name, line in sorted(captured.items()):
+                    if name in site.input_names:
+                        continue
+                    t = taint.of(name)
+                    if t in ("tensor", "array"):
+                        yield Finding(
+                            rule=self.id, file=module.rel, line=line,
+                            reason_code=self.reason_code,
+                            message=(f"op `{site.op_name or '?'}` fn "
+                                     f"captures {t} `{name}` that is not "
+                                     "a dispatch input"),
+                            symbol=qualname_of(site.call, parents))
+                    elif name in mutable_globals \
+                            and name not in site.input_names:
+                        yield Finding(
+                            rule=self.id, file=module.rel, line=line,
+                            reason_code=self.reason_code,
+                            message=(f"op `{site.op_name or '?'}` fn "
+                                     f"reads mutable module global "
+                                     f"`{name}` (dict/list/set state "
+                                     "cannot be value-keyed)"),
+                            symbol=qualname_of(site.call, parents))
+
+
+def _mutable_globals(tree):
+    """Module-level names assigned a dict/list/set display — mutable
+    state an op fn must not read (the `_globals_token` bypass class)."""
+    out = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, (ast.Dict, ast.List, ast.Set)):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out

@@ -1,0 +1,66 @@
+"""R2 stateful-rng: a registered op body draws from the global generator
+(`get_rng_key()` / `split_key()` / `default_generator.next_key()`)
+instead of reserving a hoisted stream position via
+`framework/random.rng_key_input()`.
+
+A stateful draw bakes a FRESH key into the op's closure on every call:
+the op re-keys per call (`rng_rekey`), bypasses the executable cache,
+and poisons every fusion cycle containing it — the exact bug class PR 14
+closed for dropout/bernoulli by making randomness a fold_in STREAM whose
+position rides as a lazy dispatch input. This rule freezes that win: any
+`@register_op` body that still calls into the stateful generator is
+flagged at CI time instead of being rediscovered by the flight recorder.
+
+Scope is the registered op corpus. Init-time consumers
+(nn/initializer.py), the distribution library, and jit tracing scopes
+(jit/train_step.py threads a traced key by design) draw statefully on
+purpose and are not op bodies.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..analyzer import (Finding, call_name, decorator_op_name, dotted_name,
+                        qualname_of)
+from . import rule
+
+_STATEFUL_CALLS = {"get_rng_key", "split_key"}
+
+
+@rule
+class StatefulRng:
+    id = "R2"
+    title = "stateful RNG in op body"
+    reason_code = "rng_rekey"
+    hint = ("reserve a stream position with framework/random."
+            "rng_key_input() and pass the lazy key tensor as a dispatch "
+            "input (the op wraps it back with jax.random.wrap_key_data "
+            "inside its fn, deriving the SAME fold_in(base, i) key bits "
+            "as the stateful draw) — the dropout/bernoulli pattern of "
+            "PR 14; the op then keys on structure and promotes")
+
+    def run(self, project):
+        for module in project.modules:
+            parents = module.parents()
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.FunctionDef):
+                    continue
+                op = decorator_op_name(node)
+                if op is None:
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = call_name(sub)
+                    dn = dotted_name(sub.func) or ""
+                    if name in _STATEFUL_CALLS \
+                            or dn.endswith("default_generator.next_key"):
+                        yield Finding(
+                            rule=self.id, file=module.rel,
+                            line=sub.lineno,
+                            reason_code=self.reason_code,
+                            message=(f"op `{op}` draws stateful global "
+                                     f"randomness via `{name or dn}()` — "
+                                     "bypasses rng_key_input() stream "
+                                     "hoisting"),
+                            symbol=qualname_of(sub, parents))

@@ -1,0 +1,105 @@
+"""R3 host-sync-in-hot-path: a dispatch-funnel wrapper forces a device
+value to the host (`.numpy()`, `.item()`, `float()/int()/bool()` on a
+Tensor, `np.asarray(tensor)`) on its way to `call_op`.
+
+Inside a fused replay, every live Tensor may be a pending placeholder; a
+host-forcing read materializes it and SPLITS the chain/step
+(`mid_chain_escape` / `mid_step_peek` at runtime). PR 4 fixed exactly
+this in the attention wrapper — eligibility peeks now read aval-safe
+`Tensor.shape` / `_fusion_aval` metadata instead of forcing `_value`.
+This rule pins the pattern: any function that dispatches through the
+funnel must not force tensor values first.
+
+The receiver must have Tensor taint (`x = ensure_tensor(x)` and
+friends); host syncs on plain scalars/ndarray helpers outside funnel
+wrappers are not the hot path and stay unflagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..analyzer import (Finding, TaintPass, call_name, dispatch_sites,
+                        qualname_of)
+from . import rule
+
+_FORCING_METHODS = {"numpy", "item"}
+_FORCING_BUILTINS = {"float", "int", "bool"}
+
+
+@rule
+class HostSyncInHotPath:
+    id = "R3"
+    title = "host sync in dispatch hot path"
+    reason_code = "mid_step_peek"
+    hint = ("read shape/dtype through aval-safe metadata (Tensor.shape, "
+            "ops/_helpers.jnp_dtype, _fusion_aval) instead of forcing "
+            "the value, or move the host read after dispatch — a forced "
+            "`.numpy()`/`.item()`/float() materializes pending fused "
+            "placeholders and splits the chain/step it sits in (the "
+            "PR 4 attention-eligibility fix)")
+
+    def run(self, project):
+        for module in project.modules:
+            parents = module.parents()
+            funnel_fns = {}
+            for site in dispatch_sites(module):
+                if hasattr(site.enclosing, "body") and \
+                        isinstance(site.enclosing.body, list):
+                    funnel_fns[id(site.enclosing)] = site.enclosing
+            for fn in funnel_fns.values():
+                taint = TaintPass(fn)
+                for f in self._scan(fn, module, taint, parents):
+                    yield f
+
+    def _scan(self, fn, module, taint, parents):
+        for stmt in fn.body:
+            yield from self._scan_stmt(stmt, module, taint, parents)
+
+    def _scan_stmt(self, stmt, module, taint, parents):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return      # the inner op fn runs in-graph, not on the host
+        for node in _walk_pruned(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            recv = None
+            if name in _FORCING_METHODS and \
+                    isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+            elif name in _FORCING_BUILTINS and isinstance(
+                    node.func, ast.Name) and len(node.args) == 1:
+                recv = node.args[0]
+            elif name == "asarray" and isinstance(
+                    node.func, ast.Attribute) and node.args:
+                base = node.func.value
+                if isinstance(base, ast.Name) \
+                        and base.id in ("np", "numpy"):
+                    recv = node.args[0]
+                else:
+                    continue
+            else:
+                continue
+            t = taint.taint_of(recv) if recv is not None else None
+            if t == "tensor":
+                yield Finding(
+                    rule=self.id, file=module.rel, line=node.lineno,
+                    reason_code=self.reason_code,
+                    message=(f"`{name}()` forces a Tensor value inside "
+                             "a dispatch-funnel wrapper — splits any "
+                             "pending fused chain/step"),
+                    symbol=qualname_of(node, parents))
+
+
+def _walk_pruned(stmt):
+    """ast.walk that does NOT descend into nested def/lambda bodies —
+    those run in-graph at trace time, not on the host path."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)

@@ -1,0 +1,127 @@
+"""R4 unkeyed-collective: a process-group collective call that is not
+stamped with `dispatch.mark_collective` before entering the funnel.
+
+A collective's fn closes over a compiled process-group callable —
+unkeyable by the closure scan — but its identity is fully determined by
+(kind, reduce-op, mesh key). PR 10 made `mark_collective` stamp that
+identity onto the fn so `_fn_token` keys it before any closure walk; a
+pg call that reaches dispatch WITHOUT the stamp (or never reaches
+dispatch at all) is the `collective_unkeyed` bug class: it bypasses the
+cache and poisons every training cycle containing it.
+
+Detection, matching the distributed/collective.py idiom: a data-plane
+pg call (`pg.all_reduce(...)`, `pg.gather_all(...)`, ...) is clean only
+when it sits inside a fn/lambda that flows through a MARKING funnel — a
+local function that itself calls `mark_collective` (e.g.
+`_dispatch_collective`) — or when `mark_collective` is applied in the
+same scope. Anything else is flagged; deliberate host-mediated paths
+(object gathers) are suppressed in the checked-in baseline, not hidden
+from the rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..analyzer import (Finding, call_name, enclosing_function,
+                        qualname_of)
+from . import rule
+
+# the data-plane collective surface (host-mediated p2p stays
+# control-plane by design and is exempt)
+_PG_KINDS = {"all_reduce", "all_gather", "gather_all", "broadcast",
+             "reduce_scatter", "alltoall", "alltoall_single", "scatter",
+             "reduce"}
+
+
+@rule
+class UnkeyedCollective:
+    id = "R4"
+    title = "collective without mark_collective"
+    reason_code = "collective_unkeyed"
+    hint = ("route the pg call through a funnel that stamps "
+            "dispatch.mark_collective((kind, op, mesh_key)) on the fn "
+            "(the _dispatch_collective pattern of PR 10) so the "
+            "collective keys by (kind, reduce-op, mesh) — or, for a "
+            "group with no mesh-backed pg, dispatch the explicit "
+            "collective_unkeyed marker so the poison is attributed "
+            "instead of silent")
+
+    def run(self, project):
+        for module in project.modules:
+            if "/distributed/" not in "/" + module.rel and \
+                    not module.rel.startswith("distributed/"):
+                continue
+            parents = module.parents()
+            marking = _marking_functions(module.tree)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if call_name(node) not in _PG_KINDS:
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                if not _pg_receiver(node.func.value):
+                    continue
+                if _flows_through_marker(node, parents, marking):
+                    continue
+                yield Finding(
+                    rule=self.id, file=module.rel, line=node.lineno,
+                    reason_code=self.reason_code,
+                    message=(f"pg collective `{call_name(node)}` is not "
+                             "stamped with dispatch.mark_collective — "
+                             "unkeyable in the funnel"),
+                    symbol=qualname_of(node, parents))
+
+
+def _pg_receiver(node):
+    """True when the call receiver is a process group: a name containing
+    "pg", or an attribute chain ending in .pg (group.pg, self.pg)."""
+    if isinstance(node, ast.Name):
+        return node.id == "pg" or node.id.endswith("_pg")
+    if isinstance(node, ast.Attribute):
+        return node.attr == "pg"
+    return False
+
+
+def _marking_functions(tree):
+    """Names of module/local functions that call mark_collective — the
+    marking funnels a pg-fn may flow through."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and call_name(sub) == "mark_collective":
+                    out.add(node.name)
+                    break
+    return out
+
+
+def _flows_through_marker(node, parents, marking):
+    """The pg call is inside a def/lambda that is (a) an argument to a
+    marking-funnel call, (b) itself a marking function, or (c) passed to
+    mark_collective in the enclosing scope."""
+    fn = enclosing_function(node, parents)
+    while fn is not None:
+        if isinstance(fn, ast.FunctionDef) and fn.name in marking:
+            return True
+        parent = parents.get(fn)
+        if isinstance(parent, ast.Call):
+            callee = call_name(parent)
+            if callee in marking or callee == "mark_collective":
+                return True
+        if isinstance(fn, ast.FunctionDef):
+            # `def fn(...)` then `mark_collective(fn, key)` later in the
+            # same scope
+            outer = enclosing_function(fn, parents)
+            scope_body = getattr(outer, "body", None) or []
+            for stmt in scope_body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and call_name(sub) == "mark_collective" \
+                            and sub.args \
+                            and isinstance(sub.args[0], ast.Name) \
+                            and sub.args[0].id == fn.name:
+                        return True
+        fn = enclosing_function(fn, parents)
+    return False

@@ -1,0 +1,223 @@
+"""R5 contract-coverage: the public observability contracts must stay
+closed under extension.
+
+The repo's taxonomy lives in four frozen surfaces: `REASON_CODES` /
+`CATEGORIES` (profiler/events.py), `REASON_HINTS` (profiler/explain.py),
+`METRIC_NAMES` / `METRIC_MERGE` (profiler/metrics.py), and the
+`define_flag` registry (framework/flags.py). Every PR so far extended
+one of them; the failure mode is drift — a reason code without a doctor
+hint, a metric without a fleet merge policy, an emitted event category
+off the contract, a `FLAGS_*` read that was never registered (a typo'd
+flag silently reads None forever). Each drift is invisible at runtime
+until a doctor report renders a bare code or a fleet merge guesses a
+policy.
+
+All checks are purely static (AST literal extraction), so the rule runs
+on fixture trees exactly like the real one:
+
+  * every REASON_CODES entry has a REASON_HINTS entry (and vice versa);
+  * every METRIC_NAMES entry has a METRIC_MERGE policy (and vice versa);
+  * every literal category passed to `*.emit(...)` is in CATEGORIES;
+  * every literal reason passed to `*.emit(...)` is in REASON_CODES;
+  * every `FLAGS_*` string literal used outside the registry is defined
+    by a `define_flag` call;
+  * every literal metric name registered via `.counter/.gauge/
+    .histogram(...)` inside the package is in METRIC_NAMES.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..analyzer import Finding, call_name, qualname_of
+from . import rule
+
+_FLAG_RE = re.compile(r"^FLAGS_[A-Za-z0-9_]+$")
+_METRIC_REGISTERERS = {"counter", "gauge", "histogram"}
+
+
+@rule
+class ContractCoverage:
+    id = "R5"
+    title = "observability contract drift"
+    reason_code = "contract_drift"
+    hint = ("keep the taxonomy closed: add the missing REASON_HINTS / "
+            "METRIC_MERGE / CATEGORIES / define_flag entry next to the "
+            "code that introduced the new name, and update the "
+            "contract-freeze tests (tests/test_fusion_events.py, "
+            "tests/test_metrics.py) deliberately")
+
+    def run(self, project):
+        sets = {}        # name -> (set, module, line)
+        maps = {}        # name -> (keys, module, line)
+        flags = {}       # flag -> line  (define_flag registry)
+        flags_file = None
+        for module in project.modules:
+            for stmt in ast.walk(module.tree):
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    name = stmt.targets[0].id
+                    if name in ("REASON_CODES", "CATEGORIES",
+                                "METRIC_NAMES"):
+                        vals = _frozenset_strings(stmt.value)
+                        if vals is not None:
+                            sets[name] = (vals, module, stmt.lineno)
+                    elif name in ("REASON_HINTS", "METRIC_MERGE"):
+                        keys = _dict_string_keys(stmt.value)
+                        if keys is not None:
+                            maps[name] = (keys, module, stmt.lineno)
+                elif isinstance(stmt, ast.Call) \
+                        and call_name(stmt) == "define_flag" \
+                        and stmt.args \
+                        and isinstance(stmt.args[0], ast.Constant) \
+                        and isinstance(stmt.args[0].value, str):
+                    flags[stmt.args[0].value] = stmt.lineno
+                    flags_file = module.rel
+
+        # -- set/map pairings -----------------------------------------------
+        yield from self._pair(sets, maps, "REASON_CODES", "REASON_HINTS",
+                              "doctor hint (REASON_HINTS)")
+        yield from self._pair(sets, maps, "METRIC_NAMES", "METRIC_MERGE",
+                              "fleet merge policy (METRIC_MERGE)")
+
+        codes = sets.get("REASON_CODES", (frozenset(), None, 0))[0]
+        cats = sets.get("CATEGORIES", (frozenset(), None, 0))[0]
+        metric_names = sets.get("METRIC_NAMES", (frozenset(), None, 0))[0]
+
+        # -- per-module literal checks --------------------------------------
+        for module in project.modules:
+            if module.rel == flags_file:
+                continue
+            parents = None
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name == "emit" and node.args:
+                    parents = parents or module.parents()
+                    yield from self._check_emit(node, module, parents,
+                                                cats, codes)
+                elif name in _METRIC_REGISTERERS and metric_names \
+                        and not module.rel.startswith("tools/") \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and isinstance(node.func, ast.Attribute):
+                    mn = node.args[0].value
+                    if mn not in metric_names:
+                        parents = parents or module.parents()
+                        yield Finding(
+                            rule=self.id, file=module.rel,
+                            line=node.lineno,
+                            reason_code=self.reason_code,
+                            message=(f"metric `{mn}` registered off the "
+                                     "METRIC_NAMES contract"),
+                            symbol=qualname_of(node, parents))
+            if flags:
+                yield from self._check_flags(module, flags)
+
+    # -- helpers ------------------------------------------------------------
+    def _pair(self, sets, maps, set_name, map_name, what):
+        if set_name not in sets or map_name not in maps:
+            return
+        vals, mod, line = sets[set_name]
+        keys, mmod, mline = maps[map_name]
+        for missing in sorted(vals - keys):
+            yield Finding(
+                rule=self.id, file=mod.rel, line=line,
+                reason_code=self.reason_code,
+                message=f"{set_name} entry `{missing}` has no {what}",
+                symbol=set_name)
+        for stale in sorted(keys - vals):
+            yield Finding(
+                rule=self.id, file=mmod.rel, line=mline,
+                reason_code=self.reason_code,
+                message=(f"{map_name} entry `{stale}` is not in "
+                         f"{set_name} (stale or typo)"),
+                symbol=map_name)
+
+    def _check_emit(self, node, module, parents, cats, codes):
+        cat = node.args[0]
+        if cats and isinstance(cat, ast.Constant) \
+                and isinstance(cat.value, str) and "." in cat.value \
+                and cat.value not in cats:
+            yield Finding(
+                rule=self.id, file=module.rel, line=node.lineno,
+                reason_code=self.reason_code,
+                message=(f"event category `{cat.value}` emitted off the "
+                         "CATEGORIES contract"),
+                symbol=qualname_of(node, parents))
+        reason = None
+        if len(node.args) >= 4:
+            reason = node.args[3]
+        for kw in node.keywords or ():
+            if kw.arg == "reason":
+                reason = kw.value
+        if codes and isinstance(reason, ast.Constant) \
+                and isinstance(reason.value, str) \
+                and reason.value not in codes:
+            yield Finding(
+                rule=self.id, file=module.rel, line=node.lineno,
+                reason_code=self.reason_code,
+                message=(f"reason `{reason.value}` emitted off the "
+                         "REASON_CODES contract"),
+                symbol=qualname_of(node, parents))
+
+    def _check_flags(self, module, flags):
+        parents = None
+        docstrings = _docstring_nodes(module.tree)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _FLAG_RE.match(node.value) \
+                    and id(node) not in docstrings \
+                    and node.value not in flags:
+                parents = parents or module.parents()
+                yield Finding(
+                    rule=self.id, file=module.rel, line=node.lineno,
+                    reason_code=self.reason_code,
+                    message=(f"`{node.value}` read/written but never "
+                             "registered via define_flag"),
+                    symbol=qualname_of(node, parents))
+
+
+def _frozenset_strings(node):
+    """{"a", "b"} out of `frozenset({...})` / a bare set literal."""
+    if isinstance(node, ast.Call) and call_name(node) == "frozenset" \
+            and node.args:
+        node = node.args[0]
+    if isinstance(node, ast.Set):
+        vals = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                vals.add(el.value)
+            else:
+                return None
+        return frozenset(vals)
+    return None
+
+
+def _dict_string_keys(node):
+    if isinstance(node, ast.Dict):
+        keys = set()
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+            else:
+                return None
+        return frozenset(keys)
+    return None
+
+
+def _docstring_nodes(tree):
+    """id()s of Constant nodes in docstring position (module / class /
+    def first statement) — prose mentioning FLAGS_* is not a read."""
+    out = set()
+    for node in ast.walk(tree):
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body \
+                and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Constant) \
+                and isinstance(body[0].value.value, str):
+            out.add(id(body[0].value))
+    return out

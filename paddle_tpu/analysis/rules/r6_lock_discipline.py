@@ -1,0 +1,165 @@
+"""R6 lock-discipline: in the serving engine and the telemetry plane, no
+blocking I/O or callback invocation while holding a registry/scheduler
+lock, and a consistent lock acquisition order.
+
+These are the race classes the chaos harness can only SAMPLE: a
+`time.sleep`/socket read under the metrics registry lock turns a 100 Hz
+scrape into a convoyed decode step; an `on_token` user callback invoked
+under a scheduler lock can re-enter `cancel()` and deadlock; two
+functions taking the same pair of locks in opposite orders deadlock once
+per blue moon under load. The scan is scoped to the modules where a held
+lock sits on the serving/telemetry hot path: `paddle_tpu/serving/`,
+`profiler/metrics.py`, `profiler/goodput.py`,
+`profiler/telemetry_server.py` (fixtures ride along via a
+`serving/`-named directory).
+
+Lock identity is the attribute/name spelled at the `with` site (any
+name containing "lock"); acquisition order is tracked per module as
+(outer, inner) edges — an edge pair in both directions is an inversion.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..analyzer import (Finding, call_name, dotted_name, qualname_of)
+from . import rule
+
+# calls that block (or can block unboundedly) — forbidden under a lock
+_BLOCKING_NAMES = {"sleep", "open", "print", "urlopen", "input",
+                   "block_until_ready"}
+_BLOCKING_DOTTED_HEADS = {"subprocess", "os.system", "os.popen",
+                          "shutil", "urllib"}
+# invoking user/observer code under a lock: re-entrancy + unbounded time
+_CALLBACK_CONTAINERS = ("callback", "collector", "hook", "listener",
+                        "waiter", "observer")
+
+
+def _in_scope(rel):
+    return ("/serving/" in "/" + rel or rel.startswith("serving/")
+            or rel.endswith(("profiler/metrics.py", "profiler/goodput.py",
+                             "profiler/telemetry_server.py")))
+
+
+def _lock_token(expr):
+    """"_lock" out of `self._lock` / `_cache_lock` / `reg._ring_lock` —
+    None when the with-item is not a lock."""
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        return None     # lock() factories / helpers: not a held lock name
+    if name and "lock" in name.lower():
+        return name
+    return None
+
+
+@rule
+class LockDiscipline:
+    id = "R6"
+    title = "blocking work / inversion under lock"
+    reason_code = "lock_discipline"
+    hint = ("move the blocking call / callback invocation outside the "
+            "`with lock:` block (snapshot under the lock, act after "
+            "release — the registry collector pattern), and keep one "
+            "global lock acquisition order; a scrape or user callback "
+            "must never run while a registry/scheduler lock is held")
+
+    def run(self, project):
+        for module in project.modules:
+            if not _in_scope(module.rel):
+                continue
+            parents = module.parents()
+            edges = {}            # (outer, inner) -> (line, symbol)
+            findings = []
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.With):
+                    continue
+                tokens = [t for t in
+                          (_lock_token(i.context_expr)
+                           for i in node.items) if t]
+                if not tokens:
+                    continue
+                held = tokens[0]
+                findings.extend(
+                    self._scan_body(node, module, parents, held, edges))
+            # inversion: both (a, b) and (b, a) acquired somewhere in the
+            # module — report at the LATER edge (stable, deterministic)
+            for (a, b), (line, sym) in sorted(edges.items(),
+                                              key=lambda kv: kv[1][0]):
+                if (b, a) in edges and edges[(b, a)][0] < line:
+                    findings.append(Finding(
+                        rule=self.id, file=module.rel, line=line,
+                        reason_code=self.reason_code,
+                        message=(f"lock order inversion: `{a}` -> `{b}` "
+                                 f"here, but `{b}` -> `{a}` at line "
+                                 f"{edges[(b, a)][0]}"),
+                        symbol=sym))
+            yield from findings
+
+    def _scan_body(self, with_node, module, parents, held, edges):
+        callback_vars = set()
+        for stmt in with_node.body:
+            for node in _walk_pruned(stmt):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        inner = _lock_token(item.context_expr)
+                        if inner and inner != held:
+                            edges.setdefault(
+                                (held, inner),
+                                (node.lineno,
+                                 qualname_of(node, parents)))
+                if isinstance(node, ast.For):
+                    src = dotted_name(node.iter) or ""
+                    if any(c in src.lower()
+                           for c in _CALLBACK_CONTAINERS) \
+                            and isinstance(node.target, ast.Name):
+                        callback_vars.add(node.target.id)
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node) or ""
+                dn = dotted_name(node.func) or ""
+                head = dn.split(".")[0]
+                if name in _BLOCKING_NAMES \
+                        or head in _BLOCKING_DOTTED_HEADS \
+                        or dn.startswith(("subprocess.", "urllib.")):
+                    yield Finding(
+                        rule=self.id, file=module.rel, line=node.lineno,
+                        reason_code=self.reason_code,
+                        message=(f"blocking call `{name or dn}()` while "
+                                 f"holding `{held}`"),
+                        symbol=qualname_of(node, parents))
+                elif _is_callback_invocation(node, callback_vars):
+                    yield Finding(
+                        rule=self.id, file=module.rel, line=node.lineno,
+                        reason_code=self.reason_code,
+                        message=(f"callback `{name}()` invoked while "
+                                 f"holding `{held}` (re-entrancy / "
+                                 "unbounded hold time)"),
+                        symbol=qualname_of(node, parents))
+
+
+def _is_callback_invocation(node, callback_vars):
+    name = call_name(node) or ""
+    if isinstance(node.func, ast.Name) and node.func.id in callback_vars:
+        return True
+    low = name.lower()
+    if low.startswith("on_"):
+        return True
+    return any(c in low for c in _CALLBACK_CONTAINERS) \
+        and not low.startswith(("_run",))
+
+
+def _walk_pruned(stmt):
+    """Descend without entering nested def/lambda bodies (deferred
+    execution does not run under the lock)."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)

@@ -22,7 +22,7 @@ from ....nn.layer_base import Layer
 from ....nn.initializer_util import materialize_parameter
 from ....nn import initializer as I
 from ....nn import functional as F
-from ....ops._helpers import ensure_tensor, call_op
+from ....ops._helpers import ensure_tensor, call_op, const_input
 from ...mesh import get_global_mesh
 from .mp_ops import _c_identity, _mp_allreduce, _c_concat, in_spmd_axis
 
@@ -68,12 +68,12 @@ class VocabParallelEmbedding(Layer):
         if not in_spmd_axis():
             return F.embedding(x, self.weight)
         x = ensure_tensor(x)
-        ids = x._value.astype(jnp.int32)
 
-        def fn(w_local):
+        def fn(w_local, ids):
             # inside shard_map the weight is this rank's vocab slice
             # [V/n, D] (same contract as Column/RowParallelLinear): rank i
             # owns rows [i*vshard, (i+1)*vshard)
+            ids = ids.astype(jnp.int32)
             idx = jax.lax.axis_index("model")
             vshard = w_local.shape[0]
             local = ids - idx * vshard
@@ -82,7 +82,10 @@ class VocabParallelEmbedding(Layer):
             out = jnp.take(w_local, safe, axis=0)
             out = jnp.where(in_range[..., None], out, jnp.zeros_like(out))
             return jax.lax.psum(out, "model")
-        return call_op("c_embedding", fn, (ensure_tensor(self.weight),))
+        # ids ride as a dispatch input (the PR 3 embedding fix): a
+        # captured id array would re-key the op on every batch
+        return call_op("c_embedding", fn,
+                       (ensure_tensor(self.weight), const_input(x)))
 
 
 class ColumnParallelLinear(Layer):
@@ -178,9 +181,9 @@ class ParallelCrossEntropy(Layer):
             from ....nn.functional.loss import cross_entropy
             return cross_entropy(input, label, reduction="none",
                                  ignore_index=self.ignore_index)
-        lab_v = label._value
+        ignore_index = self.ignore_index
 
-        def fn(logits):
+        def fn(logits, lab_v):
             # shard-local logits: [.., V/mp]; global softmax via psum
             n = axis_size("model")
             idx = jax.lax.axis_index("model")
@@ -205,9 +208,10 @@ class ParallelCrossEntropy(Layer):
             loss = jnp.log(denom[..., 0]) - picked
             # parity with the dense path: ignored labels contribute 0 loss
             # (and therefore 0 gradient — loss is constant in logits there)
-            return jnp.where(lab == self.ignore_index,
+            return jnp.where(lab == ignore_index,
                              jnp.zeros_like(loss), loss)
-        return call_op("parallel_cross_entropy", fn, (input,))
+        return call_op("parallel_cross_entropy", fn,
+                       (input, const_input(label)))
 
 
 class RNGStatesTracker:

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
-from ..ops._helpers import ensure_tensor, call_op
+from ..ops._helpers import ensure_tensor, call_op, const_input
 
 __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
@@ -51,35 +51,35 @@ def _segment(name, data, ids, pool, num):
 
 
 def segment_sum(data, segment_ids, name=None):
-    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    data, ids = ensure_tensor(data), const_input(segment_ids)
     num = _num_segments(ids._value, None)
     return call_op("segment_sum",
-                   lambda d: _segment("segment_sum", d, ids._value, "sum", num),
-                   (data,))
+                   lambda d, iv: _segment("segment_sum", d, iv, "sum", num),
+                   (data, ids))
 
 
 def segment_mean(data, segment_ids, name=None):
-    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    data, ids = ensure_tensor(data), const_input(segment_ids)
     num = _num_segments(ids._value, None)
     return call_op("segment_mean",
-                   lambda d: _segment("segment_mean", d, ids._value, "mean",
-                                      num), (data,))
+                   lambda d, iv: _segment("segment_mean", d, iv, "mean",
+                                          num), (data, ids))
 
 
 def segment_max(data, segment_ids, name=None):
-    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    data, ids = ensure_tensor(data), const_input(segment_ids)
     num = _num_segments(ids._value, None)
     return call_op("segment_max",
-                   lambda d: _segment("segment_max", d, ids._value, "max",
-                                      num), (data,))
+                   lambda d, iv: _segment("segment_max", d, iv, "max",
+                                          num), (data, ids))
 
 
 def segment_min(data, segment_ids, name=None):
-    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    data, ids = ensure_tensor(data), const_input(segment_ids)
     num = _num_segments(ids._value, None)
     return call_op("segment_min",
-                   lambda d: _segment("segment_min", d, ids._value, "min",
-                                      num), (data,))
+                   lambda d, iv: _segment("segment_min", d, iv, "min",
+                                          num), (data, ids))
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
@@ -87,14 +87,14 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     """Gather x[src] and segment-reduce onto dst. Reference analog:
     geometric/message_passing/send_recv.py send_u_recv (graph_send_recv op)."""
     x = ensure_tensor(x)
-    src = ensure_tensor(src_index)._value
-    dst = ensure_tensor(dst_index)._value
+    src_t, dst_t = const_input(src_index), const_input(dst_index)
+    dst = dst_t._value
     num = _num_segments(dst, out_size) if out_size is not None else \
         max(_num_segments(dst, None), x.shape[0])
 
-    def fn(v):
-        return _segment("send_u_recv", v[src], dst, reduce_op, num)
-    return call_op("send_u_recv", fn, (x,))
+    def fn(v, si, di):
+        return _segment("send_u_recv", v[si], di, reduce_op, num)
+    return call_op("send_u_recv", fn, (x, src_t, dst_t))
 
 
 def send_ue_recv(x, y, src_index, dst_index, message_op="add",
@@ -102,31 +102,30 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     """Combine node features x[src] with edge features y, then reduce onto
     dst. Reference analog: send_ue_recv (graph_send_ue_recv op)."""
     x, y = ensure_tensor(x), ensure_tensor(y)
-    src = ensure_tensor(src_index)._value
-    dst = ensure_tensor(dst_index)._value
+    src_t, dst_t = const_input(src_index), const_input(dst_index)
+    dst = dst_t._value
     num = _num_segments(dst, out_size) if out_size is not None else \
         max(_num_segments(dst, None), x.shape[0])
     ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
            "div": jnp.divide}
 
-    def fn(v, e):
-        msg = ops[message_op](v[src], e)
-        return _segment("send_ue_recv", msg, dst, reduce_op, num)
-    return call_op("send_ue_recv", fn, (x, y))
+    def fn(v, e, si, di):
+        msg = ops[message_op](v[si], e)
+        return _segment("send_ue_recv", msg, di, reduce_op, num)
+    return call_op("send_ue_recv", fn, (x, y, src_t, dst_t))
 
 
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     """Per-edge message from src features x and dst features y.
     Reference analog: send_uv (graph_send_uv op)."""
     x, y = ensure_tensor(x), ensure_tensor(y)
-    src = ensure_tensor(src_index)._value
-    dst = ensure_tensor(dst_index)._value
+    src_t, dst_t = const_input(src_index), const_input(dst_index)
     ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
            "div": jnp.divide}
 
-    def fn(v, w):
-        return ops[message_op](v[src], w[dst])
-    return call_op("send_uv", fn, (x, y))
+    def fn(v, w, si, di):
+        return ops[message_op](v[si], w[di])
+    return call_op("send_uv", fn, (x, y, src_t, dst_t))
 
 
 def _reindex_impl(x_np, nbrs, cnts):

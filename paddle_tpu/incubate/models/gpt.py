@@ -135,9 +135,9 @@ class GPTAttention(Layer):
         K/V at `pos`, attend over positions <= pos. All shapes static, so
         XLA compiles ONE program for the whole decode loop."""
         k_buf, v_buf, pos = cache
-        posv = pos._value
+        head_dim = self.head_dim
 
-        def fn(qv, kv, vv, kbv, vbv):
+        def fn(qv, kv, vv, kbv, vbv, posv):
             z = jnp.asarray(0, jnp.int32)   # match index dtypes under x64
             start = (z, posv.astype(jnp.int32), z, z)
             kbv = jax.lax.dynamic_update_slice(kbv, kv.astype(kbv.dtype),
@@ -149,7 +149,7 @@ class GPTAttention(Layer):
             qh = jnp.transpose(qv, (0, 2, 1, 3))
             kh = jnp.transpose(kbv, (0, 2, 3, 1))
             scores = jnp.einsum("bhnd,bhdt->bhnt", qh, kh) \
-                / jnp.sqrt(jnp.asarray(self.head_dim, qv.dtype))
+                / jnp.sqrt(jnp.asarray(head_dim, qv.dtype))
             # row r of this chunk sits at absolute position pos+r and may
             # attend to every position <= pos+r (causal within the chunk)
             n_in = qv.shape[1]
@@ -162,11 +162,13 @@ class GPTAttention(Layer):
             out = jnp.einsum("bhnt,bhtd->bhnd", probs, vh)
             return jnp.transpose(out, (0, 2, 1, 3)), kbv, vbv
 
-        from ...ops._helpers import call_op_multi, ensure_tensor
+        from ...ops._helpers import call_op_multi, ensure_tensor, const_input
+        # the write position rides as a dispatch input: a captured
+        # per-step position array would re-key the op on every token
         out, new_k, new_v = call_op_multi(
             "gpt_decode_attention", fn,
             (ensure_tensor(q), ensure_tensor(k), ensure_tensor(v),
-             k_buf, v_buf), num_outputs=3)
+             k_buf, v_buf, const_input(pos)), num_outputs=3)
         out = manip.reshape(out, [b, n, self.hidden_size])
         out = self.out_proj(out)
         return out, (new_k, new_v, pos)

@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...framework.core import Tensor
-from ...ops._helpers import ensure_tensor, call_op
+from ...ops._helpers import ensure_tensor, call_op, const_input
 from ...kernels import fused_ln as _fused_ln
 from ...kernels import cross_entropy as _fused_ce
 from ...ops.math import matmul as _matmul
@@ -47,21 +47,20 @@ def fused_bias_dropout_residual_layer_norm(
         return F.layer_norm(h + residual, [d], weight=scale_t, bias=shift_t,
                             epsilon=ln_epsilon)
 
-    ones = jnp.ones((d,), jnp.float32)
-    zeros = jnp.zeros((d,), jnp.float32)
     args = [x, residual]
-    b_val = bias_t._value if bias_t is not None else zeros
-    s_val = scale_t._value if scale_t is not None else ones
-    sh_val = shift_t._value if shift_t is not None else zeros
+    has_b, has_s, has_sh = (bias_t is not None, scale_t is not None,
+                            shift_t is not None)
 
     def fn(xv, rv, *rest):
         lead = xv.shape[:-1]
         x2 = xv.reshape(-1, d)
         r2 = rv.reshape(-1, d)
         vals = list(rest)
-        bb = vals.pop(0) if bias_t is not None else b_val
-        sc = vals.pop(0) if scale_t is not None else s_val
-        sh = vals.pop(0) if shift_t is not None else sh_val
+        # absent affine terms are trace-time constants built in-graph —
+        # capturing prebuilt arrays would make the op un-keyable (R1)
+        bb = vals.pop(0) if has_b else jnp.zeros((d,), jnp.float32)
+        sc = vals.pop(0) if has_s else jnp.ones((d,), jnp.float32)
+        sh = vals.pop(0) if has_sh else jnp.zeros((d,), jnp.float32)
         out = _fused_ln.fused_bias_residual_layer_norm(
             x2, r2, bb, sc, sh, ln_epsilon)
         return out.reshape(lead + (d,))
@@ -86,12 +85,14 @@ def fused_softmax_cross_entropy(logits, label, ignore_index=-100,
     lab_v = label._value
 
     if _fused_ce.is_eligible(logits._value, lab_v, force=True):
-        def fn(lg):
-            lab_idx = jnp.clip(lab_v, 0, lg.shape[1] - 1).astype(jnp.int32)
+        lab_in = const_input(label)
+
+        def fn(lg, lv):
+            lab_idx = jnp.clip(lv, 0, lg.shape[1] - 1).astype(jnp.int32)
             nll = _fused_ce.fused_softmax_cross_entropy(lg, lab_idx)
-            return _fused_ce.masked_reduce(nll, lab_v, ignore_index,
+            return _fused_ce.masked_reduce(nll, lv, ignore_index,
                                            reduction)
-        return call_op("fused_softmax_cross_entropy", fn, (logits,))
+        return call_op("fused_softmax_cross_entropy", fn, (logits, lab_in))
 
     from ...nn.functional import cross_entropy
     return cross_entropy(logits, label, ignore_index=ignore_index,

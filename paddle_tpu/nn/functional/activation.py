@@ -200,11 +200,16 @@ def log_sigmoid(x, name=None):
 
 @register_op("gumbel_softmax", "activation")
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
-    from ...framework.random import get_rng_key
+    # the gumbel noise samples in-graph from a hoisted stream position
+    # (same fold_in key bits as the old stateful draw) — the op keys on
+    # structure and promotes instead of re-keying every call (rng_rekey)
+    from ...framework.random import rng_key_input
     x = ensure_tensor(x)
-    g = jax.random.gumbel(get_rng_key(), x._value.shape, jnp.float32)
+    kd = rng_key_input()
 
-    def fn(v):
+    def fn(v, key_data):
+        g = jax.random.gumbel(jax.random.wrap_key_data(key_data),
+                              v.shape, jnp.float32)
         y = jax.nn.softmax((v + g.astype(v.dtype)) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
@@ -214,22 +219,27 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
                                         inplace=False)
             y = onehot + y - jax.lax.stop_gradient(y)
         return y
-    return unary("gumbel_softmax", fn, x)
+    return call_op("gumbel_softmax", fn, (x, kd))
 
 
 @register_op("rrelu", "activation")
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
-    from ...framework.random import get_rng_key
     x = ensure_tensor(x)
     if training:
-        a = jax.random.uniform(get_rng_key(), x._value.shape, jnp.float32,
-                               lower, upper)
-    else:
-        a = (lower + upper) / 2.0
+        # training-mode slopes sample in-graph from a hoisted stream
+        # position (bit-identical to the old stateful draw)
+        from ...framework.random import rng_key_input
+        kd = rng_key_input()
+
+        def fn(v, key_data):
+            a = jax.random.uniform(jax.random.wrap_key_data(key_data),
+                                   v.shape, jnp.float32, lower, upper)
+            return jnp.where(v >= 0, v, a.astype(v.dtype) * v)
+        return call_op("rrelu", fn, (x, kd))
+    mid = (lower + upper) / 2.0
 
     def fn(v):
-        slope = a.astype(v.dtype) if hasattr(a, "astype") else a
-        return jnp.where(v >= 0, v, slope * v)
+        return jnp.where(v >= 0, v, mid * v)
     return unary("rrelu", fn, x)
 
 

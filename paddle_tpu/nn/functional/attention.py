@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor
-from ...ops._helpers import ensure_tensor, call_op
+from ...ops._helpers import ensure_tensor, call_op, const_input
 from ...ops.registry import register_op
 
 __all__ = ["scaled_dot_product_attention"]
@@ -330,10 +330,12 @@ def sparse_attention(query, key, value, sparse_csr_offset,
                 s, e = off[b, h, m], off[b, h, m + 1]
                 col_tab[b, h, m, :e - s] = cols[b, h, s:e]
                 val_tab[b, h, m, :e - s] = True
-    col_j = jnp.asarray(col_tab)
-    valid = jnp.asarray(val_tab)
+    # the block tables ride as dispatch inputs: captured arrays would
+    # re-key the op per call even though the layout is config-derived
+    col_t = const_input(col_tab)
+    val_t = const_input(val_tab)
 
-    def fn(qv, kv, vv):
+    def fn(qv, kv, vv, col_j, valid):
         scale = 1.0 / math.sqrt(D)
         kg = jnp.take_along_axis(kv[:, :, None], col_j[..., None], axis=3)
         scores = jnp.einsum("bhmd,bhmwd->bhmw", qv, kg) * scale
@@ -343,7 +345,7 @@ def sparse_attention(query, key, value, sparse_csr_offset,
         vg = jnp.take_along_axis(vv[:, :, None], col_j[..., None], axis=3)
         return jnp.einsum("bhmw,bhmwd->bhmd", p, vg)
 
-    return call_op("sparse_attention", fn, (q, k, v))
+    return call_op("sparse_attention", fn, (q, k, v, col_t, val_t))
 
 
 __all__ += ["sparse_attention"]

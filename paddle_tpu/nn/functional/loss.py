@@ -7,7 +7,8 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor
-from ...ops._helpers import ensure_tensor, unary, binary, nary, call_op
+from ...ops._helpers import ensure_tensor, unary, binary, nary, call_op, \
+    const_input
 from ...ops.registry import register_op
 
 __all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
@@ -298,25 +299,26 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 def dice_loss(input, label, epsilon=1e-5, name=None):
     input = ensure_tensor(input)
-    label = ensure_tensor(label)
-    lab_v = label._value
+    # the label rides as a dispatch input (the nll_loss/cross_entropy
+    # pattern): a closure-captured label array would re-key every call
+    lab = const_input(label)
 
-    def fn(p):
-        y = jax.nn.one_hot(lab_v.squeeze(-1), p.shape[-1], dtype=p.dtype)
+    def fn(p, lv):
+        y = jax.nn.one_hot(lv.squeeze(-1), p.shape[-1], dtype=p.dtype)
         reduce_dims = tuple(range(1, p.ndim))
         inter = 2.0 * jnp.sum(p * y, axis=reduce_dims)
         union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y, axis=reduce_dims)
         return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
-    return call_op("dice_loss", fn, (input,))
+    return call_op("dice_loss", fn, (input, lab))
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
     anchor = ensure_tensor(anchor)
     positive = ensure_tensor(positive)
-    labels = ensure_tensor(labels)
-    lab = labels._value.reshape(-1)
+    lab_t = const_input(labels)
 
-    def fn(a, p):
+    def fn(a, p, lv):
+        lab = lv.reshape(-1)
         batch = a.shape[0]
         sim = a @ p.T
         same = (lab[:, None] == lab[None, :]).astype(a.dtype)
@@ -326,19 +328,18 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
                         jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
         return ce + reg
-    return call_op("npair_loss", fn, (anchor, positive))
+    return call_op("npair_loss", fn, (anchor, positive, lab_t))
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC via dynamic-programming forward algorithm (lax.scan over time)."""
     log_probs = ensure_tensor(log_probs)     # [T, B, C] (paddle layout)
-    labels = ensure_tensor(labels)           # [B, L]
-    in_len = ensure_tensor(input_lengths)._value
-    lab_len = ensure_tensor(label_lengths)._value
-    lab = labels._value
+    lab_t = const_input(labels)              # [B, L]
+    in_len_t = const_input(input_lengths)
+    lab_len_t = const_input(label_lengths)
 
-    def fn(lp):
+    def fn(lp, lab, lab_len, in_len):
         lp = jax.nn.log_softmax(lp, axis=-1)
         T, B, C = lp.shape
         L = lab.shape[1]
@@ -387,7 +388,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         if reduction == "sum":
             return jnp.sum(loss)
         return loss
-    return call_op("ctc_loss", fn, (log_probs,))
+    return call_op("ctc_loss", fn, (log_probs, lab_t, lab_len_t, in_len_t))
 
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
@@ -481,12 +482,15 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         args.append(ensure_tensor(bias))
 
     if path_table is not None:
-        pt = ensure_tensor(path_table)
-        pc = ensure_tensor(path_code)
+        pt = const_input(path_table)
+        pc = const_input(path_code)
+        has_bias = bias is not None
 
-        def fn(x, y, w, *b):
-            tbl = pt._value
-            code = pc._value.astype(jnp.float32)
+        def fn(x, y, w, *rest):
+            it = iter(rest)
+            bv = next(it) if has_bias else None
+            tbl = next(it)
+            code = next(it).astype(jnp.float32)
             rows = tbl[y.astype(jnp.int32)] if tbl.ndim == 2 and \
                 tbl.shape[0] != y.shape[0] else tbl
             codes = code[y.astype(jnp.int32)] if code.ndim == 2 and \
@@ -495,15 +499,15 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
             safe = jnp.where(valid, rows, 0).astype(jnp.int32)
             wv = w[safe]                       # [B, L, D]
             logits = jnp.einsum("bld,bd->bl", wv, x)
-            if b:
-                logits = logits + b[0].reshape(-1)[safe]
+            if bv is not None:
+                logits = logits + bv.reshape(-1)[safe]
             per = jnp.where(
                 valid,
                 jnp.log1p(jnp.exp(-jnp.where(codes > 0, logits, -logits))),
                 0.0)
             return jnp.sum(per, axis=-1, keepdims=True)
         # reference hsigmoid_loss has no reduction: per-sample cost [N, 1]
-        return nary("hsigmoid_loss", fn, args)
+        return nary("hsigmoid_loss", fn, args + [pt, pc])
 
     # default complete-binary-tree path, depth = ceil(log2(num_classes))
     import math

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor
-from ...ops._helpers import ensure_tensor, call_op, unary
+from ...ops._helpers import ensure_tensor, call_op, unary, const_input
 from ...ops.registry import register_op
 
 __all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
@@ -96,23 +96,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             rv = running_var._value.astype(jnp.float32)
             running_var._value = (momentum * rv + (1 - momentum) * unbiased) \
                 .astype(running_var._value.dtype)
-        frozen_mean = frozen_var = None
+        frozen = ()
     else:
-        frozen_mean = ensure_tensor(running_mean)._value.astype(jnp.float32)
-        frozen_var = ensure_tensor(running_var)._value.astype(jnp.float32)
+        # eval-mode stats ride as dispatch inputs: a closure-captured
+        # running-stat array would re-key the op every call (R1) — as
+        # inputs, eval batch_norm keys on structure and fuses
+        frozen = (const_input(running_mean), const_input(running_var))
 
     shape = [1] * x.ndim
     shape[channel_axis] = x.shape[channel_axis]
 
-    def fn(v, *wb):
+    def fn(v, *rest):
         vf = v.astype(jnp.float32)
         if use_batch_stats:
             # batch stats inside the traced fn so grads flow through mean/var
             m = jnp.mean(vf, axis=reduce_axes).reshape(shape)
             var = jnp.var(vf, axis=reduce_axes).reshape(shape)
+            wb = rest
         else:
-            m = frozen_mean.reshape(shape)
-            var = frozen_var.reshape(shape)
+            m = rest[0].astype(jnp.float32).reshape(shape)
+            var = rest[1].astype(jnp.float32).reshape(shape)
+            wb = rest[2:]
         out = ((vf - m) * jax.lax.rsqrt(var + epsilon)).astype(v.dtype)
         i = 0
         if weight is not None:
@@ -122,7 +126,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             out = out + wb[i].reshape(shape)
         return out
 
-    inputs = [x]
+    inputs = [x] + list(frozen)
     if weight is not None:
         inputs.append(ensure_tensor(weight))
     if bias is not None:

@@ -282,9 +282,9 @@ class MaxUnPool2D(Layer):
         self.output_size = output_size
 
     def forward(self, x, indices):
-        from ...ops._helpers import ensure_tensor, call_op
+        from ...ops._helpers import ensure_tensor, call_op, const_input
         x = ensure_tensor(x)
-        idx = ensure_tensor(indices)._value
+        idx = const_input(indices)
         ks = self.kernel_size if isinstance(self.kernel_size, (list, tuple)) \
             else (self.kernel_size, self.kernel_size)
         st = self.stride if isinstance(self.stride, (list, tuple)) \
@@ -295,15 +295,15 @@ class MaxUnPool2D(Layer):
         if self.output_size is not None:
             oh, ow = self.output_size[-2], self.output_size[-1]
 
-        def fn(v):
+        def fn(v, iv):
             flat = v.reshape(n, c, -1)
             out = jnp.zeros((n, c, oh * ow), v.dtype)
-            iflat = idx.reshape(n, c, -1)
+            iflat = iv.reshape(n, c, -1)
             bidx = jnp.arange(n)[:, None, None]
             cidx = jnp.arange(c)[None, :, None]
             out = out.at[bidx, cidx, iflat].set(flat)
             return out.reshape(n, c, oh, ow)
-        return call_op("max_unpool2d", fn, (x,))
+        return call_op("max_unpool2d", fn, (x, idx))
 
 
 class MaxUnPool1D(Layer):

@@ -215,7 +215,7 @@ class SpectralNorm(Layer):
         self.weight_v.stop_gradient = True
 
     def forward(self, x):
-        from ...ops._helpers import ensure_tensor, call_op
+        from ...ops._helpers import ensure_tensor, call_op, const_input
         x = ensure_tensor(x)
         dim = self._dim
         u_t, v_t = self.weight_u, self.weight_v
@@ -233,8 +233,11 @@ class SpectralNorm(Layer):
         u_t._value = u
         v_t._value = v
 
-        def fn(w):
+        # the iterated u/v ride as dispatch inputs: they change every
+        # call, so a closure capture would re-key the op forever
+        def fn(w, uu, vv):
             wmat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
-            sigma = u @ (wmat.astype(jnp.float32) @ v)
+            sigma = uu @ (wmat.astype(jnp.float32) @ vv)
             return w / sigma.astype(w.dtype)
-        return call_op("spectral_norm", fn, (x,))
+        return call_op("spectral_norm", fn,
+                       (x, const_input(u), const_input(v)))

@@ -11,7 +11,8 @@ from ..framework.dtype import get_default_dtype, to_jax_dtype
 from .dispatch import call_op, call_op_multi
 
 __all__ = ["ensure_tensor", "unary", "binary", "nary", "scalar_or_value",
-           "call_op", "call_op_multi", "axis_tuple", "jnp_dtype"]
+           "call_op", "call_op_multi", "axis_tuple", "jnp_dtype",
+           "const_input"]
 
 
 def jnp_dtype(t):
@@ -54,6 +55,19 @@ def binary(name, fn, x, y):
 
 def nary(name, fn, tensors):
     return call_op(name, fn, tuple(ensure_tensor(t) for t in tensors))
+
+
+def const_input(x, dtype=None):
+    """Thread a value into an op as a NON-differentiable dispatch input.
+
+    The replacement for baking an index/mask/label/stat array into the op
+    fn's closure (the PR 3/4 `unkeyable_closure` bug class, now linted by
+    analysis rule R1): as an input the value joins the cache key's avals
+    — the op keys on structure and stays chain/step-promotable — while
+    `stop_gradient` keeps it off the tape exactly like the closure
+    constant it replaces."""
+    t = ensure_tensor(x, dtype)
+    return t if t.stop_gradient else t.detach()
 
 
 def scalar_or_value(v):

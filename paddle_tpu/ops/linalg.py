@@ -9,7 +9,8 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from .registry import register_op
-from ._helpers import ensure_tensor, unary, binary, nary, call_op, call_op_multi
+from ._helpers import ensure_tensor, unary, binary, nary, call_op, \
+    call_op_multi, const_input
 
 __all__ = [
     "norm", "dist", "cond", "inv", "pinv", "det", "slogdet", "svd", "qr",
@@ -296,12 +297,17 @@ def corrcoef(x, rowvar=True, name=None):
 
 @register_op("cov", "linalg")
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    fw = ensure_tensor(fweights)._value if fweights is not None else None
-    aw = ensure_tensor(aweights)._value if aweights is not None else None
-    return unary("cov", lambda v: jnp.cov(v, rowvar=rowvar,
-                                          ddof=1 if ddof else 0,
-                                          fweights=fw, aweights=aw),
-                 ensure_tensor(x))
+    extra = tuple(const_input(t) for t in (fweights, aweights)
+                  if t is not None)
+    has_fw, has_aw = fweights is not None, aweights is not None
+
+    def fn(v, *w):
+        it = iter(w)
+        fw = next(it) if has_fw else None
+        aw = next(it) if has_aw else None
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+    return call_op("cov", fn, (ensure_tensor(x),) + extra)
 
 
 @register_op("householder_product", "linalg")

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..framework.dtype import to_jax_dtype
 from .registry import register_op
-from ._helpers import ensure_tensor, unary, binary, nary, call_op, call_op_multi
+from ._helpers import ensure_tensor, unary, binary, nary, call_op, \
+    call_op_multi, const_input
 
 __all__ = [
     "reshape", "reshape_", "transpose", "concat", "stack", "split", "chunk",
@@ -244,37 +245,45 @@ def roll(x, shifts, axis=None, name=None):
 
 @register_op("gather", "manipulation", ref="phi/kernels/gather_kernel.h")
 def gather(x, index, axis=0, name=None):
+    # index rides as a dispatch input (const_input): the op keys on the
+    # index aval instead of baking the array into its closure, which
+    # bypassed the executable cache on every call and poisoned fusion
+    # cycles (`unkeyable_closure` — the PR 3/4 bug class, linted by R1)
     x = ensure_tensor(x)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
     if isinstance(axis, Tensor):
         axis = int(axis.item())
-    if idx.ndim > 1:
-        idx = idx.reshape(-1)
-    return unary("gather", lambda v: jnp.take(v, idx, axis=axis), x)
+
+    def fn(v, iv):
+        if iv.ndim > 1:
+            iv = iv.reshape(-1)
+        return jnp.take(v, iv, axis=axis)
+    return call_op("gather", fn, (x, idx))
 
 
 @register_op("gather_nd", "manipulation")
 def gather_nd(x, index, name=None):
     x = ensure_tensor(x)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
 
-    def fn(v):
-        ind = tuple(jnp.moveaxis(idx, -1, 0))
+    def fn(v, iv):
+        ind = tuple(jnp.moveaxis(iv, -1, 0))
         return v[ind]
-    return unary("gather_nd", fn, x)
+    return call_op("gather_nd", fn, (x, idx))
 
 
 @register_op("scatter", "manipulation")
 def scatter(x, index, updates, overwrite=True, name=None):
     x = ensure_tensor(x)
     updates = ensure_tensor(updates)
-    idx = ensure_tensor(index)._value.reshape(-1)
+    idx = const_input(index)
 
-    def fn(v, u):
+    def fn(v, u, iv):
+        iv = iv.reshape(-1)
         if overwrite:
-            return v.at[idx].set(u)
-        return v.at[idx].set(0).at[idx].add(u)
-    return call_op("scatter", fn, (x, updates))
+            return v.at[iv].set(u)
+        return v.at[iv].set(0).at[iv].add(u)
+    return call_op("scatter", fn, (x, updates, idx))
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
@@ -286,26 +295,26 @@ def scatter_(x, index, updates, overwrite=True, name=None):
 @register_op("scatter_nd", "manipulation")
 def scatter_nd(index, updates, shape, name=None):
     updates = ensure_tensor(updates)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
     shape = [int(s) for s in shape]
 
-    def fn(u):
+    def fn(u, iv):
         z = jnp.zeros(shape, u.dtype)
-        ind = tuple(jnp.moveaxis(idx, -1, 0))
+        ind = tuple(jnp.moveaxis(iv, -1, 0))
         return z.at[ind].add(u)
-    return unary("scatter_nd", fn, updates)
+    return call_op("scatter_nd", fn, (updates, idx))
 
 
 @register_op("scatter_nd_add", "manipulation")
 def scatter_nd_add(x, index, updates, name=None):
     x = ensure_tensor(x)
     updates = ensure_tensor(updates)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
 
-    def fn(v, u):
-        ind = tuple(jnp.moveaxis(idx, -1, 0))
+    def fn(v, u, iv):
+        ind = tuple(jnp.moveaxis(iv, -1, 0))
         return v.at[ind].add(u)
-    return call_op("scatter_nd_add", fn, (x, updates))
+    return call_op("scatter_nd_add", fn, (x, updates, idx))
 
 
 @register_op("index_select", "manipulation")
@@ -316,35 +325,35 @@ def index_select(x, index, axis=0, name=None):
 @register_op("index_sample", "manipulation")
 def index_sample(x, index, name=None):
     x = ensure_tensor(x)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
 
-    def fn(v):
-        return jnp.take_along_axis(v, idx, axis=1)
-    return unary("index_sample", fn, x)
+    def fn(v, iv):
+        return jnp.take_along_axis(v, iv, axis=1)
+    return call_op("index_sample", fn, (x, idx))
 
 
 @register_op("index_add", "manipulation")
 def index_add(x, index, axis, value, name=None):
     x = ensure_tensor(x)
     value = ensure_tensor(value)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
 
-    def fn(v, u):
+    def fn(v, u, iv):
         vm = jnp.moveaxis(v, axis, 0)
         um = jnp.moveaxis(u, axis, 0)
-        return jnp.moveaxis(vm.at[idx].add(um), 0, axis)
-    return call_op("index_add", fn, (x, value))
+        return jnp.moveaxis(vm.at[iv].add(um), 0, axis)
+    return call_op("index_add", fn, (x, value, idx))
 
 
 @register_op("index_put", "manipulation")
 def index_put(x, indices, value, accumulate=False, name=None):
     x = ensure_tensor(x)
     value = ensure_tensor(value)
-    ind = tuple(ensure_tensor(i)._value for i in indices)
+    ind = tuple(const_input(i) for i in indices)
 
-    def fn(v, u):
-        return v.at[ind].add(u) if accumulate else v.at[ind].set(u)
-    return call_op("index_put", fn, (x, value))
+    def fn(v, u, *iv):
+        return v.at[iv].add(u) if accumulate else v.at[iv].set(u)
+    return call_op("index_put", fn, (x, value) + ind)
 
 
 @register_op("slice", "manipulation")
@@ -376,33 +385,33 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
 @register_op("take_along_axis", "manipulation")
 def take_along_axis(arr, indices, axis, name=None):
     arr = ensure_tensor(arr)
-    idx = ensure_tensor(indices)._value
-    return unary("take_along_axis",
-                 lambda v: jnp.take_along_axis(v, idx, axis=axis), arr)
+    idx = const_input(indices)
+    return call_op("take_along_axis",
+                   lambda v, iv: jnp.take_along_axis(v, iv, axis=axis),
+                   (arr, idx))
 
 
 @register_op("put_along_axis", "manipulation")
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
     arr = ensure_tensor(arr)
     values = ensure_tensor(values)
-    idx = ensure_tensor(indices)._value
+    idx = const_input(indices)
 
-    def scatter_indices(v):
-        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    def fn(v, u, iv):
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in iv.shape],
+                             indexing="ij")
         full_idx = list(grids)
-        full_idx[axis] = idx
-        return tuple(full_idx)
-
-    def fn(v, u):
-        u2 = jnp.broadcast_to(u, idx.shape).astype(v.dtype)
+        full_idx[axis] = iv
+        si = tuple(full_idx)
+        u2 = jnp.broadcast_to(u, iv.shape).astype(v.dtype)
         if reduce == "assign":
-            return v.at[scatter_indices(v)].set(u2)
+            return v.at[si].set(u2)
         if reduce == "add":
-            return v.at[scatter_indices(v)].add(u2)
+            return v.at[si].add(u2)
         if reduce in ("mul", "multiply"):
-            return v.at[scatter_indices(v)].multiply(u2)
+            return v.at[si].multiply(u2)
         raise NotImplementedError(f"put_along_axis reduce={reduce!r}")
-    return call_op("put_along_axis", fn, (arr, values))
+    return call_op("put_along_axis", fn, (arr, values, idx))
 
 
 @register_op("masked_select", "manipulation", differentiable=False)
@@ -415,23 +424,27 @@ def masked_select(x, mask, name=None):
 @register_op("masked_fill", "manipulation")
 def masked_fill(x, mask, value, name=None):
     x = ensure_tensor(x)
-    m = ensure_tensor(mask)._value
+    m = const_input(mask)
     if isinstance(value, Tensor):
         return call_op("masked_fill",
-                       lambda v, val: jnp.where(m, val.astype(v.dtype), v),
-                       (x, value))
-    return unary("masked_fill",
-                 lambda v: jnp.where(m, jnp.asarray(value, v.dtype), v), x)
+                       lambda v, val, mv: jnp.where(mv, val.astype(v.dtype),
+                                                    v),
+                       (x, value, m))
+    return call_op("masked_fill",
+                   lambda v, mv: jnp.where(mv, jnp.asarray(value, v.dtype),
+                                           v), (x, m))
 
 
 @register_op("where", "manipulation")
 def where(condition, x=None, y=None, name=None):
-    cond = ensure_tensor(condition)._value
+    ct = ensure_tensor(condition)
     if x is None and y is None:
+        # value-dependent output shape: inherently an eager host op
+        cond = ct._value
         nz = jnp.nonzero(cond if cond.dtype == jnp.bool_.dtype else cond != 0)
         return tuple(Tensor(i[:, None].astype(jnp.int64)) for i in nz)
-    return binary("where", lambda a, b: jnp.where(cond, a, b),
-                  ensure_tensor(x), ensure_tensor(y))
+    return call_op("where", lambda c, a, b: jnp.where(c, a, b),
+                   (const_input(ct), ensure_tensor(x), ensure_tensor(y)))
 
 
 @register_op("unbind", "manipulation")
@@ -518,11 +531,15 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 def repeat_interleave(x, repeats, axis=None, name=None):
     x = ensure_tensor(x)
     if isinstance(repeats, Tensor):
-        repeats = repeats.numpy()
-        total = int(repeats.sum())
-        return unary("repeat_interleave",
-                     lambda v: jnp.repeat(v, jnp.asarray(repeats), axis=axis,
-                                          total_repeat_length=total), x)
+        # the output LENGTH is value-dependent (sum of repeats): the one
+        # unavoidable host read sizes the result; the repeats themselves
+        # then ride as a keyable dispatch input
+        total = int(repeats.numpy().sum())
+        rt = const_input(repeats)
+        return call_op("repeat_interleave",
+                       lambda v, rv: jnp.repeat(v, rv, axis=axis,
+                                                total_repeat_length=total),
+                       (x, rt))
     return unary("repeat_interleave",
                  lambda v: jnp.repeat(v, repeats, axis=axis), x)
 
@@ -569,10 +586,12 @@ def tensordot(x, y, axes=2, name=None):
 @register_op("take", "manipulation")
 def take(x, index, mode="raise", name=None):
     x = ensure_tensor(x)
-    idx = ensure_tensor(index)._value
+    idx = const_input(index)
     jmode = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
-    return unary("take", lambda v: jnp.take(v.reshape(-1), idx.reshape(-1),
-                                            mode=jmode).reshape(idx.shape), x)
+    return call_op("take",
+                   lambda v, iv: jnp.take(v.reshape(-1), iv.reshape(-1),
+                                          mode=jmode).reshape(iv.shape),
+                   (x, idx))
 
 
 def tolist(x):

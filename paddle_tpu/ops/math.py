@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..framework.dtype import to_jax_dtype, get_default_dtype
 from .registry import register_op
-from ._helpers import ensure_tensor, unary, binary, nary, call_op, axis_tuple, \
+from ._helpers import ensure_tensor, unary, binary, nary, call_op, axis_tuple, const_input, \
     scalar_or_value, jnp_dtype
 
 __all__ = [
@@ -271,12 +271,14 @@ def increment(x, value=1.0, name=None):
 
 @register_op("multiplex", "math")
 def multiplex(inputs, index, name=None):
-    idx = ensure_tensor(index)._value.reshape(-1)
+    idx = const_input(index)
+
     def fn(*vals):
-        stacked = jnp.stack(vals)           # [n, batch, ...]
+        iv = vals[-1].reshape(-1)
+        stacked = jnp.stack(vals[:-1])      # [n, batch, ...]
         rows = jnp.arange(stacked.shape[1])
-        return stacked[idx, rows]
-    return nary("multiplex", fn, list(inputs))
+        return stacked[iv, rows]
+    return nary("multiplex", fn, list(inputs) + [idx])
 
 
 # ---------------------------------------------------------------------------
@@ -550,10 +552,18 @@ def logcumsumexp(x, axis=None, name=None):
 @register_op("diff", "math")
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
     x = ensure_tensor(x)
-    pre = prepend._value if isinstance(prepend, Tensor) else prepend
-    app = append._value if isinstance(append, Tensor) else append
-    return unary("diff", lambda v: jnp.diff(v, n=n, axis=axis,
-                                            prepend=pre, append=app), x)
+    # prepend/append ride as dispatch inputs (None stays a keyable
+    # closure constant); has_pre/has_app select them inside the fn
+    extra = tuple(const_input(t) for t in (prepend, append)
+                  if t is not None)
+    has_pre, has_app = prepend is not None, append is not None
+
+    def fn(v, *pa):
+        it = iter(pa)
+        pre = next(it) if has_pre else None
+        app = next(it) if has_app else None
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return call_op("diff", fn, (x,) + extra)
 
 
 # ---------------------------------------------------------------------------

@@ -1,8 +1,14 @@
 """Random sampling ops. Reference analog: python/paddle/tensor/random.py over
-phi uniform/gaussian kernels + the global Generator. TPU-first: functional jax
-PRNG keys split from the framework generator (see framework/random.py); under
-jit tracing, keys come from the traced-key scope so compiled steps get fresh
-randomness."""
+phi uniform/gaussian kernels + the global Generator. TPU-first: every
+registered sampler consumes the global fold_in STREAM through a HOISTED
+position (`framework/random.rng_key_input`) passed as a dispatch input —
+the key data is lazy, the op keys on structure, and a sampler inside a
+training cycle promotes instead of poisoning it as `rng_rekey`
+(ROADMAP 1(c), closed; analysis rule R2 pins the pattern at CI time).
+The drawn bits are IDENTICAL to the old stateful `get_rng_key()` path:
+both derive position i as `fold_in(base, i)`. Under jit tracing,
+`rng_key_input` yields traced key data from the tracing scope, so
+compiled steps keep fresh randomness exactly as before."""
 from __future__ import annotations
 
 import numpy as np
@@ -13,7 +19,8 @@ from ..framework.core import Tensor
 from ..framework.dtype import to_jax_dtype, get_default_dtype
 from ..framework.random import get_rng_key, rng_key_input
 from .registry import register_op
-from ._helpers import ensure_tensor, scalar_or_value, call_op
+from ._helpers import ensure_tensor, scalar_or_value, call_op, const_input, \
+    jnp_dtype
 
 __all__ = ["rand", "randn", "randint", "randint_like", "uniform", "normal",
            "standard_normal", "randperm", "bernoulli", "multinomial",
@@ -32,16 +39,28 @@ def _dt(dtype):
     return to_jax_dtype(dtype or get_default_dtype())
 
 
+def _wrap(key_data):
+    return jax.random.wrap_key_data(key_data)
+
+
 @register_op("rand", "random", differentiable=False)
 def rand(shape, dtype=None, name=None):
-    return Tensor(jax.random.uniform(get_rng_key(), _shape_list(shape),
-                                     _dt(dtype)))
+    shp, dt = tuple(_shape_list(shape)), _dt(dtype)
+    kd = rng_key_input()
+
+    def fn(key_data):
+        return jax.random.uniform(_wrap(key_data), shp, dt)
+    return call_op("rand", fn, (kd,))
 
 
 @register_op("randn", "random", differentiable=False)
 def randn(shape, dtype=None, name=None):
-    return Tensor(jax.random.normal(get_rng_key(), _shape_list(shape),
-                                    _dt(dtype)))
+    shp, dt = tuple(_shape_list(shape)), _dt(dtype)
+    kd = rng_key_input()
+
+    def fn(key_data):
+        return jax.random.normal(_wrap(key_data), shp, dt)
+    return call_op("randn", fn, (kd,))
 
 
 standard_normal = randn
@@ -51,8 +70,12 @@ standard_normal = randn
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
-    return Tensor(jax.random.randint(get_rng_key(), _shape_list(shape),
-                                     low, high, to_jax_dtype(dtype)))
+    shp, dt = tuple(_shape_list(shape)), to_jax_dtype(dtype)
+    kd = rng_key_input()
+
+    def fn(key_data):
+        return jax.random.randint(_wrap(key_data), shp, low, high, dt)
+    return call_op("randint", fn, (kd,))
 
 
 @register_op("randint_like", "random", differentiable=False)
@@ -60,31 +83,58 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
     x = ensure_tensor(x)
     if high is None:
         low, high = 0, low
-    dt = to_jax_dtype(dtype) if dtype else x._value.dtype
-    return Tensor(jax.random.randint(get_rng_key(), x._value.shape, low, high)
-                  .astype(dt))
+    # aval-safe shape/dtype peeks: sizing off a pending fused value must
+    # not force it (the values never matter here, only the geometry)
+    dt = to_jax_dtype(dtype) if dtype else jnp_dtype(x)
+    shp = tuple(x.shape)
+    kd = rng_key_input()
+
+    def fn(key_data):
+        return jax.random.randint(_wrap(key_data), shp, low, high).astype(dt)
+    return call_op("randint_like", fn, (kd,))
 
 
 @register_op("uniform", "random", differentiable=False)
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    key = jax.random.key(seed) if seed else get_rng_key()
-    return Tensor(jax.random.uniform(key, _shape_list(shape), _dt(dtype),
-                                     minval=scalar_or_value(min),
-                                     maxval=scalar_or_value(max)))
+    shp, dt = tuple(_shape_list(shape)), _dt(dtype)
+    if seed:
+        # explicit-seed contract: same seed -> same sample, no stream
+        # position consumed — a deterministic draw, not stateful RNG
+        return Tensor(jax.random.uniform(jax.random.key(seed), shp, dt,
+                                         minval=scalar_or_value(min),
+                                         maxval=scalar_or_value(max)))
+    kd = rng_key_input()
+    # Tensor-valued bounds ride as dispatch inputs; scalar bounds stay
+    # keyable closure constants
+    extra = tuple(b for b in (min, max) if isinstance(b, Tensor))
+    mn = None if isinstance(min, Tensor) else min
+    mx = None if isinstance(max, Tensor) else max
+
+    def fn(key_data, *bounds):
+        it = iter(bounds)
+        lo = next(it) if mn is None else mn
+        hi = next(it) if mx is None else mx
+        return jax.random.uniform(_wrap(key_data), shp, dt,
+                                  minval=lo, maxval=hi)
+    return call_op("uniform", fn, (kd,) + extra)
 
 
 @register_op("normal", "random", differentiable=False)
 def normal(mean=0.0, std=1.0, shape=None, name=None):
+    dt = _dt(None)
+    kd = rng_key_input()
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
-        m = ensure_tensor(mean)._value if isinstance(mean, Tensor) else mean
-        s = ensure_tensor(std)._value if isinstance(std, Tensor) else std
-        shp = jnp.broadcast_shapes(
-            m.shape if hasattr(m, "shape") else (),
-            s.shape if hasattr(s, "shape") else ())
-        return Tensor(m + s * jax.random.normal(get_rng_key(), shp,
-                                                _dt(None)))
-    shp = _shape_list(shape) if shape is not None else []
-    return Tensor(mean + std * jax.random.normal(get_rng_key(), shp, _dt(None)))
+        m, s = ensure_tensor(mean), ensure_tensor(std)
+
+        def fn(mv, sv, key_data):
+            shp = jnp.broadcast_shapes(mv.shape, sv.shape)
+            return mv + sv * jax.random.normal(_wrap(key_data), shp, dt)
+        return call_op("normal", fn, (m, s, kd))
+    shp = tuple(_shape_list(shape)) if shape is not None else ()
+
+    def fn(key_data):
+        return mean + std * jax.random.normal(_wrap(key_data), shp, dt)
+    return call_op("normal", fn, (kd,))
 
 
 gauss = normal
@@ -92,8 +142,12 @@ gauss = normal
 
 @register_op("randperm", "random", differentiable=False)
 def randperm(n, dtype="int64", name=None):
-    return Tensor(jax.random.permutation(get_rng_key(), n)
-                  .astype(to_jax_dtype(dtype)))
+    n, dt = int(n), to_jax_dtype(dtype)
+    kd = rng_key_input()
+
+    def fn(key_data):
+        return jax.random.permutation(_wrap(key_data), n).astype(dt)
+    return call_op("randperm", fn, (kd,))
 
 
 @register_op("bernoulli", "random", differentiable=False)
@@ -105,34 +159,43 @@ def bernoulli(x, name=None):
     kd = rng_key_input()
 
     def fn(v, key_data):
-        return jax.random.bernoulli(
-            jax.random.wrap_key_data(key_data), v).astype(v.dtype)
+        return jax.random.bernoulli(_wrap(key_data), v).astype(v.dtype)
     return call_op("bernoulli", fn, (x, kd))
 
 
 @register_op("multinomial", "random", differentiable=False)
 def multinomial(x, num_samples=1, replacement=False, name=None):
-    x = ensure_tensor(x)
-    v = x._value
-    logits = jnp.log(jnp.clip(v / jnp.sum(v, axis=-1, keepdims=True),
-                              1e-30, None))
-    if replacement:
-        out = jax.random.categorical(get_rng_key(), logits,
-                                     shape=(num_samples,) + v.shape[:-1])
-        out = jnp.moveaxis(out, 0, -1)
-    else:
-        # Gumbel top-k trick for sampling without replacement
-        g = jax.random.gumbel(get_rng_key(), v.shape)
-        _, out = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(out.astype(jnp.int64))
+    x = const_input(x)      # sampling draws no gradient through the probs
+    kd = rng_key_input()
+
+    def fn(v, key_data):
+        key = _wrap(key_data)
+        logits = jnp.log(jnp.clip(v / jnp.sum(v, axis=-1, keepdims=True),
+                                  1e-30, None))
+        if replacement:
+            out = jax.random.categorical(key, logits,
+                                         shape=(num_samples,) + v.shape[:-1])
+            out = jnp.moveaxis(out, 0, -1)
+        else:
+            # Gumbel top-k trick for sampling without replacement
+            g = jax.random.gumbel(key, v.shape)
+            _, out = jax.lax.top_k(logits + g, num_samples)
+        return out.astype(jnp.int64)
+    return call_op("multinomial", fn, (x, kd))
 
 
 @register_op("poisson", "random", differentiable=False)
 def poisson(x, name=None):
-    x = ensure_tensor(x)
-    return Tensor(jax.random.poisson(get_rng_key(), x._value)
-                  .astype(x._value.dtype))
+    x = const_input(x)      # the counting draw is not differentiable
+    kd = rng_key_input()
 
+    def fn(v, key_data):
+        return jax.random.poisson(_wrap(key_data), v).astype(v.dtype)
+    return call_op("poisson", fn, (x, kd))
+
+
+# -- in-place host-path variants (not registered ops: they mutate the
+# tensor's storage directly and stay on the stateful generator) ------------
 
 def exponential_(x, lam=1.0, name=None):
     x = ensure_tensor(x)

@@ -17,7 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
-from ._helpers import ensure_tensor, call_op, call_op_multi
+from ._helpers import ensure_tensor, call_op, call_op_multi, const_input
 from .registry import register_op
 
 __all__ = [
@@ -97,12 +97,12 @@ def inverse(x, name=None):
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
              name=None):
     x = ensure_tensor(x)
-    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    qt = const_input(q)
 
-    def fn(v):
+    def fn(v, qv):
         return jnp.quantile(v, qv, axis=axis, keepdims=keepdim,
                             method=interpolation)
-    return call_op("quantile", fn, (x,))
+    return call_op("quantile", fn, (x, qt))
 
 
 @register_op("nanquantile", "stat",
@@ -110,12 +110,12 @@ def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
 def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
                 name=None):
     x = ensure_tensor(x)
-    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    qt = const_input(q)
 
-    def fn(v):
+    def fn(v, qv):
         return jnp.nanquantile(v, qv, axis=axis, keepdims=keepdim,
                                method=interpolation)
-    return call_op("nanquantile", fn, (x,))
+    return call_op("nanquantile", fn, (x, qt))
 
 
 @register_op("numel", "attribute", differentiable=False,

@@ -161,6 +161,19 @@ REASON_CODES = frozenset({
     # -- kernel tier (kernels/pallas/, FLAGS_serve_attention_kernel) -------
     "kernel_fallback",     # requested kernel variant ineligible; demoted
     "kv_quantized",        # the engine's KV cache pool runs int8
+    # -- promotion-safety static analyzer (paddle_tpu/analysis/, PR 15) ----
+    # The fusion linter speaks THIS vocabulary: R1-R4 findings reuse the
+    # runtime codes above (unkeyable_closure / rng_rekey / mid_step_peek /
+    # collective_unkeyed — a static finding predicts the runtime split),
+    # and two classes exist only statically:
+    "contract_drift",      # a public contract surface went open: a
+                           # REASON_CODES entry without a REASON_HINTS
+                           # hint, a METRIC_NAMES entry without a
+                           # METRIC_MERGE policy, an emitted category off
+                           # CATEGORIES, an unregistered FLAGS_* read
+    "lock_discipline",     # blocking I/O / callback invocation while
+                           # holding a registry/scheduler lock, or an
+                           # inconsistent lock acquisition order
 })
 
 

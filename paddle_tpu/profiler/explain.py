@@ -229,6 +229,22 @@ REASON_HINTS = {
         "kv_exhausted. Informational — greedy decode is guarded "
         "token-identical (or top-1-equivalent) to fp32 KV; dequant is "
         "fused into the attention kernels' block loads."),
+    "contract_drift": (
+        "a public observability contract went open under extension "
+        "(fusion linter R5, paddle_tpu/analysis/): a REASON_CODES entry "
+        "without a REASON_HINTS hint, a METRIC_NAMES entry without a "
+        "METRIC_MERGE fleet policy, an event category emitted off "
+        "CATEGORIES, or a FLAGS_* name read without a define_flag "
+        "registration. Close the pair next to the code that introduced "
+        "the new name and update the contract-freeze tests "
+        "deliberately."),
+    "lock_discipline": (
+        "blocking I/O or a user callback runs while a registry/"
+        "scheduler lock is held, or two code paths acquire the same "
+        "lock pair in opposite orders (fusion linter R6). Snapshot "
+        "under the lock and act after release; keep one global lock "
+        "order — the chaos harness can only SAMPLE these races, the "
+        "linter proves their absence."),
 }
 
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .framework.core import Tensor
-from .ops._helpers import ensure_tensor, call_op
+from .ops._helpers import ensure_tensor, call_op, const_input
 from .audio.functional import get_window
 
 __all__ = ["stft", "istft", "frame", "overlap_add"]
@@ -70,7 +70,7 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         lpad = (n_fft - win_length) // 2
         win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
 
-    def fn(v):
+    def fn(v, wv):
         if center:
             pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
             v = jnp.pad(v, pad, mode=pad_mode)
@@ -78,13 +78,13 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         n_frames = 1 + (t - n_fft) // hop_length
         idx = (jnp.arange(n_fft)[:, None]
                + hop_length * jnp.arange(n_frames)[None, :])
-        frames = v[..., idx] * win[:, None]
+        frames = v[..., idx] * wv[:, None]
         spec = jnp.fft.rfft(frames, axis=-2) if onesided \
             else jnp.fft.fft(frames, axis=-2)
         if normalized:
             spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
         return spec
-    return call_op("stft", fn, (x,))
+    return call_op("stft", fn, (x, const_input(win)))
 
 
 def istft(x, n_fft, hop_length=None, win_length=None, window=None,
@@ -103,12 +103,12 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         lpad = (n_fft - win_length) // 2
         win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
 
-    def fn(spec):
+    def fn(spec, wv):
         if normalized:
             spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
         frames = jnp.fft.irfft(spec, n=n_fft, axis=-2) if onesided \
             else jnp.fft.ifft(spec, axis=-2).real
-        frames = frames * win[:, None]
+        frames = frames * wv[:, None]
         n_frames = frames.shape[-1]
         t = n_fft + hop_length * (n_frames - 1)
         idx = (jnp.arange(n_fft)[:, None]
@@ -118,7 +118,7 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         # NOLA normalization: divide by the summed squared window envelope
         env = jnp.zeros((t,), frames.dtype)
         env = env.at[idx.reshape(-1)].add(
-            jnp.broadcast_to((win * win)[:, None],
+            jnp.broadcast_to((wv * wv)[:, None],
                              (n_fft, n_frames)).reshape(-1))
         out = out / jnp.maximum(env, 1e-11)
         if center:
@@ -126,4 +126,4 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         if length is not None:
             out = out[..., :length]
         return out
-    return call_op("istft", fn, (x,))
+    return call_op("istft", fn, (x, const_input(win)))

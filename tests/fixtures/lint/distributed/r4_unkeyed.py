@@ -1,0 +1,28 @@
+"""R4 golden known-bad: process-group collectives entering (or
+bypassing) the funnel without a dispatch.mark_collective stamp."""
+from paddle_tpu.ops.dispatch import call_op, mark_collective
+
+
+def bad_direct_collective(tensor, group):
+    pg = group.pg
+    return pg.all_reduce(tensor._value, "sum")        # line 8: no funnel
+
+
+def bad_unmarked_funnel(tensor, group):
+    pg = group.pg
+    return call_op("dist.all_reduce",
+                   lambda v: pg.all_reduce(v, "sum"),  # line 14: unmarked
+                   [tensor])
+
+
+def _dispatch_marked(name, fn, tensor, key):
+    """The marking funnel (the _dispatch_collective pattern)."""
+    mark_collective(fn, key)
+    return call_op(name, fn, [tensor])
+
+
+def good_marked_collective(tensor, group, key):
+    """The fixed form: the fn flows through the marking funnel."""
+    pg = group.pg
+    return _dispatch_marked("dist.all_reduce",
+                            lambda v: pg.all_reduce(v, "sum"), tensor, key)

@@ -1,0 +1,48 @@
+"""R1 golden known-bad: op fns capturing Tensor/array/mutable-global
+state that never enters the dispatch-input list (the PR 3/4 bug class).
+Line numbers are asserted exactly by tests/test_fusion_lint.py — edit
+with care."""
+import jax.numpy as jnp
+
+from paddle_tpu.ops._helpers import ensure_tensor, call_op, unary
+from paddle_tpu.ops.registry import register_op
+
+_LOOKUP_STATE = {"scale": 2.0}            # mutable module global
+
+
+@register_op("bad_gather", "fixture")
+def bad_gather(x, index, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._value     # raw array...
+
+    def fn(v):
+        return jnp.take(v, idx, axis=0)   # line 19: captured, not an input
+    return call_op("bad_gather", fn, (x,))
+
+
+@register_op("bad_mask", "fixture")
+def bad_mask(x, mask, name=None):
+    x = ensure_tensor(x)
+    m = ensure_tensor(mask)               # a Tensor...
+    return unary("bad_mask",
+                 lambda v: jnp.where(m._value, v, 0.0), x)   # line 28
+
+
+@register_op("bad_global", "fixture")
+def bad_global(x, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        return v * _LOOKUP_STATE["scale"]   # line 36: mutable global read
+    return call_op("bad_global", fn, (x,))
+
+
+@register_op("good_threaded", "fixture")
+def good_threaded(x, index, name=None):
+    """The fixed form: the index rides as a dispatch input — no finding."""
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)
+
+    def fn(v, iv):
+        return jnp.take(v, iv, axis=0)
+    return call_op("good_threaded", fn, (x, idx))

@@ -1,0 +1,38 @@
+"""R2 golden known-bad: a registered op body drawing stateful global
+randomness instead of hoisting a stream position via rng_key_input()."""
+import jax
+
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.framework.random import get_rng_key, split_key, \
+    default_generator, rng_key_input
+from paddle_tpu.ops._helpers import ensure_tensor, call_op
+from paddle_tpu.ops.registry import register_op
+
+
+@register_op("bad_noise", "fixture")
+def bad_noise(shape, name=None):
+    return Tensor(jax.random.normal(get_rng_key(), tuple(shape)))  # line 14
+
+
+@register_op("bad_split", "fixture")
+def bad_split(shape, name=None):
+    keys = split_key(2)                                            # line 19
+    return Tensor(jax.random.normal(keys[0], tuple(shape)))
+
+
+@register_op("bad_direct", "fixture")
+def bad_direct(shape, name=None):
+    key = default_generator.next_key()                             # line 25
+    return Tensor(jax.random.normal(key, tuple(shape)))
+
+
+@register_op("good_hoisted", "fixture")
+def good_hoisted(x, name=None):
+    """The fixed form: a hoisted stream position — no finding."""
+    x = ensure_tensor(x)
+    kd = rng_key_input()
+
+    def fn(v, key_data):
+        return jax.random.bernoulli(
+            jax.random.wrap_key_data(key_data), v).astype(v.dtype)
+    return call_op("good_hoisted", fn, (x, kd))

@@ -1,0 +1,32 @@
+"""R3 golden known-bad: host-forcing reads (.numpy()/.item()/float())
+of Tensors inside a dispatch-funnel wrapper — each one splits any
+pending fused chain/step at runtime."""
+import jax.numpy as jnp
+
+from paddle_tpu.ops._helpers import ensure_tensor, call_op
+
+
+def bad_peeking_op(x, name=None):
+    x = ensure_tensor(x)
+    host_copy = x.numpy()                     # line 11: forces the value
+    peak = float(x)                           # line 12: forces again
+    if host_copy.ndim > 0 and peak >= 0.0:
+        pass
+
+    def fn(v):
+        return jnp.tanh(v)
+    return call_op("bad_peek", fn, (x,))
+
+
+def bad_item_op(x, threshold, name=None):
+    x = ensure_tensor(x)
+    t = ensure_tensor(threshold)
+    limit = t.item()                          # line 23: forces the value
+    return call_op("bad_item", lambda v: jnp.clip(v, -limit, limit), (x,))
+
+
+def good_aval_op(x, name=None):
+    """The fixed form: aval-safe shape peek — no finding."""
+    x = ensure_tensor(x)
+    n = x.shape[0]
+    return call_op("good_aval", lambda v: v.reshape(n, -1), (x,))

@@ -1,0 +1,9 @@
+"""R5 fixture: flag reads — one registered, one typo'd (never
+registered, silently reads None forever at runtime)."""
+from .flags import _FLAGS
+
+
+def configured():
+    ok = _FLAGS.get("FLAGS_fixture_known")
+    bad = _FLAGS.get("FLAGS_fixture_typod")     # line 8: unregistered
+    return ok, bad

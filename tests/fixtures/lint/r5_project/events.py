@@ -1,0 +1,25 @@
+"""R5 fixture: miniature contract surfaces seeded with drift."""
+
+CATEGORIES = frozenset({
+    "step.fire", "step.split",
+})
+
+REASON_CODES = frozenset({
+    "rng_rekey",
+    "shape_mismatch",
+    "orphan_code",          # line 10: no REASON_HINTS entry -> finding
+})
+
+
+class _Ring:
+    def emit(self, cat, op="", key=None, reason=None, detail=None):
+        pass
+
+
+EVENTS = _Ring()
+
+
+def fire(key):
+    EVENTS.emit("step.fire", "op", key)
+    EVENTS.emit("step.ghost", "op", key)                  # line 23: bad cat
+    EVENTS.emit("step.split", "op", key, "made_up_code")  # line 24: bad code

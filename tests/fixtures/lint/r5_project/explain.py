@@ -1,0 +1,7 @@
+"""R5 fixture: hints map missing one code and carrying one stale key."""
+
+REASON_HINTS = {
+    "rng_rekey": "hoist the key",
+    "shape_mismatch": "pad/bucket shapes",
+    "ancient_code": "this code no longer exists",   # stale -> finding
+}

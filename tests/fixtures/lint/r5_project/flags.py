@@ -1,0 +1,11 @@
+"""R5 fixture: the flag registry."""
+
+_FLAGS = {}
+
+
+def define_flag(name, default, help_str=""):
+    _FLAGS[name] = default
+    return default
+
+
+define_flag("FLAGS_fixture_known", True, "a registered flag")

@@ -1,0 +1,21 @@
+"""R5 fixture: metric contract with a policy-less name and an
+off-contract registration."""
+
+METRIC_NAMES = frozenset({
+    "train_step_seconds",
+    "orphan_metric",            # no METRIC_MERGE policy -> finding
+})
+
+METRIC_MERGE = {
+    "train_step_seconds": "sum",
+}
+
+
+class _Reg:
+    def counter(self, name, help=""):
+        return name
+
+
+def install(reg):
+    reg.counter("train_step_seconds")
+    reg.counter("rogue_total")      # off the METRIC_NAMES contract

@@ -1,0 +1,51 @@
+"""R6 golden known-bad: blocking work / callback invocation under a
+registry lock, plus a lock-order inversion."""
+import threading
+import time
+
+
+class BadRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._callbacks = []
+        self._rows = {}
+
+    def slow_write(self, row):
+        with self._lock:
+            time.sleep(0.01)                    # line 16: blocking
+            self._rows[row] = 1
+
+    def notify(self, payload):
+        with self._lock:
+            for cb in self._callbacks:
+                cb(payload)                     # line 22: callback held
+            self.on_change(payload)             # line 23: callback held
+
+    def on_change(self, payload):
+        pass
+
+    def forward_order(self):
+        with self._lock:
+            with self._state_lock:              # _lock -> _state_lock
+                return dict(self._rows)
+
+    def reverse_order(self):
+        with self._state_lock:
+            with self._lock:                    # inversion -> finding
+                return len(self._rows)
+
+
+class GoodRegistry:
+    """The fixed form: snapshot under the lock, act after release."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+        self._rows = {}
+
+    def notify(self, payload):
+        with self._lock:
+            callbacks = list(self._callbacks)
+        for cb in callbacks:
+            cb(payload)

@@ -163,6 +163,10 @@ class TestPublicContract:
             "artifact_corrupt", "version_skew",
             # kernel tier (PR 11, FLAGS_serve_attention_kernel + int8 KV)
             "kernel_fallback", "kv_quantized",
+            # promotion-safety static analyzer (PR 15,
+            # paddle_tpu/analysis/): static-only finding classes — the
+            # R1-R4 rules reuse the runtime codes above
+            "contract_drift", "lock_discipline",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

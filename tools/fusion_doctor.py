@@ -378,6 +378,11 @@ def main(argv=None) -> int:
                          "telemetry server /doctor endpoint "
                          "(FLAGS_telemetry_port) instead of running "
                          "anything locally")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the promotion-safety static analyzer "
+                         "(paddle_tpu/analysis, baseline applied) and "
+                         "cross-reference runtime split/poison reasons "
+                         "with the static findings that predicted them")
     ap.add_argument("--gc", action="store_true",
                     help="with --cache: run the size/age eviction now "
                          "(also removes quarantined *.corrupt files)")
@@ -428,6 +433,8 @@ def main(argv=None) -> int:
         set_flags({"FLAGS_profiler_events": False})
 
     report = explain(EVENTS.snapshot())
+    if args.lint:
+        _attach_lint(report)
     if want_metrics:
         from paddle_tpu.profiler.metrics import (format_metrics_summary,
                                                  metrics_snapshot)
@@ -439,10 +446,59 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=2))
     else:
         print(format_report(report))
+        if args.lint:
+            _print_lint(report.get("lint") or {})
         if want_metrics:
             print(format_metrics_summary(report["metrics"]))
             _print_goodput(report["goodput"])
     return 0
+
+
+def _attach_lint(report):
+    """`fusion_doctor --lint`: run the static analyzer over the repo
+    (suppression baseline applied) and cross-reference the RUNTIME
+    split/poison/bypass reasons of this report with the STATIC findings
+    carrying the same reason code — "this `rng_rekey` split was
+    statically predicted at ops/random_ops.py:NN". One taxonomy, two
+    observation times."""
+    from paddle_tpu.analysis import analyze, Baseline, findings_to_dicts
+    from paddle_tpu.analysis.baseline import DEFAULT_BASELINE
+
+    findings = analyze()
+    bl = Baseline.load(DEFAULT_BASELINE)
+    live, muted = bl.split(findings)
+    report["lint"] = {
+        "findings": findings_to_dicts(live),
+        "suppressed": len(muted),
+        "stale_suppressions": len(bl.stale(findings)),
+    }
+    # runtime reasons observed in THIS window, by source section
+    runtime = {}
+    step = report.get("step") or {}
+    for src in (step.get("split_reasons"), step.get("poisons"),
+                (report.get("dispatch") or {}).get("bypass_reasons"),
+                (report.get("chain") or {}).get("split_reasons")):
+        for r in (src or {}):
+            runtime[r] = runtime.get(r, 0) + (src[r].get("count") or 0)
+    predicted = []
+    for f in live:
+        if runtime.get(f.reason_code):
+            predicted.append(
+                f"runtime `{f.reason_code}` (×{runtime[f.reason_code]}) was "
+                f"statically predicted at {f.file}:{f.line} ({f.rule}: "
+                f"{f.message})")
+    report["lint"]["predicted"] = predicted
+    report.setdefault("findings", []).extend(predicted)
+
+
+def _print_lint(lint):
+    n = len(lint.get("findings") or [])
+    print(f"lint  : {n} unsuppressed static finding(s), "
+          f"{lint.get('suppressed', 0)} suppressed, "
+          f"{lint.get('stale_suppressions', 0)} stale suppression(s)")
+    for f in (lint.get("findings") or [])[:12]:
+        print(f"  - {f['file']}:{f['line']}: {f['rule']} "
+              f"[{f['reason_code']}] {f['message']}")
 
 
 if __name__ == "__main__":
