@@ -705,6 +705,289 @@ def bench_dp8(on_tpu):
     }
 
 
+def bench_pp2(on_tpu):
+    """Pipeline-parallel train leg (hybrid-parallel promotion): a pp=2 x
+    virtual=2 interleaved GPT driven through PipelineParallel.train_batch,
+    which routes the whole fill/steady/drain cycle through the
+    ops/spmd_fusion pipeline registry as ONE promoted ppermute-handoff
+    executable (fwd+bwd+update, all micro-batches rolled in). tokens/s +
+    MFU are READ BACK from the metrics registry like every train leg; the
+    comparison is the same schedule run unfused and eager
+    (forward_backward_pipeline: sequential micro-batch accumulation with
+    no cross-stage overlap). On CPU the 2-stage mesh lives on the
+    emulated 8-device platform (same harness as dp8)."""
+    import jax
+    if not on_tpu and jax.device_count() < 2:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _force_virtual_cpu_mesh
+        _force_virtual_cpu_mesh(8)
+        import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallel)
+    from paddle_tpu.incubate.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion, gpt2_124m,
+        gpt_pipeline_layers)
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache
+    from paddle_tpu.ops.spmd_fusion import clear_pipeline_programs
+    from paddle_tpu.profiler import (reset_step_fusion_stats,
+                                     step_fusion_stats, clear_fusion_events,
+                                     fusion_events, events_summary)
+    from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.profiler.metrics import reset_metrics
+    from paddle_tpu.profiler.goodput import ACCOUNTANT as _acct
+
+    accum = 4                      # micro-batches per optimizer step
+    if on_tpu:
+        seq, batch, warmup, steps, eager_steps = 1024, 8, 4, 8, 2
+        cfg = gpt2_124m(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        max_position_embeddings=seq)
+    else:
+        seq, batch, warmup, steps, eager_steps = 64, 4, 3, 4, 2
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=8,
+                        num_attention_heads=4, intermediate_size=128,
+                        max_position_embeddings=seq, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    reset_step_fusion_stats()
+    clear_fusion_events()
+    reset_metrics()
+    prev = get_flags(["FLAGS_profiler_events", "FLAGS_metrics"])
+    # eager tiers OFF: the pipeline registry owns promotion here, and a
+    # half-warm chain tier would only add tracer_input noise to the doctor
+    set_flags({"FLAGS_profiler_events": True, "FLAGS_metrics": True,
+               "FLAGS_eager_op_cache": False,
+               "FLAGS_eager_chain_fusion": False,
+               "FLAGS_eager_step_fusion": False})
+    try:
+        clear_dispatch_cache()
+        clear_pipeline_programs()
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                          jnp.int32)
+        labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                             jnp.int32)
+
+        def make_runner():
+            paddle.seed(0)
+            model = GPTForCausalLM(cfg)
+            pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2,
+                               loss_fn=GPTPretrainingCriterion(),
+                               num_virtual_pipeline_stages=2)
+            runner = PipelineParallel(pl, hcg=None)
+            runner.accumulate_steps = accum
+            opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                         weight_decay=0.01,
+                                         parameters=model.parameters())
+            return model, runner, opt
+
+        # -- unfused eager schedule (single-controller fallback) ----------
+        set_global_mesh(None)
+        _, runner, opt = make_runner()
+        for _ in range(2):
+            float(runner.train_batch((ids, labels), opt))
+        t0 = time.perf_counter()
+        for _ in range(eager_steps):
+            float(runner.train_batch((ids, labels), opt))
+        eager_s = (time.perf_counter() - t0) / eager_steps
+
+        # -- promoted pipeline cycle --------------------------------------
+        mesh = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                          devices=jax.devices()[:2])
+        set_global_mesh(mesh)
+        model, runner, opt = make_runner()
+        n_params = model.num_params()
+        for _ in range(warmup):
+            loss = runner.train_batch((ids, labels), opt)
+        jax.block_until_ready(loss._value)
+        flops_per_token = model.flops_per_token(seq, training=True)
+        _acct.reset(warm=True)
+        _acct.set_flops_per_step(flops_per_token * batch * seq,
+                                 tokens=batch * seq,
+                                 peak=peak_flops_per_chip())
+        s0 = dict(step_fusion_stats())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = runner.train_batch((ids, labels), opt)
+        jax.block_until_ready(loss._value)
+        final = float(loss.numpy())
+        _acct.finalize()
+        fused_s = (time.perf_counter() - t0) / steps
+        s1 = dict(step_fusion_stats())
+
+        goodput = _acct.snapshot()
+        ev = fusion_events()
+        promotes = [e for e in ev if e["cat"] == "step.promote"
+                    and e["detail"].get("pipe")]
+        fires = [e for e in ev if e["cat"] == "step.fire"]
+        doctor = explain(ev)
+        platform = jax.devices()[0].platform
+        return {
+            "metric": "pp2_interleaved_train_tokens_per_sec_per_chip",
+            "value": round(goodput["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "extra": {"mfu": round(goodput["mfu"], 4),
+                      "loss": round(final, 3),
+                      "schedule": (promotes[0]["detail"]["schedule"]
+                                   if promotes else None),
+                      "pipeline_promotes": len(promotes),
+                      "pipeline_fires": len(fires),
+                      "retraces_in_window": s1["retraces"] - s0["retraces"],
+                      "accumulate_steps": accum,
+                      "batch": batch, "seq": seq, "params": n_params,
+                      "fused_ms_per_step": round(fused_s * 1e3, 3),
+                      "eager_ms_per_step": round(eager_s * 1e3, 3),
+                      "speedup_vs_eager_schedule": round(eager_s / fused_s,
+                                                         3),
+                      "goodput": goodput,
+                      "fusion_events": events_summary(ev),
+                      "fusion_doctor": {"verdict": doctor["verdict"],
+                                        "headline": doctor["headline"]},
+                      "platform": platform},
+        }
+    finally:
+        set_flags(prev)
+        from paddle_tpu.distributed.mesh import set_global_mesh as _sgm
+        _sgm(None)
+
+
+def bench_moe8(on_tpu):
+    """MoE train leg (hybrid-parallel promotion): an 8-expert gshard
+    MoELayer trained EAGERLY — the stamped gate fn
+    (dispatch.mark_collective on the moe_layer dispatch) keys the
+    collective so the whole fwd+bwd+update cycle promotes through the
+    funnel instead of poisoning every cycle as collective_unkeyed.
+    tokens/s + MFU are READ BACK from the metrics registry; the
+    comparison is the same loop with the funnel off (per-op eager
+    dispatch)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import get_flags, set_flags
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.ops.dispatch import clear_dispatch_cache
+    from paddle_tpu.profiler import (reset_step_fusion_stats,
+                                     step_fusion_stats, clear_fusion_events,
+                                     fusion_events, events_summary)
+    from paddle_tpu.profiler.explain import explain
+    from paddle_tpu.profiler.metrics import reset_metrics
+    from paddle_tpu.profiler.goodput import ACCOUNTANT as _acct
+
+    top_k = 2                                    # gshard gate
+    if on_tpu:
+        d_model, d_hidden, experts = 512, 2048, 8
+        batch, seq, warmup, steps, eager_steps = 8, 256, 10, 20, 4
+    else:
+        d_model, d_hidden, experts = 16, 32, 8
+        batch, seq, warmup, steps, eager_steps = 4, 32, 10, 8, 4
+    tokens = batch * seq
+    # analytic active FLOPs/token: gate matmul + top_k expert FFNs, fwd;
+    # training ~= 3x fwd (bwd re-does both matmul operands)
+    flops_per_token = 3 * (2 * d_model * experts
+                           + top_k * 4 * d_model * d_hidden)
+    reset_step_fusion_stats()
+    clear_fusion_events()
+    reset_metrics()
+    prev = get_flags(["FLAGS_profiler_events", "FLAGS_metrics"])
+    set_flags({"FLAGS_profiler_events": True, "FLAGS_metrics": True})
+
+    def make_loop(fused, seed=0):
+        set_flags({"FLAGS_eager_op_cache": fused,
+                   "FLAGS_eager_op_cache_size": 512,
+                   "FLAGS_eager_chain_fusion": fused,
+                   "FLAGS_eager_chain_fusion_min_count": 3,
+                   "FLAGS_eager_step_fusion": fused,
+                   "FLAGS_eager_step_fusion_min_count": 4})
+        clear_dispatch_cache()
+        paddle.seed(seed)
+        rng = np.random.default_rng(seed)
+        x = paddle.to_tensor(rng.standard_normal(
+            (batch, seq, d_model)).astype(np.float32))
+        m = MoELayer(d_model, d_hidden, experts, gate="gshard",
+                     capacity_factor=2.0, eval_capacity_factor=2.0)
+        m.train()
+        opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                                   parameters=m.parameters())
+
+        def step():
+            y = m(x)
+            loss = paddle.mean(paddle.multiply(y, y)) + 0.01 * m.l_aux
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return m, step
+
+    try:
+        # -- funnel off: per-op eager dispatch ----------------------------
+        m, step = make_loop(False)
+        for _ in range(3):
+            step()
+        jax.block_until_ready(m.w1._value)
+        t0 = time.perf_counter()
+        for _ in range(eager_steps):
+            step()
+        jax.block_until_ready(m.w1._value)
+        eager_s = (time.perf_counter() - t0) / eager_steps
+
+        # -- funnel on: stamped gate -> promoted cycle --------------------
+        m, step = make_loop(True)
+        n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+        for _ in range(warmup):
+            step()
+        jax.block_until_ready(m.w1._value)
+        _acct.reset(warm=True)
+        _acct.set_flops_per_step(flops_per_token * tokens, tokens=tokens,
+                                 peak=peak_flops_per_chip())
+        s0 = dict(step_fusion_stats())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step()
+        jax.block_until_ready(m.w1._value)
+        final = float(loss.numpy())
+        _acct.finalize()
+        fused_s = (time.perf_counter() - t0) / steps
+        s1 = dict(step_fusion_stats())
+
+        goodput = _acct.snapshot()
+        ev = fusion_events()
+        doctor = explain(ev)
+        platform = jax.devices()[0].platform
+        return {
+            "metric": "moe8_gshard_train_tokens_per_sec_per_chip",
+            "value": round(goodput["tokens_per_sec"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "extra": {"mfu": round(goodput["mfu"], 4),
+                      "loss": round(final, 4),
+                      "experts": experts, "top_k": top_k,
+                      "d_model": d_model, "d_hidden": d_hidden,
+                      "batch": batch, "seq": seq, "params": n_params,
+                      "steps_promoted": s1["steps_promoted"],
+                      "fused_steps_in_window":
+                          s1["fused_steps"] - s0["fused_steps"],
+                      "retraces_in_window": s1["retraces"] - s0["retraces"],
+                      "fallback_splits": s1["fallback_splits"],
+                      "fused_ms_per_step": round(fused_s * 1e3, 3),
+                      "eager_ms_per_step": round(eager_s * 1e3, 3),
+                      "speedup_vs_unfused_eager": round(eager_s / fused_s,
+                                                        3),
+                      "goodput": goodput,
+                      "step_fusion": s1,
+                      "fusion_events": events_summary(ev),
+                      "fusion_doctor": {"verdict": doctor["verdict"],
+                                        "headline": doctor["headline"]},
+                      "platform": platform},
+        }
+    finally:
+        set_flags(prev)
+
+
 # --------------------------------------------------------------------------
 # child / parent plumbing
 # --------------------------------------------------------------------------
@@ -720,13 +1003,16 @@ CONFIG_FNS = {
     "gpt2_train": bench_gpt2_train,
     "accum4": bench_accum4,
     "dp8": bench_dp8,
+    "pp2": bench_pp2,
+    "moe8": bench_moe8,
 }
 
 # per-config hard timeouts (seconds) when the probe said TPU; CPU smoke
 # versions are tiny and get a flat cap
 TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
             "serve_64": 150, "flash4096": 210, "gpt2_355m": 240,
-            "gpt2_train": 280, "accum4": 240, "dp8": 180}
+            "gpt2_train": 280, "accum4": 240, "dp8": 180, "pp2": 200,
+            "moe8": 180}
 CPU_CAP = 150
 HEADLINE = "gpt2_train"
 HEADLINE_RESERVE = 300      # wall-clock held back for the headline config
@@ -739,8 +1025,8 @@ def _child_probe():
 
 
 def _child_config(name, platform, budget_s):
-    if name == "dp8" and platform == "cpu":
-        # the multichip leg needs its 8 emulated devices BEFORE the first
+    if name in ("dp8", "pp2") and platform == "cpu":
+        # the multichip legs need their emulated devices BEFORE the first
         # backend init — XLA parses this env var only once per process
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:

@@ -17,6 +17,17 @@ local function that itself calls `mark_collective` (e.g.
 same scope. Anything else is flagged; deliberate host-mediated paths
 (object gathers) are suppressed in the checked-in baseline, not hidden
 from the rule.
+
+PR 16 widened the surface to the SPMD axis-name collectives: a
+`jax.lax` collective (`ppermute`, `all_to_all`, `psum`, ...) written
+inside an fn that is eagerly dispatched (`call_op`/`call_op_multi`) is
+the same bug class — the closure scan cannot key the axis binding, so
+the site poisons every cycle containing it unless stamped. The dispatch
+edge is the trigger: `lax` collectives inside shard_map/jit-only bodies
+(distributed/collective.py's compiled process-group programs, the
+pipeline ppermute scan) never reach the funnel and are exempt. Scope
+covers every collective-bearing tree: `distributed/` (including
+`fleet/meta_parallel/`) and `incubate/distributed/` (MoE).
 """
 from __future__ import annotations
 
@@ -31,6 +42,17 @@ from . import rule
 _PG_KINDS = {"all_reduce", "all_gather", "gather_all", "broadcast",
              "reduce_scatter", "alltoall", "alltoall_single", "scatter",
              "reduce"}
+
+# SPMD axis-name collectives: flagged only when the containing fn is
+# eagerly dispatched — inside compiled shard_map/jit bodies they are the
+# intended lowering and never touch the dispatch cache
+_LAX_KINDS = {"psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+              "ppermute", "pshuffle", "psum_scatter"}
+_DISPATCHERS = {"call_op", "call_op_multi"}
+
+# every tree that carries collectives: distributed/ (which includes
+# fleet/meta_parallel/) plus incubate/distributed/ (MoE)
+_SCOPES = ("/distributed/", "/incubate/", "/meta_parallel/")
 
 
 @rule
@@ -48,29 +70,42 @@ class UnkeyedCollective:
 
     def run(self, project):
         for module in project.modules:
-            if "/distributed/" not in "/" + module.rel and \
-                    not module.rel.startswith("distributed/"):
+            rel = "/" + module.rel
+            if not any(scope in rel for scope in _SCOPES):
                 continue
             parents = module.parents()
             marking = _marking_functions(module.tree)
+            dispatched = _dispatched_fn_names(module.tree)
             for node in ast.walk(module.tree):
                 if not isinstance(node, ast.Call):
                     continue
-                if call_name(node) not in _PG_KINDS:
-                    continue
+                name = call_name(node)
                 if not isinstance(node.func, ast.Attribute):
                     continue
-                if not _pg_receiver(node.func.value):
-                    continue
-                if _flows_through_marker(node, parents, marking):
-                    continue
-                yield Finding(
-                    rule=self.id, file=module.rel, line=node.lineno,
-                    reason_code=self.reason_code,
-                    message=(f"pg collective `{call_name(node)}` is not "
-                             "stamped with dispatch.mark_collective — "
-                             "unkeyable in the funnel"),
-                    symbol=qualname_of(node, parents))
+                if name in _PG_KINDS and _pg_receiver(node.func.value):
+                    if _flows_through_marker(node, parents, marking):
+                        continue
+                    yield Finding(
+                        rule=self.id, file=module.rel, line=node.lineno,
+                        reason_code=self.reason_code,
+                        message=(f"pg collective `{name}` is not "
+                                 "stamped with dispatch.mark_collective — "
+                                 "unkeyable in the funnel"),
+                        symbol=qualname_of(node, parents))
+                elif name in _LAX_KINDS \
+                        and _lax_receiver(node.func.value) \
+                        and _reaches_dispatch(node, parents, dispatched) \
+                        and not _flows_through_marker(node, parents,
+                                                      marking):
+                    yield Finding(
+                        rule=self.id, file=module.rel, line=node.lineno,
+                        reason_code=self.reason_code,
+                        message=(f"lax collective `{name}` inside an "
+                                 "eagerly dispatched fn without a "
+                                 "dispatch.mark_collective stamp — the "
+                                 "closure scan cannot key the axis "
+                                 "binding"),
+                        symbol=qualname_of(node, parents))
 
 
 def _pg_receiver(node):
@@ -80,6 +115,44 @@ def _pg_receiver(node):
         return node.id == "pg" or node.id.endswith("_pg")
     if isinstance(node, ast.Attribute):
         return node.attr == "pg"
+    return False
+
+
+def _lax_receiver(node):
+    """True when the call receiver is the lax namespace: `lax.psum` or
+    `jax.lax.psum`."""
+    if isinstance(node, ast.Name):
+        return node.id == "lax"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "lax"
+    return False
+
+
+def _dispatched_fn_names(tree):
+    """Names passed (by name) as arguments to call_op/call_op_multi —
+    the fns that enter the eager funnel."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) in _DISPATCHERS:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+def _reaches_dispatch(node, parents, dispatched):
+    """The lax call sits inside a def/lambda that enters the funnel:
+    a lambda inlined into a call_op/call_op_multi call, or a named def
+    that is passed to one somewhere in the module."""
+    fn = enclosing_function(node, parents)
+    while fn is not None:
+        parent = parents.get(fn)
+        if isinstance(parent, ast.Call) \
+                and call_name(parent) in _DISPATCHERS:
+            return True
+        if isinstance(fn, ast.FunctionDef) and fn.name in dispatched:
+            return True
+        fn = enclosing_function(fn, parents)
     return False
 
 

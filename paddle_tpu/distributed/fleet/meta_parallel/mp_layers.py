@@ -23,8 +23,10 @@ from ....nn.initializer_util import materialize_parameter
 from ....nn import initializer as I
 from ....nn import functional as F
 from ....ops._helpers import ensure_tensor, call_op, const_input
+from ....ops.dispatch import mark_collective
 from ...mesh import get_global_mesh
-from .mp_ops import _c_identity, _mp_allreduce, _c_concat, in_spmd_axis
+from .mp_ops import (_c_identity, _mp_allreduce, _c_concat, in_spmd_axis,
+                     _mp_collective_key)
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy", "RNGStatesTracker",
@@ -84,6 +86,7 @@ class VocabParallelEmbedding(Layer):
             return jax.lax.psum(out, "model")
         # ids ride as a dispatch input (the PR 3 embedding fix): a
         # captured id array would re-key the op on every batch
+        mark_collective(fn, _mp_collective_key("c_embedding"))
         return call_op("c_embedding", fn,
                        (ensure_tensor(self.weight), const_input(x)))
 
@@ -210,6 +213,8 @@ class ParallelCrossEntropy(Layer):
             # (and therefore 0 gradient — loss is constant in logits there)
             return jnp.where(lab == ignore_index,
                              jnp.zeros_like(loss), loss)
+        mark_collective(fn, _mp_collective_key("parallel_cross_entropy",
+                                               ignore_index))
         return call_op("parallel_cross_entropy", fn,
                        (input, const_input(label)))
 

@@ -16,11 +16,23 @@ import jax.numpy as jnp
 from ....framework.core import Tensor
 from ....framework.jax_compat import axis_size
 from ....ops._helpers import ensure_tensor, call_op
+from ....ops.dispatch import mark_collective
 
 __all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce", "split",
            "in_spmd_axis", "MODEL_AXIS"]
 
 MODEL_AXIS = "model"
+
+
+def _mp_collective_key(kind, *extra):
+    """Collective identity for an mp-axis fn: (kind, axis, bound axis
+    size) — shapes ride in as dispatch inputs, so nothing else varies.
+    None (→ the explicit unkeyable marker, so the poison is attributed
+    instead of silent) when the axis size cannot be read."""
+    try:
+        return (kind, MODEL_AXIS, int(axis_size(MODEL_AXIS))) + extra
+    except Exception:
+        return None
 
 
 def in_spmd_axis(axis_name=MODEL_AXIS):
@@ -59,6 +71,7 @@ def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
             return (jax.lax.psum(g, MODEL_AXIS),)
         ident.defvjp(fwd, bwd)
         return ident(v)
+    mark_collective(fn, _mp_collective_key("c_identity"))
     return call_op("c_identity", fn, (t,))
 
 
@@ -81,6 +94,7 @@ def _mp_allreduce(tensor, group=None, use_calc_stream=True,
             return (g,)
         allred.defvjp(fwd, bwd)
         return allred(v)
+    mark_collective(fn, _mp_collective_key("mp_allreduce"))
     return call_op("mp_allreduce", fn, (t,))
 
 
@@ -92,6 +106,7 @@ def _c_concat(tensor, group=None):
 
     def fn(v):
         return jax.lax.all_gather(v, MODEL_AXIS, axis=v.ndim - 1, tiled=True)
+    mark_collective(fn, _mp_collective_key("c_concat"))
     return call_op("c_concat", fn, (t,))
 
 

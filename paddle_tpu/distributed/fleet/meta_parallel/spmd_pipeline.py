@@ -400,6 +400,7 @@ class PipelineTrainStep:
         self._remat = remat
         self._flat = flat
         self._jitted = None
+        self._program = None
 
     # -- construction -----------------------------------------------------
     def _resolve_stage_sizes(self, flat, start, count):
@@ -645,6 +646,9 @@ class PipelineTrainStep:
 
         def step(outer_vals, stacked_vals, outer_accs, stacked_accs,
                  x, y, lr, step_count, key):
+            from ....profiler.step_fusion import STEP_STATS
+            STEP_STATS.retraces += 1   # side effect: runs only while tracing
+
             def closure(train_outer, train_stacked):
                 full_outer, ti = [], 0
                 for p, v in zip(outer, outer_vals):
@@ -689,9 +693,41 @@ class PipelineTrainStep:
                     out_stacked.append(v)
             return loss, out_outer, out_stacked, new_oaccs, new_saccs
 
+        # Route the program through the promotion funnel
+        # (ops/spmd_fusion.py pipeline registry) instead of an anonymous
+        # bare jit: the compiled step gets a canonical mesh-keyed
+        # signature, step.promote/step.fire flight-recorder events, and
+        # schedule churn over the same mesh + stage structure is
+        # attributed as `pipe_schedule_mismatch`.
+        from ....ops import spmd_fusion as _spmd_fusion
+        stage_struct = tuple(
+            (tuple(leaf.shape), str(leaf.dtype)) for leaf in self._stacked)
+        stage_struct += (("outer",) + tuple(
+            (tuple(p._value.shape), str(p._value.dtype),
+             bool(p.stop_gradient)) for p in outer),)
+        if self._stage_sizes_eff is not None:
+            stage_struct += (("ragged",) + tuple(self._stage_sizes_eff),)
+        if self._remat:
+            stage_struct += (("remat",),)
+        # architecture + per-model token: same-shaped models with
+        # different block code (or config buried in layer attributes)
+        # must never alias one compiled program
+        stage_struct += (("arch",)
+                         + tuple(type(l).__qualname__ for l in flat)
+                         + (id(flat[0]) if flat else 0,),)
+        sig = _spmd_fusion.pipeline_signature(
+            mesh, axis, S, V, M, stage_struct, opt)
+        label = (f"pipeline[{S}pp×{V}v×{M}mb]+{type(opt).__name__}"
+                 f"@mesh[{axis}]")
+        # unfused-schedule launch estimate: per micro-batch one forward
+        # and one backward launch per block plus the boundary update
+        n_launches = M * max(1, len(self._blocks)) * 2 + 1
+        self._program = _spmd_fusion.promote_pipeline(
+            sig, label, lambda: jax.jit(step, donate_argnums=(2, 3)),
+            n_launches=n_launches)
         # donate accumulators only: params are aliased by live eager
         # Parameter wrappers on the first step (same policy as TrainStep)
-        self._jitted = jax.jit(step, donate_argnums=(2, 3))
+        self._jitted = self._program.exe
         self._outer_vals = [p._value for p in outer]
 
     # -- execution --------------------------------------------------------
@@ -715,6 +751,11 @@ class PipelineTrainStep:
             self._stacked_accs = self._jitted(
                 self._outer_vals, self._stacked, self._outer_accs,
                 self._stacked_accs, xv, yv, lr, sc, key)
+        if self._program is not None:
+            from ....ops import spmd_fusion as _spmd_fusion
+            _spmd_fusion.fire_pipeline(self._program)
+        from ....profiler import goodput as _goodput
+        _goodput.on_step(opt)
         from ....framework.flags import _FLAGS
         if _FLAGS.get("FLAGS_check_nan_inf") and \
                 not bool(jnp.isfinite(loss)):

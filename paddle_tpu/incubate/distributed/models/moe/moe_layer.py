@@ -31,6 +31,8 @@ from .....nn.layer_base import Layer
 from .....nn import initializer as I
 from .....nn.initializer_util import materialize_parameter, ParamAttr
 from .....ops._helpers import ensure_tensor, call_op_multi
+from .....ops.dispatch import mark_collective
+from .....distributed.mesh import current_mesh, mesh_key
 from .....distributed.fleet.meta_parallel.mp_ops import in_spmd_axis
 from .gate import top1_dispatch, top2_dispatch, naive_dispatch
 
@@ -134,6 +136,21 @@ class MoELayer(Layer):
             y = jnp.einsum("tec,ecm->tm", combine, out)
             return y.reshape(xv.shape), aux.astype(jnp.float32)
 
+        # Funnel keying: fn closes over `self` (unkeyable by the closure
+        # scan), but the traced program is fully determined by the gate
+        # kind, embedding size, the expert axis + mesh, and the ACTIVE
+        # capacity factor — token/expert counts ride in via input shapes.
+        # Stamping that identity (ops/dispatch.mark_collective) lets MoE
+        # dispatch join chain fusion and the super-cycle instead of
+        # poisoning every cycle as `collective_unkeyed`.
+        mkey = mesh_key(current_mesh()) if spmd else None
+        cf = self.capacity_factor if self.training else \
+            self.eval_capacity_factor
+        key = None
+        if not spmd or mkey is not None:
+            key = ("moe_layer", self.gate_type, self.top_k, self.d_model,
+                   axis, bool(spmd), float(cf), mkey)
+        mark_collective(fn, key)
         y, aux = call_op_multi(
             "moe_layer", fn,
             (x, self.gate_weight, self.w1, self.b1, self.w2, self.b2), 2)

@@ -1017,12 +1017,19 @@ def step_digest(sig, opt, updated):
     from .step_fusion import _snapshot_obj
     try:
         if sig and sig[0] == "super":
-            _tag, cg_e, seg_entries, scaler_e, step_e = sig
+            _tag, cg_e, seg_entries, scaler_e, step_e = sig[:5]
             entries = ("super", _canon_cycle_entries(tuple(seg_entries)),
                        cg_e is not None,
                        None if scaler_e is None
                        else ("scaler", _canon(scaler_e[2], 1)),
                        ("step", len(step_e[2])))
+            if len(sig) > 5:
+                # ragged tail: the tail segment joins the digest so a
+                # ragged program never aliases its uniform twin (the main
+                # sub/update pair restores from the store; the tail sub
+                # compiles live)
+                entries += (("tail",
+                             _canon_cycle_entries(tuple(sig[5]))),)
         else:
             entries = _canon_cycle_entries(sig)
         accs = tuple(sorted(getattr(opt, "_accumulators", {}).keys()))

@@ -58,7 +58,9 @@ from ..framework.flags import _FLAGS
 __all__ = ["MeshPlan", "plan_program", "enabled", "sync_root_and_grads",
            "global_finite", "sharded_single_update", "compile_step",
            "compile_accum", "compile_update", "zero_accum",
-           "fire_mismatch", "probation_tolerance"]
+           "fire_mismatch", "probation_tolerance",
+           "pipeline_signature", "promote_pipeline", "fire_pipeline",
+           "clear_pipeline_programs"]
 
 
 def enabled():
@@ -498,3 +500,126 @@ def probation_tolerance(dtype):
     if d in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
         return 3e-2, 1e-2
     return 2e-3, 1e-5
+
+
+# ---------------------------------------------------------------------------
+# pipeline promotion registry
+# ---------------------------------------------------------------------------
+# The pipe-axis train step (meta_parallel/spmd_pipeline.PipelineTrainStep)
+# is already ONE shard_map program — k micro-batches rotated between stages
+# by a single lax.ppermute per scan step, fwd+bwd+update fused. What it
+# lacked was the funnel's bookkeeping: programs compiled as anonymous bare
+# jits, invisible to the flight recorder and the retrace counters, and a
+# schedule change (micro-batch count, virtual-stage interleave, optimizer
+# swap) silently rebuilt the whole step. This registry gives every pipeline
+# program the same lifecycle as a promoted cycle: a canonical mesh-keyed
+# signature, step.promote / step.fire events, STEP_STATS accounting, and a
+# `pipe_schedule_mismatch` record when a new schedule forces a second
+# program over the same mesh + stage structure.
+
+_PIPE_PROGRAMS = {}        # sig -> _PipelineProgram
+_PIPE_BASES = {}           # base key -> last schedule tuple seen
+
+
+class _PipelineProgram:
+    """One promoted pipeline train-step executable."""
+
+    __slots__ = ("sig", "label", "exe", "fires", "n_launches", "chain",
+                 "entries", "spmd_plan")
+
+    def __init__(self, sig, label, exe, n_launches):
+        self.sig = sig
+        self.label = label
+        self.exe = exe
+        self.fires = 0
+        self.n_launches = n_launches
+        # goodput.on_fused_fire introspection surface (no recorded cycle:
+        # bench legs pin exact FLOPs for pipeline programs)
+        self.chain = None
+        self.entries = ()
+        self.spmd_plan = None
+
+
+def pipeline_signature(mesh, axis, num_stages, num_virtual, num_micro,
+                       stage_struct, opt):
+    """Canonical identity of one pipeline train-step program: the mesh key
+    + pipe axis name + stage structure (what is compiled in) and the
+    schedule + optimizer binding (what forces a recompile). Returns None
+    when the mesh has no canonical key — the caller falls back to an
+    anonymous jit and the build is attributed `collective_unkeyed`."""
+    from ..distributed.mesh import mesh_key
+    mk = mesh_key(mesh)
+    if mk is None:
+        return None
+    try:
+        opt_key = (type(opt).__qualname__, tuple(opt._extra_cache_key()))
+    except Exception:
+        opt_key = (type(opt).__qualname__,)
+    return ("pipe", mk, axis,
+            (int(num_stages), int(num_virtual), int(num_micro)),
+            tuple(stage_struct), opt_key)
+
+
+def _pipe_base(sig):
+    # everything but the schedule triple: same mesh + stage structure
+    return (sig[1], sig[2], sig[4], sig[5])
+
+
+def promote_pipeline(sig, label, build, n_launches=1):
+    """Look up or build the pipeline program for `sig`. `build()` returns
+    the compiled step callable; the first build of a signature emits
+    `step.promote` and counts as a promotion, and a signature that differs
+    from a previously promoted one ONLY in its schedule triple is recorded
+    as `pipe_schedule_mismatch` before building — the doctor's hint for
+    schedule churn. `sig=None` (unkeyable mesh) builds uncached and poisons
+    as `collective_unkeyed`."""
+    from ..profiler.events import EVENTS as _EVENTS
+    from ..profiler.step_fusion import STEP_STATS
+    if sig is None:
+        _EVENTS.emit("step.record", "pipeline_step",
+                     reason="collective_unkeyed",
+                     detail={"kind": "pipe", "label": label})
+        return _PipelineProgram(None, label, build(), n_launches)
+    prog = _PIPE_PROGRAMS.get(sig)
+    if prog is not None:
+        return prog
+    base = _pipe_base(sig)
+    prev_sched = _PIPE_BASES.get(base)
+    if prev_sched is not None and prev_sched != sig[3]:
+        _EVENTS.emit("step.record", "pipeline_step",
+                     reason="pipe_schedule_mismatch",
+                     detail={"kind": "pipe", "label": label,
+                             "prev_schedule": prev_sched,
+                             "schedule": sig[3]})
+    prog = _PipelineProgram(sig, label, build(), n_launches)
+    _PIPE_PROGRAMS[sig] = prog
+    _PIPE_BASES[base] = sig[3]
+    if len(_PIPE_PROGRAMS) > 16:
+        _PIPE_PROGRAMS.pop(next(iter(_PIPE_PROGRAMS)))
+    STEP_STATS.promoted(label)
+    _EVENTS.emit("step.promote", label,
+                 detail={"pipe": True, "schedule": sig[3],
+                         "mesh_axes": sig[1][0] if sig[1] else None,
+                         "launches_estimate": n_launches})
+    return prog
+
+
+def fire_pipeline(prog):
+    """One completed pipeline step through `prog.exe`: the step.fire /
+    goodput accounting of a fused replay (launch savings are the unfused
+    schedule's per-micro-batch launches collapsed into one program)."""
+    from ..profiler.events import EVENTS as _EVENTS
+    from ..profiler.step_fusion import STEP_STATS
+    from ..profiler import goodput as _goodput
+    prog.fires += 1
+    STEP_STATS.replay(prog.label, prog.n_launches, 0)
+    _goodput.on_fused_fire(prog)
+    _EVENTS.emit("step.fire", prog.label,
+                 detail={"pipe": True, "fires": prog.fires,
+                         "launches_saved": prog.n_launches - 1})
+
+
+def clear_pipeline_programs():
+    """Test/teardown hook: drop every promoted pipeline program."""
+    _PIPE_PROGRAMS.clear()
+    _PIPE_BASES.clear()

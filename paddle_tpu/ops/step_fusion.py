@@ -186,7 +186,9 @@ class _StepProgram:
                  "fail_streak", "dead", "_exe", "_shims", "donate_params",
                  "check", "scaler_ref", "scaler_consts", "aot_digest",
                  "aot_stored", "spmd_plan", "spmd_ok", "rng_slots",
-                 "super", "seg_start", "_sub_exe", "_upd_exe", "_zero_acc")
+                 "super", "seg_start", "_sub_exe", "_upd_exe", "_zero_acc",
+                 "tail_chain", "tail_root_flat", "tail_rng_slots",
+                 "_tail_sub_exe")
 
     def __init__(self):
         self.fail_streak = 0
@@ -224,7 +226,16 @@ class _StepProgram:
         self.seg_start = 0      # entry index of the segment's first entry
         self._sub_exe = None
         self._upd_exe = None
-        self._zero_acc = None   # (zero grad accumulators, True scalar)
+        self._zero_acc = None
+        # ragged tail (epoch-boundary batches): a SECOND op template +
+        # sub-executable for the one smaller micro-batch closing the
+        # accumulation loop — k−1 full rounds fire the main sub, the tail
+        # round fires this one into the SAME accumulator (grads share the
+        # param avals, so the shapes agree). ≤3 executables total.
+        self.tail_chain = None
+        self.tail_root_flat = None
+        self.tail_rng_slots = ()
+        self._tail_sub_exe = None   # (zero grad accumulators, True scalar)
 
     def release_heavy(self):
         """A deactivated program stays in the library as a tombstone (so
@@ -237,6 +248,7 @@ class _StepProgram:
         self._sub_exe = None
         self._upd_exe = None
         self._zero_acc = None
+        self._tail_sub_exe = None
 
     # -- the fused executable ----------------------------------------------
     def _grad_transform(self, pvals, grads):
@@ -457,6 +469,17 @@ class _StepProgram:
             self._upd_exe = self._compile_update()
         return self._upd_exe
 
+    def tail_sub_exe(self):
+        """The ragged-tail sub-executable: the same fwd+vjp+accumulate
+        body compiled against the TAIL segment's op template (the one
+        smaller epoch-boundary micro-batch). Adds into the same
+        accumulator as the main sub — grads share the param avals."""
+        if self._tail_sub_exe is None:
+            self._tail_sub_exe = self._compile_sub(
+                chain=self.tail_chain, root_flat=self.tail_root_flat,
+                rng_slots=self.tail_rng_slots)
+        return self._tail_sub_exe
+
     def _maybe_load_super(self):
         """AOT warm start for the super-cycle pair: deserialize both
         stored executables (zero fresh traces); corrupt or mismatched
@@ -489,19 +512,19 @@ class _StepProgram:
             self._zero_acc = (accs, jnp.asarray(True))
         return self._zero_acc
 
-    def _compile_sub(self):
+    def _compile_sub(self, chain=None, root_flat=None, rng_slots=None):
         from . import guardian
         from . import spmd_fusion as _spmd
         plan = self.spmd_plan
-        chain = self.chain
+        chain = self.chain if chain is None else chain
         pure = chain.pure_fn
-        root = self.root_flat
+        root = self.root_flat if root_flat is None else root_flat
         seed_shape, seed_dtype = chain.flat_avals[root][:2]
         param_slots = tuple(sorted(self.param_slots.items()))
         ext_order = self.ext_order
         n_ext = chain.n_ext
-        rng_items = tuple(sorted(self.rng_slots.items())) \
-            if self.rng_slots else ()
+        rng_slots = self.rng_slots if rng_slots is None else rng_slots
+        rng_items = tuple(sorted(rng_slots.items())) if rng_slots else ()
         n_rng = 2 if rng_items else 0
         check = self.check
 
@@ -638,7 +661,7 @@ class _PendingStep:
                  "ext_edges", "placeholders", "params", "grad_phs",
                  "backward_done", "fired", "done", "lock", "t0",
                  "rng_epoch0", "rng_base", "rounds", "round_losses",
-                 "acc_vals", "fwd_ok", "sub_args")
+                 "acc_vals", "fwd_ok", "sub_args", "in_tail", "tail_done")
 
     def __init__(self, program, params, owner):
         self.program = program
@@ -670,7 +693,12 @@ class _PendingStep:
         self.round_losses = []
         self.acc_vals = None
         self.fwd_ok = None
-        self.sub_args = None    # last sub fire's args (AOT export specs)
+        self.sub_args = None    # last MAIN sub fire's args (AOT export)
+        # ragged tail: the current round is matching against the TAIL op
+        # template (the smaller epoch-boundary micro-batch); tail_done
+        # records that a tail round already archived this cycle
+        self.in_tail = False
+        self.tail_done = False
 
 
 class _TLS(threading.local):
@@ -761,7 +789,7 @@ class _StepFusionManager:
                         self._split(pending, escape=False,
                                     reason="event_mismatch", blocked_op=name)
                         return MISS
-                    mismatch = self._op_mismatch_reason(
+                    mismatch = self._match_round(
                         program, pending, key, inputs, diff_mask,
                         num_outputs)
                     if mismatch is None:
@@ -775,7 +803,7 @@ class _StepFusionManager:
                 pending = self._start_pending(st, program)
                 if pending is not None:
                     with pending.lock:
-                        mismatch = self._op_mismatch_reason(
+                        mismatch = self._match_round(
                             program, pending, key, inputs, diff_mask,
                             num_outputs)
                         if mismatch is None:
@@ -880,7 +908,8 @@ class _StepFusionManager:
                     # round — fire the reusable sub-executable (grads
                     # accumulate on device) and keep matching: the next
                     # event is either another round or the boundary
-                    if clean and pending.op_pos == len(program.chain.ops):
+                    round_chain = self._round_template(program, pending)[0]
+                    if clean and pending.op_pos == len(round_chain.ops):
                         if pending.rounds:
                             clean = all(
                                 p.grad is ph and not p._hooks
@@ -1203,17 +1232,47 @@ class _StepFusionManager:
         st.pending = pending
         return pending
 
+    @staticmethod
+    def _round_template(program, pending):
+        """(chain, rng_slots) of the op template the CURRENT round matches
+        against — the tail template when a ragged-tail round is in
+        flight, else the main segment."""
+        if program.super and pending.in_tail \
+                and program.tail_chain is not None:
+            return program.tail_chain, program.tail_rng_slots
+        return program.chain, program.rng_slots
+
+    def _match_round(self, program, pending, key, inputs, diff_mask,
+                     num_outputs):
+        """Tail-aware round matching: at a round boundary (op_pos 0) of a
+        ragged-tail program, a main-template key mismatch retries against
+        the TAIL template before splitting — the epoch-boundary batch is
+        the recorded second shape, not a replay failure."""
+        mismatch = self._op_mismatch_reason(program, pending, key, inputs,
+                                            diff_mask, num_outputs)
+        if mismatch is not None and program.super \
+                and program.tail_chain is not None \
+                and pending.op_pos == 0 and not pending.in_tail:
+            pending.in_tail = True
+            tail_mismatch = self._op_mismatch_reason(
+                program, pending, key, inputs, diff_mask, num_outputs)
+            if tail_mismatch is None:
+                return None
+            pending.in_tail = False
+        return mismatch
+
     def _op_mismatch_reason(self, program, pending, key, inputs, diff_mask,
                             num_outputs):
         """None when the incoming dispatch matches the program's next op
         template; else the reason code the split should carry."""
-        op = program.chain.ops[pending.op_pos]
+        chain, rng_slots = self._round_template(program, pending)
+        op = chain.ops[pending.op_pos]
         if key != op.key:
             return _key_diff_reason(op.key, key)
         if diff_mask != op.diff_mask or num_outputs != op.num_outputs \
                 or len(inputs) != len(op.wiring):
             return "key_mismatch"
-        slots = program.chain.ext_of[pending.op_pos]
+        slots = chain.ext_of[pending.op_pos]
         for k, (t, w) in enumerate(zip(inputs, op.wiring)):
             if _is_pending(t) and t._pending_chain is pending:
                 if w[0] != "prev" or t._chain_coord != (w[1], w[2]):
@@ -1226,8 +1285,7 @@ class _StepFusionManager:
                     # the slot must be fed by the SAME parameter object the
                     # program was built against — identity is the binding
                     return "param_mismatch"
-                delta = program.rng_slots.get(slots[k]) \
-                    if program.rng_slots else None
+                delta = rng_slots.get(slots[k]) if rng_slots else None
                 if delta is not None:
                     # hoisted RNG slot: the incoming key must sit at the
                     # recorded stream offset from this cycle's first
@@ -1250,12 +1308,13 @@ class _StepFusionManager:
 
     def _defer(self, st, pending, inputs, num_outputs):
         program = pending.program
-        op = program.chain.ops[pending.op_pos]
-        slots = program.chain.ext_of[pending.op_pos]
+        chain, rng_slots = self._round_template(program, pending)
+        op = chain.ops[pending.op_pos]
+        slots = chain.ext_of[pending.op_pos]
         for k, t in enumerate(inputs):
             if op.wiring[k][0] != "ext":
                 continue
-            if program.rng_slots and slots[k] in program.rng_slots:
+            if rng_slots and slots[k] in rng_slots:
                 # hoisted RNG slot: keep the LAZY key tensor — the fused
                 # fire derives the key in-graph (nothing launches), and a
                 # transactional split forces it then (bitwise the same
@@ -1582,7 +1641,11 @@ class _StepFusionManager:
         captured state and reset the per-round cursors so the next event
         may open another round or hit the boundary."""
         pending.rounds.append([pending.ext_vals, pending.ext_edges,
-                               pending.placeholders, pending.rng_epoch0])
+                               pending.placeholders, pending.rng_epoch0,
+                               pending.in_tail])
+        if pending.in_tail:
+            pending.tail_done = True
+        pending.in_tail = False
         pending.ext_vals = []
         pending.ext_edges = []
         pending.placeholders = []
@@ -1613,7 +1676,9 @@ class _StepFusionManager:
                                            pending.rng_epoch0,
                                            pending.acc_vals,
                                            pending.fwd_ok)
-                out = program.sub_exe()(*args)
+                exe = program.tail_sub_exe() if pending.in_tail \
+                    else program.sub_exe()
+                out = exe(*args)
             except jax.errors.JaxRuntimeError:
                 self._split(pending, escape=False, reason="exec_fault",
                             blocked_op="backward")
@@ -1629,7 +1694,10 @@ class _StepFusionManager:
             pending.acc_vals = list(out[1])
             if program.check:
                 pending.fwd_ok = out[2]
-            pending.sub_args = args
+            if not pending.in_tail:
+                # AOT export specs must describe the MAIN sub's arg
+                # shapes; a tail round's smaller batch would corrupt them
+                pending.sub_args = args
         self._archive_round(pending)
         return True
 
@@ -1714,7 +1782,8 @@ class _StepFusionManager:
             # each round's loss: served from its sub-executable output,
             # tape-marked consumed (one FusedStepNode per micro-batch)
             i, j = program.root_coord
-            for r, (evals, eedges, rows, ep0) in enumerate(pending.rounds):
+            for r, (evals, eedges, rows, ep0, _tail) in \
+                    enumerate(pending.rounds):
                 root_ph = rows[i][j]
                 rv = pending.round_losses[r]
                 if _VALUE_SLOT.__get__(root_ph) is _PENDING:
@@ -1792,10 +1861,12 @@ class _StepFusionManager:
             bake_decay_flags(opt, params)
             zeros, fwd_ok = program.zero_state()
             acc = [scratch(z) for z in zeros]
-            for evals, eedges, rows, ep0 in pending.rounds:
+            for evals, eedges, rows, ep0, is_tail in pending.rounds:
                 args = self._sub_fire_args(program, evals, ep0, acc,
                                            fwd_ok)
-                out = program.sub_exe()(*args)
+                exe = program.tail_sub_exe() if is_tail \
+                    else program.sub_exe()
+                out = exe(*args)
                 losses.append(out[0])
                 acc = list(out[1])
                 if program.check:
@@ -1826,7 +1897,8 @@ class _StepFusionManager:
         why = "trace_fail" if fused is None else None
         if ok:
             i, j = program.root_coord
-            for r, (evals, eedges, rows, ep0) in enumerate(pending.rounds):
+            for r, (evals, eedges, rows, ep0, _tail) in \
+                    enumerate(pending.rounds):
                 ev = np.asarray(_VALUE_SLOT.__get__(rows[i][j]))
                 rt, at = _spmd.probation_tolerance(ev.dtype)
                 if not np.allclose(np.asarray(losses[r]), ev, rtol=rt,
@@ -2042,12 +2114,14 @@ class _StepFusionManager:
 
             if program.super:
                 # a fired super-cycle's intermediates: every round
-                # replays from its own captured inputs
-                for evals, eedges, store, _ep in pending.rounds:
+                # replays from its own captured inputs (tail rounds
+                # through the tail op template)
+                for evals, eedges, store, _ep, is_tail in pending.rounds:
+                    ops = program.tail_chain.ops if is_tail \
+                        else program.chain.ops
                     self._force_rng_ext(program, evals)
-                    replay_ops_per_op(program.chain.ops, evals, eedges,
-                                      revive(store),
-                                      len(program.chain.ops),
+                    replay_ops_per_op(ops, evals, eedges,
+                                      revive(store), len(ops),
                                       skip_materialized=True)
                 pending.done = True
                 return
@@ -2110,7 +2184,6 @@ class _StepFusionManager:
         pending.lock."""
         st = self._tls
         program = pending.program
-        n_ops = len(program.chain.ops)
         st.busy = True
         try:
             params = pending.params
@@ -2120,10 +2193,11 @@ class _StepFusionManager:
                 # backward): re-accumulate from scratch
                 for p in params:
                     p.grad = None
-            for evals, eedges, rows, _ep in pending.rounds:
+            for evals, eedges, rows, _ep, is_tail in pending.rounds:
+                ops = program.tail_chain.ops if is_tail \
+                    else program.chain.ops
                 self._force_rng_ext(program, evals)
-                replay_ops_per_op(program.chain.ops, evals, eedges, rows,
-                                  n_ops)
+                replay_ops_per_op(ops, evals, eedges, rows, len(ops))
                 root = rows[i][j]
                 node = _NODE_SLOT.__get__(root)
                 if node is not None:
@@ -2133,8 +2207,9 @@ class _StepFusionManager:
                     run_backward(node, _IDX_SLOT.__get__(root), seed)
             # current round's deferred prefix (its backward — if one is in
             # flight — is run by the caller on the replayed real graph)
+            cur_ops = self._round_template(program, pending)[0].ops
             self._force_rng_ext(program, pending.ext_vals)
-            replay_ops_per_op(program.chain.ops, pending.ext_vals,
+            replay_ops_per_op(cur_ops, pending.ext_vals,
                               pending.ext_edges, pending.placeholders,
                               pending.op_pos)
             if pending.grad_phs is not None:
@@ -2340,6 +2415,14 @@ class _StepFusionManager:
             rebased.append(("bwd", (bi, bcoord[1])))
             canon.append(tuple(rebased))
         if any(c != canon[0] for c in canon[1:]):
+            # Ragged tail: k−1 identical full segments + one differing
+            # final segment (the epoch-boundary short micro-batch). The
+            # tail shape joins the signature — same sig on every epoch,
+            # one extra tail sub-executable, still ≤3 programs total.
+            if k >= 3 and canon[-1] != canon[0] \
+                    and all(c == canon[0] for c in canon[1:-1]):
+                return ("super", cg, canon[0], scaler_e, step_e,
+                        canon[-1])
             return None
         return ("super", cg, canon[0], scaler_e, step_e)
 
@@ -2566,7 +2649,8 @@ class _StepFusionManager:
                                  "super": True})
             return None
 
-        _tag, cg_e, seg_entries, scaler_e, _step_e = sig
+        _tag, cg_e, seg_entries, scaler_e, _step_e = sig[:5]
+        tail_entries = sig[5] if len(sig) > 5 else None
         seg_ops = len(seg_entries) - 1
         k = cyc.n_backward
         if not cyc.ops or not updated:
@@ -2637,6 +2721,54 @@ class _StepFusionManager:
                     if s is None or s in param_slots:
                         return unbuildable("rng_wiring")
                     rng_slots[s] = delta
+        # ragged tail: build the tail segment's own chain. It compiles to
+        # a SECOND sub-executable that adds into the same accumulator —
+        # grads share the param avals regardless of batch shape — so the
+        # program stays ≤3 executables (main sub, tail sub, update).
+        tail_chain = tail_root_flat = None
+        tail_rng_slots = {}
+        if tail_entries is not None:
+            tail_base = (k - 1) * seg_ops
+            recs_tail = cyc.ops[tail_base:]
+            tail_ops = []
+            for r in recs_tail:
+                # recorded wiring is cycle-global; rebase to tail-local
+                # (cross-segment dataflow already excluded by _super_sig)
+                wiring = tuple(
+                    ("prev", w[1] - tail_base, w[2]) if w[0] == "prev"
+                    else w
+                    for w in r.wiring)
+                tail_ops.append(_ChainOp(
+                    r.name, r.key, r.fn, wiring, r.diff_mask,
+                    r.num_outputs, r.out_avals, r.out_stop_grads))
+            tail_chain = Chain(sig, tail_ops, 0)
+            if not tail_chain.grad_mode \
+                    or tail_chain.n_ext != chain.n_ext:
+                return unbuildable("ragged_tail_mismatch")
+            # the tail must bind the SAME param objects into the SAME
+            # slots — only the data inputs (the short batch) may differ
+            for i, r in enumerate(recs_tail):
+                slots = tail_chain.ext_of[i]
+                for k2, s in enumerate(slots):
+                    if s in param_slots \
+                            and r.ins[k2] is not slot_inputs[s]:
+                        return unbuildable("ragged_tail_mismatch")
+            troot = tail_entries[-1][1]
+            for flat, owner in enumerate(tail_chain.owners):
+                if owner == troot:
+                    tail_root_flat = flat
+                    break
+            if tail_root_flat is None:
+                return unbuildable("root_not_in_chain")
+            for i, e in enumerate(tail_entries[:-1]):
+                if len(e) > 5:
+                    for k2, delta in e[5]:
+                        s = tail_chain.ext_of[i][k2]
+                        if s is None or s in param_slots:
+                            return unbuildable("rng_wiring")
+                        tail_rng_slots[s] = delta
+            if set(tail_rng_slots) != set(rng_slots):
+                return unbuildable("ragged_tail_mismatch")
         entries = []
         if cg_e is not None:
             entries.append(cg_e)
@@ -2652,6 +2784,9 @@ class _StepFusionManager:
         program.seg_start = seg_start
         program.sig = sig
         program.chain = chain
+        program.tail_chain = tail_chain
+        program.tail_root_flat = tail_root_flat
+        program.tail_rng_slots = tail_rng_slots
         program.entries = tuple(entries)
         program.root_coord = root_coord
         program.root_flat = root_flat

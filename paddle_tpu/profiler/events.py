@@ -155,6 +155,12 @@ REASON_CODES = frozenset({
     "spmd_divergence",     # probation fire diverged from the eager step:
                            # the cycle violates the data-parallel pmean
                            # contract; demoted to the plain jit lowering
+    "pipe_schedule_mismatch",  # a promoted pipeline program's schedule
+                           # (micro-batch count / virtual stages /
+                           # optimizer binding) changed for the same mesh
+                           # + stage structure: a SECOND program compiles
+                           # — expected at schedule boundaries, a perf
+                           # bug when it churns every step
     # -- AOT executable store decisions (ops/aot_cache.py) -----------------
     "artifact_corrupt",    # torn/garbled artifact: quarantined + recompiled
     "version_skew",        # artifact built under another env fingerprint

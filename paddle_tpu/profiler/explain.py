@@ -201,6 +201,14 @@ REASON_HINTS = {
         "so the pmean contract does not hold. The step still fused "
         "through the plain jit lowering (GSPMD-exact); to get explicit "
         "collectives, make the loss a mean over the batch."),
+    "pipe_schedule_mismatch": (
+        "a promoted pipeline train-step's schedule changed (micro-batch "
+        "count, virtual-stage interleave, or optimizer binding) over the "
+        "SAME mesh and stage structure, forcing a second compiled "
+        "program. Expected once at deliberate schedule boundaries "
+        "(curriculum batch-size ramps); a mismatch recorded every step "
+        "means the loop alternates schedules and pays a retrace each "
+        "time — pin accumulate_steps/num_virtual per phase."),
     "artifact_corrupt": (
         "an AOT store artifact failed its CRC/envelope check (torn "
         "write, bit rot, truncation) — it was quarantined as *.corrupt "

@@ -157,8 +157,10 @@ class TestPublicContract:
             "client_cancel", "deadline_expired", "queue_full",
             "deadline_infeasible", "step_hang", "decode_fault",
             "crash_resume",
-            # distributed step fusion (PR 10, ops/spmd_fusion.py)
+            # distributed step fusion (PR 10, ops/spmd_fusion.py);
+            # pipeline promotion registry (PR 16) adds schedule churn
             "collective_unkeyed", "mesh_mismatch", "spmd_divergence",
+            "pipe_schedule_mismatch",
             # AOT executable-store decisions (PR 9, ops/aot_cache.py)
             "artifact_corrupt", "version_skew",
             # kernel tier (PR 11, FLAGS_serve_attention_kernel + int8 KV)

@@ -40,7 +40,8 @@ _FIXTURE_PATHS = {
     "R1": ["r1_unkeyable.py"],
     "R2": ["r2_stateful_rng.py"],
     "R3": ["r3_host_sync.py"],
-    "R4": ["distributed/r4_unkeyed.py"],
+    "R4": ["distributed/r4_unkeyed.py",
+           "incubate/distributed/r4_lax_unkeyed.py"],
     "R5": ["r5_project"],
     "R6": ["serving/r6_locks.py"],
 }
@@ -91,9 +92,13 @@ class TestRuleFixtures:
         fs = _fixture_findings("R4")
         assert _triples(fs) == [
             ("R4", "collective_unkeyed", 8),   # pg call outside the funnel
+            ("R4", "collective_unkeyed", 13),  # unstamped lax.ppermute
             ("R4", "collective_unkeyed", 14),  # funnel without the stamp
+            ("R4", "collective_unkeyed", 20),  # unstamped lax.all_to_all
         ]
         assert not any(f.symbol == "good_marked_collective" for f in fs)
+        # the stamped and shard_map-only lax forms stay clean
+        assert not any(f.symbol.startswith("good_") for f in fs)
 
     def test_r5_contract_coverage(self):
         fs = _fixture_findings("R5")

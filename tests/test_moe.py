@@ -96,3 +96,130 @@ def test_moe_expert_parallel_matches_dense():
     sharded = jax.jit(fn)(x, wg, w1, b1, w2, b2)
     np.testing.assert_allclose(np.asarray(sharded), np.asarray(dense),
                                rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# PR 16 tentpole (b): the MoE dispatch rides the promotion funnel — the
+# gate fn is stamped via dispatch.mark_collective, so gshard/switch MoE
+# keys by (gate, d_model, axis, capacity, mesh) instead of poisoning
+# every cycle as collective_unkeyed.
+# ---------------------------------------------------------------------------
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.ops.dispatch import clear_dispatch_cache
+from paddle_tpu.profiler import (reset_step_fusion_stats,
+                                 step_fusion_stats)
+
+_FUNNEL_FLAGS = {
+    "FLAGS_eager_op_cache": True,
+    "FLAGS_eager_op_cache_size": 512,
+    "FLAGS_eager_chain_fusion": True,
+    "FLAGS_eager_chain_fusion_min_count": 3,
+    "FLAGS_eager_chain_cache_size": 128,
+    "FLAGS_eager_step_fusion": True,
+    "FLAGS_eager_step_fusion_min_count": 4,
+    "FLAGS_eager_step_fusion_cache_size": 8,
+}
+
+
+@pytest.fixture
+def funnel():
+    set_flags(dict(_FUNNEL_FLAGS))
+    clear_dispatch_cache()
+    reset_step_fusion_stats()
+    yield
+    set_flags(dict(_FUNNEL_FLAGS))
+    clear_dispatch_cache()
+    reset_step_fusion_stats()
+
+
+def _moe_train(fused, gate, n=12, cf=4.0, seed=5):
+    set_flags({"FLAGS_eager_step_fusion": fused,
+               "FLAGS_eager_chain_fusion": fused,
+               "FLAGS_eager_op_cache": fused})
+    clear_dispatch_cache()
+    paddle.seed(seed)
+    rng = np.random.default_rng(seed)
+    x = paddle.to_tensor(
+        rng.standard_normal((4, 12, M)).astype(np.float32))
+    m = MoELayer(M, H, E, gate=gate, capacity_factor=cf,
+                 eval_capacity_factor=cf)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+    losses = []
+    for _ in range(n):
+        y = m(x)
+        loss = paddle.mean(paddle.multiply(y, y)) + 0.01 * m.l_aux
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    # snapshot BEFORE the trailing eval forward: that read escapes the
+    # then-pending cycle by design and would count one fallback split
+    stats = dict(step_fusion_stats())
+    return (np.asarray(losses), np.asarray(m(x)._value),
+            np.asarray(m.gate_weight._value), stats)
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch"])
+def test_moe_funnel_parity(funnel, gate):
+    """Fused-vs-eager training trajectories match at 8 experts; the
+    gate promotes (steps_promoted ≥ 1) instead of poisoning as
+    collective_unkeyed, and replays with zero fresh retraces."""
+    eager_l, eager_y, eager_wg, _ = _moe_train(False, gate)
+    fused_l, fused_y, fused_wg, s = _moe_train(True, gate)
+    assert s["steps_promoted"] >= 1, s
+    assert s["fused_steps"] >= 4, s
+    assert s["fallback_splits"] == 0, s
+    np.testing.assert_allclose(fused_l, eager_l, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(fused_y, eager_y, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(fused_wg, eager_wg, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("gate", ["gshard", "switch"])
+def test_moe_capacity_overflow_drops_identical(funnel, gate):
+    """Under a tight capacity factor the gate drops tokens; fused and
+    eager agree on WHICH tokens drop (trajectory parity), and the
+    drops are real (a generous-capacity run diverges)."""
+    eager_l, eager_y, _, _ = _moe_train(False, gate, cf=0.5, seed=9)
+    fused_l, fused_y, _, s = _moe_train(True, gate, cf=0.5, seed=9)
+    assert s["steps_promoted"] >= 1, s
+    np.testing.assert_allclose(fused_l, eager_l, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(fused_y, eager_y, rtol=1e-4, atol=1e-5)
+    roomy_l, _, _, _ = _moe_train(False, gate, cf=8.0, seed=9)
+    assert not np.allclose(roomy_l, eager_l, rtol=1e-5, atol=1e-7), \
+        "capacity 0.5 dropped nothing — the overflow case is untested"
+
+
+def test_moe_zero_steady_retraces(funnel):
+    """After promotion at 8 experts, further steps replay the promoted
+    cycle with ZERO fresh retraces — shapes and the stamped key are
+    stable."""
+    paddle.seed(5)
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(
+        rng.standard_normal((4, 12, M)).astype(np.float32))
+    m = MoELayer(M, H, E, gate="gshard", capacity_factor=4.0,
+                 eval_capacity_factor=4.0)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+
+    def step():
+        y = m(x)
+        loss = paddle.mean(paddle.multiply(y, y)) + 0.01 * m.l_aux
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    for _ in range(10):
+        step()
+    s0 = dict(step_fusion_stats())
+    assert s0["steps_promoted"] >= 1, s0
+    assert s0["fallback_splits"] == 0, s0
+    for _ in range(8):
+        step()
+    s1 = step_fusion_stats()
+    assert s1["retraces"] == s0["retraces"], (s0, s1)
+    assert s1["fallback_splits"] == 0, s1
+    assert s1["fused_steps"] - s0["fused_steps"] == 8, (s0, s1)

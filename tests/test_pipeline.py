@@ -710,3 +710,109 @@ class TestRaggedInterleaved:
         ev = runner.eval_batch((ids, labels))
         assert np.isfinite(float(ev))
         assert np.isfinite(l1)
+
+
+class TestPipelinePromotion:
+    """PR 16 tentpole (a): train_batch over a pipe>1 mesh routes through
+    the ops/spmd_fusion pipeline registry — ONE promoted
+    ppermute-handoff program per (mesh, schedule, stage structure,
+    optimizer), fired with launch accounting and zero steady-state
+    retraces. Interleaved (virtual>1) schedules key into the same
+    signature."""
+
+    @pytest.fixture(autouse=True)
+    def _events_on(self):
+        from paddle_tpu.framework.flags import set_flags, _FLAGS
+        from paddle_tpu.profiler.events import clear_fusion_events
+        from paddle_tpu.profiler import reset_step_fusion_stats
+        from paddle_tpu.ops.spmd_fusion import clear_pipeline_programs
+        prev = bool(_FLAGS.get("FLAGS_profiler_events"))
+        set_flags({"FLAGS_profiler_events": True})
+        clear_fusion_events()
+        clear_pipeline_programs()
+        reset_step_fusion_stats()
+        yield
+        set_flags({"FLAGS_profiler_events": prev})
+        clear_pipeline_programs()
+        set_global_mesh(None)
+
+    def _runner(self, virtual=2, accum=4, layers=8, seed=0):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel, PipelineLayer)
+        mesh = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                          devices=jax.devices()[:2])
+        set_global_mesh(mesh)
+        paddle.seed(seed)
+        model = GPTForCausalLM(tiny_cfg(num_hidden_layers=layers))
+        crit = GPTPretrainingCriterion()
+        pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2,
+                           loss_fn=crit,
+                           num_virtual_pipeline_stages=virtual)
+        runner = PipelineParallel(pl, hcg=None)
+        runner.accumulate_steps = accum
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=model.parameters())
+        rng = np.random.default_rng(seed)
+        ids = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+        return runner, opt, ids, labels
+
+    def _events(self, cat, reason=None):
+        from paddle_tpu.profiler.events import fusion_events
+        return [e for e in fusion_events()
+                if e["cat"] == cat
+                and (reason is None or e.get("reason") == reason)]
+
+    def test_pp_interleaved_promotes_fires_zero_steady_retraces(self):
+        from paddle_tpu.profiler import step_fusion_stats
+        runner, opt, ids, labels = self._runner(virtual=2)
+        losses = [float(runner.train_batch((ids, labels), opt))
+                  for _ in range(3)]
+        s0 = dict(step_fusion_stats())
+        promotes = self._events("step.promote")
+        assert len(promotes) == 1, promotes
+        d = promotes[0]["detail"]
+        assert d["pipe"] is True
+        # interleaved schedule keys into the signature: (S, V, M)
+        assert tuple(d["schedule"]) == (2, 2, 4), d
+        assert d["launches_estimate"] > 1
+        # every train_batch fired the ONE promoted program
+        assert len(self._events("step.fire")) == 3
+        assert not self._events("step.split")
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+        # steady state: more batches, zero fresh retraces
+        for _ in range(4):
+            runner.train_batch((ids, labels), opt)
+        s1 = step_fusion_stats()
+        assert s1["retraces"] == s0["retraces"], (s0, s1)
+        assert len(self._events("step.fire")) == 7
+
+    def test_schedule_change_is_attributed(self):
+        """Rebinding the SAME model+mesh+optimizer to a different
+        micro-batch count re-promotes and emits the
+        pipe_schedule_mismatch attribution (the REASON_CODES entry the
+        doctor hints on)."""
+        runner, opt, ids, labels = self._runner(virtual=2)
+        runner.train_batch((ids, labels), opt)
+        assert len(self._events("step.promote")) == 1
+        runner.accumulate_steps = 2          # new M over the same base
+        runner.train_batch((ids, labels), opt)
+        assert len(self._events("step.promote")) == 2
+        mismatches = self._events("step.record", "pipe_schedule_mismatch")
+        assert len(mismatches) == 1, mismatches
+        det = mismatches[0]["detail"]
+        assert tuple(det["prev_schedule"]) == (2, 2, 4)
+        assert tuple(det["schedule"]) == (2, 2, 2)
+
+    def test_distinct_models_do_not_alias(self):
+        """Two models with identical architecture promote two programs
+        (the per-model token in the stage structure): no cross-model
+        executable aliasing."""
+        r1, o1, ids, labels = self._runner(virtual=2, seed=0)
+        r1.train_batch((ids, labels), o1)
+        r2, o2, _, _ = self._runner(virtual=2, seed=1)
+        r2.train_batch((ids, labels), o2)
+        assert len(self._events("step.promote")) == 2
+        # same-shape schedules on DIFFERENT models are not a mismatch
+        assert not self._events("step.record", "pipe_schedule_mismatch")

@@ -836,3 +836,94 @@ class TestSuperCycle:
         assert s["fused_steps"] >= 8, s
         np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(wf, wu, rtol=1e-4, atol=1e-5)
+
+
+class TestRaggedTail:
+    """PR 16 tentpole (c): an epoch of k−1 full micro-batches plus one
+    SMALLER tail micro-batch (dataset length not divisible by the accum
+    factor) promotes with ONE extra tail sub-executable keyed by the
+    tail shape — ≤3 executables total, zero steady-state retraces — and
+    the tail's grads ADD into the same accumulator the full rounds
+    feed."""
+
+    def _ragged_run(self, fused, n=14, k=4, kind="sgd", seed=3):
+        set_flags({"FLAGS_eager_step_fusion": fused})
+        clear_dispatch_cache()
+        paddle.seed(seed)
+        rng = np.random.default_rng(11)
+        xs = [paddle.to_tensor(
+            rng.standard_normal((8, 16)).astype(np.float32))
+            for _ in range(k - 1)]
+        # the short epoch-boundary batch: 3 rows instead of 8
+        xs.append(paddle.to_tensor(
+            rng.standard_normal((3, 16)).astype(np.float32)))
+        w = paddle.to_tensor(
+            rng.standard_normal((16, 16)).astype(np.float32),
+            stop_gradient=False)
+        b = paddle.to_tensor(rng.standard_normal(16).astype(np.float32),
+                             stop_gradient=False)
+        opt = _make_opt(kind, [w, b])
+        losses = []
+        for _ in range(n):
+            per = []
+            for x in xs:
+                y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                loss = paddle.mean(y)   # mean: the tail term differs
+                loss.backward()
+                per.append(loss)
+            opt.step()
+            opt.clear_grad()
+            losses.append([float(l.numpy()) for l in per])
+        return np.asarray(losses), w.numpy().copy()
+
+    @pytest.mark.parametrize("kind", ["sgd", "adam"])
+    def test_ragged_parity_three_executables(self, kind):
+        unfused, w0 = self._ragged_run(False, kind=kind)
+        fused, w1 = self._ragged_run(True, kind=kind)
+        s = step_fusion_stats()
+        assert s["steps_promoted"] >= 1, s
+        assert s["fused_steps"] >= 5, s
+        assert s["fallback_splits"] == 0, s
+        # exactly 3 traces: main sub + tail sub + update — a 4th would
+        # mean the tail retraces per epoch (the irregular_accum bug)
+        assert s["retraces"] == 3, s["retraces"]
+        np.testing.assert_allclose(fused, unfused, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w1, w0, rtol=1e-4, atol=1e-5)
+
+    def test_steady_state_zero_retraces(self):
+        """After warmup, further ragged epochs — and a uniform epoch on
+        the same params — replay with zero fresh retraces."""
+        paddle.seed(6)
+        rng = np.random.default_rng(13)
+        full = paddle.to_tensor(
+            rng.standard_normal((8, 16)).astype(np.float32))
+        short = paddle.to_tensor(
+            rng.standard_normal((3, 16)).astype(np.float32))
+        w = paddle.to_tensor(
+            rng.standard_normal((16, 16)).astype(np.float32),
+            stop_gradient=False)
+        b = paddle.to_tensor(rng.standard_normal(16).astype(np.float32),
+                             stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[w, b])
+
+        def epoch(xs):
+            for x in xs:
+                y = F.gelu(paddle.add(paddle.matmul(x, w), b))
+                paddle.mean(y).backward()
+            opt.step()
+            opt.clear_grad()
+
+        for _ in range(8):
+            epoch([full, full, full, short])
+        s0 = step_fusion_stats()
+        assert s0["steps_promoted"] == 1, s0
+        assert s0["retraces"] == 3, s0["retraces"]
+        for _ in range(6):
+            epoch([full, full, full, short])
+        # an all-full epoch replays main rounds + boundary on the SAME
+        # program (the tail sub simply does not fire)
+        epoch([full, full, full, full])
+        s1 = step_fusion_stats()
+        assert s1["retraces"] == s0["retraces"], s1
+        assert s1["fallback_splits"] == 0, s1
+        assert s1["fused_steps"] - s0["fused_steps"] == 7, s1

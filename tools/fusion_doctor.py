@@ -237,6 +237,96 @@ def _demo_metrics(steps):
                    "FLAGS_check_numerics_level": 0})
 
 
+def _demo_pp(steps):
+    """Pipeline-parallel acceptance fixture: PipelineParallel.train_batch
+    over a pipe=2 × virtual=2 interleaved mesh. The train step routes
+    through the ops/spmd_fusion.py pipeline registry: ONE ppermute-handoff
+    shard_map program, promoted with a canonical mesh-keyed signature —
+    the report reads clean_promotion with step.promote + step.fire from
+    the pipeline funnel. Eager per-op fusion stays OFF here: stage compute
+    lives inside the compiled program, there is no eager cycle to record
+    (runs on the emulated multi-device CPU mesh; --demo pp arms
+    xla_force_host_platform_device_count=8 automatically)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineParallel, PipelineLayer)
+    from paddle_tpu.incubate.models import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+        gpt_pipeline_layers)
+
+    from paddle_tpu.framework.flags import set_flags
+
+    if jax.device_count() < 2:
+        raise SystemExit(
+            "--demo pp needs >=2 devices; run with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    # eager fusion OFF: every stage op runs under the pipeline program's
+    # jit trace (tracer inputs) — recording those as poisons would be
+    # noise about a loop that has no eager cycle at all
+    set_flags({"FLAGS_eager_op_cache": False,
+               "FLAGS_eager_chain_fusion": False,
+               "FLAGS_eager_step_fusion": False})
+    mesh = build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                      devices=jax.devices()[:2])
+    set_global_mesh(mesh)
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=4,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=32, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2,
+                       loss_fn=GPTPretrainingCriterion(),
+                       num_virtual_pipeline_stages=2)
+    runner = PipelineParallel(pl, hcg=None)
+    runner.accumulate_steps = 4
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 128, (4, 16)), jnp.int32)
+    for _ in range(steps):
+        runner.train_batch((ids, labels), opt)
+
+
+def _demo_moe(steps):
+    """Mixture-of-experts acceptance fixture: an MoELayer (gshard top-2
+    gate) training loop. The expert dispatch fn closes over the layer —
+    formerly an unkeyable closure that poisoned every cycle — but now
+    stamps its (kind, gate, d_model, expert-axis, capacity) identity via
+    dispatch.mark_collective, so the whole step promotes through the
+    funnel: clean_promotion, zero steady-state retraces."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    set_flags({"FLAGS_eager_op_cache": True,
+               "FLAGS_eager_chain_fusion": True,
+               "FLAGS_eager_chain_fusion_min_count": 4,
+               "FLAGS_eager_step_fusion": True,
+               "FLAGS_eager_step_fusion_min_count": 5})
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        (rng.standard_normal((16, 32)) * 0.5).astype(np.float32))
+    moe = MoELayer(d_model=32, d_hidden=64, num_experts=8, gate="gshard")
+    moe.train()
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=moe.parameters())
+    for _ in range(steps):
+        y = moe(x)
+        loss = paddle.mean(paddle.multiply(y, y)) + 0.01 * moe.l_aux
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
 def _print_goodput(g):
     """One-line goodput rendering shared by --metrics and --url: the
     fraction, the buckets, and WHICH steps each non-productive bucket
@@ -344,7 +434,8 @@ def main(argv=None) -> int:
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script (after --)")
     ap.add_argument("--demo", choices=("dropout", "masked", "accum",
-                                       "serve", "dp", "metrics"),
+                                       "serve", "dp", "pp", "moe",
+                                       "metrics"),
                     help="run a built-in tiny GPT-ish demo loop instead "
                          "of a script (`dropout`: hoisted-key dropout "
                          "promotes cleanly; `accum`: a k=4 grad-"
@@ -353,9 +444,13 @@ def main(argv=None) -> int:
                          "over a tight KV pool; `dp`: a sharded "
                          "data-parallel loop whose unkeyable grad "
                          "collective blocks promotion — "
-                         "collective_unkeyed; `metrics`: the telemetry "
-                         "plane armed over a promoting loop with an "
-                         "injected guardian skip — live goodput/MFU)")
+                         "collective_unkeyed; `pp`: a pipe=2 × virtual=2 "
+                         "interleaved pipeline promoting through the "
+                         "spmd_fusion pipeline registry; `moe`: a keyed "
+                         "gshard MoE layer riding the funnel; `metrics`: "
+                         "the telemetry plane armed over a promoting "
+                         "loop with an injected guardian skip — live "
+                         "goodput/MFU)")
     ap.add_argument("--steps", type=int, default=20,
                     help="demo loop steps (requests, for --demo serve; "
                          "default 20)")
@@ -387,6 +482,14 @@ def main(argv=None) -> int:
                     help="with --cache: run the size/age eviction now "
                          "(also removes quarantined *.corrupt files)")
     args = ap.parse_args(argv)
+    if args.demo == "pp" and \
+            "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the pipe demo needs a multi-device mesh; arm the emulated CPU
+        # topology BEFORE the first jax import below
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
     if args.url:
         return _url_report(args)
     if args.cache:
@@ -410,6 +513,10 @@ def main(argv=None) -> int:
             _demo_serve(args.steps)
         elif args.demo == "dp":
             _demo_dp(args.steps)
+        elif args.demo == "pp":
+            _demo_pp(args.steps)
+        elif args.demo == "moe":
+            _demo_moe(args.steps)
         elif args.demo == "metrics":
             _demo_metrics(args.steps)
         elif args.demo:

@@ -93,6 +93,12 @@ WARMUP = 14
 MEASURE = 40
 # promoted DP step vs unfused eager collectives (ops/spmd_fusion.py)
 DP_SPEEDUP_GUARD = 1.3
+# promoted pp pipeline cycle (ops/spmd_fusion.py pipeline registry) vs the
+# unfused eager schedule (forward_backward_pipeline: sequential micro-batch
+# accumulation, per-op dispatch). Same bound as the pytest acceptance
+# (1.3x) — the whole fill/steady/drain cycle fusing into one executable is
+# worth an order of magnitude even on a loaded box, so no CLI loosening
+PP_SPEEDUP_GUARD = 1.3
 # warm-start guard: a warm store must reach the first PROMOTED FUSED step
 # in at most this fraction of the cold process's time-to-first-fire (the
 # cold path pays per-op traces + the whole-step trace + XLA compiles; the
@@ -1132,6 +1138,105 @@ def main() -> int:
             f"{sb['fallback_splits']} split(s) in the steady accumulation "
             "loop (PR 14)")
 
+    # ---- hybrid pipeline promotion leg (PR 16 guard) ---------------------
+    # (n) a pp=2 x virtual=2 interleaved pipeline cycle must promote
+    # through the ops/spmd_fusion pipeline registry (ONE ppermute-handoff
+    # executable spanning fill/steady/drain + update), replay it on every
+    # train_batch with zero steady-state retraces, and beat the same
+    # schedule run unfused and eager (forward_backward_pipeline:
+    # sequential micro-batch accumulation) by the guard ratio
+    pp_speedup = 0.0
+    pp_retraces = 0
+    pp_promoted = 0
+    if _jax.device_count() >= 2:
+        import jax.numpy as _jnp
+        from paddle_tpu.distributed.mesh import build_mesh, set_global_mesh
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineLayer, PipelineParallel)
+        from paddle_tpu.incubate.models import (
+            GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+            gpt_pipeline_layers)
+        from paddle_tpu.ops.spmd_fusion import clear_pipeline_programs
+
+        # eager tiers off both sides: the registry owns promotion on the
+        # fused side, and the eager side is the pure per-op schedule
+        set_flags({"FLAGS_eager_op_cache": False,
+                   "FLAGS_eager_chain_fusion": False,
+                   "FLAGS_eager_step_fusion": False})
+        _cdc()
+        clear_pipeline_programs()
+        _ppcfg = GPTConfig(vocab_size=128, hidden_size=32,
+                           num_hidden_layers=8, num_attention_heads=4,
+                           intermediate_size=64,
+                           max_position_embeddings=32,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           use_flash_attention=False)
+        _pprng = _np.random.default_rng(0)
+        pids = _jnp.asarray(_pprng.integers(0, 128, (4, 32)), _jnp.int32)
+        plab = _jnp.asarray(_pprng.integers(0, 128, (4, 32)), _jnp.int32)
+
+        def _pp_runner():
+            _pd.seed(0)
+            model = GPTForCausalLM(_ppcfg)
+            pl = PipelineLayer(gpt_pipeline_layers(model), num_stages=2,
+                               loss_fn=GPTPretrainingCriterion(),
+                               num_virtual_pipeline_stages=2)
+            runner = PipelineParallel(pl, hcg=None)
+            runner.accumulate_steps = 4
+            opt = _pd.optimizer.AdamW(learning_rate=1e-3,
+                                      parameters=model.parameters())
+            return runner, opt
+
+        PP_STEPS = 6
+        set_global_mesh(None)                 # unfused eager schedule
+        runner, opt = _pp_runner()
+        float(runner.train_batch((pids, plab), opt))
+        t0 = time.perf_counter()
+        for _ in range(2):
+            float(runner.train_batch((pids, plab), opt))
+        t_pp_eager = (time.perf_counter() - t0) / 2
+
+        set_global_mesh(build_mesh(dp=1, pp=2, sharding=1, sep=1, mp=1,
+                                   devices=_jax.devices()[:2]))
+        runner, opt = _pp_runner()
+        s0 = step_fusion_stats()
+        for _ in range(3):                    # warmup: trace + compile
+            float(runner.train_batch((pids, plab), opt))
+        s1 = step_fusion_stats()
+        pp_promoted = s1["steps_promoted"] - s0["steps_promoted"]
+        t0 = time.perf_counter()
+        for _ in range(PP_STEPS):
+            float(runner.train_batch((pids, plab), opt))
+        t_pp_fused = (time.perf_counter() - t0) / PP_STEPS
+        s2 = step_fusion_stats()
+        pp_retraces = s2["retraces"] - s1["retraces"]
+        pp_fires = s2["fused_steps"] - s1["fused_steps"]
+        pp_speedup = t_pp_eager / t_pp_fused if t_pp_fused > 0 else 0.0
+        set_global_mesh(None)
+        clear_pipeline_programs()
+        if pp_promoted != 1:
+            failures.append(
+                f"the pp=2 interleaved cycle promoted {pp_promoted} "
+                "pipeline program(s) (expected exactly 1) — train_batch "
+                "fell off the registry path (PR 16 regression)")
+        if pp_fires != PP_STEPS:
+            failures.append(
+                f"only {pp_fires}/{PP_STEPS} train_batch calls fired the "
+                "promoted pipeline executable (PR 16 regression)")
+        if pp_retraces:
+            failures.append(
+                f"{pp_retraces} steady-state retrace(s) in the promoted "
+                "pipeline cycle: the handoff program is re-tracing a "
+                "stable schedule (PR 16 regression)")
+        if pp_promoted and pp_speedup < PP_SPEEDUP_GUARD:
+            failures.append(
+                f"promoted pipeline cycle speedup {pp_speedup:.2f}x over "
+                "the unfused eager schedule is below the "
+                f"{PP_SPEEDUP_GUARD}x guard (eager "
+                f"{t_pp_eager*1e3:.1f}ms vs fused {t_pp_fused*1e3:.1f}ms) "
+                "(PR 16 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -1173,7 +1278,9 @@ def main() -> int:
           f"dropout fused={drop_replays}/{MEASURE} "
           f"speedup={drop_speedup:.2f}x (retraces={drop_retraces}), "
           f"accum super-cycle fused={sb['fused_steps']} "
-          f"executables={accum_retraces} splits={sb['fallback_splits']}")
+          f"executables={accum_retraces} splits={sb['fallback_splits']}, "
+          f"pp pipeline promotes={pp_promoted} "
+          f"speedup={pp_speedup:.2f}x (retraces={pp_retraces})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)
