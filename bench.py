@@ -586,19 +586,24 @@ def bench_decode(on_tpu):
 # serve_1 / serve_8 / serve_64: the continuous-batching engine
 # --------------------------------------------------------------------------
 
-def _bench_serve(streams):
+def _bench_serve(streams, prefix=False):
     """Serving-engine leg at N concurrent streams; the heavy lifting
     (workload, warmup, zero-retrace window accounting) lives in
     tools/serve_bench.run_serve_bench so the CLI and the bench measure
-    the same thing."""
+    the same thing. `prefix=True` runs the multi-tenant shared-prefix
+    workload (PR 17) with the prefix cache enabled, so the trajectory
+    carries the aliasing economy (hit rate, COW copies) as first-class
+    numbers next to the cold-prefill serve legs."""
     def run(on_tpu):
         import jax
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import serve_bench
         platform = jax.devices()[0].platform
-        tdir = os.path.join(TRACE_ROOT, platform, f"serve_{streams}")
-        return serve_bench.run_serve_bench(streams, on_tpu, trace_dir=tdir)
+        leg = f"serve_{streams}_prefix" if prefix else f"serve_{streams}"
+        tdir = os.path.join(TRACE_ROOT, platform, leg)
+        return serve_bench.run_serve_bench(streams, on_tpu, trace_dir=tdir,
+                                           prefix_cache=prefix)
     return run
 
 
@@ -998,6 +1003,7 @@ CONFIG_FNS = {
     "serve_1": _bench_serve(1),
     "serve_8": _bench_serve(8),
     "serve_64": _bench_serve(64),
+    "serve_8_prefix": _bench_serve(8, prefix=True),
     "flash4096": bench_flash4096,
     "gpt2_355m": bench_gpt2_355m,
     "gpt2_train": bench_gpt2_train,
@@ -1010,7 +1016,8 @@ CONFIG_FNS = {
 # per-config hard timeouts (seconds) when the probe said TPU; CPU smoke
 # versions are tiny and get a flat cap
 TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
-            "serve_64": 150, "flash4096": 210, "gpt2_355m": 240,
+            "serve_64": 150, "serve_8_prefix": 120,
+            "flash4096": 210, "gpt2_355m": 240,
             "gpt2_train": 280, "accum4": 240, "dp8": 180, "pp2": 200,
             "moe8": 180}
 CPU_CAP = 150
@@ -1186,7 +1193,7 @@ def main():
 
     results = {}
     for name in ("vit", "decode", "serve_1", "serve_8", "serve_64",
-                 "flash4096", "gpt2_355m", "dp8"):
+                 "serve_8_prefix", "flash4096", "gpt2_355m", "dp8"):
         avail = remaining() - HEADLINE_RESERVE
         if avail < 45:
             results[name] = {"metric": name, "skipped": "budget_exhausted",
@@ -1245,6 +1252,15 @@ def main():
                               "refused_queue_full", "refused_deadline",
                               "cancelled", "expired", "hangs",
                               "eager_fallbacks", "resumed")}
+                # multi-tenant counters (PR 17): the aliasing economy and
+                # tenant churn ride the trajectory next to throughput —
+                # a prefix-hit or hot-swap regression shows here even
+                # when tokens/s looks healthy
+                head["extra"][name]["tenancy"] = {
+                    k: ex.get(k, 0)
+                    for k in ("prefix_cache", "prefix_hit_tokens",
+                              "prefix_hit_rate", "cow_copies",
+                              "adapter_switches", "weight_swaps")}
     print(json.dumps(head), flush=True)
 
 

@@ -84,6 +84,11 @@ CATEGORIES = frozenset({
     # crash-resume re-admission
     "serve.cancel", "serve.expire", "serve.refuse", "serve.hang",
     "serve.degrade", "serve.resume",
+    # multi-tenant serving (PR 17, serving/tenancy.py): a prefix-cache
+    # admission aliased cached prompt KV / prefilled cold / cold entries
+    # reclaimed under pool pressure; a live weight hot-swap committed
+    "serve.prefix_hit", "serve.prefix_miss", "serve.prefix_evict",
+    "serve.swap",
     # persistent AOT executable cache (ops/aot_cache.py): warm-start
     # loads, cold misses, artifact writes, quarantined corruption,
     # environment-fingerprint skew, size/age eviction
@@ -148,6 +153,15 @@ REASON_CODES = frozenset({
     "decode_fault",        # the compiled decode faulted/was poisoned;
                            # requests fell back to eager generate()
     "crash_resume",        # an in-flight request re-admitted after restart
+    # -- multi-tenant serving (paddle_tpu/serving/tenancy.py, PR 17) -------
+    "prefix_hit",          # admission aliased cached prompt KV blocks:
+                           # the shared prefill was paid once (benign)
+    "adapter_mismatch",    # a request named an adapter the engine does
+                           # not have registered: refused, never silently
+                           # served base weights
+    "torn_swap",           # a resume snapshot's weight CRC does not match
+                           # the serving weights: restore refused rather
+                           # than decode half a stream per weight set
     # -- distributed step fusion (ops/spmd_fusion.py) ----------------------
     "collective_unkeyed",  # a collective's group/mesh has no canonical key
     "mesh_mismatch",       # cycle inputs span meshes, or a fired program's

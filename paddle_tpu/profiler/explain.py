@@ -179,6 +179,27 @@ REASON_HINTS = {
         "snapshot after a restart; resume re-prefills prompt + emitted "
         "tokens and continues byte-identically. Expected exactly once "
         "per interrupted request per restart."),
+    "prefix_hit": (
+        "admission aliased this prompt's leading tokens onto KV blocks "
+        "another stream already prefilled (serving/tenancy.py "
+        "PrefixCache): the shared prefix's prefill and KV bytes were "
+        "paid once. Benign — the win the prefix cache exists for; a "
+        "LOW hit rate under shared-prompt traffic is the thing to "
+        "investigate (prompts differing before the first block "
+        "boundary never alias)."),
+    "adapter_mismatch": (
+        "a request named a LoRA adapter the engine does not have "
+        "registered (or the engine was built with max_adapters=0); it "
+        "was refused rather than silently served base weights. Fix the "
+        "routing layer or register_adapter() the tenant before "
+        "admitting its traffic."),
+    "torn_swap": (
+        "a crash-resume snapshot was taken under a different base "
+        "weight set (weights-CRC mismatch) than the restoring engine "
+        "serves — usually a kill mid-hot-swap. restore_state refused "
+        "rather than decode half of every stream per weight set; load "
+        "the checkpoint matching the snapshot's CRC (or re-stage the "
+        "swap) and restore again."),
     "collective_unkeyed": (
         "a collective op's group has no canonically-keyable mesh (a "
         "hand-built Group without a mesh-backed process group), so the "
@@ -379,6 +400,11 @@ def explain(events=None):
             "hangs": n("serve.hang"),
             "degraded": n("serve.degrade"),
             "resumed": n("serve.resume"),
+            # multi-tenant layer (PR 17, serving/tenancy.py)
+            "prefix_hits": n("serve.prefix_hit"),
+            "prefix_misses": n("serve.prefix_miss"),
+            "prefix_evictions": n("serve.prefix_evict"),
+            "weight_swaps": n("serve.swap"),
             "occupancy_mean": (round(sum(occ) / len(occ), 4)
                                if occ else None),
             "reasons": _attr(events,
@@ -657,6 +683,15 @@ def format_report(report):
         if resil:
             lines.append("resil : " + " ".join(
                 f"{k}={v}" for k, v in sorted(resil.items())))
+        tenant = {k: sv.get(k, 0)
+                  for k in ("prefix_hits", "prefix_misses",
+                            "prefix_evictions", "weight_swaps")}
+        if any(tenant.values()):
+            lines.append(
+                f"tenant: prefix_hits={tenant['prefix_hits']} "
+                f"misses={tenant['prefix_misses']} "
+                f"evictions={tenant['prefix_evictions']} "
+                f"swaps={tenant['weight_swaps']}")
         live = sv.get("live")
         if live:
             lines.append("live  : " + " ".join(

@@ -666,6 +666,11 @@ METRIC_NAMES = frozenset({
     "serve_refusals_total",         # labels: reason
     "serve_hangs_total",
     "serve_preemptions_total",
+    # multi-tenant serving (PR 17, serving/tenancy.py)
+    "serve_prefix_hit_tokens_total",
+    "serve_prefix_hit_rate",
+    "serve_adapter_switches_total",
+    "serve_weight_swaps_total",
 })
 
 # goodput wall-time attribution buckets (profiler/goodput.py): where did
@@ -713,6 +718,13 @@ METRIC_MERGE = {
     "serve_refusals_total": "sum",
     "serve_hangs_total": "sum",
     "serve_preemptions_total": "sum",
+    "serve_prefix_hit_tokens_total": "sum",
+    # per-replica convenience ratio; the fleet-truthful rate is DERIVED
+    # from the summed hit-tokens counter over summed admitted context
+    # tokens, so the merged gauge is only the best-replica watermark
+    "serve_prefix_hit_rate": "max",
+    "serve_adapter_switches_total": "sum",
+    "serve_weight_swaps_total": "sum",
 }
 
 
@@ -769,6 +781,18 @@ def _install_default_metrics(reg):
     s.hangs = reg.counter("serve_hangs_total", "watchdog firings")
     s.preemptions = reg.counter("serve_preemptions_total",
                                 "KV-pressure evictions")
+    s.prefix_hit_tokens = reg.counter(
+        "serve_prefix_hit_tokens_total",
+        "prompt tokens served off shared prefix-cache KV blocks")
+    s.prefix_hit_rate = reg.gauge(
+        "serve_prefix_hit_rate",
+        "prefix-cache hit tokens over admitted context tokens")
+    s.adapter_switches = reg.counter(
+        "serve_adapter_switches_total",
+        "batch-slot adapter index changes (tenant churn)")
+    s.weight_swaps = reg.counter(
+        "serve_weight_swaps_total",
+        "live base-weight hot-swap commits")
 
     for name, label in (("dispatch_events_total", "per-op executable "
                          "cache outcomes"),

@@ -39,6 +39,7 @@ from .cache import (BlockAllocator, PagedKVCache, PagedCacheView,  # noqa: F401
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING,  # noqa: F401
                         FINISHED, FAILED, CANCELLED, EXPIRED)
 from .resilience import ServeRefusal, StepHang  # noqa: F401
+from .tenancy import PrefixCache, AdapterSet  # noqa: F401
 from .engine import LLMEngine, ServeStats  # noqa: F401
 
 __all__ = ["LLMEngine", "ServeStats", "Request", "Scheduler",
@@ -46,4 +47,4 @@ __all__ = ["LLMEngine", "ServeStats", "Request", "Scheduler",
            "scatter_prefill", "NULL_BLOCK", "QUEUED", "RUNNING",
            "FINISHED", "FAILED", "CANCELLED", "EXPIRED",
            "ServeRefusal", "StepHang", "pool_bytes_per_block",
-           "num_blocks_for_bytes"]
+           "num_blocks_for_bytes", "PrefixCache", "AdapterSet"]

@@ -48,10 +48,20 @@ NULL_BLOCK = 0
 
 
 class BlockAllocator:
-    """Host-side free-list allocator over the pool's block ids.
+    """Host-side refcounted free-list allocator over the pool's block ids.
 
     Pure bookkeeping — no device state. O(1) allocate/free; the free
     count is the scheduler's admission-watermark signal.
+
+    PR 17 makes ownership refcounted for shared-prefix KV reuse: a block
+    aliased into several sequences' tables (serving/tenancy.py
+    PrefixCache) carries one reference per owner, `free` is a decref
+    that returns the block to the free list only at zero, and the free
+    list holds exactly the refcount-zero blocks — so `num_free` counts
+    every shared block ONCE by construction and the watermark/admission
+    math needs no aliasing-aware correction. Exclusive ownership (every
+    pre-PR 17 caller) behaves exactly as before: allocate hands out a
+    block at refcount 1 and the first free releases it.
     """
 
     def __init__(self, num_blocks):
@@ -62,6 +72,7 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         # block 0 reserved; 1..num_blocks-1 allocatable
         self._free = deque(range(1, self.num_blocks))
+        self._refs = {}          # block id -> refcount (allocated only)
 
     @property
     def num_free(self):
@@ -72,18 +83,54 @@ class BlockAllocator:
         """Allocatable blocks (pool minus the null block)."""
         return self.num_blocks - 1
 
+    @property
+    def num_shared(self):
+        """Allocated blocks with more than one owner (prefix aliases)."""
+        return sum(1 for rc in self._refs.values() if rc > 1)
+
+    def refcount(self, block):
+        """Live owners of `block` (0 when free/never allocated) — the
+        engine's copy-on-write trigger reads this before every write
+        that would land in a possibly-shared block."""
+        return self._refs.get(block, 0)
+
     def allocate(self, n):
-        """Pop `n` block ids, or None (allocating nothing) when fewer
-        than `n` are free — admission is all-or-nothing."""
+        """Pop `n` block ids (each at refcount 1), or None (allocating
+        nothing) when fewer than `n` are free — admission is
+        all-or-nothing."""
         if n > len(self._free):
             return None
-        return [self._free.popleft() for _ in range(n)]
+        out = [self._free.popleft() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
+
+    def incref(self, block):
+        """Add an owner to an ALLOCATED block (prefix-cache aliasing:
+        a new sequence's table points at an existing block's KV)."""
+        if block == NULL_BLOCK:
+            raise ValueError("attempt to share the reserved null block")
+        rc = self._refs.get(block)
+        if rc is None:
+            raise ValueError(
+                f"incref of free/unallocated block {block}")
+        self._refs[block] = rc + 1
 
     def free(self, blocks):
+        """Drop one owner per listed block; a block rejoins the free
+        list only when its LAST owner lets go (shared prefix blocks
+        survive any one sequence's eviction)."""
         for b in blocks:
             if b == NULL_BLOCK:
                 raise ValueError("attempt to free the reserved null block")
-            self._free.append(b)
+            rc = self._refs.get(b)
+            if rc is None:
+                raise ValueError(f"free of unallocated block {b}")
+            if rc == 1:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = rc - 1
 
 
 class PagedCacheView:

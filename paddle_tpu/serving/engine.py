@@ -65,6 +65,26 @@ Resilience (PR 7, serving/resilience.py) rides every one of those layers:
     every stream byte-identically (incubate.checkpoint.ServeCheckpointer
     + tools/chaos.py `serve_kill`).
 
+Multi-tenancy (PR 17, serving/tenancy.py) makes the replica serve MANY
+logical models and MANY users off the one compiled decode step:
+
+  * **shared-prefix KV reuse** — `enable_prefix_cache=True` indexes
+    every prefilled prompt's blocks by content hash; N streams sharing
+    a system prompt alias the same refcounted blocks (admission
+    allocates only the private remainder), pay its prefill once, and
+    copy-on-write the first block a divergent token would land in;
+  * **batched LoRA-style adapters** — `max_adapters=N` installs padded
+    per-slot low-rank delta stacks as VALUE inputs to the decode
+    executable; tenants join/leave/churn with zero retraces
+    (`add_request(..., adapter=name)`, `register_adapter` /
+    `unregister_adapter`);
+  * **live weight hot-swap** — `hot_swap=True` passes the base weights
+    as values too, so `swap_weights(new_values)` cuts every stream over
+    to a new checkpoint at an exact iteration boundary (in-flight
+    streams are preempted and re-prefill under the new weights, the
+    prefix index is invalidated, the weight epoch bumps) — again zero
+    retraces, attributed as `serve.swap`.
+
 Telemetry rides the PR 4 fusion flight recorder: `serve.*` events
 (enqueue/admit/step/evict/complete + cancel/expire/refuse/hang/degrade/
 resume) with reason codes `kv_exhausted` / `bucket_retrace` /
@@ -95,6 +115,7 @@ from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
                         FAILED, CANCELLED, EXPIRED)
 from .resilience import (ServeRefusal, MonitoredWait, StepHang,
                          request_payload, payload_request)
+from .tenancy import PrefixCache, AdapterSet
 
 __all__ = ["LLMEngine", "ServeStats"]
 
@@ -146,6 +167,15 @@ class ServeStats:
         self.occupancy_sum = 0.0
         self.saturated_steps = 0
         self.saturated_occupancy_sum = 0.0
+        # multi-tenant counters (PR 17): prefix_prompt_tokens is the
+        # hit-rate denominator — every admitted context token that COULD
+        # have aliased cached KV, hit or not
+        self.prefix_hit_tokens = 0
+        self.prefix_prompt_tokens = 0
+        self.prefix_evictions = 0
+        self.cow_copies = 0
+        self.adapter_switches = 0
+        self.weight_swaps = 0
         # recent raw samples only (the admission wait estimate averages
         # the tail); percentiles live in the windowed histograms below
         self.step_times_s = []
@@ -193,6 +223,14 @@ class ServeStats:
             "hangs": self.hangs,
             "eager_fallbacks": self.eager_fallbacks,
             "resumed": self.resumed,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_hit_rate": (self.prefix_hit_tokens
+                                / self.prefix_prompt_tokens
+                                if self.prefix_prompt_tokens else 0.0),
+            "prefix_evictions": self.prefix_evictions,
+            "cow_copies": self.cow_copies,
+            "adapter_switches": self.adapter_switches,
+            "weight_swaps": self.weight_swaps,
             "occupancy_mean": (self.occupancy_sum / self.steps
                                if self.steps else 0.0),
             "occupancy_saturated": (
@@ -232,16 +270,23 @@ class LLMEngine:
 
     Decoding is greedy (matches ``model.generate(do_sample=False)``
     token-for-token — the parity contract tests/test_serving.py pins).
-    The model is put in eval mode and its parameters are BAKED into the
-    compiled programs as constants (the engine owns the model for its
-    lifetime); swapping weights means building a new engine.
+    The model is put in eval mode; by default its parameters are BAKED
+    into the compiled programs as constants. `hot_swap=True` and/or
+    `max_adapters>0` switch the programs to the multi-tenant signature
+    (serving/tenancy.py): the weights / adapter stacks become VALUE
+    inputs, so `swap_weights()` refreshes the base checkpoint mid-traffic
+    and tenants churn adapters with zero retraces.
+    `enable_prefix_cache=True` adds shared-prefix KV block aliasing with
+    copy-on-write — N streams sharing a system prompt pay its prefill
+    and its KV bytes once.
     """
 
     def __init__(self, model, max_batch_size=8, block_size=16,
                  num_blocks=None, max_context=None, watermark_blocks=None,
                  dtype=None, tokenizer=None, max_queue_depth=None,
                  aging_max_preemptions=3, kv_dtype=None,
-                 attention_kernel=None):
+                 attention_kernel=None, enable_prefix_cache=False,
+                 max_adapters=0, adapter_rank=4, hot_swap=False):
         cfg = model.config
         model.eval()
         self._model = model
@@ -290,6 +335,32 @@ class LLMEngine:
                                    max_queue_depth=max_queue_depth,
                                    aging_max_preemptions=
                                    aging_max_preemptions)
+        # -- multi-tenant layer (PR 17, serving/tenancy.py) -------------
+        # prefix cache: content-addressed aliasing of prompt KV blocks
+        self._prefix = (PrefixCache(self.cache.allocator, self.block_size)
+                        if enable_prefix_cache else None)
+        # batched adapters: padded low-rank stacks as decode VALUE inputs
+        self._adapters = (AdapterSet(model, max_adapters, adapter_rank,
+                                     dtype=self._dtype)
+                          if max_adapters > 0 else None)
+        self._hot_swap = bool(hot_swap)
+        # aux-input mode: the decode/prefill signatures gain an `aux`
+        # pytree (weights as values / adapter stacks + slot indices);
+        # with both features off the signatures stay byte-identical to
+        # the single-tenant engine
+        self._tenant = self._hot_swap or self._adapters is not None
+        self._holder = None
+        if self._adapters is not None:
+            holder = getattr(model, "_tenancy_holder", None)
+            if holder is None:
+                holder = {"active": None}
+                model._tenancy_holder = holder
+            self._holder = holder
+            self._adapters.install(holder)
+        self._weight_epoch = 0
+        self._pending_weights = None
+        self._weights_crc = self._params_crc() if self._hot_swap else None
+        self._cow_fn = None
         self._stats = ServeStats()
         self._monitor = MonitoredWait()
         # degraded-mode latch: set by the watchdog / a decode fault,
@@ -303,6 +374,11 @@ class LLMEngine:
         self._lens = np.zeros(s, np.int32)
         self._active = np.zeros(s, bool)
         self._tokens = np.zeros(s, np.int32)
+        # per-slot adapter index into the padded stacks (0 = base);
+        # deliberately NOT reset by _clear_slot — a stale index on an
+        # inactive slot is masked out, and clearing it would count a
+        # spurious adapter switch on the next same-tenant admission
+        self._aslots = np.zeros(s, np.int32)
         self._k_pools = self.cache.k_pools
         self._v_pools = self.cache.v_pools
         self._k_scales = self.cache.k_scales       # None unless int8 KV
@@ -343,11 +419,18 @@ class LLMEngine:
     # public API
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16, request_id=None,
-                    eos_token_id=None, on_token=None, ttl_s=None):
+                    eos_token_id=None, on_token=None, ttl_s=None,
+                    adapter=None):
         """Enqueue a generation request; returns the Request handle.
 
         `ttl_s` arms a deadline: the request is expired (attributed
         `deadline_expired`) if the TTL passes while it waits or runs.
+
+        `adapter` names the registered LoRA-style adapter this stream
+        decodes under (None = base weights); an unknown name is refused
+        as `adapter_mismatch` — silently serving base weights to a
+        tenant that asked for its fine-tune would be a correctness bug,
+        not a degraded mode.
 
         Raises `ServeRefusal` (a ValueError) when admission would be
         doomed work, each refusal attributed in the flight recorder as a
@@ -380,12 +463,23 @@ class LLMEngine:
                 f"request id {rid!r} is already queued/running; ids may "
                 "only be reused after the previous request finishes")
         req = Request(rid, prompt, max_new_tokens, eos_token_id, on_token,
-                      ttl_s=ttl_s)
+                      ttl_s=ttl_s, adapter=adapter)
         if len(prompt) + req.max_new_tokens > self.max_context:
             raise ValueError(
                 f"request {rid}: prompt ({len(prompt)}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds max_context "
                 f"({self.max_context})")
+        if adapter is not None and (
+                self._adapters is None
+                or not self._adapters.is_registered(adapter)):
+            self._refuse(req, "adapter_mismatch",
+                         f"request {rid}: adapter {adapter!r} is not "
+                         "registered with this engine; register it (or "
+                         "build the engine with max_adapters > 0) before "
+                         "routing its tenant here",
+                         {"adapter": adapter,
+                          "registered": ([] if self._adapters is None
+                                         else self._adapters.names())})
         self._admission_policy(req)
         self.scheduler.enqueue(req)
         self.requests[rid] = req
@@ -411,7 +505,13 @@ class LLMEngine:
                           "max_queue_depth": sched.max_queue_depth})
         peak = sched.max_blocks_of(req)
         budget = sched.block_budget()
-        if not sched.can_ever_fit(req):
+        shared = 0
+        if self._prefix is not None:
+            # aliasing credit: blocks this prompt would inherit by
+            # reference rather than allocate (counted ONCE — the PR 17
+            # accounting bugfix; advisory, so no references are taken)
+            shared, _ = self._prefix.probe(req.prompt + req.generated)
+        if not sched.can_ever_fit(req, shared_blocks=shared):
             self._refuse(req, "kv_exhausted",
                          f"request {req.rid}: needs {peak} KV blocks at "
                          f"peak but the pool only ever has {budget} "
@@ -543,16 +643,31 @@ class LLMEngine:
 
     def _step_locked(self):
         sched = self.scheduler
+        # -- weight hot-swap cutover (exact iteration boundary) --------
+        if self._pending_weights is not None:
+            self._commit_swap()
         # -- cancel/deadline sweep + admission (token boundary) --------
         self._boundary_housekeeping()
+        hook = self._prefix_hook if self._prefix is not None else None
         while True:
             # expire a dead head BEFORE admission assigns it a slot —
             # it never ran, and the serve.expire where=queued/running
             # split must stay truthful for queue-sizing diagnosis
             while sched.waiting and sched.waiting[0].expired():
                 self._expire(sched.waiting[0])
-            req = sched.try_admit()
+            req = sched.try_admit(prefix_hook=hook)
             if req is None:
+                # the pool may be dry only because the prefix index is
+                # hoarding cold entries — release those and retry before
+                # giving up on this boundary (only when a slot is
+                # actually free: batch pressure is not block pressure)
+                if (self._prefix is not None and sched.waiting
+                        and None in sched.slots
+                        and self._reclaim_prefix(
+                            sched.blocks_needed(
+                                sched.waiting[0].context_len)
+                            + sched.watermark_blocks)):
+                    continue
                 break
             self._admit(req)
         if not sched.running:
@@ -568,6 +683,8 @@ class LLMEngine:
                 if sched.grow(req):
                     self._sync_slot(req)
                     continue
+                if self._prefix is not None and self._reclaim_prefix(1):
+                    continue    # cold prefix entries go before tenants
                 victim = sched.preempt_victim(exclude=req)
                 if victim is not None:
                     self._evict(victim)
@@ -582,6 +699,12 @@ class LLMEngine:
         if not sched.running:
             self._stats.wall_t1 = time.perf_counter()
             return bool(sched.waiting)
+        # -- copy-on-write boundary: privatize shared write targets ----
+        if self._prefix is not None:
+            self._cow_sweep()
+            if not sched.running:
+                self._stats.wall_t1 = time.perf_counter()
+                return bool(sched.waiting)
         # -- the ONE compiled decode step (watchdog-monitored) ---------
         demand = sched.demand
         n_active = len(sched.running)
@@ -630,6 +753,13 @@ class LLMEngine:
             slot = req.slot
             req.cached_len += 1
             self._lens[slot] = req.cached_len
+            if req.chew:
+                # prefix-hit warm-up: the next context token is already
+                # KNOWN — feed it as the next decode input and drop the
+                # prediction (made from a mid-context position, it is
+                # not this stream's next output token)
+                self._tokens[slot] = req.chew.pop(0)
+                continue
             tok = int(toks[slot])
             self._tokens[slot] = tok
             self._emit_token(req, tok)
@@ -665,6 +795,12 @@ class LLMEngine:
         snap["block_size"] = self.block_size
         snap["attention_kernel"] = self._attn_kernel
         snap["kv_dtype"] = str(jnp.dtype(self._kv_dtype))
+        if self._prefix is not None:
+            snap["prefix_entries"] = self._prefix.entries
+        if self._tenant:
+            snap["weight_epoch"] = self._weight_epoch
+            snap["adapters"] = ([] if self._adapters is None
+                                else self._adapters.names())
         return snap
 
     def reset_stats(self):
@@ -695,8 +831,18 @@ class LLMEngine:
     def _admit(self, req):
         """Bucketed prefill of prompt + already-generated tokens (resume
         case) into the request's freshly assigned blocks, then join the
-        decode batch. Never touches the decode executable."""
+        decode batch. Never touches the decode executable. A prefix-hit
+        admission (try_admit aliased cached blocks) skips the prefill
+        entirely."""
         ctx = req.prompt + req.generated
+        if req.prefix_hit > 0:
+            self._admit_prefix_hit(req, ctx)
+            return
+        if self._prefix is not None:
+            self._stats.prefix_prompt_tokens += len(ctx)
+            self._note_prefix_rate()
+            _EVENTS.emit("serve.prefix_miss", req.rid,
+                         detail={"context_len": len(ctx)})
         bucket = self._bucket_for(len(ctx))
         fn = self._prefill_fns.get(bucket)
         new_bucket = fn is None
@@ -732,10 +878,74 @@ class LLMEngine:
             self._k_scales, self._v_scales = res[3], res[4]
         req.cached_len = len(ctx)
         self._sync_slot(req)
+        self._set_adapter_slot(req)
+        if self._prefix is not None:
+            # index this prompt's blocks for the NEXT tenant sharing it;
+            # a resume's partial tail holds generated-token KV, which
+            # must never be served as prompt KV
+            self._prefix.publish(ctx, req.blocks,
+                                 include_tail=not req.generated)
         tok = int(np.asarray(nxt))
         # the prefill's sampled token is the next decode step's input
         self._tokens[req.slot] = tok
         self._emit_token(req, tok)
+
+    def _admit_prefix_hit(self, req, ctx):
+        """Prefix-hit admission: the aliased blocks already hold the
+        first `prefix_hit` tokens' KV, so there is NO prefill — the
+        stream joins the decode batch at `cached_len = hit` and the
+        decode step chews the remaining known suffix tokens (one per
+        iteration, nothing emitted) before real sampling resumes. N
+        streams sharing a long system prompt pay its prefill — and its
+        KV bytes — once."""
+        hit = req.prefix_hit
+        self._stats.admitted += 1
+        self._stats.prefix_hit_tokens += hit
+        self._stats.prefix_prompt_tokens += len(ctx)
+        _EVENTS.emit("serve.admit", req.rid,
+                     detail={"context_len": len(ctx), "bucket": None,
+                             "blocks": len(req.blocks),
+                             "resumed": bool(req.generated),
+                             "prefix_hit": hit})
+        _EVENTS.emit("serve.prefix_hit", req.rid, reason="prefix_hit",
+                     detail={"hit_tokens": hit,
+                             "context_len": len(ctx),
+                             "chew": len(ctx) - hit - 1})
+        now = time.perf_counter_ns()
+        if req.admit_ns is None:
+            req.admit_ns = now
+            wait_s = (now - req.enqueue_ns) / 1e9
+            self._stats.queue_wait_hist.observe(wait_s)
+            if _metrics_on():
+                _M.queue_wait_s.observe(wait_s)
+        if _metrics_on():
+            _M.prefix_hit_tokens.inc(hit)
+        self._note_prefix_rate()
+        req.cached_len = hit
+        self._sync_slot(req)
+        self._set_adapter_slot(req)
+        # decode input: the first token WITHOUT cached KV; the known
+        # tokens after it queue as chew (fed, never emitted)
+        self._tokens[req.slot] = int(ctx[hit])
+        req.chew = [int(t) for t in ctx[hit + 1:]]
+
+    def _note_prefix_rate(self):
+        if _metrics_on() and self._stats.prefix_prompt_tokens:
+            _M.prefix_hit_rate.set(self._stats.prefix_hit_tokens
+                                   / self._stats.prefix_prompt_tokens)
+
+    def _set_adapter_slot(self, req):
+        """Point the request's batch slot at its tenant's adapter stack
+        index (0 = base). An index CHANGE is an adapter switch — the
+        churn the zero-retrace contract is measured against."""
+        if self._adapters is None:
+            return
+        idx = self._adapters.slot_of(req.adapter)
+        if idx != int(self._aslots[req.slot]):
+            self._stats.adapter_switches += 1
+            if _metrics_on():
+                _M.adapter_switches.inc()
+        self._aslots[req.slot] = idx
 
     def _prefill_step(self, fn, padded, length, row, req):
         """One monitored prefill fire. The ladder is per-request (a hung
@@ -744,8 +954,11 @@ class LLMEngine:
         attempt = 1
         while True:
             try:
-                res = fn(*self._kv_args(padded, length, row,
-                                        self._k_pools, self._v_pools))
+                base = (padded, length, row)
+                if self._tenant:
+                    base = base + (self._prefill_aux(req),)
+                res = fn(*self._kv_args(*(base + (self._k_pools,
+                                                  self._v_pools))))
                 self._monitor.wait(res, "prefill", attempt)
                 return res
             except StepHang:
@@ -896,9 +1109,12 @@ class LLMEngine:
         attempt = 1
         while True:
             try:
+                base = (self._tokens, self._tables, self._lens,
+                        self._active)
+                if self._tenant:
+                    base = base + (self._decode_aux(),)
                 res = self._decode_fn(*self._kv_args(
-                    self._tokens, self._tables, self._lens, self._active,
-                    self._k_pools, self._v_pools))
+                    *(base + (self._k_pools, self._v_pools))))
                 self._monitor.wait(res, "decode", attempt)
             except StepHang:
                 if not self._on_hang(attempt):
@@ -1018,8 +1234,16 @@ class LLMEngine:
         remaining = req.remaining_tokens
         if remaining > 0:
             ctx = np.asarray([req.prompt + req.generated], np.int64)
-            out = self._model.generate(ctx, max_new_tokens=remaining,
-                                       do_sample=False)
+            if self._adapters is not None and req.adapter is not None:
+                # the eager path folds the tenant's delta into the
+                # weights (values only — generate's cached program does
+                # not retrace) so the fallback serves the SAME model
+                with self._adapters.merged(req.adapter):
+                    out = self._model.generate(
+                        ctx, max_new_tokens=remaining, do_sample=False)
+            else:
+                out = self._model.generate(ctx, max_new_tokens=remaining,
+                                           do_sample=False)
             arr = np.asarray(out._value if hasattr(out, "_value")
                              else out)[0]
             for tok in arr.tolist():
@@ -1048,10 +1272,15 @@ class LLMEngine:
         self._lens = np.zeros(s, np.int32)
         self._active = np.zeros(s, bool)
         self._tokens = np.zeros(s, np.int32)
+        self._aslots = np.zeros(s, np.int32)
         self._k_pools = self.cache.k_pools
         self._v_pools = self.cache.v_pools
         self._k_scales = self.cache.k_scales
         self._v_scales = self.cache.v_scales
+        if self._prefix is not None:
+            # the old pool died with its allocator — the index's
+            # references are meaningless now: forget, do not free
+            self._prefix.reset(self.cache.allocator)
 
     # ------------------------------------------------------------------
     # crash-resume (serving/resilience.py + incubate.ServeCheckpointer)
@@ -1071,9 +1300,18 @@ class LLMEngine:
                       + list(self.scheduler.running),
                       key=lambda r: (r.arrival_seq
                                      if r.arrival_seq is not None else -1))
-        return {"version": 1, "kind": "serve_state",
-                "next_rid": self._next_rid,
-                "requests": [request_payload(r, now) for r in live]}
+        payload = {"version": 1, "kind": "serve_state",
+                   "next_rid": self._next_rid,
+                   "requests": [request_payload(r, now) for r in live]}
+        if self._tenant:
+            # the restore-time torn-swap check keys on these: a snapshot
+            # taken under one weight epoch must not resume under another
+            payload["weight_epoch"] = self._weight_epoch
+            payload["weights_crc"] = self._weights_crc
+            payload["swap_pending"] = self._pending_weights is not None
+            payload["adapters"] = ([] if self._adapters is None
+                                   else self._adapters.names())
+        return payload
 
     def restore_state(self, payload, on_token=None):
         """Re-admit every request of a `state_payload()` snapshot in its
@@ -1084,10 +1322,47 @@ class LLMEngine:
         {request_id: callable} mapping. Returns the restored Requests."""
         if not payload:
             return []
+        crc = payload.get("weights_crc")
+        if self._hot_swap and crc is not None \
+                and crc != self._weights_crc:
+            # torn swap: the snapshot was taken under a different weight
+            # set than the one this process loaded — resuming would
+            # decode half of every stream under each epoch. Refuse; the
+            # supervisor loads the matching checkpoint and retries.
+            _EVENTS.emit("serve.refuse", "engine", reason="torn_swap",
+                         detail={"payload_crc": crc,
+                                 "engine_crc": self._weights_crc,
+                                 "payload_epoch":
+                                     payload.get("weight_epoch"),
+                                 "swap_pending":
+                                     payload.get("swap_pending")})
+            if _metrics_on():
+                _M.refusals.labels(reason="torn_swap").inc()
+            raise ServeRefusal(
+                "torn_swap",
+                f"state snapshot was taken under weights_crc {crc:#x} "
+                f"but this engine serves {self._weights_crc:#x}; load "
+                "the matching weight set before restoring",
+                {"payload_crc": crc, "engine_crc": self._weights_crc})
         restored = []
         for rp in sorted(payload.get("requests", ()),
                          key=lambda p: p.get("arrival_seq") or 0):
             rid = rp["rid"]
+            ad = rp.get("adapter")
+            if ad is not None and (
+                    self._adapters is None
+                    or not self._adapters.is_registered(ad)):
+                _EVENTS.emit("serve.refuse", rid,
+                             reason="adapter_mismatch",
+                             detail={"adapter": ad, "resume": True})
+                if _metrics_on():
+                    _M.refusals.labels(reason="adapter_mismatch").inc()
+                raise ServeRefusal(
+                    "adapter_mismatch",
+                    f"restore_state: request {rid!r} decodes under "
+                    f"adapter {ad!r}, which is not registered in this "
+                    "engine; re-register every tenant before restoring",
+                    {"rid": rid, "adapter": ad})
             prev = self.requests.get(rid)
             if prev is not None and not prev.finished:
                 raise ValueError(
@@ -1105,6 +1380,8 @@ class LLMEngine:
             restored.append(req)
         self._next_rid = max(self._next_rid,
                              int(payload.get("next_rid") or 0))
+        self._weight_epoch = max(self._weight_epoch,
+                                 int(payload.get("weight_epoch") or 0))
         return restored
 
     # ------------------------------------------------------------------
@@ -1127,13 +1404,23 @@ class LLMEngine:
         import zlib
         try:
             crc = 0
-            for p in self._model.parameters():
-                v = np.asarray(p._value)
-                crc = zlib.crc32(repr((v.shape, str(v.dtype))).encode(),
-                                 crc)
-                crc = zlib.crc32(v.tobytes(), crc)
+            if not self._hot_swap:
+                # hot-swap mode passes the weights as VALUES — they are
+                # not baked into the executable, so they must not key it
+                for p in self._model.parameters():
+                    v = np.asarray(p._value)
+                    crc = zlib.crc32(
+                        repr((v.shape, str(v.dtype))).encode(), crc)
+                    crc = zlib.crc32(v.tobytes(), crc)
             cfg = {k: v for k, v in vars(self._model.config).items()
                    if isinstance(v, (int, float, bool, str, type(None)))}
+            # tenant mode re-keys the artifact: the aux-input signature
+            # (weights as values, adapter stack rank/shape) is a
+            # different program from the baked-weights one
+            tenant = (self._tenant, self._hot_swap,
+                      0 if self._adapters is None
+                      else (self._adapters.max_adapters,
+                            self._adapters.rank))
             dg = _aot._digest_of(
                 ("decode", type(self._model).__qualname__,
                  tuple(sorted(cfg.items())), self.max_batch_size,
@@ -1142,7 +1429,8 @@ class LLMEngine:
                  # the kernel tier re-keys the artifact: a blockwise
                  # executable must never replay as the pallas one, and an
                  # int8 pool has a different signature entirely
-                 self._attn_kernel, str(jnp.dtype(self._kv_dtype)), crc))
+                 self._attn_kernel, str(jnp.dtype(self._kv_dtype)), crc,
+                 tenant))
         except Exception:
             dg = None
         self._aot_digest_cache = dg or ""
@@ -1176,6 +1464,11 @@ class LLMEngine:
                                   "block_size": self.block_size})
 
     def _build_decode(self, use_aot=True):
+        if self._tenant:
+            # the aux-input program: weights/adapters as values. AOT
+            # export of a pytree-carrying signature is not supported —
+            # tenant replicas always trace once at start
+            return self._build_decode_tenant()
         model = self._model
         num_layers = model.config.num_hidden_layers
         block_size = self.block_size
@@ -1225,7 +1518,70 @@ class LLMEngine:
                 self._aot_pending_store = (digest, jitted)
         return jitted
 
+    def _build_decode_tenant(self):
+        """The multi-tenant decode executable: same fixed slot layout,
+        plus an `aux` pytree of VALUE inputs — the base weights
+        (hot-swap mode: a swap writes new values, never retraces) and
+        the padded adapter stacks with the per-slot adapter index
+        (tenant churn is a value edit). Weight substitution uses the
+        same save/swap/restore idiom as `model.generate`: for the
+        duration of the trace the parameters' `_value`s ARE the traced
+        inputs. Compiles exactly once per engine, like the base
+        program."""
+        model = self._model
+        num_layers = model.config.num_hidden_layers
+        block_size = self.block_size
+        stats = self._stats
+        variant = self._attn_kernel
+        params = model.parameters()
+        holder = self._holder
+
+        def decode(tokens, tables, lens, active, aux, k_pools, v_pools,
+                   k_scales=None, v_scales=None):
+            stats.decode_compiles += 1   # runs only while tracing
+            pvals = aux.get("params")
+            saved = None
+            if pvals is not None:
+                saved = [pp._value for pp in params]
+                for pp, vv in zip(params, pvals):
+                    pp._value = vv
+            if holder is not None and "adapters" in aux:
+                holder["active"] = AdapterSet.trace_ctx(
+                    aux["adapters"], slots=aux["aslots"])
+            try:
+                views = [PagedCacheView(
+                    k_pools[l], v_pools[l], tables, lens, active,
+                    block_size,
+                    k_scales=None if k_scales is None else k_scales[l],
+                    v_scales=None if v_scales is None else v_scales[l],
+                    kernel=variant)
+                    for l in range(num_layers)]
+                with set_grad_enabled(False):
+                    logits, new_views = model(
+                        Tensor(tokens[:, None], stop_gradient=True),
+                        caches=views)
+            finally:
+                if saved is not None:
+                    for pp, vv in zip(params, saved):
+                        pp._value = vv
+                if holder is not None:
+                    holder["active"] = None
+            new_k = jnp.stack([v.k_pool for v in new_views])
+            new_v = jnp.stack([v.v_pool for v in new_views])
+            nxt = jnp.argmax(logits._value[:, -1, :], axis=-1) \
+                .astype(jnp.int32)
+            if k_scales is not None:
+                new_ks = jnp.stack([v.k_scales for v in new_views])
+                new_vs = jnp.stack([v.v_scales for v in new_views])
+                return nxt, new_k, new_v, new_ks, new_vs
+            return nxt, new_k, new_v
+
+        donate = (5, 6, 7, 8) if self._kv_quantized else (5, 6)
+        return jax.jit(decode, donate_argnums=self._donate(donate))
+
     def _build_prefill(self, bucket):
+        if self._tenant:
+            return self._build_prefill_tenant(bucket)
         model = self._model
         cfg = model.config
         num_layers = cfg.num_hidden_layers
@@ -1256,3 +1612,298 @@ class LLMEngine:
 
         donate = (3, 4, 5, 6) if self._kv_quantized else (3, 4)
         return jax.jit(prefill, donate_argnums=self._donate(donate))
+
+    def _build_prefill_tenant(self, bucket):
+        """Tenant twin of `_build_prefill`: the same bucketed prompt
+        program with the aux pytree (weights as values in hot-swap mode;
+        the one admitted request's scalar adapter slot)."""
+        model = self._model
+        cfg = model.config
+        num_layers = cfg.num_hidden_layers
+        heads = cfg.num_attention_heads
+        head_dim = cfg.hidden_size // heads
+        block_size = self.block_size
+        params = model.parameters()
+        dt = params[0]._value.dtype if params else jnp.float32
+        stats = self._stats
+        holder = self._holder
+
+        def prefill(ids, length, block_row, aux, k_pools, v_pools,
+                    k_scales=None, v_scales=None):
+            stats.prefill_compiles += 1   # runs only while tracing
+            pvals = aux.get("params")
+            saved = None
+            if pvals is not None:
+                saved = [pp._value for pp in params]
+                for pp, vv in zip(params, pvals):
+                    pp._value = vv
+            if holder is not None and "adapters" in aux:
+                holder["active"] = AdapterSet.trace_ctx(
+                    aux["adapters"], slot=aux["slot"])
+            try:
+                empty = [(Tensor(jnp.zeros((1, 0, heads, head_dim),
+                                           dt)),) * 2
+                         for _ in range(num_layers)]
+                with set_grad_enabled(False):
+                    logits, caches = model(
+                        Tensor(ids, stop_gradient=True),
+                        caches=[tuple(c) for c in empty])
+            finally:
+                if saved is not None:
+                    for pp, vv in zip(params, saved):
+                        pp._value = vv
+                if holder is not None:
+                    holder["active"] = None
+            k_layers = jnp.stack([c[0]._value[0] for c in caches])
+            v_layers = jnp.stack([c[1]._value[0] for c in caches])
+            written = scatter_prefill(
+                k_pools, v_pools, k_layers, v_layers, block_row, length,
+                block_size, k_scales=k_scales, v_scales=v_scales)
+            last = jax.lax.dynamic_index_in_dim(
+                logits._value[0], length - 1, axis=0, keepdims=False)
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return (nxt,) + tuple(written)
+
+        donate = (4, 5, 6, 7) if self._kv_quantized else (4, 5)
+        return jax.jit(prefill, donate_argnums=self._donate(donate))
+
+    # ------------------------------------------------------------------
+    # multi-tenant serving (PR 17, serving/tenancy.py)
+    # ------------------------------------------------------------------
+    def _decode_aux(self):
+        """The decode executable's aux VALUE inputs — a pytree with a
+        STABLE structure per engine config (keys never appear or vanish
+        between calls), so churning its values never re-keys the
+        program."""
+        aux = {}
+        if self._hot_swap:
+            aux["params"] = [p._value
+                             for p in self._model.parameters()]
+        if self._adapters is not None:
+            aux["adapters"] = self._adapters.device_stacks()
+            aux["aslots"] = jnp.asarray(self._aslots)
+        return aux
+
+    def _prefill_aux(self, req):
+        aux = {}
+        if self._hot_swap:
+            aux["params"] = [p._value
+                             for p in self._model.parameters()]
+        if self._adapters is not None:
+            aux["adapters"] = self._adapters.device_stacks()
+            aux["slot"] = jnp.asarray(
+                self._adapters.slot_of(req.adapter), jnp.int32)
+        return aux
+
+    def _prefix_hook(self, req):
+        """try_admit's shared-prefix acquisition: the longest cached
+        block run matching the head's context, increfed for the
+        admission. The scheduler undoes the claim symmetrically when
+        admission fails anyway (watermark / pool pressure)."""
+        return self._prefix.acquire(req.prompt + req.generated)
+
+    def _reclaim_prefix(self, num_free_target):
+        """Drop cold prefix-cache entries (leaf-first, LRU) until the
+        allocator can serve `num_free_target` free blocks. Attribution
+        happens HERE, after the cache released its lock (R6: no events
+        under a lock). True when anything was freed."""
+        dropped = self._prefix.reclaim(num_free_target)
+        if not dropped:
+            return False
+        self._stats.prefix_evictions += dropped
+        _EVENTS.emit("serve.prefix_evict", "engine",
+                     detail={"entries": dropped,
+                             "free_blocks":
+                                 self.cache.allocator.num_free})
+        return True
+
+    def _cow_sweep(self):
+        """Copy-on-write boundary: before the decode step writes each
+        stream's next-token KV at position `cached_len`, any stream
+        whose target block is still SHARED (refcount > 1 — a prefix
+        entry and/or sibling streams also own it) gets a private copy:
+        one jitted block copy, a host table edit, a decref of the
+        original. The first divergent write therefore never clobbers KV
+        another stream is attending over."""
+        sched = self.scheduler
+        alloc = self.cache.allocator
+        for req in sorted(list(sched.running),
+                          key=lambda r: r.admit_seq):
+            if req.state != RUNNING:
+                continue      # evicted/failed by an earlier COW's ladder
+            wi = req.cached_len // self.block_size
+            if wi >= len(req.blocks):
+                continue
+            src = req.blocks[wi]
+            if alloc.refcount(src) <= 1:
+                continue
+            got = alloc.allocate(1)
+            while got is None:
+                # same pressure ladder as growth: cold prefix entries
+                # first, then LIFO preemption, then give up on this one
+                if self._reclaim_prefix(1):
+                    got = alloc.allocate(1)
+                    continue
+                victim = sched.preempt_victim(exclude=req)
+                if victim is None:
+                    break
+                self._evict(victim)
+                got = alloc.allocate(1)
+            if got is None:
+                if not sched.protected(req):
+                    self._evict(req)
+                else:
+                    self._fail(req, "kv_exhausted")
+                continue
+            dst = got[0]
+            self._copy_block(src, dst)
+            alloc.free([src])
+            req.blocks[wi] = dst
+            self._sync_slot(req)
+            self._stats.cow_copies += 1
+
+    def _copy_block(self, src, dst):
+        """One jitted pool-to-pool block copy (all layers, K+V, and the
+        int8 scale rows). src/dst are traced int32 scalars, so the copy
+        program compiles once and serves every COW."""
+        if self._cow_fn is None:
+            def cow(k_pools, v_pools, src, dst,
+                    k_scales=None, v_scales=None):
+                k_pools = k_pools.at[:, dst].set(k_pools[:, src])
+                v_pools = v_pools.at[:, dst].set(v_pools[:, src])
+                if k_scales is not None:
+                    k_scales = k_scales.at[:, dst].set(k_scales[:, src])
+                    v_scales = v_scales.at[:, dst].set(v_scales[:, src])
+                    return k_pools, v_pools, k_scales, v_scales
+                return k_pools, v_pools
+
+            donate = (0, 1, 4, 5) if self._kv_quantized else (0, 1)
+            self._cow_fn = jax.jit(cow,
+                                   donate_argnums=self._donate(donate))
+        res = self._cow_fn(*self._kv_args(
+            self._k_pools, self._v_pools,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32)))
+        self._k_pools, self._v_pools = res[0], res[1]
+        if self._kv_quantized:
+            self._k_scales, self._v_scales = res[2], res[3]
+
+    def _params_crc(self):
+        """CRC over every parameter's bytes — the weight-set identity
+        the hot-swap cutover and the crash-resume torn-swap check key
+        on."""
+        import zlib
+        crc = 0
+        for p in self._model.parameters():
+            crc = zlib.crc32(np.asarray(p._value).tobytes(), crc)
+        return crc
+
+    def register_adapter(self, name, weights=None, scale=1.0, seed=None):
+        """Install a tenant's LoRA-style adapter into a free stack slot
+        (a VALUE edit of the padded stacks — zero retraces). See
+        `tenancy.AdapterSet.register` for the weights layout."""
+        if self._adapters is None:
+            raise ValueError(
+                "engine was built with max_adapters=0; adapters need "
+                "max_adapters > 0 at construction (the stack shapes are "
+                "baked into the decode executable)")
+        return self._adapters.register(name, weights=weights,
+                                       scale=scale, seed=seed)
+
+    def unregister_adapter(self, name):
+        """Free a departed tenant's slot. Refuses while any live stream
+        still decodes under the adapter — zeroing the slot mid-stream
+        would silently cut those streams over to base weights."""
+        if self._adapters is None:
+            raise ValueError("engine was built with max_adapters=0")
+        live = [r.rid for r in (list(self.scheduler.waiting)
+                                + list(self.scheduler.running))
+                if r.adapter == name]
+        if live:
+            raise ValueError(
+                f"adapter {name!r} still serves live requests {live}; "
+                "drain or cancel them first")
+        return self._adapters.unregister(name)
+
+    def stage_weights(self, values):
+        """Stage a live weight hot-swap: `values` (one array per
+        `model.parameters()` entry, same shapes) replaces the base
+        weights at the next iteration boundary — a byte-exact cutover:
+        every token of every stream is produced entirely under one
+        weight set or the other, never a mix. Returns True when staged;
+        False when the incoming set is byte-identical to the serving
+        one (attributed as a skipped `serve.swap`)."""
+        if not self._hot_swap:
+            raise ValueError(
+                "engine was built without hot_swap=True — its weights "
+                "are baked into the compiled programs as constants")
+        import zlib
+        params = self._model.parameters()
+        if len(values) != len(params):
+            raise ValueError(
+                f"stage_weights: got {len(values)} arrays for "
+                f"{len(params)} parameters")
+        vals, crc = [], 0
+        for p, v in zip(params, values):
+            arr = jnp.asarray(v).astype(p._value.dtype)
+            if arr.shape != p._value.shape:
+                raise ValueError(
+                    f"stage_weights: shape {arr.shape} does not match "
+                    f"parameter shape {p._value.shape}")
+            vals.append(arr)
+            crc = zlib.crc32(np.asarray(arr).tobytes(), crc)
+        if crc == self._weights_crc and self._pending_weights is None:
+            _EVENTS.emit("serve.swap", "engine",
+                         detail={"skipped": True, "crc_match": True,
+                                 "epoch": self._weight_epoch})
+            return False
+        self._pending_weights = (vals, crc)
+        return True
+
+    def swap_weights(self, values):
+        """Stage + commit a hot-swap. Called between steps (the usual
+        checkpoint-watcher pattern) the cutover happens immediately;
+        called from inside a streaming callback mid-step it commits at
+        the next iteration boundary. Returns the serving weight epoch
+        after the call."""
+        if self.stage_weights(values) and not self._stepping:
+            self._commit_swap()
+        return self._weight_epoch
+
+    def _commit_swap(self):
+        """The cutover: preempt every running stream (they re-prefill
+        under the new weights and continue from their emitted tokens),
+        invalidate the prefix index (cached KV is a function of the base
+        weights), write the staged values into the parameters, bump the
+        epoch. No compiled program is touched — the weights are VALUE
+        inputs."""
+        values, crc = self._pending_weights
+        self._pending_weights = None
+        sched = self.scheduler
+        preempted = 0
+        for req in list(sched.running):
+            # scheduler.preempt directly — NOT _evict: this is a planned
+            # cutover, not kv pressure, and must not pollute the
+            # kv_exhausted eviction attribution
+            slot = req.slot
+            sched.preempt(req)
+            if slot is not None:
+                self._clear_slot(slot)
+            preempted += 1
+        dropped = (self._prefix.invalidate()
+                   if self._prefix is not None else 0)
+        for p, v in zip(self._model.parameters(), values):
+            p._value = v
+        self._weight_epoch += 1
+        self._weights_crc = crc
+        self._stats.weight_swaps += 1
+        if _metrics_on():
+            _M.weight_swaps.inc()
+        _EVENTS.emit("serve.swap", "engine",
+                     detail={"epoch": self._weight_epoch,
+                             "preempted": preempted,
+                             "prefix_dropped": dropped})
+
+    @property
+    def weight_epoch(self):
+        """Serving weight-set generation (0 = construction weights)."""
+        return self._weight_epoch

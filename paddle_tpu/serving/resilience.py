@@ -173,6 +173,10 @@ def request_payload(req, now_ns=None):
         "arrival_seq": req.arrival_seq,
         "preemptions": req.preemptions,
         "ttl_remaining_s": req.ttl_remaining_s(now_ns),
+        # multi-tenant identity (PR 17): which adapter the stream
+        # decodes under — restore refuses (adapter_mismatch) when the
+        # restoring engine does not have it registered
+        "adapter": req.adapter,
     }
 
 
@@ -187,7 +191,8 @@ def payload_request(payload, on_token=None):
                   payload["max_new_tokens"],
                   eos_token_id=payload.get("eos_token_id"),
                   on_token=on_token,
-                  ttl_s=max(0.0, ttl) if ttl is not None else None)
+                  ttl_s=max(0.0, ttl) if ttl is not None else None,
+                  adapter=payload.get("adapter"))
     req.generated = list(payload.get("generated") or [])
     req.preemptions = int(payload.get("preemptions") or 0)
     return req

@@ -81,10 +81,11 @@ class Request:
                  "cached_len", "arrival_seq", "admit_seq", "preemptions",
                  "error", "enqueue_ns", "first_token_ns", "finish_ns",
                  "deadline_ns", "cancel_requested", "admit_ns",
-                 "last_token_ns", "token_ns")
+                 "last_token_ns", "token_ns", "adapter", "prefix_hit",
+                 "chew")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
-                 on_token=None, ttl_s=None):
+                 on_token=None, ttl_s=None, adapter=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -118,6 +119,16 @@ class Request:
         # arrives from inside a streaming callback mid-step — the fixed
         # slot layout is only ever edited between decode steps
         self.cancel_requested = False
+        # multi-tenant serving (PR 17, serving/tenancy.py): the named
+        # LoRA-style adapter this stream decodes under (None = base
+        # weights); `prefix_hit` is the shared-prefix token count the
+        # LAST admission aliased from the prefix cache (0 = cold), and
+        # `chew` holds the un-prefilled suffix tokens a prefix-hit
+        # admission still has to feed through the decode step one per
+        # iteration before real sampling resumes
+        self.adapter = adapter
+        self.prefix_hit = 0
+        self.chew = []
 
     @property
     def context_len(self):
@@ -226,13 +237,21 @@ class Scheduler:
         request needing more than this could wait forever)."""
         return self.allocator.capacity - self.watermark_blocks
 
-    def can_ever_fit(self, req):
+    def can_ever_fit(self, req, shared_blocks=0):
         """False when no amount of waiting/eviction can serve this
         request — its peak block need exceeds what admission will ever
         grant (capacity minus the watermark reserve). Refuse such a
         request at enqueue: strict FCFS would deadlock the whole queue
-        behind it."""
-        return self.max_blocks_of(req) <= self.block_budget()
+        behind it.
+
+        `shared_blocks` is the prefix-cache aliasing credit (PR 17):
+        blocks the request would inherit by reference rather than
+        allocate. The pre-aliasing math assumed exclusive ownership and
+        would spuriously refuse a multi-tenant request whose private
+        footprint fits fine once its shared system prompt is counted
+        once — refcounted blocks cost the pool nothing extra."""
+        return self.max_blocks_of(req) - int(shared_blocks) \
+            <= self.block_budget()
 
     def queue_full(self):
         """The bounded waiting queue is at capacity (engine refuses with
@@ -282,10 +301,19 @@ class Scheduler:
         self.waiting.insert(i, req)
 
     # -- admission ----------------------------------------------------------
-    def try_admit(self):
+    def try_admit(self, prefix_hook=None):
         """Admit the FCFS head if a slot is free and its context's blocks
         leave the watermark intact. Returns the Request (now RUNNING,
-        blocks + slot assigned, KV not yet filled) or None."""
+        blocks + slot assigned, KV not yet filled) or None.
+
+        `prefix_hook(req) -> (shared_blocks, hit_tokens)` is the PR 17
+        shared-prefix probe: it ACQUIRES (increfs) the longest cached
+        block run matching the head's context, so admission only
+        allocates the private remainder and the watermark check counts
+        each refcounted block once. When admission then fails anyway
+        (watermark / slot pressure) the acquired references are dropped
+        symmetrically — the hook's incref and this free are the only
+        two sides of the claim."""
         if not self.waiting:
             return None
         try:
@@ -293,14 +321,22 @@ class Scheduler:
         except ValueError:
             return None
         req = self.waiting[0]
-        needed = self.blocks_needed(req.context_len)
+        shared, hit = [], 0
+        if prefix_hook is not None:
+            shared, hit = prefix_hook(req)
+        needed = max(0, self.blocks_needed(req.context_len) - len(shared))
         if self.allocator.num_free - needed < self.watermark_blocks:
+            if shared:
+                self.allocator.free(shared)     # undo the hook's claim
             return None
         blocks = self.allocator.allocate(needed)
         if blocks is None:
+            if shared:
+                self.allocator.free(shared)
             return None
         self.waiting.pop(0)
-        req.blocks = blocks
+        req.blocks = list(shared) + blocks
+        req.prefix_hit = hit
         req.slot = slot
         req.state = RUNNING
         req.admit_seq = self._admissions
@@ -337,12 +373,15 @@ class Scheduler:
         return max(cands, key=lambda r: r.admit_seq) if cands else None
 
     def preempt(self, req):
-        """Evict: blocks back to the pool, KV forgotten (cached_len=0 —
-        resume re-prefills context_len tokens), request back in the
-        waiting queue at its arrival position."""
+        """Evict: blocks back to the pool (a DECREF — shared prefix
+        blocks survive for their other owners), KV forgotten
+        (cached_len=0 — resume re-prefills context_len tokens), request
+        back in the waiting queue at its arrival position."""
         self._detach(req)
         req.preemptions += 1
         req.cached_len = 0
+        req.prefix_hit = 0
+        req.chew = []
         self._requeue(req)
 
     def release(self, req):
@@ -370,6 +409,7 @@ class Scheduler:
             "waiting": len(self.waiting),
             "running": len(self.running),
             "free_blocks": self.allocator.num_free,
+            "shared_blocks": getattr(self.allocator, "num_shared", 0),
             "watermark_blocks": self.watermark_blocks,
             "max_queue_depth": self.max_queue_depth,
             "aging_max_preemptions": self.aging_max_preemptions,

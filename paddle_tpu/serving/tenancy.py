@@ -1,0 +1,527 @@
+"""Multi-tenant serving primitives: shared-prefix KV reuse, batched
+LoRA-style adapters, live weight hot-swap staging (PR 17).
+
+Reference analog: the reference's parameter-server shape — "multiple
+programs, one runtime" — serves many logical models off one resident
+process. This module is that idea rebuilt for the PR 6 serving engine's
+single compiled decode step:
+
+  * `PrefixCache` — a content-addressed index over the paged block pool
+    (serving/cache.py). Prompt-aligned FULL blocks key by a rolling
+    chain digest (h_i = digest(h_{i-1}, block tokens)) so a lookup walks
+    the chain dict-hit by dict-hit; partial tails key under their parent
+    chain with the exact token tuple, and a lookup may also use the
+    leading tokens of a published block (common-prefix scan of the
+    parent's children), which is what makes copy-on-write REAL: a
+    sequence admitted onto a shared tail writes its next token's KV
+    into a block other owners still read, so the engine COWs that one
+    block first. The index holds its OWN reference on every published
+    block (BlockAllocator refcounts), so entries survive their
+    publisher's completion and are reclaimed leaf-first in LRU order
+    when the pool runs dry. A match is capped at context_len - 1
+    tokens: there is always at least one input token to feed, so the
+    decode step (never the prefill path) produces the first sampled
+    token and greedy decode stays token-identical to the cold path.
+
+  * `AdapterSet` — per-tenant low-rank deltas batched as VALUE inputs
+    to the ONE compiled decode executable. All adapters live in fixed
+    padded stacks (``[K, L, in, r]`` / ``[K, L, r, out]`` per target
+    projection, K = max_adapters + 1 with slot 0 the all-zeros base),
+    so tenants joining/leaving/churning only change array VALUES and a
+    per-batch-slot int32 index — zero retraces. The delta applies at
+    the activation level (``y + (x @ A) @ B * scale``) through
+    instance-level forwards installed on the attention projections;
+    with the context unarmed the wrapper is the original forward
+    bit-for-bit, so training and `model.generate` never see it.
+
+Lock discipline (analysis/rules/r6_lock_discipline.py applies to this
+file): every refcount/index mutation happens under the owning lock;
+snapshots are taken under the lock and ALL side effects — flight
+recorder events, metrics, device copies — happen after release. Never
+call back into user code with a lock held.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["PrefixCache", "AdapterSet"]
+
+# chain root for the first block of every prompt
+_ROOT = "prefix:root"
+
+
+def _digest(parent, tokens):
+    """Rolling chain digest: stable across processes (crc32, not
+    Python's salted hash) so a future shared index could persist."""
+    h = zlib.crc32(repr(parent).encode())
+    h = zlib.crc32(repr(tuple(int(t) for t in tokens)).encode(), h)
+    return h
+
+
+class _Entry:
+    __slots__ = ("key", "parent", "block", "tokens")
+
+    def __init__(self, key, parent, block, tokens):
+        self.key = key
+        self.parent = parent
+        self.block = block
+        self.tokens = tokens      # the tokens whose KV this block holds
+
+
+class PrefixCache:
+    """Content-hash index of prompt-aligned block runs in the paged pool.
+
+    `acquire(tokens)` returns the longest cached run matching a prompt
+    prefix — already increfed, ready to alias into a block table;
+    `publish(tokens, blocks)` indexes a freshly prefilled prompt's
+    blocks (increfing them on behalf of the index); `reclaim(n)` drops
+    cold entries leaf-first in LRU order until the allocator can serve
+    `n` free blocks. `invalidate()` empties the index (weight hot-swap:
+    cached KV is a function of the base weights); `reset(allocator)`
+    rebinds after the engine rebuilt the pool (the old refs died with
+    the old allocator).
+    """
+
+    def __init__(self, allocator, block_size):
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._lock = threading.Lock()
+        self._entries = {}          # key -> _Entry, insertion = LRU order
+        self._children = {}         # parent key -> {key: _Entry}
+        self.hits = 0
+        self.misses = 0
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def entries(self):
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def blocks_held(self):
+        with self._lock:
+            return len(self._entries)   # one block per entry
+
+    # -- lookup -------------------------------------------------------------
+    def _walk(self, tokens):
+        """Longest cached run covering a strict prefix of `tokens`
+        (capped at len-1 so one input token always remains). Caller
+        holds the lock. Returns (entries, hit_tokens)."""
+        bs = self.block_size
+        limit = len(tokens) - 1
+        path, hit, parent = [], 0, _ROOT
+        i = 0
+        while (i + 1) * bs <= limit:
+            key = ("b", _digest(parent, tokens[i * bs:(i + 1) * bs]))
+            e = self._entries.get(key)
+            if e is None:
+                break
+            path.append(e)
+            parent = key
+            hit += bs
+            i += 1
+        # partial step: the longest common prefix between the remaining
+        # tokens and any published child (a tail entry, or the leading
+        # tokens of a full block) — THE copy-on-write case: the next
+        # write lands inside this still-shared block
+        rest = tokens[hit:limit]
+        best, best_t = None, 0
+        for e in self._children.get(parent, {}).values():
+            t = 0
+            for a, b in zip(e.tokens, rest):
+                if int(a) != int(b):
+                    break
+                t += 1
+            if t > best_t:
+                best, best_t = e, t
+        if best is not None and best_t > 0:
+            path.append(best)
+            hit += best_t
+        return path, hit
+
+    def probe(self, tokens):
+        """Non-acquiring feasibility probe: (shared_block_count,
+        hit_tokens) for `can_ever_fit` / admission-policy sizing. Takes
+        no references — the answer is advisory and may differ by the
+        time admission runs."""
+        with self._lock:
+            path, hit = self._walk(list(tokens))
+            if not self._usable(hit, len(tokens)):
+                return 0, 0
+            return len(path), hit
+
+    def _usable(self, hit, prompt_len):
+        # a hit below one block (unless it covers the whole cacheable
+        # prompt) saves less prefill than its chew steps cost
+        return hit > 0 and (hit >= self.block_size
+                            or hit == prompt_len - 1)
+
+    def acquire(self, tokens):
+        """Longest cached run for a prompt prefix, INCREFED for the
+        caller (one reference per block — symmetric with
+        `allocator.free`). Returns (blocks, hit_tokens); ([], 0) on a
+        miss. Touches the matched entries' LRU position."""
+        tokens = list(tokens)
+        with self._lock:
+            path, hit = self._walk(tokens)
+            if not self._usable(hit, len(tokens)):
+                self.misses += 1
+                return [], 0
+            blocks = []
+            for e in path:
+                self.allocator.incref(e.block)
+                blocks.append(e.block)
+                # dict move-to-end = LRU touch
+                self._entries.pop(e.key, None)
+                self._entries[e.key] = e
+            self.hits += 1
+            return blocks, hit
+
+    # -- publication --------------------------------------------------------
+    def publish(self, tokens, blocks, include_tail=True):
+        """Index a freshly prefilled prompt's aligned blocks. Every NEW
+        entry increfs its block on behalf of the index (the index is an
+        owner like any sequence). `include_tail=False` skips the
+        partial last block (resume prefills write generated-token KV
+        into it, which must never be served as prompt KV). Returns the
+        number of entries added."""
+        tokens = list(tokens)
+        bs = self.block_size
+        added = 0
+        with self._lock:
+            parent = _ROOT
+            n_full = len(tokens) // bs
+            for i in range(n_full):
+                chunk = tokens[i * bs:(i + 1) * bs]
+                key = ("b", _digest(parent, chunk))
+                if key not in self._entries:
+                    if i >= len(blocks):
+                        break
+                    self.allocator.incref(blocks[i])
+                    e = _Entry(key, parent, blocks[i], tuple(chunk))
+                    self._entries[key] = e
+                    self._children.setdefault(parent, {})[key] = e
+                    added += 1
+                parent = key
+            tail = tokens[n_full * bs:]
+            if include_tail and tail and n_full < len(blocks):
+                key = ("t", _digest(parent, tail), len(tail))
+                if key not in self._entries:
+                    self.allocator.incref(blocks[n_full])
+                    e = _Entry(key, parent, blocks[n_full], tuple(tail))
+                    self._entries[key] = e
+                    self._children.setdefault(parent, {})[key] = e
+                    added += 1
+        return added
+
+    # -- reclaim / invalidation ---------------------------------------------
+    def _drop(self, e):
+        """Remove one entry and release the index's reference. Caller
+        holds the lock."""
+        self._entries.pop(e.key, None)
+        kids = self._children.get(e.parent)
+        if kids:
+            kids.pop(e.key, None)
+            if not kids:
+                del self._children[e.parent]
+        self.allocator.free([e.block])
+
+    def reclaim(self, num_free_target):
+        """Release cold entries (leaf-first, LRU order) until the
+        allocator has `num_free_target` free blocks or the index is
+        empty. Returns the number of entries dropped — the caller emits
+        the `serve.prefix_evict` attribution AFTER this returns (no
+        events under the lock)."""
+        dropped = 0
+        with self._lock:
+            while self.allocator.num_free < num_free_target:
+                victim = None
+                for e in self._entries.values():       # insertion = LRU
+                    if not self._children.get(e.key):
+                        victim = e
+                        break
+                if victim is None:
+                    break
+                self._drop(victim)
+                dropped += 1
+        return dropped
+
+    def invalidate(self):
+        """Empty the index, releasing every reference it holds — the
+        weight hot-swap path: cached KV is a function of the base
+        weights, so a new weight epoch starts cold. Returns the number
+        of entries released."""
+        with self._lock:
+            n = len(self._entries)
+            for e in list(self._entries.values()):
+                self.allocator.free([e.block])
+            self._entries.clear()
+            self._children.clear()
+        return n
+
+    def reset(self, allocator):
+        """Forget everything WITHOUT releasing references — the engine
+        rebuilt the pool (`_reset_kv_state`) and the old allocator died
+        with the old blocks."""
+        with self._lock:
+            self._entries.clear()
+            self._children.clear()
+            self.allocator = allocator
+
+
+class AdapterSet:
+    """Per-tenant LoRA-style deltas batched into fixed padded stacks.
+
+    Targets the attention projections (`qkv_proj`, `out_proj`) of every
+    layer. For each target the set owns ``A [K, L, in, r]`` and
+    ``B [K, L, r, out]`` plus ``scale [K]``, K = max_adapters + 1 —
+    slot 0 is the reserved all-zeros BASE adapter, whose delta is
+    exactly 0.0 (not merely small), so base tenants stay bit-identical
+    to the adapter-free engine. Registration writes VALUES into the
+    stacks; the compiled decode/prefill programs take the stacks and a
+    per-batch-slot index as inputs, so tenant churn never retraces.
+
+    All registry mutations happen under `self._lock`; the stacks are
+    swapped whole (copy-on-write on the host arrays) so a compiled call
+    mid-flight never sees a half-written slot.
+    """
+
+    def __init__(self, model, max_adapters, rank, dtype=None):
+        cfg = model.config
+        if max_adapters < 1:
+            raise ValueError("max_adapters must be >= 1")
+        if rank < 1:
+            raise ValueError("adapter rank must be >= 1")
+        self.model = model
+        self.max_adapters = int(max_adapters)
+        self.rank = int(rank)
+        self.num_layers = int(cfg.num_hidden_layers)
+        hidden = int(cfg.hidden_size)
+        if dtype is None:
+            params = model.parameters()
+            dtype = (np.asarray(params[0]._value).dtype if params
+                     else np.float32)
+        self.dtype = np.dtype(dtype)
+        k = self.max_adapters + 1
+        l, r = self.num_layers, self.rank
+        # target name -> (in_features, out_features)
+        self.targets = {"qkv": (hidden, 3 * hidden),
+                        "out": (hidden, hidden)}
+        self._a = {t: np.zeros((k, l, i, r), self.dtype)
+                   for t, (i, _) in self.targets.items()}
+        self._b = {t: np.zeros((k, l, r, o), self.dtype)
+                   for t, (_, o) in self.targets.items()}
+        self._scale = np.zeros(k, np.float32)
+        self._lock = threading.Lock()
+        self._names = {}            # name -> slot (1..max_adapters)
+        self._device = None         # cached jnp views of the stacks
+
+    # -- registry -----------------------------------------------------------
+    def names(self):
+        with self._lock:
+            return sorted(self._names)
+
+    def slot_of(self, name):
+        """Stack slot for an adapter name (0 = base for None)."""
+        if name is None:
+            return 0
+        with self._lock:
+            slot = self._names.get(name)
+        if slot is None:
+            raise KeyError(f"adapter {name!r} is not registered")
+        return slot
+
+    def is_registered(self, name):
+        if name is None:
+            return True
+        with self._lock:
+            return name in self._names
+
+    def register(self, name, weights=None, scale=1.0, seed=None):
+        """Install an adapter into a free slot. `weights` maps target
+        name ("qkv"/"out") to an ``(A [L, in, r], B [L, r, out])``
+        pair; with `weights=None` both factors draw from a seeded
+        normal (handy for tests/benches — note real LoRA inits B to
+        zero, which would make the delta vanish). Returns the slot."""
+        if name is None:
+            raise ValueError("adapter name must be a non-empty string")
+        new_a = {t: None for t in self.targets}
+        new_b = {t: None for t in self.targets}
+        for t, (i, o) in self.targets.items():
+            if weights is not None:
+                a, b = weights[t]
+                a = np.asarray(a, self.dtype)
+                b = np.asarray(b, self.dtype)
+            else:
+                rng = np.random.default_rng(
+                    zlib.crc32(f"{name}:{t}:{seed}".encode()))
+                a = rng.normal(0.0, 0.05,
+                               (self.num_layers, i, self.rank)) \
+                    .astype(self.dtype)
+                b = rng.normal(0.0, 0.05,
+                               (self.num_layers, self.rank, o)) \
+                    .astype(self.dtype)
+            want_a = (self.num_layers, i, self.rank)
+            want_b = (self.num_layers, self.rank, o)
+            if a.shape != want_a or b.shape != want_b:
+                raise ValueError(
+                    f"adapter {name!r} target {t!r}: want A{want_a} / "
+                    f"B{want_b}, got A{a.shape} / B{b.shape}")
+            new_a[t], new_b[t] = a, b
+        with self._lock:
+            if name in self._names:
+                raise ValueError(f"adapter {name!r} is already registered")
+            used = set(self._names.values())
+            slot = next((s for s in range(1, self.max_adapters + 1)
+                         if s not in used), None)
+            if slot is None:
+                raise ValueError(
+                    f"all {self.max_adapters} adapter slots are in use")
+            for t in self.targets:
+                self._a[t][slot] = new_a[t]
+                self._b[t][slot] = new_b[t]
+            self._scale[slot] = float(scale)
+            self._names[name] = slot
+            self._device = None
+        return slot
+
+    def unregister(self, name):
+        """Free an adapter's slot (zeroing it — the stack VALUES change,
+        the shapes never do). The caller ensures no live stream still
+        decodes under it."""
+        with self._lock:
+            slot = self._names.pop(name, None)
+            if slot is None:
+                raise KeyError(f"adapter {name!r} is not registered")
+            for t in self.targets:
+                self._a[t][slot] = 0
+                self._b[t][slot] = 0
+            self._scale[slot] = 0.0
+            self._device = None
+        return slot
+
+    # -- compiled-program inputs --------------------------------------------
+    def device_stacks(self):
+        """The padded stacks as ONE flat tuple of arrays — the decode/
+        prefill executables' adapter VALUE inputs. Shapes are fixed at
+        construction (K, L, r baked), so churn never re-keys. Cached
+        until the registry next mutates."""
+        with self._lock:
+            dev = self._device
+            if dev is None:
+                dev = tuple(jnp.asarray(x) for x in (
+                    self._a["qkv"], self._b["qkv"],
+                    self._a["out"], self._b["out"], self._scale))
+                self._device = dev
+        return dev
+
+    @staticmethod
+    def trace_ctx(stacks, slots=None, slot=None):
+        """Arm the projection wrappers for one traced call: `slots` is
+        the per-batch-slot adapter index ([S] int32, decode), `slot` a
+        scalar index (prefill)."""
+        a_qkv, b_qkv, a_out, b_out, scale = stacks
+        return {"a": {"qkv": a_qkv, "out": a_out},
+                "b": {"qkv": b_qkv, "out": b_out},
+                "scale": scale, "slots": slots, "slot": slot}
+
+    # -- model wiring -------------------------------------------------------
+    def install(self, holder):
+        """Install activation-level wrappers on every target projection.
+        `holder` is a mutable dict shared with the engine's compiled
+        programs: `holder["active"]` is None outside a tenant trace (the
+        wrapper then IS the original forward), or a `trace_ctx` dict
+        whose arrays are the current trace's value inputs. Idempotent
+        per model."""
+        if getattr(self.model, "_tenancy_wrapped", False):
+            return
+        for layer_idx, block in enumerate(self.model.gpt.h):
+            for tname, lin in (("qkv", block.attn.qkv_proj),
+                               ("out", block.attn.out_proj)):
+                lin.forward = _adapter_forward(lin, layer_idx, tname,
+                                               holder)
+        self.model._tenancy_wrapped = True
+
+    # -- eager merge (degraded-mode fallback) -------------------------------
+    def merged(self, name):
+        """Context manager: fold one adapter into the target weights
+        (``W + A @ B * scale``) for the eager `model.generate` fallback
+        path, restoring the base weights on exit. `model.generate`
+        passes parameters as VALUES, so the merge never retraces its
+        cached program. Note the merge is mathematically — not
+        bitwise — equal to the activation-level delta (matmul
+        associativity), which is exactly the fallback contract the
+        compiled path also honors for the base slot (whose delta is an
+        exact 0.0)."""
+        return _MergedAdapter(self, name)
+
+
+class _MergedAdapter:
+    def __init__(self, adapters, name):
+        self._adapters = adapters
+        self._name = name
+        self._saved = []
+
+    def __enter__(self):
+        ad = self._adapters
+        slot = ad.slot_of(self._name)
+        if slot == 0:
+            return self
+        scale = float(ad._scale[slot])
+        for layer_idx, block in enumerate(ad.model.gpt.h):
+            for tname, lin in (("qkv", block.attn.qkv_proj),
+                               ("out", block.attn.out_proj)):
+                w = lin.weight._value
+                self._saved.append((lin, w))
+                delta = (ad._a[tname][slot, layer_idx]
+                         @ ad._b[tname][slot, layer_idx]) * scale
+                lin.weight._value = w + jnp.asarray(delta).astype(w.dtype)
+        return self
+
+    def __exit__(self, *exc):
+        for lin, w in self._saved:
+            lin.weight._value = w
+        self._saved = []
+        return False
+
+
+def _adapter_forward(lin, layer_idx, tname, holder):
+    """Instance-level forward for one target projection: the original
+    linear plus the slot-gathered low-rank delta when a tenant trace is
+    active; the original linear exactly otherwise."""
+    from ..nn import functional as F
+    from ..framework.core import Tensor
+
+    def forward(x):
+        y = F.linear(x, lin.weight, lin.bias)
+        ctx = holder.get("active")
+        if ctx is None:
+            return y
+        a = ctx["a"][tname]
+        b = ctx["b"][tname]
+        scale = ctx["scale"]
+        xv = x._value if hasattr(x, "_value") else jnp.asarray(x)
+        if ctx["slots"] is not None:
+            # decode: every batch slot gathers ITS tenant's factors
+            sl = ctx["slots"]
+            av = a[sl, layer_idx]               # [S, in, r]
+            bv = b[sl, layer_idx]               # [S, r, out]
+            sc = scale[sl].astype(xv.dtype)     # [S]
+            delta = jnp.einsum("sni,sir->snr", xv, av)
+            delta = jnp.einsum("snr,sro->sno", delta, bv) \
+                * sc[:, None, None]
+        else:
+            # prefill: one request, scalar slot index
+            idx = ctx["slot"]
+            av = a[idx, layer_idx]
+            bv = b[idx, layer_idx]
+            delta = (xv @ av) @ bv \
+                * scale[idx].astype(xv.dtype)
+        return Tensor(y._value + delta.astype(y._value.dtype),
+                      stop_gradient=True)
+
+    return forward

@@ -1,0 +1,57 @@
+"""R6 golden known-bad, tenancy flavor (PR 17): device sync and event
+side effects while holding the prefix-index lock, plus an inversion
+against the allocator lock — the race classes serving/tenancy.py's
+snapshot-then-act discipline exists to rule out."""
+import threading
+
+
+class BadPrefixIndex:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alloc_lock = threading.Lock()
+        self._entries = {}
+        self._evict_hooks = []
+
+    def publish(self, key, block, pool):
+        with self._lock:
+            self._entries[key] = block
+            pool.block_until_ready()            # line 18: device sync held
+
+    def reclaim(self, key):
+        with self._lock:
+            block = self._entries.pop(key)
+            for hook in self._evict_hooks:
+                hook(key, block)                # line 24: observer held
+            self.on_evict(key)                  # line 25: event emit held
+        return block
+
+    def on_evict(self, key):
+        pass
+
+    def acquire(self, key):
+        with self._lock:
+            with self._alloc_lock:              # _lock -> _alloc_lock
+                return self._entries.get(key)
+
+    def refcount_fast(self, key):
+        with self._alloc_lock:
+            with self._lock:                    # line 38: inversion
+                return key in self._entries
+
+
+class GoodPrefixIndex:
+    """The fixed form tenancy.py ships: mutate the index/refcounts under
+    the lock, emit events and touch the device after release."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._evict_hooks = []
+
+    def reclaim(self, key):
+        with self._lock:
+            block = self._entries.pop(key)
+            hooks = list(self._evict_hooks)
+        for hook in hooks:
+            hook(key, block)
+        return block

@@ -130,6 +130,9 @@ class TestPublicContract:
             # serving resilience (PR 7, serving/resilience.py)
             "serve.cancel", "serve.expire", "serve.refuse", "serve.hang",
             "serve.degrade", "serve.resume",
+            # multi-tenant serving (PR 17, serving/tenancy.py)
+            "serve.prefix_hit", "serve.prefix_miss", "serve.prefix_evict",
+            "serve.swap",
             # persistent AOT executable cache (PR 9, ops/aot_cache.py)
             "aot.hit", "aot.miss", "aot.store", "aot.corrupt",
             "aot.version_skew", "aot.evict",
@@ -157,6 +160,8 @@ class TestPublicContract:
             "client_cancel", "deadline_expired", "queue_full",
             "deadline_infeasible", "step_hang", "decode_fault",
             "crash_resume",
+            # multi-tenant serving (PR 17, serving/tenancy.py)
+            "prefix_hit", "adapter_mismatch", "torn_swap",
             # distributed step fusion (PR 10, ops/spmd_fusion.py);
             # pipeline promotion registry (PR 16) adds schedule churn
             "collective_unkeyed", "mesh_mismatch", "spmd_divergence",

@@ -43,7 +43,7 @@ _FIXTURE_PATHS = {
     "R4": ["distributed/r4_unkeyed.py",
            "incubate/distributed/r4_lax_unkeyed.py"],
     "R5": ["r5_project"],
-    "R6": ["serving/r6_locks.py"],
+    "R6": ["serving/r6_locks.py", "serving/r6_tenancy.py"],
 }
 
 
@@ -117,12 +117,19 @@ class TestRuleFixtures:
         fs = _fixture_findings("R6")
         assert _triples(fs) == [
             ("R6", "lock_discipline", 16),     # sleep under lock
+            ("R6", "lock_discipline", 18),     # device sync under lock
             ("R6", "lock_discipline", 22),     # callback loop under lock
             ("R6", "lock_discipline", 23),     # on_* callback under lock
+            ("R6", "lock_discipline", 24),     # evict hooks under lock
+            ("R6", "lock_discipline", 25),     # event emit under lock
             ("R6", "lock_discipline", 35),     # lock-order inversion
+            ("R6", "lock_discipline", 38),     # alloc-lock inversion
         ]
         # the snapshot-then-invoke pattern stays clean
         assert not any(f.symbol.startswith("GoodRegistry") for f in fs)
+        # ...and the tenancy-flavored fixed form (the discipline
+        # serving/tenancy.py actually ships) stays clean too
+        assert not any(f.symbol.startswith("GoodPrefixIndex") for f in fs)
 
     def test_every_finding_on_the_reason_contract(self):
         """Static findings and runtime attributions are ONE taxonomy:

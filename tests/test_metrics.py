@@ -98,6 +98,8 @@ class TestContract:
             "serve_tokens_total", "serve_occupancy",
             "serve_requests_total", "serve_refusals_total",
             "serve_hangs_total", "serve_preemptions_total",
+            "serve_prefix_hit_tokens_total", "serve_prefix_hit_rate",
+            "serve_adapter_switches_total", "serve_weight_swaps_total",
         })
 
     def test_goodput_buckets_frozen(self):

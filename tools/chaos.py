@@ -611,6 +611,147 @@ def scenario_serve_kill():
     return {"ok": not failures, "failures": failures}
 
 
+def tenant_child_main(args):
+    """One resumable HOT-SWAP serving run (invoked as `chaos.py
+    --tenant-child`): a hot_swap engine in lockstep (batch >= streams),
+    ServeCheckpointer ticking every step, and a live weight swap staged
+    once every stream has >= 3 tokens — a TOKEN-space boundary, so the
+    cutover lands at the same token index in every run regardless of how
+    resume re-prefills re-shuffle the step count. `--kill-mode staged`
+    SIGKILLs between stage and commit (the pending set must die with the
+    process); `--kill-mode committed` SIGKILLs after the cutover has
+    been checkpointed (the restart must refuse to resume under the OLD
+    weights: torn_swap). Writes {rid: tokens} JSON on completion plus
+    `__torn_refusals__` — how many restores the torn-swap guard bounced
+    before the child loaded the matching weight set."""
+    import numpy as np
+    from paddle_tpu.incubate.checkpoint import ServeCheckpointer
+    from paddle_tpu.serving import LLMEngine, ServeRefusal
+
+    SWAP_TOKENS = 3
+    model, prompts = _serve_setup()
+    # the incoming weight set, derived from the SEEDED construction
+    # weights before anything mutates them: bit-reproducible in every
+    # child process, killed or clean
+    w2 = [np.asarray(p._value) * np.float32(1.0001)
+          for p in model.parameters()]
+    engine = LLMEngine(model, max_batch_size=4, block_size=4,
+                       hot_swap=True)
+    ck = ServeCheckpointer(args.ckpt_dir, save_every_n_steps=1,
+                           max_checkpoints=3)
+    torn = 0
+    payload = ck.restore()
+    try:
+        restored = engine.restore_state(payload)
+    except ServeRefusal as e:
+        if e.reason != "torn_swap":
+            raise
+        # the snapshot was taken under the NEW weights: load them first
+        # (the supervisor pattern), then resume — never decode a single
+        # token against the torn set
+        torn = 1
+        engine.swap_weights(w2)
+        restored = engine.restore_state(payload)
+    if not restored:
+        for i, p in enumerate(prompts):
+            engine.add_request(p, max_new_tokens=10, request_id=f"s{i}")
+    n = 0
+    while True:
+        live = [r for r in engine.requests.values() if not r.finished]
+        if engine.weight_epoch == 0 and live \
+                and all(len(r.generated) >= SWAP_TOKENS for r in live):
+            if args.kill_mode == "staged":
+                # mid-hot-swap: staged, never committed — the pending
+                # weights must die with the process
+                engine.stage_weights(w2)
+                os.kill(os.getpid(), signal.SIGKILL)
+            engine.swap_weights(w2)
+            if args.kill_mode == "committed":
+                # cutover done; checkpoint it, then die before serving
+                # another step under the new epoch
+                ck.tick(n + 1000, engine.state_payload())
+                os.kill(os.getpid(), signal.SIGKILL)
+        alive = engine.step()
+        n += 1
+        ck.tick(n, engine.state_payload())
+        if not alive:
+            break
+    out = {r.rid: list(r.generated) for r in engine.requests.values()}
+    out["__resumed__"] = len(restored)
+    out["__torn_refusals__"] = torn
+    out["__epoch__"] = engine.weight_epoch
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+def _spawn_tenant_child(ckpt_dir, out, kill_mode=None, timeout=300):
+    cmd = [sys.executable, os.path.abspath(__file__), "--tenant-child",
+           "--ckpt-dir", ckpt_dir, "--out", out]
+    if kill_mode:
+        cmd += ["--kill-mode", kill_mode]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def scenario_tenant_swap():
+    """PR 17: SIGKILL around a live weight hot-swap. Three runs share
+    the deterministic child: clean (the reference), killed between
+    stage and commit (the staged set must vanish with the process), and
+    killed after the committed cutover was checkpointed (the restart
+    must be REFUSED under the old weights — torn_swap — then finish
+    byte-identically once the matching set is loaded)."""
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        out_clean = os.path.join(tmp, "clean.json")
+        r0 = _spawn_tenant_child(os.path.join(tmp, "ck_clean"), out_clean)
+        if r0.returncode != 0:
+            failures.append(f"clean tenant run failed: {r0.stderr[-800:]}")
+            return {"ok": False, "failures": failures}
+        with open(out_clean) as f:
+            ref = json.load(f)
+        if ref["__epoch__"] != 1:
+            failures.append(
+                f"clean run served epoch {ref['__epoch__']}, expected 1")
+
+        for mode, want_torn in (("staged", 0), ("committed", 1)):
+            ck = os.path.join(tmp, f"ck_{mode}")
+            out = os.path.join(tmp, f"{mode}.json")
+            r1 = _spawn_tenant_child(ck, out, kill_mode=mode)
+            if r1.returncode != -signal.SIGKILL:
+                failures.append(
+                    f"[{mode}] expected SIGKILL death, rc={r1.returncode} "
+                    f"stderr={r1.stderr[-500:]}")
+                continue
+            if os.path.exists(out):
+                failures.append(f"[{mode}] killed run wrote final output")
+            r2 = _spawn_tenant_child(ck, out)
+            if r2.returncode != 0:
+                failures.append(
+                    f"[{mode}] restarted run failed: {r2.stderr[-800:]}")
+                continue
+            with open(out) as f:
+                res = json.load(f)
+            if res["__resumed__"] < 1:
+                failures.append(f"[{mode}] restart restored no requests")
+            if res["__torn_refusals__"] != want_torn:
+                failures.append(
+                    f"[{mode}] torn-swap refusals: "
+                    f"{res['__torn_refusals__']}, expected {want_torn}")
+            if res["__epoch__"] < 1:
+                failures.append(
+                    f"[{mode}] restart finished on epoch "
+                    f"{res['__epoch__']} — streams decoded against the "
+                    "old weights")
+            for rid in sorted(k for k in ref if not k.startswith("__")):
+                if res.get(rid) != ref[rid]:
+                    failures.append(
+                        f"[{mode}] stream {rid} not byte-identical "
+                        "through the kill/restart cutover")
+    return {"ok": not failures, "failures": failures}
+
+
 # ---------------------------------------------------------------------------
 # warm-restart scenario (PR 9): AOT store + StepCheckpointer child
 # ---------------------------------------------------------------------------
@@ -975,6 +1116,7 @@ SCENARIOS = {"nan": scenario_nan, "exception": scenario_exception,
              "serve_hang": scenario_serve_hang,
              "serve_fused_fault": scenario_serve_fused_fault,
              "serve_kill": scenario_serve_kill,
+             "tenant_swap": scenario_tenant_swap,
              "telemetry": scenario_telemetry}
 
 
@@ -987,6 +1129,11 @@ def main(argv=None):
     # internal: child training/serving runs for the kill scenarios
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--serve-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--tenant-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--kill-mode", default=None,
+                    choices=("staged", "committed"),
                     help=argparse.SUPPRESS)
     ap.add_argument("--aot-child", action="store_true",
                     help=argparse.SUPPRESS)
@@ -1002,6 +1149,8 @@ def main(argv=None):
         return child_main(args)
     if args.serve_child:
         return serve_child_main(args)
+    if args.tenant_child:
+        return tenant_child_main(args)
     if args.aot_child:
         return aot_child_main(args)
 

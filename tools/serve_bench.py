@@ -64,21 +64,28 @@ def _build_model(on_tpu):
     return model
 
 
-def _workload(streams, vocab, max_prompt, seed=0):
+def _workload(streams, vocab, max_prompt, seed=0, shared_prefix=0):
     import numpy as np
     rng = np.random.default_rng(seed)
-    lens = rng.integers(4, max_prompt + 1, streams)
-    return [rng.integers(0, vocab, int(n)).tolist() for n in lens]
+    prefix = (rng.integers(0, vocab, shared_prefix).tolist()
+              if shared_prefix else [])
+    lens = rng.integers(4, max_prompt + 1 - shared_prefix, streams)
+    return [prefix + rng.integers(0, vocab, int(n)).tolist()
+            for n in lens]
 
 
 def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
-                    model=None, kernel=None, kv_dtype=None):
+                    model=None, kernel=None, kv_dtype=None,
+                    prefix_cache=False):
     """One serving bench leg; returns a bench.py-style record dict.
 
     `kernel` pins the attention variant (default: the engine resolves
     FLAGS_serve_attention_kernel); `kv_dtype="int8"` runs the quantized
     KV pool. Both land in the record's extra so a bench trajectory always
-    says WHICH kernel tier produced its numbers."""
+    says WHICH kernel tier produced its numbers. `prefix_cache` runs the
+    multi-tenant shared-prefix workload (PR 17): every stream carries
+    the same leading system prompt, so the record's prefix-hit counters
+    show the aliasing economy instead of zeros."""
     import jax
     import numpy as np
     from paddle_tpu.framework.flags import get_flags, set_flags
@@ -118,8 +125,11 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
                            # healthy run and move in the trajectory when
                            # admission or deadline behavior regresses
                            max_queue_depth=4 * streams,
-                           attention_kernel=kernel, kv_dtype=kv_dtype)
-        prompts = _workload(streams, cfg.vocab_size, max_prompt)
+                           attention_kernel=kernel, kv_dtype=kv_dtype,
+                           enable_prefix_cache=prefix_cache)
+        prompts = _workload(streams, cfg.vocab_size, max_prompt,
+                            shared_prefix=(max_prompt // 2
+                                           if prefix_cache else 0))
         # warmup: compile the decode program and every prefill bucket the
         # workload will hit (one representative prompt per bucket)
         buckets = {}
@@ -153,7 +163,8 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
 
     platform = jax.devices()[0].platform
     return {
-        "metric": f"serve_{streams}_tokens_per_sec",
+        "metric": (f"serve_{streams}_prefix_tokens_per_sec" if prefix_cache
+                   else f"serve_{streams}_tokens_per_sec"),
         "value": round(snap["tokens_per_sec"], 1),
         "unit": "tokens/s",
         # serving target: compiled decode step <= 0.08 ms (TPU); CPU runs
@@ -202,6 +213,15 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             "hangs": snap["hangs"],
             "eager_fallbacks": snap["eager_fallbacks"],
             "resumed": snap["resumed"],
+            # multi-tenant counters (PR 17): zeros on a plain engine;
+            # with --prefix-cache the hit-rate line IS the aliasing
+            # economy (prefill work the shared system prompt avoided)
+            "prefix_cache": prefix_cache,
+            "prefix_hit_tokens": snap["prefix_hit_tokens"],
+            "prefix_hit_rate": round(snap["prefix_hit_rate"], 4),
+            "cow_copies": snap["cow_copies"],
+            "adapter_switches": snap["adapter_switches"],
+            "weight_swaps": snap["weight_swaps"],
             "platform": platform,
             "trace": tdir,
             "fusion_events": events_summary(ev),
@@ -224,6 +244,10 @@ def main(argv=None) -> int:
                          "FLAGS_serve_attention_kernel)")
     ap.add_argument("--kv-dtype", default=None, choices=("int8",),
                     help="quantized KV cache mode (default: model dtype)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="multi-tenant shared-prefix workload: every "
+                         "stream carries the same system prompt and the "
+                         "engine aliases its KV blocks (PR 17)")
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trace", default=None,
                     help="directory for a jax profiler trace of a few "
@@ -251,7 +275,8 @@ def main(argv=None) -> int:
     rec = run_serve_bench(args.streams, on_tpu,
                           max_new_tokens=args.max_new_tokens,
                           trace_dir=args.trace, kernel=args.kernel,
-                          kv_dtype=args.kv_dtype)
+                          kv_dtype=args.kv_dtype,
+                          prefix_cache=args.prefix_cache)
     rec["elapsed_s"] = round(time.perf_counter() - t0, 1)
     if args.json:
         print(json.dumps(rec, indent=2))
@@ -268,6 +293,10 @@ def main(argv=None) -> int:
               f"decode_compiles {ex['decode_compiles']} (window), "
               f"evictions {ex['evictions']}, refused {ex['refused']}, "
               f"expired {ex['expired']}, hangs {ex['hangs']}")
+        if ex["prefix_cache"]:
+            print(f"prefix: hit_rate {ex['prefix_hit_rate']} "
+                  f"({ex['prefix_hit_tokens']} tokens aliased), "
+                  f"cow_copies {ex['cow_copies']}")
         print(f"doctor: {ex['fusion_doctor']['headline']}")
     return 0 if rec["extra"]["decode_compiles"] == 0 else 1
 
