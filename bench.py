@@ -586,24 +586,41 @@ def bench_decode(on_tpu):
 # serve_1 / serve_8 / serve_64: the continuous-batching engine
 # --------------------------------------------------------------------------
 
-def _bench_serve(streams, prefix=False):
+def _bench_serve(streams, prefix=False, sampled=False, pipeline=False):
     """Serving-engine leg at N concurrent streams; the heavy lifting
     (workload, warmup, zero-retrace window accounting) lives in
     tools/serve_bench.run_serve_bench so the CLI and the bench measure
     the same thing. `prefix=True` runs the multi-tenant shared-prefix
     workload (PR 17) with the prefix cache enabled, so the trajectory
     carries the aliasing economy (hit rate, COW copies) as first-class
-    numbers next to the cold-prefill serve legs."""
+    numbers next to the cold-prefill serve legs. `sampled=True` turns
+    the streams stochastic (PR 18: per-slot temperature/top-k/top-p
+    inside the ONE compiled decode — the record's `sampling` block
+    carries the entropy sanity), `pipeline=True` runs the
+    software-pipelined decode loop."""
     def run(on_tpu):
         import jax
         sys.path.insert(0, os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tools"))
         import serve_bench
         platform = jax.devices()[0].platform
-        leg = f"serve_{streams}_prefix" if prefix else f"serve_{streams}"
+        leg = f"serve_{streams}"
+        if prefix:
+            leg += "_prefix"
+        if sampled:
+            leg += "_sampled"
+        if pipeline:
+            leg += "_pipelined"
         tdir = os.path.join(TRACE_ROOT, platform, leg)
-        return serve_bench.run_serve_bench(streams, on_tpu, trace_dir=tdir,
-                                           prefix_cache=prefix)
+        rec = serve_bench.run_serve_bench(
+            streams, on_tpu, trace_dir=tdir, prefix_cache=prefix,
+            temperature=0.8 if sampled else 0.0,
+            top_k=40 if sampled else 0,
+            top_p=0.95 if sampled else 1.0,
+            seed=1234 if sampled else None, pipeline=pipeline)
+        if sampled or pipeline:
+            rec["metric"] = f"{leg}_tokens_per_sec"
+        return rec
     return run
 
 
@@ -1004,6 +1021,7 @@ CONFIG_FNS = {
     "serve_8": _bench_serve(8),
     "serve_64": _bench_serve(64),
     "serve_8_prefix": _bench_serve(8, prefix=True),
+    "serve_8_sampled": _bench_serve(8, sampled=True, pipeline=True),
     "flash4096": bench_flash4096,
     "gpt2_355m": bench_gpt2_355m,
     "gpt2_train": bench_gpt2_train,
@@ -1017,6 +1035,7 @@ CONFIG_FNS = {
 # versions are tiny and get a flat cap
 TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
             "serve_64": 150, "serve_8_prefix": 120,
+            "serve_8_sampled": 120,
             "flash4096": 210, "gpt2_355m": 240,
             "gpt2_train": 280, "accum4": 240, "dp8": 180, "pp2": 200,
             "moe8": 180}
@@ -1193,7 +1212,8 @@ def main():
 
     results = {}
     for name in ("vit", "decode", "serve_1", "serve_8", "serve_64",
-                 "serve_8_prefix", "flash4096", "gpt2_355m", "dp8"):
+                 "serve_8_prefix", "serve_8_sampled", "flash4096",
+                 "gpt2_355m", "dp8"):
         avail = remaining() - HEADLINE_RESERVE
         if avail < 45:
             results[name] = {"metric": name, "skipped": "budget_exhausted",

@@ -31,7 +31,7 @@ __all__ = ["seed", "get_rng_key", "split_key", "default_generator",
            "tracing_key_scope", "RNGKeyContext", "rng_epoch",
            "rng_checkpoint_state", "set_rng_checkpoint_state",
            "rng_key_input", "derive_key_data", "stream_base_data",
-           "HoistedKeyTensor"]
+           "slot_sample_keys", "HoistedKeyTensor"]
 
 
 class _GlobalGenerator:
@@ -201,6 +201,19 @@ def derive_key_data(base_data, epoch):
     so fused and eager key streams agree bit-for-bit."""
     key = jax.random.fold_in(jax.random.wrap_key_data(base_data), epoch)
     return jax.random.key_data(key)
+
+
+def slot_sample_keys(seeds, positions):
+    """Per-slot sampling keys `fold_in(PRNGKey(seed), position)` — pure and
+    traceable over `[S]` uint32 seed and `[S]` int32 position arrays. The
+    serving engine keys every stochastic token off (request seed, count of
+    known context tokens), so a stream replays bit-for-bit across
+    preemption, watchdog rebuild, and crash resume: re-prefilling the
+    prompt+generated context restores exactly the positions the original
+    stream consumed."""
+    def one(seed, pos):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+    return jax.vmap(one)(seeds, positions)
 
 
 def stream_base_data():

@@ -388,7 +388,7 @@ class GPTForCausalLM(Layer):
                 for _ in range(cfg.num_hidden_layers)]
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 top_k=1, temperature=1.0, seed=0):
+                 top_k=1, top_p=1.0, temperature=1.0, seed=0):
         """Batched autoregressive decoding, compiled as ONE XLA program:
         prefill on the full prompt, then a lax.scan over decode steps
         against static KV buffers (shapes fixed at [B, P + N]).
@@ -396,7 +396,10 @@ class GPTForCausalLM(Layer):
         Reference analog: the serving decode the reference drives through
         AnalysisPredictor + fused_multi_transformer
         (inference/api/analysis_predictor.h:95, incubate FusedMultiTransformer);
-        greedy (do_sample=False) or top-k temperature sampling.
+        greedy (do_sample=False) or top-k/top-p temperature sampling
+        (top_p >= 1 disables the nucleus filter; the mask reuses the
+        serving sampler's `apply_top_p`, so both paths keep one
+        definition of the nucleus rule).
         Returns the generated ids, [B, max_new_tokens].
         """
         ids = input_ids._value if isinstance(input_ids, Tensor) \
@@ -451,6 +454,11 @@ class GPTForCausalLM(Layer):
                 if top_k and top_k > 0:
                     kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
                     lg = jnp.where(lg < kth, -jnp.inf, lg)
+                if top_p is not None and float(top_p) < 1.0:
+                    from ...serving.sampling import apply_top_p
+                    lg = apply_top_p(lg, jnp.full((lg.shape[0],),
+                                                  float(top_p),
+                                                  jnp.float32))
                 return jax.random.categorical(k2, lg, axis=-1) \
                     .astype(jnp.int32)
 
@@ -483,6 +491,7 @@ class GPTForCausalLM(Layer):
             if not hasattr(self, "_gen_cache"):
                 self._gen_cache = {}
             sig = (b, p, n_new, bool(do_sample), int(top_k),
+                   float(top_p if top_p is not None else 1.0),
                    float(temperature))
             jitted = self._gen_cache.get(sig)
             if jitted is None:

@@ -89,6 +89,10 @@ CATEGORIES = frozenset({
     # reclaimed under pool pressure; a live weight hot-swap committed
     "serve.prefix_hit", "serve.prefix_miss", "serve.prefix_evict",
     "serve.swap",
+    # compiled stochastic sampling + pipelined decode (PR 18): a request
+    # enqueued with a stochastic sampler config, or a speculative token
+    # discarded at the commit-lag-1 boundary (reason commit_lag_rollback)
+    "serve.sample",
     # persistent AOT executable cache (ops/aot_cache.py): warm-start
     # loads, cold misses, artifact writes, quarantined corruption,
     # environment-fingerprint skew, size/age eviction
@@ -162,6 +166,15 @@ REASON_CODES = frozenset({
     "torn_swap",           # a resume snapshot's weight CRC does not match
                            # the serving weights: restore refused rather
                            # than decode half a stream per weight set
+    # -- compiled sampling + pipelined decode (serving/sampling.py, PR 18) -
+    "sampler_mismatch",    # a sampler config outside the compiled
+                           # program's contract (temperature < 0,
+                           # top_p outside (0,1], ...): refused at the
+                           # door, never a silent clamp or a retrace
+    "commit_lag_rollback", # pipelined decode: a stream left its slot
+                           # (cancel / expire / preempt / finish) between
+                           # launch and the lag-1 commit — its one
+                           # speculative token is discarded, by design
     # -- distributed step fusion (ops/spmd_fusion.py) ----------------------
     "collective_unkeyed",  # a collective's group/mesh has no canonical key
     "mesh_mismatch",       # cycle inputs span meshes, or a fired program's

@@ -200,6 +200,24 @@ REASON_HINTS = {
         "rather than decode half of every stream per weight set; load "
         "the checkpoint matching the snapshot's CRC (or re-stage the "
         "swap) and restore again."),
+    "sampler_mismatch": (
+        "a request's sampler config is outside the compiled decode "
+        "program's contract (temperature negative/non-finite, top_k "
+        "negative, top_p outside (0, 1], repetition_penalty "
+        "non-positive): it was refused at admission rather than "
+        "silently clamped — a clamp would break the (seed, prompt, "
+        "sampler) reproducibility contract. Fix the caller's "
+        "parameters; every in-contract value is a pure VALUE edit and "
+        "never retraces the decode executable."),
+    "commit_lag_rollback": (
+        "software-pipelined decode commits each step's tokens one "
+        "iteration late (launch N+1, then commit N); a stream that "
+        "left its slot in that window — client cancel, TTL expiry, "
+        "preemption, or finishing on the committed token — has exactly "
+        "one speculative token discarded. By design: boundary "
+        "decisions land deterministically at the lag-1 boundary. A "
+        "high rollback rate relative to completions means churny "
+        "cancel traffic, not an engine bug."),
     "collective_unkeyed": (
         "a collective op's group has no canonically-keyable mesh (a "
         "hand-built Group without a mesh-backed process group), so the "

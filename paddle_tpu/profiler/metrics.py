@@ -671,6 +671,9 @@ METRIC_NAMES = frozenset({
     "serve_prefix_hit_rate",
     "serve_adapter_switches_total",
     "serve_weight_swaps_total",
+    # compiled stochastic sampling + pipelined decode (PR 18)
+    "serve_sampled_tokens_total",
+    "serve_commit_rollbacks_total",
 })
 
 # goodput wall-time attribution buckets (profiler/goodput.py): where did
@@ -725,6 +728,8 @@ METRIC_MERGE = {
     "serve_prefix_hit_rate": "max",
     "serve_adapter_switches_total": "sum",
     "serve_weight_swaps_total": "sum",
+    "serve_sampled_tokens_total": "sum",
+    "serve_commit_rollbacks_total": "sum",
 }
 
 
@@ -793,6 +798,12 @@ def _install_default_metrics(reg):
     s.weight_swaps = reg.counter(
         "serve_weight_swaps_total",
         "live base-weight hot-swap commits")
+    s.sampled_tokens = reg.counter(
+        "serve_sampled_tokens_total",
+        "tokens emitted by stochastic (temperature > 0) streams")
+    s.commit_rollbacks = reg.counter(
+        "serve_commit_rollbacks_total",
+        "speculative tokens discarded at the pipelined lag-1 commit")
 
     for name, label in (("dispatch_events_total", "per-op executable "
                          "cache outcomes"),

@@ -116,6 +116,8 @@ from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
 from .resilience import (ServeRefusal, MonitoredWait, StepHang,
                          request_payload, payload_request)
 from .tenancy import PrefixCache, AdapterSet
+from .sampling import SAMPLER_VERSION, validate_sampler, default_seed, \
+    sample_tokens
 
 __all__ = ["LLMEngine", "ServeStats"]
 
@@ -176,6 +178,14 @@ class ServeStats:
         self.cow_copies = 0
         self.adapter_switches = 0
         self.weight_swaps = 0
+        # compiled stochastic sampling + pipelined decode (PR 18):
+        # sampled_tokens counts committed tokens from slots decoding with
+        # temperature > 0 (greedy slots are the same program, different
+        # values); commit_rollbacks counts speculative tokens a lag-1
+        # commit discarded because the slot's request was cancelled /
+        # expired / preempted / finished between launch and commit
+        self.sampled_tokens = 0
+        self.commit_rollbacks = 0
         # recent raw samples only (the admission wait estimate averages
         # the tail); percentiles live in the windowed histograms below
         self.step_times_s = []
@@ -231,6 +241,8 @@ class ServeStats:
             "cow_copies": self.cow_copies,
             "adapter_switches": self.adapter_switches,
             "weight_swaps": self.weight_swaps,
+            "sampled_tokens": self.sampled_tokens,
+            "commit_rollbacks": self.commit_rollbacks,
             "occupancy_mean": (self.occupancy_sum / self.steps
                                if self.steps else 0.0),
             "occupancy_saturated": (
@@ -286,7 +298,8 @@ class LLMEngine:
                  dtype=None, tokenizer=None, max_queue_depth=None,
                  aging_max_preemptions=3, kv_dtype=None,
                  attention_kernel=None, enable_prefix_cache=False,
-                 max_adapters=0, adapter_rank=4, hot_swap=False):
+                 max_adapters=0, adapter_rank=4, hot_swap=False,
+                 logprobs_topk=0, pipeline_decode=False):
         cfg = model.config
         model.eval()
         self._model = model
@@ -379,6 +392,33 @@ class LLMEngine:
         # inactive slot is masked out, and clearing it would count a
         # spurious adapter switch on the next same-tenant admission
         self._aslots = np.zeros(s, np.int32)
+        # -- compiled stochastic sampling (PR 18, serving/sampling.py) --
+        # per-slot sampler config as fixed [S] VALUE buffers — edited
+        # like tokens/lens on join/leave, never reshaping, so arbitrary
+        # per-slot sampler churn keeps decode_compiles == 1. Greedy is
+        # temperature=0 under the same program; the no-op values below
+        # keep a cleared slot on the cheap all-greedy cond branch
+        self._logprobs_topk = int(logprobs_topk)
+        self._temps = np.zeros(s, np.float32)
+        self._topks = np.zeros(s, np.int32)
+        self._topps = np.ones(s, np.float32)
+        self._rpens = np.ones(s, np.float32)
+        self._seeds = np.zeros(s, np.uint32)
+        # per-slot context-token history for the in-graph repetition
+        # penalty; positions <= lens are valid. The decode step scatters
+        # its own input token at index `lens` in-graph, so the one token
+        # the host has not committed yet (pipelined mode) is still seen
+        self._history = np.zeros((s, self.max_context), np.int32)
+        # -- software-pipelined decode (PR 18) --------------------------
+        # launch step N+1 against device-fed tokens while step N's host
+        # commit overlaps: `_inflight` holds the un-committed launch,
+        # `_feedback` the device next-token array it will consume, and
+        # `_override[slot]` marks slots whose HOST token (admission /
+        # chew / restore) must win over the device feedback
+        self._pipeline = bool(pipeline_decode)
+        self._inflight = None
+        self._feedback = None
+        self._override = np.ones(s, bool)
         self._k_pools = self.cache.k_pools
         self._v_pools = self.cache.v_pools
         self._k_scales = self.cache.k_scales       # None unless int8 KV
@@ -420,8 +460,21 @@ class LLMEngine:
     # ------------------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16, request_id=None,
                     eos_token_id=None, on_token=None, ttl_s=None,
-                    adapter=None):
+                    adapter=None, temperature=0.0, top_k=0, top_p=1.0,
+                    repetition_penalty=1.0, seed=None):
         """Enqueue a generation request; returns the Request handle.
+
+        `temperature` / `top_k` / `top_p` / `repetition_penalty` / `seed`
+        configure the stream's sampler — VALUES in the one compiled
+        decode step (serving/sampling.py), so a batch may mix greedy and
+        any number of distinct sampler configs with zero retraces.
+        ``temperature=0`` (the default) is greedy under the same program,
+        token-identical to ``model.generate(do_sample=False)``; the other
+        knobs are inert at temperature 0. `seed` defaults to a stable
+        hash of the request id; a given (seed, prompt, sampler config)
+        reproduces its stream byte-identically across preemption,
+        watchdog rebuild, and crash resume. Out-of-contract values are
+        refused as `sampler_mismatch`.
 
         `ttl_s` arms a deadline: the request is expired (attributed
         `deadline_expired`) if the TTL passes while it waits or runs.
@@ -463,7 +516,19 @@ class LLMEngine:
                 f"request id {rid!r} is already queued/running; ids may "
                 "only be reused after the previous request finishes")
         req = Request(rid, prompt, max_new_tokens, eos_token_id, on_token,
-                      ttl_s=ttl_s, adapter=adapter)
+                      ttl_s=ttl_s, adapter=adapter,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      repetition_penalty=repetition_penalty,
+                      seed=(default_seed(rid) if seed is None
+                            else int(seed) & 0xFFFFFFFF))
+        try:
+            validate_sampler(temperature, top_k, top_p, repetition_penalty)
+        except ValueError as e:
+            self._refuse(req, "sampler_mismatch",
+                         f"request {rid}: {e}",
+                         {"temperature": temperature, "top_k": top_k,
+                          "top_p": top_p,
+                          "repetition_penalty": repetition_penalty})
         if len(prompt) + req.max_new_tokens > self.max_context:
             raise ValueError(
                 f"request {rid}: prompt ({len(prompt)}) + max_new_tokens "
@@ -487,6 +552,16 @@ class LLMEngine:
                      detail={"prompt_len": len(prompt),
                              "max_new_tokens": req.max_new_tokens,
                              "ttl_s": ttl_s})
+        if req.temperature > 0:
+            # sampler lifecycle attribution: one event per stochastic
+            # stream, carrying the full resolved config — the flight
+            # recorder's proof that sampler churn stayed value-only
+            _EVENTS.emit("serve.sample", rid,
+                         detail={"temperature": req.temperature,
+                                 "top_k": req.top_k, "top_p": req.top_p,
+                                 "repetition_penalty":
+                                     req.repetition_penalty,
+                                 "seed": req.seed})
         return req
 
     def _admission_policy(self, req):
@@ -671,6 +746,8 @@ class LLMEngine:
                 break
             self._admit(req)
         if not sched.running:
+            if self._pipeline:
+                self._flush_inflight()
             self._stats.wall_t1 = time.perf_counter()
             return bool(sched.waiting)
         # -- KV growth, preempting (newest first) when the pool is dry --
@@ -697,20 +774,27 @@ class LLMEngine:
                 self._fail(req, "kv_exhausted")
                 break
         if not sched.running:
+            if self._pipeline:
+                self._flush_inflight()
             self._stats.wall_t1 = time.perf_counter()
             return bool(sched.waiting)
         # -- copy-on-write boundary: privatize shared write targets ----
         if self._prefix is not None:
             self._cow_sweep()
             if not sched.running:
+                if self._pipeline:
+                    self._flush_inflight()
                 self._stats.wall_t1 = time.perf_counter()
                 return bool(sched.waiting)
+        # -- software-pipelined tail: launch N+1, commit N (lag 1) -----
+        if self._pipeline:
+            return self._step_pipelined()
         # -- the ONE compiled decode step (watchdog-monitored) ---------
         demand = sched.demand
         n_active = len(sched.running)
         t0 = time.perf_counter()
-        toks = self._decode_step()
-        if toks is None:
+        out = self._decode_step()
+        if out is None:
             # ladder rung 3 / eager fallback retired the batch; the
             # engine stays serviceable for queued + new work. Any stall
             # booked inside the abandoned step must not be subtracted
@@ -745,6 +829,7 @@ class LLMEngine:
             _EVENTS.emit("serve.degrade", "engine",
                          detail={"recovered": True})
         # -- stream + retire -------------------------------------------
+        toks, logps, aids, alps = out
         for req in list(sched.running):
             if req.finished or req.slot is None:
                 # retired mid-loop (a streaming callback cancelled it);
@@ -758,13 +843,266 @@ class LLMEngine:
                 # KNOWN — feed it as the next decode input and drop the
                 # prediction (made from a mid-context position, it is
                 # not this stream's next output token)
-                self._tokens[slot] = req.chew.pop(0)
+                t = req.chew.pop(0)
+                self._tokens[slot] = t
+                if req.cached_len < self.max_context:
+                    self._history[slot, req.cached_len] = t
                 continue
             tok = int(toks[slot])
             self._tokens[slot] = tok
-            self._emit_token(req, tok)
+            if req.cached_len < self.max_context:
+                self._history[slot, req.cached_len] = tok
+            self._emit_token(req, tok, logp=float(logps[slot]),
+                             alts=((aids[slot], alps[slot])
+                                   if self._logprobs_topk else None))
         self._stats.wall_t1 = time.perf_counter()
         return bool(sched.running or sched.waiting)
+
+    # ------------------------------------------------------------------
+    # software-pipelined decode (PR 18): launch N+1, commit N at lag 1
+    # ------------------------------------------------------------------
+    def _step_pipelined(self):
+        """Pipelined tail of one iteration: LAUNCH this step's decode
+        against device-fed tokens (the previous launch's sampled ids
+        feed back as a device array — no host round-trip), then COMMIT
+        the previous launch's host work (detokenize, callbacks,
+        retirement) while the device runs the new one. Steady-state step
+        time is max(device, host-commit) instead of their sum, and the
+        watchdog's monitored wait only ever covers device time."""
+        sched = self.scheduler
+        demand = sched.demand
+        n_active = len(sched.running)
+        t0 = time.perf_counter()
+        launched = self._launch_decode()
+        ok = self._commit_inflight()
+        if not ok:
+            # destructive recovery fired mid-window: the launch just
+            # issued consumed suspect pool/token state — discard it too
+            if launched is not None:
+                self._discard_records(launched)
+            self._reset_pipeline()
+            if _metrics_on():
+                _goodput.ACCOUNTANT.drop_stall_carry()
+            self._stats.wall_t1 = time.perf_counter()
+            return bool(sched.running or sched.waiting)
+        self._inflight = launched
+        if launched is None:
+            self._stats.wall_t1 = time.perf_counter()
+            return bool(sched.running or sched.waiting)
+        dt = time.perf_counter() - t0
+        self._stats.observe_step(n_active, self.max_batch_size, demand,
+                                 dt)
+        self._hb_ns = time.perf_counter_ns()
+        self._compile_grace_ns = None
+        _telemetry.beat("decode", step=self._stats.steps)
+        if _metrics_on():
+            _M.step_s.observe(dt)
+            _M.occupancy.set(n_active / self.max_batch_size)
+            _goodput.ACCOUNTANT.note_productive(dt)
+        _EVENTS.emit("serve.step", "engine",
+                     detail={"active": n_active,
+                             "occupancy": round(
+                                 n_active / self.max_batch_size, 4),
+                             "ms": round(dt * 1e3, 4),
+                             "pipelined": True})
+        if self.degraded:
+            self.degraded = False
+            _EVENTS.emit("serve.degrade", "engine",
+                         detail={"recovered": True})
+        self._stats.wall_t1 = time.perf_counter()
+        return bool(sched.running or sched.waiting
+                    or self._inflight is not None)
+
+    def _launch_decode(self):
+        """Dispatch one decode launch asynchronously. Structural state
+        (cached_len, lens, chew) advances HERE — the KV write at
+        position `lens` is certain regardless of what token the launch
+        samples — while token-dependent state (generated, callbacks,
+        finish) waits for the lag-1 commit. Returns the inflight record,
+        or None when no slot can accept another token."""
+        sched = self.scheduler
+        if not sched.running:
+            return None
+        if self._decode_fn is None:
+            self._compile_grace_ns = time.perf_counter_ns()
+            self._decode_fn = self._build_decode()
+        launch_active = self._active.copy()
+        plan = []
+        for req in list(sched.running):
+            if req.state != RUNNING or req.slot is None:
+                continue
+            slot = req.slot
+            pending = 1 if self._has_pending(req, slot) else 0
+            if (not req.chew
+                    and len(req.generated) + pending
+                    >= req.max_new_tokens):
+                # every remaining token is committed or in flight —
+                # launching this slot could only overshoot max_new
+                launch_active[slot] = False
+                continue
+            plan.append((req, slot))
+        if not plan:
+            return None
+        tokens_in = self._tokens
+        if self._feedback is not None and not self._override.all():
+            if self._override.any():
+                # mixed: device feedback for slots whose last token
+                # exists only on-device, host-authored tokens
+                # (admission/chew/restore) win via the override mask
+                tokens_in = jnp.where(jnp.asarray(self._override),
+                                      jnp.asarray(self._tokens),
+                                      self._feedback).astype(jnp.int32)
+            else:
+                # steady state (no joins/chew since the last launch):
+                # the previous launch's output feeds straight back in —
+                # zero host round-trip, zero extra dispatches
+                tokens_in = self._feedback
+        base = (tokens_in, self._tables, self._lens, launch_active)
+        if self._tenant:
+            base = base + (self._decode_aux(),)
+        base = base + self._sampler_args()
+        res = self._decode_fn(*self._kv_args(
+            *(base + (self._k_pools, self._v_pools))))
+        # adopt the launch's pool lineage NOW: any prefill issued before
+        # the commit must consume THESE outputs, so XLA's dataflow
+        # orders the speculative KV write before the reuse
+        self._k_pools, self._v_pools = res[4], res[5]
+        if self._kv_quantized:
+            self._k_scales, self._v_scales = res[6], res[7]
+        self._feedback = res[0]
+        records = []
+        for req, slot in plan:
+            req.cached_len += 1
+            self._lens[slot] = req.cached_len
+            if req.chew:
+                t = req.chew.pop(0)
+                self._tokens[slot] = t
+                if req.cached_len < self.max_context:
+                    self._history[slot, req.cached_len] = t
+                self._override[slot] = True
+            else:
+                records.append((req, slot, req.cached_len,
+                                req.admit_seq))
+                self._override[slot] = False
+        return {"res": res, "records": records}
+
+    def _commit_inflight(self):
+        """Commit the PREVIOUS launch: monitored wait, then stream its
+        tokens through the normal emission path. A record whose request
+        was cancelled / expired / preempted / finished since launch is
+        discarded as `commit_lag_rollback` — boundary decisions land
+        deterministically at lag 1, costing each departed stream exactly
+        its one speculative token. Returns False when destructive
+        recovery (hang rung 3 / decode fault) retired the batch."""
+        from ..ops import guardian
+        inf, self._inflight = self._inflight, None
+        if inf is None:
+            return True
+        res = inf["res"]
+        attempt = 1
+        while True:
+            try:
+                self._monitor.wait(res, "decode", attempt)
+                break
+            except StepHang:
+                self._stats.hangs += 1
+                self._note_hang()
+                _EVENTS.emit("serve.hang", "engine", reason="step_hang",
+                             detail={"attempt": attempt,
+                                     "phase": "commit",
+                                     "active": len(
+                                         self.scheduler.running)})
+                consumed = self._pools_consumed()
+                if attempt >= 2 or consumed:
+                    # a wedged device holds BOTH outstanding launches —
+                    # rungs 1-2 of the serial ladder cannot replay a
+                    # window whose successor already consumed it, so the
+                    # pipelined ladder goes straight to fail-active
+                    self._degrade("step_hang",
+                                  {"rung": "fail_active",
+                                   "phase": "commit",
+                                   "pools_consumed": consumed})
+                    self._discard_records(inf)
+                    for req in list(self.scheduler.running):
+                        self._fail(req, "step_hang")
+                    self._reset_pipeline()
+                    if consumed:
+                        self._reset_kv_state()
+                    self._compile_grace_ns = time.perf_counter_ns()
+                    self._decode_fn = self._build_decode(use_aot=False)
+                    return False
+                self._degrade("step_hang", {"rung": "retry",
+                                            "phase": "commit"})
+                attempt += 1
+            except jax.errors.JaxRuntimeError as e:
+                self._degrade("decode_fault",
+                              {"organic": True, "error": str(e)[:200]})
+                self._discard_records(inf)
+                self._reset_pipeline()
+                self._recover_with_fallback(rebuild=True)
+                return False
+        if guardian.poll_fault("serve.decode",
+                               ("nan_output", "raise")) is not None:
+            self._degrade("decode_fault", {"injected": True})
+            self._discard_records(inf)
+            self._reset_pipeline()
+            self._recover_with_fallback(rebuild=False)
+            return False
+        toks = np.asarray(res[0])
+        logps = np.asarray(res[1])
+        aids = np.asarray(res[2])
+        alps = np.asarray(res[3])
+        for req, slot, pos, aseq in inf["records"]:
+            if (req.state != RUNNING or req.slot != slot
+                    or req.admit_seq != aseq):
+                self._rollback(req, slot)
+                continue
+            tok = int(toks[slot])
+            self._tokens[slot] = tok
+            if pos < self.max_context:
+                self._history[slot, pos] = tok
+            self._emit_token(req, tok, logp=float(logps[slot]),
+                             alts=((aids[slot], alps[slot])
+                                   if self._logprobs_topk else None))
+        self._maybe_store_decode()
+        return True
+
+    def _has_pending(self, req, slot):
+        inf = self._inflight
+        if inf is None:
+            return False
+        return any(r is req and s == slot
+                   for r, s, _p, _a in inf["records"])
+
+    def _discard_records(self, inf):
+        for req, slot, _pos, _aseq in inf["records"]:
+            self._rollback(req, slot)
+
+    def _rollback(self, req, slot):
+        """One speculative token discarded at the lag-1 boundary."""
+        self._stats.commit_rollbacks += 1
+        if _metrics_on():
+            _M.commit_rollbacks.inc()
+        _EVENTS.emit("serve.sample", req.rid,
+                     reason="commit_lag_rollback",
+                     detail={"slot": int(slot), "state": req.state})
+
+    def _flush_inflight(self):
+        """Synchronously commit (or roll back) the pending pipelined
+        launch. Drain points — an idle boundary, the weight-swap
+        cutover, explicit drains — must not leave a speculative token in
+        flight. After the flush the host token mirror is authoritative
+        for every slot. No-op when nothing is pending (including the
+        unpipelined engine)."""
+        if self._inflight is not None:
+            self._commit_inflight()
+        self._feedback = None
+        self._override[:] = True
+
+    def _reset_pipeline(self):
+        self._inflight = None
+        self._feedback = None
+        self._override[:] = True
 
     def run(self, max_steps=None):
         """Drive step() until every request drains (or `max_steps`)."""
@@ -873,9 +1211,10 @@ class LLMEngine:
         res = self._prefill_step(fn, padded, np.int32(len(ctx)), row, req)
         if res is None:
             return            # watchdog failed the request, slot is clear
-        nxt, self._k_pools, self._v_pools = res[0], res[1], res[2]
+        nxt, logp, aids, alps = res[0], res[1], res[2], res[3]
+        self._k_pools, self._v_pools = res[4], res[5]
         if self._kv_quantized:
-            self._k_scales, self._v_scales = res[3], res[4]
+            self._k_scales, self._v_scales = res[6], res[7]
         req.cached_len = len(ctx)
         self._sync_slot(req)
         self._set_adapter_slot(req)
@@ -888,7 +1227,12 @@ class LLMEngine:
         tok = int(np.asarray(nxt))
         # the prefill's sampled token is the next decode step's input
         self._tokens[req.slot] = tok
-        self._emit_token(req, tok)
+        if req.cached_len < self.max_context:
+            self._history[req.slot, req.cached_len] = tok
+        self._override[req.slot] = True
+        self._emit_token(req, tok, logp=float(np.asarray(logp)),
+                         alts=((aids, alps) if self._logprobs_topk
+                               else None))
 
     def _admit_prefix_hit(self, req, ctx):
         """Prefix-hit admission: the aliased blocks already hold the
@@ -927,6 +1271,7 @@ class LLMEngine:
         # decode input: the first token WITHOUT cached KV; the known
         # tokens after it queue as chew (fed, never emitted)
         self._tokens[req.slot] = int(ctx[hit])
+        self._override[req.slot] = True
         req.chew = [int(t) for t in ctx[hit + 1:]]
 
     def _note_prefix_rate(self):
@@ -957,6 +1302,13 @@ class LLMEngine:
                 base = (padded, length, row)
                 if self._tenant:
                     base = base + (self._prefill_aux(req),)
+                # the admitted request's sampler config rides as scalar
+                # VALUES — a new config never re-keys the bucket program
+                base = base + (np.float32(req.temperature),
+                               np.int32(req.top_k),
+                               np.float32(req.top_p),
+                               np.float32(req.repetition_penalty),
+                               np.uint32(req.seed or 0))
                 res = fn(*self._kv_args(*(base + (self._k_pools,
                                                   self._v_pools))))
                 self._monitor.wait(res, "prefill", attempt)
@@ -1005,19 +1357,55 @@ class LLMEngine:
         self._tables[slot] = row
         self._lens[slot] = req.cached_len
         self._active[slot] = True
+        self._temps[slot] = req.temperature
+        self._topks[slot] = req.top_k
+        self._topps[slot] = req.top_p
+        self._rpens[slot] = req.repetition_penalty
+        self._seeds[slot] = req.seed or 0
+        # rebuild the slot's context history from the COMMITTED tokens;
+        # the in-graph scatter at index `lens` covers the one token a
+        # pipelined launch knows only on-device
+        ctx = req.prompt + req.generated
+        self._history[slot] = 0
+        n = min(len(ctx), self.max_context)
+        self._history[slot, :n] = ctx[:n]
 
     def _clear_slot(self, slot):
         self._tables[slot] = 0
         self._lens[slot] = 0
         self._active[slot] = False
         self._tokens[slot] = 0
+        # sampler no-op values keep a cleared slot on the all-greedy
+        # cond branch (and out of the repetition-penalty seen set)
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self._topps[slot] = 1.0
+        self._rpens[slot] = 1.0
+        self._seeds[slot] = 0
+        self._history[slot] = 0
+        self._override[slot] = True
 
     # ------------------------------------------------------------------
     # token delivery / retirement
     # ------------------------------------------------------------------
-    def _emit_token(self, req, tok):
+    def _emit_token(self, req, tok, logp=None, alts=None):
         req.generated.append(tok)
+        # logprob panels stay index-aligned with `generated`: None for
+        # tokens whose emitting step's outputs no longer exist (prefix
+        # chew, crash resume, eager fallback)
+        req.token_logprobs.append(logp)
+        if alts is None:
+            req.alt_ids.append(None)
+            req.alt_logprobs.append(None)
+        else:
+            req.alt_ids.append([int(i) for i in np.asarray(alts[0])])
+            req.alt_logprobs.append([float(v)
+                                     for v in np.asarray(alts[1])])
         self._stats.tokens_generated += 1
+        if req.temperature > 0:
+            self._stats.sampled_tokens += 1
+            if _metrics_on():
+                _M.sampled_tokens.inc()
         now = time.perf_counter_ns()
         mon = _metrics_on()
         if req.first_token_ns is None:
@@ -1113,6 +1501,7 @@ class LLMEngine:
                         self._active)
                 if self._tenant:
                     base = base + (self._decode_aux(),)
+                base = base + self._sampler_args()
                 res = self._decode_fn(*self._kv_args(
                     *(base + (self._k_pools, self._v_pools))))
                 self._monitor.wait(res, "decode", attempt)
@@ -1139,11 +1528,19 @@ class LLMEngine:
                 self._degrade("decode_fault", {"injected": True})
                 self._recover_with_fallback(rebuild=False)
                 return None
-            self._k_pools, self._v_pools = res[1], res[2]
+            self._k_pools, self._v_pools = res[4], res[5]
             if self._kv_quantized:
-                self._k_scales, self._v_scales = res[3], res[4]
+                self._k_scales, self._v_scales = res[6], res[7]
             self._maybe_store_decode()
-            return np.asarray(nxt)
+            return (np.asarray(nxt), np.asarray(res[1]),
+                    np.asarray(res[2]), np.asarray(res[3]))
+
+    def _sampler_args(self):
+        """The decode signature's per-slot sampler VALUE inputs, in
+        positional order — the single source of truth shared by the live
+        call, the AOT spec builder, and the pipelined launch."""
+        return (self._temps, self._topks, self._topps, self._rpens,
+                self._seeds, self._history)
 
     def _pools_consumed(self):
         deleted = getattr(self._k_pools, "is_deleted", None)
@@ -1273,6 +1670,15 @@ class LLMEngine:
         self._active = np.zeros(s, bool)
         self._tokens = np.zeros(s, np.int32)
         self._aslots = np.zeros(s, np.int32)
+        self._temps = np.zeros(s, np.float32)
+        self._topks = np.zeros(s, np.int32)
+        self._topps = np.ones(s, np.float32)
+        self._rpens = np.ones(s, np.float32)
+        self._seeds = np.zeros(s, np.uint32)
+        self._history = np.zeros((s, self.max_context), np.int32)
+        self._inflight = None
+        self._feedback = None
+        self._override = np.ones(s, bool)
         self._k_pools = self.cache.k_pools
         self._v_pools = self.cache.v_pools
         self._k_scales = self.cache.k_scales
@@ -1430,7 +1836,12 @@ class LLMEngine:
                  # executable must never replay as the pallas one, and an
                  # int8 pool has a different signature entirely
                  self._attn_kernel, str(jnp.dtype(self._kv_dtype)), crc,
-                 tenant))
+                 tenant,
+                 # the sampler head is part of the program: its math
+                 # version, the static logprob panel width and the
+                 # history buffer width all change the executable
+                 ("sampler", SAMPLER_VERSION, self._logprobs_topk,
+                  self.max_context)))
         except Exception:
             dg = None
         self._aot_digest_cache = dg or ""
@@ -1450,6 +1861,8 @@ class LLMEngine:
         try:
             specs = tuple(_aot._spec_of(a) for a in self._kv_args(
                 self._tokens, self._tables, self._lens, self._active,
+                self._temps, self._topks, self._topps, self._rpens,
+                self._seeds, self._history,
                 self._k_pools, self._v_pools))
             blobs = [_aot.export_bytes(jitted, specs)]
         except Exception as e:
@@ -1474,8 +1887,10 @@ class LLMEngine:
         block_size = self.block_size
         stats = self._stats
         variant = self._attn_kernel
+        lp_topk = self._logprobs_topk
 
-        def decode(tokens, tables, lens, active, k_pools, v_pools,
+        def decode(tokens, tables, lens, active, temps, topks, topps,
+                   rpens, seeds, history, k_pools, v_pools,
                    k_scales=None, v_scales=None):
             stats.decode_compiles += 1   # runs only while tracing
             views = [PagedCacheView(
@@ -1490,15 +1905,29 @@ class LLMEngine:
                     caches=views)
             new_k = jnp.stack([v.k_pool for v in new_views])
             new_v = jnp.stack([v.v_pool for v in new_views])
-            nxt = jnp.argmax(logits._value[:, -1, :], axis=-1) \
-                .astype(jnp.int32)
+            # the in-graph history scatter: the input token enters the
+            # context at index `lens` — under pipelined decode it may
+            # exist ONLY on-device (feedback), so the host mirror cannot
+            # be trusted to contain it
+            rows = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+            idx = jnp.clip(lens, 0, history.shape[1] - 1)
+            hist = history.at[rows, idx].set(tokens)
+            valid = (jnp.arange(history.shape[1], dtype=jnp.int32)[None, :]
+                     <= lens[:, None])
+            # sampling position = known context tokens = lens + 1; every
+            # replay (preempt re-prefill, rebuild, kill-9 resume)
+            # restores the same positions -> byte-identical streams
+            nxt, logp, alt_ids, alt_lps = sample_tokens(
+                logits._value[:, -1, :], temps, topks, topps, rpens,
+                seeds, lens + 1, hist, valid, logprobs_topk=lp_topk)
             if k_scales is not None:
                 new_ks = jnp.stack([v.k_scales for v in new_views])
                 new_vs = jnp.stack([v.v_scales for v in new_views])
-                return nxt, new_k, new_v, new_ks, new_vs
-            return nxt, new_k, new_v
+                return (nxt, logp, alt_ids, alt_lps, new_k, new_v,
+                        new_ks, new_vs)
+            return nxt, logp, alt_ids, alt_lps, new_k, new_v
 
-        donate = (4, 5, 6, 7) if self._kv_quantized else (4, 5)
+        donate = (10, 11, 12, 13) if self._kv_quantized else (10, 11)
         jitted = jax.jit(decode, donate_argnums=self._donate(donate))
         from ..ops import aot_cache as _aot
         if use_aot and _aot.enabled():
@@ -1535,8 +1964,10 @@ class LLMEngine:
         variant = self._attn_kernel
         params = model.parameters()
         holder = self._holder
+        lp_topk = self._logprobs_topk
 
-        def decode(tokens, tables, lens, active, aux, k_pools, v_pools,
+        def decode(tokens, tables, lens, active, aux, temps, topks,
+                   topps, rpens, seeds, history, k_pools, v_pools,
                    k_scales=None, v_scales=None):
             stats.decode_compiles += 1   # runs only while tracing
             pvals = aux.get("params")
@@ -1568,15 +1999,22 @@ class LLMEngine:
                     holder["active"] = None
             new_k = jnp.stack([v.k_pool for v in new_views])
             new_v = jnp.stack([v.v_pool for v in new_views])
-            nxt = jnp.argmax(logits._value[:, -1, :], axis=-1) \
-                .astype(jnp.int32)
+            rows = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+            idx = jnp.clip(lens, 0, history.shape[1] - 1)
+            hist = history.at[rows, idx].set(tokens)
+            valid = (jnp.arange(history.shape[1], dtype=jnp.int32)[None, :]
+                     <= lens[:, None])
+            nxt, logp, alt_ids, alt_lps = sample_tokens(
+                logits._value[:, -1, :], temps, topks, topps, rpens,
+                seeds, lens + 1, hist, valid, logprobs_topk=lp_topk)
             if k_scales is not None:
                 new_ks = jnp.stack([v.k_scales for v in new_views])
                 new_vs = jnp.stack([v.v_scales for v in new_views])
-                return nxt, new_k, new_v, new_ks, new_vs
-            return nxt, new_k, new_v
+                return (nxt, logp, alt_ids, alt_lps, new_k, new_v,
+                        new_ks, new_vs)
+            return nxt, logp, alt_ids, alt_lps, new_k, new_v
 
-        donate = (5, 6, 7, 8) if self._kv_quantized else (5, 6)
+        donate = (11, 12, 13, 14) if self._kv_quantized else (11, 12)
         return jax.jit(decode, donate_argnums=self._donate(donate))
 
     def _build_prefill(self, bucket):
@@ -1591,8 +2029,10 @@ class LLMEngine:
         params = model.parameters()
         dt = params[0]._value.dtype if params else jnp.float32
         stats = self._stats
+        lp_topk = self._logprobs_topk
 
-        def prefill(ids, length, block_row, k_pools, v_pools,
+        def prefill(ids, length, block_row, temp, topk, topp, rpen,
+                    seedv, k_pools, v_pools,
                     k_scales=None, v_scales=None):
             stats.prefill_compiles += 1   # runs only while tracing
             empty = [(Tensor(jnp.zeros((1, 0, heads, head_dim), dt)),) * 2
@@ -1607,10 +2047,21 @@ class LLMEngine:
                 block_size, k_scales=k_scales, v_scales=v_scales)
             last = jax.lax.dynamic_index_in_dim(
                 logits._value[0], length - 1, axis=0, keepdims=False)
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            return (nxt,) + tuple(written)
+            # the prompt's first sampled token: position = prompt length
+            # (the count of known context tokens), same convention as the
+            # decode head — replays land on the same fold_in stream
+            valid = (jnp.arange(ids.shape[1], dtype=jnp.int32)
+                     < length)[None, :]
+            nxt, logp, alt_ids, alt_lps = sample_tokens(
+                last[None, :], jnp.reshape(temp, (1,)),
+                jnp.reshape(topk, (1,)), jnp.reshape(topp, (1,)),
+                jnp.reshape(rpen, (1,)), jnp.reshape(seedv, (1,)),
+                jnp.reshape(length, (1,)), ids.astype(jnp.int32), valid,
+                logprobs_topk=lp_topk)
+            return (nxt[0], logp[0], alt_ids[0], alt_lps[0]) \
+                + tuple(written)
 
-        donate = (3, 4, 5, 6) if self._kv_quantized else (3, 4)
+        donate = (8, 9, 10, 11) if self._kv_quantized else (8, 9)
         return jax.jit(prefill, donate_argnums=self._donate(donate))
 
     def _build_prefill_tenant(self, bucket):
@@ -1627,8 +2078,10 @@ class LLMEngine:
         dt = params[0]._value.dtype if params else jnp.float32
         stats = self._stats
         holder = self._holder
+        lp_topk = self._logprobs_topk
 
-        def prefill(ids, length, block_row, aux, k_pools, v_pools,
+        def prefill(ids, length, block_row, aux, temp, topk, topp, rpen,
+                    seedv, k_pools, v_pools,
                     k_scales=None, v_scales=None):
             stats.prefill_compiles += 1   # runs only while tracing
             pvals = aux.get("params")
@@ -1661,10 +2114,18 @@ class LLMEngine:
                 block_size, k_scales=k_scales, v_scales=v_scales)
             last = jax.lax.dynamic_index_in_dim(
                 logits._value[0], length - 1, axis=0, keepdims=False)
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            return (nxt,) + tuple(written)
+            valid = (jnp.arange(ids.shape[1], dtype=jnp.int32)
+                     < length)[None, :]
+            nxt, logp, alt_ids, alt_lps = sample_tokens(
+                last[None, :], jnp.reshape(temp, (1,)),
+                jnp.reshape(topk, (1,)), jnp.reshape(topp, (1,)),
+                jnp.reshape(rpen, (1,)), jnp.reshape(seedv, (1,)),
+                jnp.reshape(length, (1,)), ids.astype(jnp.int32), valid,
+                logprobs_topk=lp_topk)
+            return (nxt[0], logp[0], alt_ids[0], alt_lps[0]) \
+                + tuple(written)
 
-        donate = (4, 5, 6, 7) if self._kv_quantized else (4, 5)
+        donate = (9, 10, 11, 12) if self._kv_quantized else (9, 10)
         return jax.jit(prefill, donate_argnums=self._donate(donate))
 
     # ------------------------------------------------------------------
@@ -1876,6 +2337,9 @@ class LLMEngine:
         weights), write the staged values into the parameters, bump the
         epoch. No compiled program is touched — the weights are VALUE
         inputs."""
+        # a pipelined launch in flight was issued under the OLD weights:
+        # commit its tokens before the preemption sweep discards them
+        self._flush_inflight()
         values, crc = self._pending_weights
         self._pending_weights = None
         sched = self.scheduler

@@ -177,6 +177,15 @@ def request_payload(req, now_ns=None):
         # decodes under — restore refuses (adapter_mismatch) when the
         # restoring engine does not have it registered
         "adapter": req.adapter,
+        # sampler identity (PR 18): the resolved sampler config,
+        # including the resolved seed — (seed, prompt, sampler) is the
+        # reproducibility contract, so the restored stream continues
+        # byte-identically from the same fold_in positions
+        "temperature": req.temperature,
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        "repetition_penalty": req.repetition_penalty,
+        "seed": req.seed,
     }
 
 
@@ -192,7 +201,18 @@ def payload_request(payload, on_token=None):
                   eos_token_id=payload.get("eos_token_id"),
                   on_token=on_token,
                   ttl_s=max(0.0, ttl) if ttl is not None else None,
-                  adapter=payload.get("adapter"))
+                  adapter=payload.get("adapter"),
+                  temperature=payload.get("temperature", 0.0),
+                  top_k=payload.get("top_k", 0),
+                  top_p=payload.get("top_p", 1.0),
+                  repetition_penalty=payload.get(
+                      "repetition_penalty", 1.0),
+                  seed=payload.get("seed"))
     req.generated = list(payload.get("generated") or [])
+    # logprobs for pre-crash tokens died with the process — pad with
+    # None so the panels stay index-aligned with `generated`
+    req.token_logprobs = [None] * len(req.generated)
+    req.alt_ids = [None] * len(req.generated)
+    req.alt_logprobs = [None] * len(req.generated)
     req.preemptions = int(payload.get("preemptions") or 0)
     return req

@@ -82,10 +82,14 @@ class Request:
                  "error", "enqueue_ns", "first_token_ns", "finish_ns",
                  "deadline_ns", "cancel_requested", "admit_ns",
                  "last_token_ns", "token_ns", "adapter", "prefix_hit",
-                 "chew")
+                 "chew", "temperature", "top_k", "top_p",
+                 "repetition_penalty", "seed", "token_logprobs",
+                 "alt_ids", "alt_logprobs")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
-                 on_token=None, ttl_s=None, adapter=None):
+                 on_token=None, ttl_s=None, adapter=None,
+                 temperature=0.0, top_k=0, top_p=1.0,
+                 repetition_penalty=1.0, seed=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -129,6 +133,24 @@ class Request:
         self.adapter = adapter
         self.prefix_hit = 0
         self.chew = []
+        # compiled stochastic sampling (PR 18, serving/sampling.py):
+        # per-request sampler config — VALUES in the one compiled decode
+        # (temperature=0 is greedy under the same program). `seed` is
+        # resolved by the engine (crc32(rid) default) and serializes, so
+        # the stream replays byte-identically across preempt/resume,
+        # watchdog rebuild, and crash resume. `token_logprobs` parallels
+        # `generated` (None for tokens re-fed from chew/prefix, whose
+        # logprob was never an output of the step that emitted them);
+        # `alt_ids`/`alt_logprobs` hold the optional static-K top-k
+        # alternative panels when the engine enables logprobs_topk.
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.repetition_penalty = float(repetition_penalty)
+        self.seed = seed
+        self.token_logprobs = []
+        self.alt_ids = []
+        self.alt_logprobs = []
 
     @property
     def context_len(self):
@@ -178,6 +200,22 @@ class Request:
             out["inter_token_p99_ms"] = gaps[
                 min(len(gaps) - 1, int(0.99 * len(gaps)))]
         return out
+
+    def logprobs(self):
+        """Per-token logprob summary, `latency()`-style: the sampled
+        token's logprob under the RAW (pre-masking) distribution for each
+        generated token, plus the optional top-k alternative panels when
+        the engine was built with ``logprobs_topk > 0``. Entries are None
+        for tokens re-fed from a prefix hit or crash resume (their
+        emitting step's outputs no longer exist). Valid any time —
+        streaming callbacks may read the live handle mid-flight."""
+        return {
+            "token_logprobs": list(self.token_logprobs),
+            "topk_ids": [None if a is None else list(a)
+                         for a in self.alt_ids],
+            "topk_logprobs": [None if a is None else list(a)
+                              for a in self.alt_logprobs],
+        }
 
     def ttl_remaining_s(self, now_ns=None):
         """Seconds until the deadline (None without one; may be <= 0).

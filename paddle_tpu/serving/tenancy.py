@@ -17,8 +17,11 @@ single compiled decode step:
     into a block other owners still read, so the engine COWs that one
     block first. The index holds its OWN reference on every published
     block (BlockAllocator refcounts), so entries survive their
-    publisher's completion and are reclaimed leaf-first in LRU order
-    when the pool runs dry. A match is capped at context_len - 1
+    publisher's completion and are reclaimed leaf-first, least-popular
+    first, when the pool runs dry: eviction orders leaves by an AGED
+    hit count (halved every `_AGE_PERIOD` lookups, so popularity
+    decays) with last-use recency as the tie-break — a cold tenant's
+    burst evicts its own blocks, never the hot shared system prompt. A match is capped at context_len - 1
     tokens: there is always at least one input token to feed, so the
     decode step (never the prefill path) produces the first sampled
     token and greedy decode stays token-identical to the cold path.
@@ -62,14 +65,22 @@ def _digest(parent, tokens):
     return h
 
 
-class _Entry:
-    __slots__ = ("key", "parent", "block", "tokens")
+# acquires between hit-count halvings: aging keeps yesterday's hot
+# prompt from squatting on blocks today's traffic needs, without
+# forgetting a genuinely popular prefix the moment it pauses
+_AGE_PERIOD = 256
 
-    def __init__(self, key, parent, block, tokens):
+
+class _Entry:
+    __slots__ = ("key", "parent", "block", "tokens", "hits", "tick")
+
+    def __init__(self, key, parent, block, tokens, tick=0):
         self.key = key
         self.parent = parent
         self.block = block
         self.tokens = tokens      # the tokens whose KV this block holds
+        self.hits = 0             # aged popularity (halved every period)
+        self.tick = tick          # last-use tick (recency tie-break)
 
 
 class PrefixCache:
@@ -79,8 +90,8 @@ class PrefixCache:
     prefix — already increfed, ready to alias into a block table;
     `publish(tokens, blocks)` indexes a freshly prefilled prompt's
     blocks (increfing them on behalf of the index); `reclaim(n)` drops
-    cold entries leaf-first in LRU order until the allocator can serve
-    `n` free blocks. `invalidate()` empties the index (weight hot-swap:
+    cold entries leaf-first — least aged-hit-count first, recency as
+    tie-break — until the allocator can serve `n` free blocks. `invalidate()` empties the index (weight hot-swap:
     cached KV is a function of the base weights); `reset(allocator)`
     rebinds after the engine rebuilt the pool (the old refs died with
     the old allocator).
@@ -90,8 +101,9 @@ class PrefixCache:
         self.allocator = allocator
         self.block_size = int(block_size)
         self._lock = threading.Lock()
-        self._entries = {}          # key -> _Entry, insertion = LRU order
+        self._entries = {}          # key -> _Entry
         self._children = {}         # parent key -> {key: _Entry}
+        self._tick = 0              # lookup clock for recency ordering
         self.hits = 0
         self.misses = 0
 
@@ -160,13 +172,29 @@ class PrefixCache:
         return hit > 0 and (hit >= self.block_size
                             or hit == prompt_len - 1)
 
+    def _touch(self, e):
+        """One use of an entry: bump its aged hit count and recency
+        tick. Caller holds the lock."""
+        e.hits += 1
+        e.tick = self._tick
+
+    def _advance_clock(self):
+        """Bump the lookup clock; every `_AGE_PERIOD` ticks halve all
+        hit counts so popularity DECAYS — an entry hot last epoch but
+        cold now loses its eviction immunity. Caller holds the lock."""
+        self._tick += 1
+        if self._tick % _AGE_PERIOD == 0:
+            for e in self._entries.values():
+                e.hits >>= 1
+
     def acquire(self, tokens):
         """Longest cached run for a prompt prefix, INCREFED for the
         caller (one reference per block — symmetric with
         `allocator.free`). Returns (blocks, hit_tokens); ([], 0) on a
-        miss. Touches the matched entries' LRU position."""
+        miss. Touches the matched entries' hit count + recency."""
         tokens = list(tokens)
         with self._lock:
+            self._advance_clock()
             path, hit = self._walk(tokens)
             if not self._usable(hit, len(tokens)):
                 self.misses += 1
@@ -175,9 +203,7 @@ class PrefixCache:
             for e in path:
                 self.allocator.incref(e.block)
                 blocks.append(e.block)
-                # dict move-to-end = LRU touch
-                self._entries.pop(e.key, None)
-                self._entries[e.key] = e
+                self._touch(e)
             self.hits += 1
             return blocks, hit
 
@@ -202,7 +228,8 @@ class PrefixCache:
                     if i >= len(blocks):
                         break
                     self.allocator.incref(blocks[i])
-                    e = _Entry(key, parent, blocks[i], tuple(chunk))
+                    e = _Entry(key, parent, blocks[i], tuple(chunk),
+                               tick=self._tick)
                     self._entries[key] = e
                     self._children.setdefault(parent, {})[key] = e
                     added += 1
@@ -212,7 +239,8 @@ class PrefixCache:
                 key = ("t", _digest(parent, tail), len(tail))
                 if key not in self._entries:
                     self.allocator.incref(blocks[n_full])
-                    e = _Entry(key, parent, blocks[n_full], tuple(tail))
+                    e = _Entry(key, parent, blocks[n_full], tuple(tail),
+                               tick=self._tick)
                     self._entries[key] = e
                     self._children.setdefault(parent, {})[key] = e
                     added += 1
@@ -231,19 +259,25 @@ class PrefixCache:
         self.allocator.free([e.block])
 
     def reclaim(self, num_free_target):
-        """Release cold entries (leaf-first, LRU order) until the
-        allocator has `num_free_target` free blocks or the index is
-        empty. Returns the number of entries dropped — the caller emits
-        the `serve.prefix_evict` attribution AFTER this returns (no
-        events under the lock)."""
+        """Release cold entries until the allocator has
+        `num_free_target` free blocks or the index is empty. Victims
+        are leaves (dropping an interior entry would orphan its chain)
+        ordered by (aged hit count, last-use tick): the least-popular
+        leaf goes first, recency breaks ties — so one cold tenant's
+        burst evicts ITS blocks, not the hot shared system prompt that
+        a plain LRU scan would rotate out. Returns the number of
+        entries dropped — the caller emits the `serve.prefix_evict`
+        attribution AFTER this returns (no events under the lock)."""
         dropped = 0
         with self._lock:
             while self.allocator.num_free < num_free_target:
-                victim = None
-                for e in self._entries.values():       # insertion = LRU
-                    if not self._children.get(e.key):
-                        victim = e
-                        break
+                victim, best = None, None
+                for e in self._entries.values():
+                    if self._children.get(e.key):
+                        continue              # interior: kids pin it
+                    score = (e.hits, e.tick)
+                    if best is None or score < best:
+                        victim, best = e, score
                 if victim is None:
                     break
                 self._drop(victim)

@@ -133,6 +133,8 @@ class TestPublicContract:
             # multi-tenant serving (PR 17, serving/tenancy.py)
             "serve.prefix_hit", "serve.prefix_miss", "serve.prefix_evict",
             "serve.swap",
+            # compiled stochastic sampling + pipelined decode (PR 18)
+            "serve.sample",
             # persistent AOT executable cache (PR 9, ops/aot_cache.py)
             "aot.hit", "aot.miss", "aot.store", "aot.corrupt",
             "aot.version_skew", "aot.evict",
@@ -162,6 +164,9 @@ class TestPublicContract:
             "crash_resume",
             # multi-tenant serving (PR 17, serving/tenancy.py)
             "prefix_hit", "adapter_mismatch", "torn_swap",
+            # compiled sampling + pipelined decode (PR 18,
+            # serving/sampling.py)
+            "sampler_mismatch", "commit_lag_rollback",
             # distributed step fusion (PR 10, ops/spmd_fusion.py);
             # pipeline promotion registry (PR 16) adds schedule churn
             "collective_unkeyed", "mesh_mismatch", "spmd_divergence",

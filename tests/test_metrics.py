@@ -100,6 +100,7 @@ class TestContract:
             "serve_hangs_total", "serve_preemptions_total",
             "serve_prefix_hit_tokens_total", "serve_prefix_hit_rate",
             "serve_adapter_switches_total", "serve_weight_swaps_total",
+            "serve_sampled_tokens_total", "serve_commit_rollbacks_total",
         })
 
     def test_goodput_buckets_frozen(self):

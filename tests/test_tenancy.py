@@ -195,6 +195,85 @@ class TestPrefixCacheUnit:
         shared, _ = pc.probe(a + [99])
         assert shared in (0, 1)
 
+    def test_reclaim_popularity_beats_recency(self):
+        """PR 18 aging eviction: a plain LRU would evict the OLDEST
+        entry; the aged-hit-count policy evicts the LEAST POPULAR one,
+        so a cold tenant's recent burst cannot rotate out the hot
+        shared system prompt."""
+        pc, alloc = self._setup(num_blocks=8)
+        hot = [10, 11, 12, 13]
+        cold = [20, 21, 22, 23]
+        for toks in (hot, cold):
+            blocks = alloc.allocate(1)
+            pc.publish(toks, blocks)
+            alloc.free(blocks)
+        for _ in range(3):                        # hot: 3 hits, old ticks
+            got, _ = pc.acquire(hot + [99])
+            alloc.free(got)
+        got, _ = pc.acquire(cold + [99])          # cold: 1 hit, NEWEST tick
+        alloc.free(got)
+        assert pc.reclaim(alloc.num_free + 1) == 1
+        assert pc.acquire(cold + [99]) == ([], 0)  # recency didn't save it
+        got, hit = pc.acquire(hot + [99])
+        assert hit == 4                            # popularity did
+        alloc.free(got)
+
+    def test_reclaim_hit_tie_breaks_on_recency(self):
+        pc, alloc = self._setup(num_blocks=8)
+        first = [10, 11, 12, 13]
+        second = [20, 21, 22, 23]
+        for toks in (first, second):
+            blocks = alloc.allocate(1)
+            pc.publish(toks, blocks)
+            alloc.free(blocks)
+        for toks in (first, second):              # one hit each, in order
+            got, _ = pc.acquire(toks + [99])
+            alloc.free(got)
+        assert pc.reclaim(alloc.num_free + 1) == 1
+        assert pc.acquire(first + [99]) == ([], 0)  # older tick loses
+        assert pc.acquire(second + [99])[1] == 4
+
+    def test_aging_decays_stale_popularity(self):
+        """Hit counts halve every _AGE_PERIOD lookups: an entry hot last
+        epoch but cold now loses its eviction immunity to a recently
+        used neighbor."""
+        from paddle_tpu.serving.tenancy import _AGE_PERIOD
+        pc, alloc = self._setup(num_blocks=8)
+        stale = [10, 11, 12, 13]
+        blocks = alloc.allocate(1)
+        pc.publish(stale, blocks)
+        alloc.free(blocks)
+        for _ in range(4):                        # hot... for now
+            got, _ = pc.acquire(stale + [99])
+            alloc.free(got)
+        for i in range(2 * _AGE_PERIOD):          # two epochs of misses:
+            pc.acquire([70 + (i % 8), 1, 2, 3, 4])  # 4 hits decay to 1
+        fresh = [20, 21, 22, 23]
+        blocks = alloc.allocate(1)
+        pc.publish(fresh, blocks)
+        alloc.free(blocks)
+        got, _ = pc.acquire(fresh + [99])         # 1 hit, newest tick
+        alloc.free(got)
+        # decayed tie (1 == 1): the stale entry's OLD tick evicts it —
+        # without decay its 4 early hits would have been immunity forever
+        assert pc.reclaim(alloc.num_free + 1) == 1
+        assert pc.acquire(stale + [99]) == ([], 0)
+        assert pc.acquire(fresh + [99])[1] == 4
+
+    def test_reclaim_never_drops_pinned_interior(self):
+        """A popular leaf cannot force eviction of its own chain's
+        interior blocks: victims are leaves only, however cold the
+        interior entry's own counters look."""
+        pc, alloc = self._setup(num_blocks=8)
+        chain = list(range(12))                   # 3 full blocks
+        blocks = alloc.allocate(3)
+        pc.publish(chain, blocks)
+        alloc.free(blocks)
+        assert pc.reclaim(alloc.num_free + 1) == 1  # only the leaf goes
+        got, hit = pc.acquire(chain + [99])
+        assert hit == 8                           # interior chain intact
+        alloc.free(got)
+
     def test_invalidate_frees_reset_forgets(self):
         pc, alloc = self._setup()
         blocks = alloc.allocate(2)

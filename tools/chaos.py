@@ -63,7 +63,10 @@ Serving scenarios (PR 7), the same methodology against LLMEngine:
                     step) is SIGKILLed mid-serve, then re-run against the
                     same checkpoint dir. Must hold: the restarted engine
                     restores every in-flight request and finishes each
-                    stream BYTE-identically to an uninterrupted run.
+                    stream BYTE-identically to an uninterrupted run —
+                    including SAMPLED streams (PR 18), whose serialized
+                    (seed, sampler) identity plus position-derived keys
+                    make the resume a replay, not a re-roll.
 
   telemetry         PR 13: a "stall" fault (the wall-clock hang variant)
                     wedges two decode steps under an armed telemetry
@@ -535,7 +538,16 @@ def serve_child_main(args):
     restored = engine.restore_state(ck.restore())
     if not restored:
         for i, p in enumerate(prompts):
-            engine.add_request(p, max_new_tokens=10, request_id=f"s{i}")
+            kw = {}
+            if i % 2:
+                # every other stream samples: (seed, prompt, sampler) must
+                # reproduce byte-identically across the kill-9 resume —
+                # the serialized sampler identity + fold_in(seed, position)
+                # keys make the replayed stream a replay, not a re-roll
+                kw = dict(temperature=0.9, top_k=20, top_p=0.9,
+                          seed=4242 + i)
+            engine.add_request(p, max_new_tokens=10, request_id=f"s{i}",
+                               **kw)
     n = 0
     while True:
         if args.kill_at is not None and n == int(args.kill_at):

@@ -208,6 +208,56 @@ def _demo_serve(steps):
     engine.run()
 
 
+def _demo_sample(steps):
+    """Compiled-sampling + pipelined-decode fixture (PR 18,
+    serving/sampling.py): mixed greedy/stochastic streams on a lag-1
+    pipelined engine — per-slot temperature/top-k/top-p/penalty/seed ride
+    the ONE decode program as value buffers, so the report must show a
+    single decode compile across the whole heterogeneous churn. The
+    serve section's `serve.sample` events carry the two PR 18 reason
+    codes: a `sampler_mismatch` refusal (an out-of-contract sampler is
+    rejected at admission, never silently clamped — a clamp would break
+    the (seed, prompt, sampler) reproducibility contract) and the
+    `commit_lag_rollback` cost of a client cancel landing at the lag-1
+    pipeline boundary (one speculative token, by design)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import LLMEngine, ServeRefusal
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = LLMEngine(model, max_batch_size=3, block_size=4,
+                       pipeline_decode=True, logprobs_topk=2)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 128, int(n)).tolist()
+               for n in rng.integers(4, 12, max(6, steps))]
+    cfgs = [dict(),                                        # greedy slot
+            dict(temperature=0.8, top_k=16, seed=101),
+            dict(temperature=0.9, top_p=0.9,
+                 repetition_penalty=1.2, seed=102)]
+    reqs = [engine.add_request(p, max_new_tokens=8,
+                               **cfgs[i % len(cfgs)])
+            for i, p in enumerate(prompts)]
+    # an out-of-contract sampler: refused at admission (sampler_mismatch)
+    try:
+        engine.add_request(prompts[0], max_new_tokens=8, temperature=-1.0)
+    except (ServeRefusal, ValueError):
+        pass
+    # a client cancel while a pipelined launch is in flight: the commit
+    # discards exactly that stream's speculative token (lag-1 rollback)
+    for _ in range(6):
+        engine.step()
+    engine.cancel(reqs[1].rid)
+    engine.run()
+
+
 def _demo_tenants(steps):
     """Multi-tenant serving fixture (PR 17, serving/tenancy.py): eight
     tenants share one system prompt on a prefix-cache + batched-adapter
@@ -476,14 +526,17 @@ def main(argv=None) -> int:
     ap.add_argument("script_args", nargs=argparse.REMAINDER,
                     help="arguments passed to the script (after --)")
     ap.add_argument("--demo", choices=("dropout", "masked", "accum",
-                                       "serve", "tenants", "dp", "pp",
-                                       "moe", "metrics"),
+                                       "serve", "sample", "tenants",
+                                       "dp", "pp", "moe", "metrics"),
                     help="run a built-in tiny GPT-ish demo loop instead "
                          "of a script (`dropout`: hoisted-key dropout "
                          "promotes cleanly; `accum`: a k=4 grad-"
                          "accumulation loop promotes as a super-cycle; "
                          "`serve`: a continuous-batching serving run "
-                         "over a tight KV pool; `tenants`: eight "
+                         "over a tight KV pool; `sample`: mixed "
+                         "greedy/stochastic streams on a lag-1 "
+                         "pipelined engine — sampler_mismatch refusal + "
+                         "commit_lag_rollback; `tenants`: eight "
                          "tenants sharing a system prompt on a "
                          "prefix-cache + adapter + hot-swap engine; "
                          "`dp`: a sharded "
@@ -556,6 +609,8 @@ def main(argv=None) -> int:
     try:
         if args.demo == "serve":
             _demo_serve(args.steps)
+        elif args.demo == "sample":
+            _demo_sample(args.steps)
         elif args.demo == "tenants":
             _demo_tenants(args.steps)
         elif args.demo == "dp":

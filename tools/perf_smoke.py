@@ -122,6 +122,20 @@ STEP_SPEEDUP_GUARD = 1.15
 # shared prompt turns a whole-prompt prefill into a short tail prefill,
 # worth far more than 3x even on a loaded box
 PREFIX_SPEEDUP_GUARD = 3.0
+# sampled decode vs greedy decode per step (serving/sampling.py): the
+# sampler head (one shared sort + gumbel) must stay a rounding error next
+# to the transformer forward, so the guard runs on a forward-dominated
+# model (hidden 640) where the head's fixed cost cannot hide a regression
+# behind model FLOPs it doesn't have
+SAMPLED_OVERHEAD_GUARD = 0.05
+# lag-1 pipelined decode vs unpipelined on the serve_8 workload whose
+# per-token commit blocks the host (a stream-write stand-in): the pipeline
+# overlaps host WAIT with device compute — on a 1-core CI box CPU-bound
+# host work cannot overlap anything, but blocked-host time (client
+# sockets, log fsync) can, and on a real accelerator ALL host work can.
+# If the launch path ever re-synchronizes (dispatch blocking on the
+# in-flight step), both sides degenerate to D+H and the ratio collapses
+PIPELINE_SPEEDUP_GUARD = 1.15
 
 
 def _loop(step_fused, check_numerics=False, use_scaler=False):
@@ -1382,6 +1396,157 @@ def main() -> int:
             "hot engine reports a zero prefix hit rate on a repeated "
             "identical prompt (PR 17 regression)")
 
+    # ---- compiled sampling + pipelined decode legs (PR 18 guards) --------
+    # (p1) 64 streams churn through 4 slots with HETEROGENEOUS sampler
+    # configs — greedy, temperature-only, top-k, top-p, penalties, per-
+    # request seeds, all mixed in the same running batch — and the decode
+    # executable must still compile exactly once: sampler params are VALUE
+    # buffers of the one program, never structure
+    paddle.seed(0)
+    samp_eng = LLMEngine(smodel, max_batch_size=4, block_size=4)
+    samp_cfgs = [dict(),                                     # greedy slot
+                 dict(temperature=0.7),
+                 dict(temperature=0.9, top_k=20),
+                 dict(temperature=0.8, top_p=0.9),
+                 dict(temperature=1.0, top_k=12, top_p=0.95,
+                      repetition_penalty=1.2)]
+    for i, p in enumerate(sprompts):
+        kw = dict(samp_cfgs[i % len(samp_cfgs)])
+        if kw:
+            kw["seed"] = 1000 + i
+        samp_eng.add_request(p, max_new_tokens=6, **kw)
+    samp_eng.run()
+    samp_stats = samp_eng.stats()
+    if samp_stats["decode_compiles"] != 1:
+        failures.append(
+            f"decode compiled {samp_stats['decode_compiles']}x across 64 "
+            "churning streams with mixed sampler configs (must be exactly "
+            "1): sampler params leaked into the decode structure "
+            "(PR 18 regression)")
+    if samp_stats["sampled_tokens"] <= 0:
+        failures.append(
+            "zero sampled tokens across a mixed greedy/stochastic stream "
+            "churn: the stochastic path never ran (PR 18 regression)")
+
+    # (p2) the sampler head must stay cheap: interleaved greedy/sampled
+    # windows on a forward-dominated model (hidden 640 — the head's fixed
+    # sort+gumbel cost has real FLOPs to amortize against), min-of-paired-
+    # ratios (the prefix-leg statistic: a load spike lands on both
+    # windows, a real regression inflates every pair)
+    paddle.seed(0)
+    samp_cfg2 = GPTConfig(vocab_size=128, hidden_size=640,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=1280,
+                          max_position_embeddings=128,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0,
+                          use_flash_attention=False)
+    samp_model2 = GPTForCausalLM(samp_cfg2)
+    samp_model2.eval()
+    ov_eng = LLMEngine(samp_model2, max_batch_size=8, block_size=8,
+                       max_context=96)
+    ov_prompts = [srng.integers(0, 128, 4).tolist() for _ in range(8)]
+    ov_eng.generate(ov_prompts, max_new_tokens=3)          # warm greedy
+
+    def _sampler_window(temp, n_new=16):
+        for i, p in enumerate(ov_prompts):
+            kw = dict(max_new_tokens=n_new)
+            if temp > 0:
+                kw.update(temperature=temp, top_k=20, top_p=0.9,
+                          seed=11 + i)
+            ov_eng.add_request(p, **kw)
+        t0 = time.perf_counter()
+        ov_eng.run()
+        return time.perf_counter() - t0
+
+    _sampler_window(0.9, 4)                                # warm sampled
+    sratios = []
+    for _ in range(5):
+        t_greedy = _sampler_window(0.0)
+        t_sampled = _sampler_window(0.9)
+        sratios.append(t_sampled / t_greedy if t_greedy > 0
+                       else float("inf"))
+    sampled_overhead = min(sratios) - 1.0
+    if sampled_overhead > SAMPLED_OVERHEAD_GUARD:
+        failures.append(
+            f"sampled decode costs {sampled_overhead * 100:.1f}%/step "
+            f"over greedy (> {SAMPLED_OVERHEAD_GUARD * 100:.0f}%): the "
+            "sampler head is no longer a rounding error next to the "
+            "forward — a sort fell out of the shared pass or the "
+            "stochastic branch runs for greedy batches "
+            "(PR 18 regression)")
+    if ov_eng.stats()["decode_compiles"] != 1:
+        failures.append(
+            "the sampled-overhead windows retraced the decode program "
+            "(PR 18 regression)")
+
+    # (p3) lag-1 pipelined decode vs unpipelined, serve_8 windows whose
+    # per-token commit BLOCKS the host (time.sleep — a stream-write /
+    # slow-client stand-in that frees the core, which is the only thing a
+    # 1-core CI box can genuinely overlap; on an accelerator the same
+    # pipeline overlaps ALL host work with off-host device compute).
+    # Interleaved min-of-ratios: every round must clear the bar
+    paddle.seed(0)
+    pipe_cfg = GPTConfig(vocab_size=128, hidden_size=256,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=512,
+                         max_position_embeddings=128,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0,
+                         use_flash_attention=False)
+    pipe_model = GPTForCausalLM(pipe_cfg)
+    pipe_model.eval()
+
+    def _blocking_sink(req, tok, text):
+        time.sleep(0.0006)
+
+    def _mk_pipe_eng(pipelined):
+        e = LLMEngine(pipe_model, max_batch_size=8, block_size=8,
+                      num_blocks=256, max_context=96,
+                      pipeline_decode=pipelined)
+        e.generate(ov_prompts, max_new_tokens=3)           # warm programs
+        return e
+
+    unpipe_eng = _mk_pipe_eng(False)
+    pipe_eng = _mk_pipe_eng(True)
+
+    def _pipe_window(eng, n_new=20):
+        for i, p in enumerate(ov_prompts):
+            eng.add_request(p, max_new_tokens=n_new, temperature=0.9,
+                            top_k=20, top_p=0.9, seed=31 + i,
+                            on_token=_blocking_sink)
+        t0 = time.perf_counter()
+        eng.run()
+        return time.perf_counter() - t0
+
+    _pipe_window(unpipe_eng)
+    _pipe_window(pipe_eng)
+    pipe_ratios = []
+    for _ in range(6):
+        t_unpipe = _pipe_window(unpipe_eng)
+        t_pipe = _pipe_window(pipe_eng)
+        pipe_ratios.append(t_unpipe / t_pipe if t_pipe > 0
+                           else float("inf"))
+    pipe_speedup = min(pipe_ratios)
+    if pipe_speedup < PIPELINE_SPEEDUP_GUARD:
+        failures.append(
+            f"pipelined decode is only {pipe_speedup:.2f}x the "
+            f"unpipelined engine on the blocked-host serve_8 windows "
+            f"(>= {PIPELINE_SPEEDUP_GUARD}x required): the launch path "
+            "re-synchronized — commit work no longer overlaps the "
+            "in-flight step (PR 18 regression)")
+    pipe_stats = pipe_eng.stats()
+    if pipe_stats["decode_compiles"] != 1:
+        failures.append(
+            f"pipelined decode compiled {pipe_stats['decode_compiles']}x "
+            "(must be exactly 1): the feedback path leaked into the "
+            "decode structure (PR 18 regression)")
+    if pipe_stats["commit_rollbacks"] != 0:
+        failures.append(
+            f"{pipe_stats['commit_rollbacks']} commit rollback(s) on a "
+            "cancel-free pipelined workload (expected 0): the lag-1 "
+            "boundary is discarding healthy streams (PR 18 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -1430,7 +1595,12 @@ def main() -> int:
           f"(swaps={tstats['weight_swaps']} "
           f"switches={tstats['adapter_switches']} "
           f"prefix hit_tokens={tstats['prefix_hit_tokens']}), "
-          f"prefix prefill speedup={prefix_speedup:.2f}x")
+          f"prefix prefill speedup={prefix_speedup:.2f}x, "
+          f"mixed-sampler churn compiles={samp_stats['decode_compiles']} "
+          f"(sampled_tokens={samp_stats['sampled_tokens']}), "
+          f"sampled overhead={sampled_overhead * 100:.1f}%/step, "
+          f"pipelined speedup={pipe_speedup:.2f}x "
+          f"(rollbacks={pipe_stats['commit_rollbacks']})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)

@@ -74,9 +74,35 @@ def _workload(streams, vocab, max_prompt, seed=0, shared_prefix=0):
             for n in lens]
 
 
+def _sampling_block(reqs, vocab, temperature, top_k, top_p, seed, snap):
+    """The `sampling` headline: distinct-token fraction and normalized
+    entropy over every emitted token. Greedy tiny-model streams loop
+    hard (both numbers sit near 0); a working stochastic sampler spreads
+    mass — the block is the cheap end-to-end sanity that temperature
+    actually reached the compiled program."""
+    import collections
+    import math
+    toks = [t for r in reqs for t in r.generated]
+    block = {"temperature": float(temperature), "top_k": int(top_k),
+             "top_p": float(top_p), "seed": seed,
+             "sampled_tokens": snap["sampled_tokens"],
+             "distinct_frac": 0.0, "entropy_norm": 0.0}
+    if len(toks) > 1:
+        counts = collections.Counter(toks)
+        total = len(toks)
+        ent = -sum((c / total) * math.log(c / total)
+                   for c in counts.values())
+        denom = math.log(min(total, vocab))
+        block["distinct_frac"] = round(len(counts) / total, 4)
+        block["entropy_norm"] = round(ent / denom if denom > 0 else 0.0,
+                                      4)
+    return block
+
+
 def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
                     model=None, kernel=None, kv_dtype=None,
-                    prefix_cache=False):
+                    prefix_cache=False, temperature=0.0, top_k=0,
+                    top_p=1.0, seed=None, pipeline=False):
     """One serving bench leg; returns a bench.py-style record dict.
 
     `kernel` pins the attention variant (default: the engine resolves
@@ -85,7 +111,16 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
     says WHICH kernel tier produced its numbers. `prefix_cache` runs the
     multi-tenant shared-prefix workload (PR 17): every stream carries
     the same leading system prompt, so the record's prefix-hit counters
-    show the aliasing economy instead of zeros."""
+    show the aliasing economy instead of zeros.
+
+    Sampler knobs (PR 18) ride per-request: `temperature > 0` turns the
+    legs stochastic (per-stream seeds derive from `seed`), and the
+    record grows a `sampling` block — distinct-token fraction +
+    normalized entropy over the emitted streams, the sanity check that
+    the compiled sampler actually explores (greedy loops collapse both
+    toward 0). `pipeline=True` runs the software-pipelined decode loop
+    (launch N+1 / commit N) — same contract, overlap measured by the
+    tokens/s headline."""
     import jax
     import numpy as np
     from paddle_tpu.framework.flags import get_flags, set_flags
@@ -126,7 +161,8 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
                            # admission or deadline behavior regresses
                            max_queue_depth=4 * streams,
                            attention_kernel=kernel, kv_dtype=kv_dtype,
-                           enable_prefix_cache=prefix_cache)
+                           enable_prefix_cache=prefix_cache,
+                           pipeline_decode=pipeline)
         prompts = _workload(streams, cfg.vocab_size, max_prompt,
                             shared_prefix=(max_prompt // 2
                                            if prefix_cache else 0))
@@ -139,10 +175,16 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             engine.generate([p], max_new_tokens=2)
         engine.reset_stats()
 
-        for p in prompts:
-            engine.add_request(p, max_new_tokens=max_new_tokens)
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(engine.add_request(
+                p, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=(None if seed is None else seed + i)))
         engine.run()
         snap = engine.stats()
+        sampling = _sampling_block(reqs, cfg.vocab_size, temperature,
+                                   top_k, top_p, seed, snap)
 
         tdir = None
         if trace_dir:
@@ -222,6 +264,14 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             "cow_copies": snap["cow_copies"],
             "adapter_switches": snap["adapter_switches"],
             "weight_swaps": snap["weight_swaps"],
+            # compiled sampling + pipelined decode (PR 18): the headline
+            # sanity block — a stochastic leg whose streams collapse to
+            # repeats (distinct/entropy near 0) is broken sampling even
+            # when tokens/s looks fine
+            "pipeline": pipeline,
+            "sampled_tokens": snap["sampled_tokens"],
+            "commit_rollbacks": snap["commit_rollbacks"],
+            "sampling": sampling,
             "platform": platform,
             "trace": tdir,
             "fusion_events": events_summary(ev),
@@ -248,6 +298,19 @@ def main(argv=None) -> int:
                     help="multi-tenant shared-prefix workload: every "
                          "stream carries the same system prompt and the "
                          "engine aliases its KV blocks (PR 17)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="per-stream sampling temperature (0 = greedy, "
+                         "the compiled program is the SAME either way)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="per-stream top-k filter (0 disables)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="per-stream nucleus mass (1.0 disables)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="base sampling seed; stream i uses seed+i "
+                         "(default: per-request crc32(rid) seeds)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="software-pipelined decode: launch step N+1 "
+                         "while step N's host commit overlaps (PR 18)")
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--trace", default=None,
                     help="directory for a jax profiler trace of a few "
@@ -276,7 +339,10 @@ def main(argv=None) -> int:
                           max_new_tokens=args.max_new_tokens,
                           trace_dir=args.trace, kernel=args.kernel,
                           kv_dtype=args.kv_dtype,
-                          prefix_cache=args.prefix_cache)
+                          prefix_cache=args.prefix_cache,
+                          temperature=args.temperature,
+                          top_k=args.top_k, top_p=args.top_p,
+                          seed=args.seed, pipeline=args.pipeline)
     rec["elapsed_s"] = round(time.perf_counter() - t0, 1)
     if args.json:
         print(json.dumps(rec, indent=2))
@@ -297,6 +363,15 @@ def main(argv=None) -> int:
             print(f"prefix: hit_rate {ex['prefix_hit_rate']} "
                   f"({ex['prefix_hit_tokens']} tokens aliased), "
                   f"cow_copies {ex['cow_copies']}")
+        sb = ex["sampling"]
+        if args.temperature > 0 or args.pipeline:
+            print(f"sampling: T={sb['temperature']} top_k={sb['top_k']} "
+                  f"top_p={sb['top_p']} "
+                  f"-> distinct {sb['distinct_frac']}, "
+                  f"entropy {sb['entropy_norm']}, "
+                  f"sampled_tokens {sb['sampled_tokens']}, "
+                  f"pipelined {ex['pipeline']}, "
+                  f"commit_rollbacks {ex['commit_rollbacks']}")
         print(f"doctor: {ex['fusion_doctor']['headline']}")
     return 0 if rec["extra"]["decode_compiles"] == 0 else 1
 
