@@ -1070,10 +1070,30 @@ def _child_config(name, platform, budget_s):
     # snapshot for the parent's timeout autopsy
     from paddle_tpu.profiler.telemetry_server import maybe_start_from_flags
     maybe_start_from_flags()
+    # the goodput accountant feeds the leg's sentinel record below; a
+    # config that arms its own flags (serve_bench, the train legs) wins,
+    # this just covers the microbench legs that never touch FLAGS_metrics
+    # (<0.3%/step, budgeted by perf_smoke leg (d))
+    from paddle_tpu.framework.flags import set_flags as _set_flags
+    _set_flags({"FLAGS_metrics": True})
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     deadline = time.monotonic() + budget_s
     rec = with_retry(lambda: CONFIG_FNS[name](on_tpu), name,
                      deadline=deadline)
+    # sentinel-comparable leg record (profiler/sentinel.py): each config
+    # runs in its own child process, so the absolute counters ARE this
+    # leg's counters. tools/perf_baseline.py extracts these from the
+    # BENCH JSON-lines to seed/check tools/perf_baselines.json.
+    try:
+        from paddle_tpu.profiler.sentinel import capture_record
+        extra = rec.setdefault("extra", {})
+        if "sentinel_record" in extra:          # serve legs capture
+            extra["sentinel_record"]["leg"] = name  # in-engine; restamp
+        else:
+            extra["sentinel_record"] = capture_record(name)
+    except Exception as e:                      # never sink a bench leg
+        print(json.dumps({"event": "sentinel_record_error", "config": name,
+                          "error": str(e)[:200]}), flush=True)
     print(json.dumps(rec), flush=True)
 
 

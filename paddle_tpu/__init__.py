@@ -162,3 +162,9 @@ from .hapi import callbacks  # noqa: F401,E402
 # the framework imports. One dict lookup when the flag is 0 (default).
 from .profiler import telemetry_server as _telemetry_server  # noqa: E402
 _telemetry_server.maybe_start_from_flags()
+
+# Performance regression sentinel (profiler/sentinel.py): FLAGS_sentinel=1
+# arms the per-window drift watcher the same way. One bool check per
+# step-boundary / decode-step tick when disarmed (default).
+from .profiler import sentinel as _sentinel  # noqa: E402
+_sentinel.maybe_arm_from_flags()

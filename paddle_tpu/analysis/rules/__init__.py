@@ -29,5 +29,6 @@ from . import r3_host_sync           # noqa: E402,F401
 from . import r4_unkeyed_collective  # noqa: E402,F401
 from . import r5_contract_coverage   # noqa: E402,F401
 from . import r6_lock_discipline     # noqa: E402,F401
+from . import r7_perf_contract       # noqa: E402,F401
 
 __all__ = ["RULES", "rule"]

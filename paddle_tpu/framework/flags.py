@@ -317,6 +317,34 @@ define_flag("FLAGS_telemetry_stale_s", 120.0,
             "older than this reports unhealthy. Armed serving engines "
             "use the FLAGS_serve_step_timeout_ms budget instead")
 
+# Performance regression sentinel (profiler/sentinel.py). Disarmed by
+# default: every tick site costs one module-bool check. Armed, the
+# sentinel snapshots the goodput accountant / metrics registry once per
+# evaluation window, classifies drift against a checked-in per-leg
+# baseline (tools/perf_baselines.json) — or against its own first clean
+# window when no leg is named — and flips the /readyz degraded latch
+# with the finding attached.
+define_flag("FLAGS_sentinel", False,
+            "arm the performance regression sentinel "
+            "(profiler/sentinel.py): per-window drift verdicts "
+            "(perf_drift / split_regression / compile_storm / "
+            "latency_drift), a /sentinel endpoint on the telemetry "
+            "server, and a /readyz flip on confirmed drift. Disarmed "
+            "= one bool check per step")
+define_flag("FLAGS_sentinel_window_s", 10.0,
+            "sentinel evaluation window in seconds: drift is judged "
+            "over whole windows (one registry/accountant snapshot per "
+            "window), so smaller windows detect faster but judge "
+            "noisier statistics")
+define_flag("FLAGS_sentinel_baseline", "",
+            "path to the per-leg perf baseline JSON for the sentinel "
+            "and tools/perf_baseline.py; empty = the checked-in "
+            "tools/perf_baselines.json")
+define_flag("FLAGS_sentinel_leg", "",
+            "baseline leg name the live sentinel compares against "
+            "(e.g. 'fused', 'serve_8'); empty = self-calibrate: the "
+            "first completed clean window becomes the reference band")
+
 define_flag("FLAGS_aot_cache", False,
             "persist fused executables (per-op/chain/whole-step/serving "
             "decode) to a content-addressed on-disk store via jax.export "

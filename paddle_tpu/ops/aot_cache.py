@@ -192,6 +192,42 @@ _fp_cache = None
 _fp_generation = (-1, -1)  # (flags._GENERATION, mesh generation) of the memo
 _fp_lock = threading.Lock()
 
+# Flags read on the compiled-op path that are DELIBERATELY absent from
+# `env_fingerprint` (lint rule R7 requires every FLAGS_* used under ops/
+# or nn/ to be fingerprinted here or declared below). Two families only:
+#   * cache-shape knobs (eager_* tier gates/sizes, aot_cache_* storage
+#     limits): they decide WHETHER a cache/fusion tier engages, never the
+#     lowered program for a given cache key — each program is keyed by
+#     its own op/avals key, so flipping these cannot alias artifacts;
+#   * host-side validation/debug toggles (check_nan_inf*,
+#     check_numerics*, benchmark): they run on host values around the
+#     dispatch, outside the compiled program.
+# A flag that changes which kernel an op lowers to does NOT belong here —
+# it goes into the fingerprint's flags tuple.
+FUSION_NEUTRAL_FLAGS = frozenset({
+    "FLAGS_aot_cache",
+    "FLAGS_aot_cache_dir",
+    "FLAGS_aot_cache_max_age_s",
+    "FLAGS_aot_cache_max_bytes",
+    "FLAGS_benchmark",
+    "FLAGS_check_nan_inf",
+    "FLAGS_check_nan_inf_level",
+    "FLAGS_check_numerics",
+    "FLAGS_check_numerics_level",
+    "FLAGS_eager_chain_cache_size",
+    "FLAGS_eager_chain_fusion",
+    "FLAGS_eager_chain_fusion_min_count",
+    "FLAGS_eager_chain_stitching",
+    "FLAGS_eager_op_cache",
+    "FLAGS_eager_op_cache_donate",
+    "FLAGS_eager_op_cache_size",
+    "FLAGS_eager_step_fusion",
+    "FLAGS_eager_step_fusion_cache_size",
+    "FLAGS_eager_step_fusion_donate_params",
+    "FLAGS_eager_step_fusion_min_count",
+    "FLAGS_eager_step_fusion_spmd",
+})
+
 
 def env_fingerprint() -> dict:
     """What must match for a stored executable to be trusted: serializer

@@ -105,6 +105,12 @@ CATEGORIES = frozenset({
     # stamps the informational `kernel.quantized` marker (reason
     # `kv_quantized`) so the fallback stream stays demotions-only
     "kernel.fallback", "kernel.quantized",
+    # regression sentinel (profiler/sentinel.py, PR 19): armed/disarmed
+    # transitions, one evaluation-window verdict per check (`sentinel.check`
+    # is clean; `sentinel.drift` carries the attributed reason + drifted
+    # metric in detail), and the recovery transition that clears the
+    # /readyz degraded latch
+    "sentinel.arm", "sentinel.check", "sentinel.drift", "sentinel.recover",
 })
 
 # Machine-readable causes. Stable across releases: the fusion doctor, the
@@ -207,6 +213,23 @@ REASON_CODES = frozenset({
     "lock_discipline",     # blocking I/O / callback invocation while
                            # holding a registry/scheduler lock, or an
                            # inconsistent lock acquisition order
+    # -- regression sentinel verdicts (profiler/sentinel.py, PR 19) --------
+    # One evaluation window's live record violated its baseline band;
+    # the code names WHICH band so the supervisor/readyz consumer can
+    # route without parsing prose:
+    "perf_drift",          # goodput fraction / tokens-per-sec fell below
+                           # the baseline floor
+    "split_regression",    # a split/bypass/hang reason absent from the
+                           # baseline histogram appeared (or exceeded its
+                           # per-reason cap) in a steady window
+    "compile_storm",       # dispatch/chain/step retraces or decode/prefill
+                           # rebuilds exceeded the baseline allowance
+    "latency_drift",       # step-time or serve p50/p99 left its band
+    # R7 static twin (analysis/rules/r7_perf_contract.py): a perf meter
+    # would silently lie — a heavy-compute @register_op invisible to
+    # estimate_cycle_flops, or a program-altering FLAGS_* outside the AOT
+    # env fingerprint with no fusion-neutral annotation
+    "perf_contract",
 })
 
 

@@ -292,6 +292,39 @@ REASON_HINTS = {
         "under the lock and act after release; keep one global lock "
         "order — the chaos harness can only SAMPLE these races, the "
         "linter proves their absence."),
+    # -- regression sentinel verdicts (profiler/sentinel.py) ---------------
+    "perf_drift": (
+        "goodput fraction or tokens/sec fell below the baseline floor "
+        "for a full evaluation window. Read /sentinel (or `fusion_doctor "
+        "--watch`) for the drifted metric, then /goodput buckets_s: time "
+        "leaking into skipped/stalled/other names the thief; if buckets "
+        "look clean the denominator grew — check for a batch/seq-length "
+        "change against the baseline leg."),
+    "split_regression": (
+        "a split/bypass/hang reason outside the baseline histogram "
+        "appeared in a steady window (or blew its per-reason cap). The "
+        "detail names the reason — chase THAT code's own hint; a steady "
+        "loop re-splitting is the regression class the bench ladder "
+        "died on, never 'expected churn'."),
+    "compile_storm": (
+        "retraces or decode/prefill rebuilds exceeded the baseline "
+        "allowance after warmup. Diff /metrics.json compile counters "
+        "against the baseline record; a steady loop recompiling means "
+        "a cache key churns — see the retrace reasons in /events."),
+    "latency_drift": (
+        "step-time or serve p50/p99 left its tolerance band while "
+        "goodput/splits stayed clean: the same work got slower. Suspect "
+        "host interference, a device sharing another tenant, or an op "
+        "routed off its kernel tier (check kernel.fallback events) "
+        "before blaming the model."),
+    # -- R7 static twin (analysis/rules/r7_perf_contract.py) ---------------
+    "perf_contract": (
+        "a perf meter would silently lie: a heavy-compute @register_op "
+        "estimate_cycle_flops cannot see (declare its FLOPs via "
+        "goodput.declare_op_flops or name it into a known family), or "
+        "a program-altering FLAGS_* missing from the AOT env "
+        "fingerprint (add it there, or list it in "
+        "aot_cache.FUSION_NEUTRAL_FLAGS with a justification)."),
 }
 
 

@@ -44,6 +44,7 @@ from collections import deque
 from ..framework.flags import _FLAGS
 from . import metrics as _metrics
 from . import telemetry_server as _telemetry
+from . import sentinel as _sentinel
 
 __all__ = ["GoodputAccountant", "ACCOUNTANT", "on_step", "on_fused_fire",
            "mark", "note_stall", "estimate_cycle_flops",
@@ -99,6 +100,24 @@ def peak_flops_per_chip():
 # analytic FLOPs from a recorded fused cycle
 # ---------------------------------------------------------------------------
 
+# Per-op FLOPs declarations (the R7 perf-contract escape hatch): ops whose
+# cost the name-family heuristic below would misfile register an explicit
+# estimator here. The fn receives the input shapes (tuples, rank >= 1) and
+# returns forward FLOPs. Lint rule R7 accepts a heavy-compute op as covered
+# when a `declare_op_flops("<name>", ...)` call exists anywhere in the tree.
+_DECLARED_FLOPS = {}
+
+
+def declare_op_flops(name, fn):
+    """Declare the forward-FLOPs estimator for op `name` (overrides the
+    family heuristic in `_flops_of_op`). `fn(shapes) -> int` where shapes
+    is the list of input shape tuples."""
+    if not callable(fn):
+        raise TypeError(f"declare_op_flops({name!r}): fn must be callable")
+    _DECLARED_FLOPS[name] = fn
+    return fn
+
+
 def _flops_of_op(name, avals):
     """Forward FLOPs of one recorded dispatch, from its cache-key input
     avals ((shape, dtype, weak_type) per input). 2 FLOPs per MAC. Coarse
@@ -108,6 +127,9 @@ def _flops_of_op(name, avals):
     shapes = [tuple(av[0]) for av in avals if av and len(av[0]) >= 1]
     if not shapes:
         return 0
+    declared = _DECLARED_FLOPS.get(name)
+    if declared is not None:
+        return int(declared(shapes))
     if "matmul" in name or name in ("linear", "mm", "bmm", "addmm"):
         mats = [s for s in shapes if len(s) >= 2]
         if len(mats) >= 2:
@@ -142,6 +164,53 @@ def _numel(shape):
     for d in shape:
         n *= d
     return n
+
+
+# Declarations for the contraction ops the name-family heuristic above
+# would misfile as O(numel): each is quadratic in its operands. Registered
+# here (not in ops/) so the estimator has no import edge into the op
+# layer. `shapes` is the list of input shape tuples; 2 FLOPs per MAC.
+def _contraction_flops(shapes, k_axes=1):
+    """2 * |a| * |b| / k for a pairwise contraction over the trailing
+    `k_axes` axes of the first operand."""
+    if len(shapes) < 2:
+        return sum(_numel(s) for s in shapes)
+    a, b = shapes[0], shapes[1]
+    k = _numel(a[len(a) - min(k_axes, len(a)):])
+    return 2 * _numel(a) * _numel(b) // max(k, 1)
+
+
+def _chain_matmul_flops(shapes):
+    """Left-to-right chain product FLOPs for multi_dot."""
+    mats = [s for s in shapes if len(s) >= 2]
+    if len(mats) < 2:
+        return sum(_numel(s) for s in shapes)
+    total, (m, k) = 0, mats[0][-2:]
+    for s in mats[1:]:
+        n = s[-1] if s[-2] == k else s[-2]
+        total += 2 * m * k * n
+        k = n
+    return total
+
+
+declare_op_flops("inner", _contraction_flops)
+declare_op_flops("tensordot", lambda shapes: _contraction_flops(shapes, 2))
+declare_op_flops("outer",
+                 lambda shapes: _contraction_flops(shapes, 0) // 2)
+declare_op_flops("kron",
+                 lambda shapes: _contraction_flops(shapes, 0) // 2)
+declare_op_flops("multi_dot", _chain_matmul_flops)
+# one n^3 multiply per squaring step; the exponent is not in the shapes,
+# so count a single multiply (a roofline floor, like conv's numel)
+declare_op_flops("matrix_power",
+                 lambda shapes: 2 * _numel(shapes[0]) * shapes[0][-1])
+# 2x3 (or 3x4) theta against every output grid point — O(numel) scaled
+declare_op_flops("affine_grid",
+                 lambda shapes: 6 * sum(_numel(s) for s in shapes))
+# k Householder reflectors applied to an m x n matrix: ~4mnk
+declare_op_flops("householder_product",
+                 lambda shapes: 4 * _numel(shapes[0]) *
+                 (_numel(shapes[1]) if len(shapes) > 1 else 1))
 
 
 def estimate_cycle_flops(entries, training=True):
@@ -498,6 +567,7 @@ def on_step(opt=None, tokens=None):
     no server runs; the beat keeps its own step counter so the number
     moves even with the accountant disarmed)."""
     _telemetry.beat("train")
+    _sentinel.tick()
     if not _FLAGS.get("FLAGS_metrics"):
         return
     ACCOUNTANT.step_boundary(tokens=tokens)

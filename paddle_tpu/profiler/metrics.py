@@ -674,6 +674,9 @@ METRIC_NAMES = frozenset({
     # compiled stochastic sampling + pipelined decode (PR 18)
     "serve_sampled_tokens_total",
     "serve_commit_rollbacks_total",
+    # regression sentinel (PR 19, profiler/sentinel.py)
+    "sentinel_checks_total",        # labels: verdict (clean/perf_drift/...)
+    "sentinel_degraded",            # 0/1: the sentinel's readyz latch
 })
 
 # goodput wall-time attribution buckets (profiler/goodput.py): where did
@@ -730,6 +733,9 @@ METRIC_MERGE = {
     "serve_weight_swaps_total": "sum",
     "serve_sampled_tokens_total": "sum",
     "serve_commit_rollbacks_total": "sum",
+    "sentinel_checks_total": "sum",
+    # ANY degraded host degrades the fleet view — a max over 0/1 latches
+    "sentinel_degraded": "max",
 }
 
 
@@ -805,6 +811,11 @@ def _install_default_metrics(reg):
         "serve_commit_rollbacks_total",
         "speculative tokens discarded at the pipelined lag-1 commit")
 
+    reg.counter("sentinel_checks_total",
+                "sentinel evaluation-window verdicts", ("verdict",))
+    reg.gauge("sentinel_degraded",
+              "1 while the sentinel's drift latch holds /readyz degraded")
+
     for name, label in (("dispatch_events_total", "per-op executable "
                          "cache outcomes"),
                         ("chain_events_total", "op-chain fusion counters"),
@@ -849,6 +860,11 @@ def _install_collectors(reg):
     def _goodput_gauges(reg):
         from . import goodput
         goodput.ACCOUNTANT.publish()
+
+    @reg.collect
+    def _sentinel_gauges(reg):
+        from . import sentinel
+        sentinel.publish_metrics(reg)
 
 
 TRAIN, SERVE = _install_default_metrics(REGISTRY)

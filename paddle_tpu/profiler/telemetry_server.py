@@ -248,8 +248,16 @@ def ready_report():
         except Exception:
             continue
     from .aot import aot_cache_stats
+    from . import sentinel as _sentinel
     aot = aot_cache_stats()
+    # the regression sentinel's drift latch is a readiness input like an
+    # engine's degraded latch: a confirmed perf regression takes the
+    # replica out of rotation WITH the machine-readable finding attached
+    snt = _sentinel.sentinel_ready()
+    if snt["degraded"]:
+        ready = False
     return {"ready": ready, "engines": engines,
+            "sentinel": snt,
             "aot": {"enabled": bool(_FLAGS.get("FLAGS_aot_cache")),
                     "hits": aot.get("hits", 0),
                     "misses": aot.get("misses", 0),
@@ -316,10 +324,13 @@ def _route(path, qs):
     if path == "/readyz":
         rep = ready_report()
         return _json_body(rep, 200 if rep["ready"] else 503)
+    if path == "/sentinel":
+        from . import sentinel as _sentinel
+        return _json_body(_sentinel.sentinel_report())
     if path == "/":
         return _json_body({"endpoints": [
             "/metrics", "/metrics.json", "/goodput", "/doctor",
-            "/events", "/healthz", "/readyz"]})
+            "/events", "/healthz", "/readyz", "/sentinel"]})
     return _json_body({"error": f"unknown endpoint {path!r}"}, 404)
 
 

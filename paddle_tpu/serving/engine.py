@@ -110,6 +110,7 @@ from ..profiler.metrics import LogHistogram, SERVE as _M, \
     enabled as _metrics_on
 from ..profiler import goodput as _goodput
 from ..profiler import telemetry_server as _telemetry
+from ..profiler import sentinel as _sentinel
 from .cache import PagedKVCache, PagedCacheView, scatter_prefill, _is_int8
 from .scheduler import (Request, Scheduler, QUEUED, RUNNING, FINISHED,
                         FAILED, CANCELLED, EXPIRED)
@@ -454,6 +455,7 @@ class LLMEngine:
         self._compile_grace_ns = None
         _telemetry.maybe_start_from_flags()
         _telemetry.register_engine(self)
+        _sentinel.maybe_arm_from_flags()
 
     # ------------------------------------------------------------------
     # public API
@@ -811,6 +813,7 @@ class LLMEngine:
         # watchdog budget
         self._compile_grace_ns = None
         _telemetry.beat("decode", step=self._stats.steps)
+        _sentinel.tick()
         if _metrics_on():
             _M.step_s.observe(dt)
             _M.occupancy.set(n_active / self.max_batch_size)
@@ -895,6 +898,7 @@ class LLMEngine:
         self._hb_ns = time.perf_counter_ns()
         self._compile_grace_ns = None
         _telemetry.beat("decode", step=self._stats.steps)
+        _sentinel.tick()
         if _metrics_on():
             _M.step_s.observe(dt)
             _M.occupancy.set(n_active / self.max_batch_size)

@@ -1,0 +1,67 @@
+"""R7 golden fixture: perf-contract drift.
+
+A heavy-contraction op dispatching under a name the goodput estimator
+cannot cover (and with no declare_op_flops declaration), plus a
+compiled-path flag that is neither in the env fingerprint nor declared
+fusion-neutral. The good forms (matmul-family dispatch name, declared
+estimator, fingerprinted/neutral flags) stay clean.
+"""
+import jnp
+
+# contract surfaces (mini mirrors of ops/aot_cache.py)
+FUSION_NEUTRAL_FLAGS = frozenset({"FLAGS_neutral_cache_size"})
+
+
+def env_fingerprint():
+    return {"flags": [("FLAGS_routes_kernel", True)]}
+
+
+def register_op(name, kind, ref=None):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def binary(name, fn, a, b):
+    return fn(a, b)
+
+
+def declare_op_flops(name, fn):
+    return fn
+
+
+@register_op("bad_contract", "math")
+def bad_contract(x, y):
+    # heavy einsum under an uncoverable dispatch name -> finding
+    return binary("bad_contract",
+                  lambda a, b: jnp.einsum("ij,jk->ik", a, b), x, y)
+
+
+@register_op("good_family_name", "math")
+def good_family_name(x, y):
+    # dispatches under "matmul": the estimator's family heuristic covers it
+    return binary("matmul", jnp.matmul, x, y)
+
+
+@register_op("good_declared", "math")
+def good_declared(x, y):
+    # heavy tensordot, but its dispatch name carries a declaration below
+    return binary("declared_contraction", jnp.tensordot, x, y)
+
+
+declare_op_flops("declared_contraction", lambda shapes: 1)
+
+
+@register_op("routed", "math")
+def routed(x):
+    if read_flag("FLAGS_undeclared_routing"):   # off-contract -> finding
+        return x
+    if read_flag("FLAGS_neutral_cache_size"):   # declared neutral: clean
+        return x
+    if read_flag("FLAGS_routes_kernel"):        # fingerprinted: clean
+        return x
+    return x
+
+
+def read_flag(name):
+    return False

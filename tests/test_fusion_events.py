@@ -140,6 +140,9 @@ class TestPublicContract:
             "aot.version_skew", "aot.evict",
             # kernel tier (PR 11, kernels/pallas/ + int8 KV cache)
             "kernel.fallback", "kernel.quantized",
+            # regression sentinel (PR 19, profiler/sentinel.py)
+            "sentinel.arm", "sentinel.check", "sentinel.drift",
+            "sentinel.recover",
         })
 
     def test_reason_codes_exact(self):
@@ -179,6 +182,10 @@ class TestPublicContract:
             # paddle_tpu/analysis/): static-only finding classes — the
             # R1-R4 rules reuse the runtime codes above
             "contract_drift", "lock_discipline",
+            # regression sentinel verdicts (PR 19, profiler/sentinel.py)
+            # + the R7 static perf-contract finding class
+            "perf_drift", "split_regression", "compile_storm",
+            "latency_drift", "perf_contract",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

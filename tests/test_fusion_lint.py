@@ -44,6 +44,7 @@ _FIXTURE_PATHS = {
            "incubate/distributed/r4_lax_unkeyed.py"],
     "R5": ["r5_project"],
     "R6": ["serving/r6_locks.py", "serving/r6_tenancy.py"],
+    "R7": ["r7_perf_contract.py"],
 }
 
 
@@ -131,6 +132,19 @@ class TestRuleFixtures:
         # serving/tenancy.py actually ships) stays clean too
         assert not any(f.symbol.startswith("GoodPrefixIndex") for f in fs)
 
+    def test_r7_perf_contract(self):
+        fs = _fixture_findings("R7")
+        assert _triples(fs) == [
+            ("R7", "perf_contract", 34),       # heavy op, uncoverable name
+            ("R7", "perf_contract", 57),       # flag off the fingerprint
+        ]
+        # matmul-family dispatch name and declared estimator stay clean
+        assert not any(f.symbol.startswith("good_") for f in fs)
+        # neutral + fingerprinted flag reads stay clean (the `routed`
+        # finding is the undeclared flag only)
+        assert all("FLAGS_undeclared_routing" in f.message
+                   for f in fs if f.symbol == "routed")
+
     def test_every_finding_on_the_reason_contract(self):
         """Static findings and runtime attributions are ONE taxonomy:
         every fixture finding carries a REASON_CODES entry with a
@@ -167,6 +181,16 @@ class TestCleanTree:
 
     def test_r6_lock_discipline_clean_on_live_tree(self):
         assert analyze(root=REPO, rules=["R6"]) == []
+
+    def test_r7_perf_contract_on_live_tree(self):
+        """Every heavy op is coverable (family name or declare_op_flops)
+        and every ops/nn flag is classified — except einsum, whose
+        equation-dependent cost is a deliberate, noted baseline entry."""
+        fs = analyze(root=REPO, rules=["R7"])
+        assert [(f.file, f.symbol) for f in fs] == \
+            [("paddle_tpu/ops/einsum_op.py", "einsum")]
+        bl = Baseline.load(DEFAULT_BASELINE)
+        assert bl.split(fs)[0] == []
 
     def test_cli_gate_exits_zero_within_budget(self):
         """The tier-1 CI wiring: `python tools/fusion_lint.py
@@ -219,7 +243,8 @@ class TestCLI:
             assert f["reason_code"] in REASON_HINTS
             assert f["hint"]
         # the rule table rides along for consumers
-        assert set(doc["rules"]) == {"R1", "R2", "R3", "R4", "R5", "R6"}
+        assert set(doc["rules"]) == {"R1", "R2", "R3", "R4", "R5", "R6",
+                                     "R7"}
 
     def test_fix_hints_render(self):
         out = subprocess.run(
@@ -318,7 +343,7 @@ class TestGateCannotSilentlyPass:
     def test_unknown_rule_id_is_an_error(self):
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "fusion_lint.py"),
-             "--rules", "R7"],
+             "--rules", "R99"],
             capture_output=True, text=True, timeout=120,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert out.returncode == 2

@@ -101,6 +101,7 @@ class TestContract:
             "serve_prefix_hit_tokens_total", "serve_prefix_hit_rate",
             "serve_adapter_switches_total", "serve_weight_swaps_total",
             "serve_sampled_tokens_total", "serve_commit_rollbacks_total",
+            "sentinel_checks_total", "sentinel_degraded",
         })
 
     def test_goodput_buckets_frozen(self):
@@ -129,6 +130,8 @@ class TestContract:
         assert pm.METRIC_MERGE["train_mfu"] == "max"
         assert pm.METRIC_MERGE["train_flops_per_step"] == "max"
         assert pm.METRIC_MERGE["goodput_step_index"] == "max"
+        # any degraded host degrades the fleet: the sentinel latch maxes
+        assert pm.METRIC_MERGE["sentinel_degraded"] == "max"
         # unknown names keep the kind defaults
         assert pm.merge_policy("_not_a_metric", "counter") == "sum"
         assert pm.merge_policy("_not_a_metric", "gauge") == "max"

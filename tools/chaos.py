@@ -76,6 +76,16 @@ Serving scenarios (PR 7), the same methodology against LLMEngine:
                     step, streams stay token-identical, and /goodput
                     names the stalled step indices.
 
+  sentinel          PR 19: the perf regression sentinel, armed on short
+                    self-calibrated windows, watches the same stall
+                    storm. Must hold: the degraded latch flips within
+                    one evaluation window with a machine-readable
+                    verdict ({reason, metric, observed, bound} on the
+                    REASON_CODES contract), /readyz is 503 with the
+                    finding attached, the latch recovers on the first
+                    clean window after the fault clears, and the storm's
+                    streams finish token-identically.
+
 Every decision flows through the PR 4 fusion flight recorder, so each
 scenario's report embeds the doctor's verdict.
 
@@ -518,6 +528,144 @@ def scenario_telemetry():
     finally:
         stop.set()
         guardian.clear_faults()
+        telemetry_server.stop()
+        set_flags({"FLAGS_serve_step_timeout_ms": 0,
+                   "FLAGS_metrics": False})
+
+
+def scenario_sentinel():
+    """PR 19: the perf regression sentinel under an injected drift. A
+    serving engine runs with the telemetry server up and the sentinel
+    armed on short self-calibrated windows; after >=1 clean window, a
+    chaos "stall" fault wedges two consecutive decode steps (a split/
+    hang storm the baseline histogram has never seen). Must hold: the
+    sentinel flips its degraded latch within one evaluation window of
+    the storm with a machine-readable verdict (split_regression family,
+    {reason, metric, observed, bound}), /readyz reads 503 with that
+    finding attached under "sentinel", /sentinel serves the full
+    snapshot schema, the latch RECOVERS on the first clean window after
+    the fault clears (readyz 200 again), and the streams served through
+    the storm finish token-identically."""
+    import numpy as np
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.ops import guardian
+    from paddle_tpu.profiler import sentinel as snt
+    from paddle_tpu.profiler import telemetry_server
+    from paddle_tpu.profiler.metrics import reset_metrics
+    from paddle_tpu.serving import LLMEngine, FINISHED
+
+    _arm_serve()
+    budget_ms = 120
+    window_s = 0.4
+    set_flags({"FLAGS_serve_step_timeout_ms": budget_ms,
+               "FLAGS_metrics": True})
+    reset_metrics()
+    snt.disarm()
+    snt.SENTINEL.reset()
+    model, prompts = _serve_setup()
+    refs = _serve_refs(model, prompts, 8)
+    failures = []
+    srv = telemetry_server.start(port=0)
+
+    def probe(ep):
+        return telemetry_server.probe_endpoint(f"{srv.url}/{ep}",
+                                               timeout=5)
+
+    def filler(engine, n=3):
+        rng = np.random.default_rng(engine.stats()["steps"] + 1)
+        for k in (5, 7, 9)[:n]:
+            engine.add_request(rng.integers(0, 128, k).tolist(),
+                               max_new_tokens=4)
+        engine.run()
+
+    try:
+        engine = LLMEngine(model, max_batch_size=4, block_size=4)
+        filler(engine)              # decode compiled pre-calibration
+        snt.arm(window_s=window_s)
+        deadline = time.perf_counter() + 60
+        while snt.SENTINEL.windows < 2 and time.perf_counter() < deadline:
+            filler(engine)
+        if snt.SENTINEL.band_source != "self":
+            failures.append("sentinel never self-calibrated on clean "
+                            "serve traffic")
+        if snt.SENTINEL.degraded:
+            failures.append("sentinel degraded on CLEAN traffic before "
+                            "any fault was injected")
+        st0, body0 = probe("readyz")
+        if st0 != 200 or not body0.get("sentinel", {}).get("armed"):
+            failures.append(f"readyz pre-fault not 200/armed (st={st0})")
+
+        # -- the storm: two wedged decode steps mid-stream --------------
+        t_inject = time.perf_counter()
+        guardian.inject_fault("stall", op="serve.decode", times=2)
+        reqs = [engine.add_request(p, max_new_tokens=8) for p in prompts]
+        engine.run()                # wedges ~2x budget, then recovers
+        guardian.clear_faults()
+        t_evidence = time.perf_counter()   # storm is now in the counters
+        while not snt.SENTINEL.degraded \
+                and time.perf_counter() < deadline:
+            filler(engine, n=1)     # drive the window edge
+        trip_s = time.perf_counter() - t_inject
+        detect_s = time.perf_counter() - t_evidence
+        if not snt.SENTINEL.degraded:
+            failures.append("the stall storm never tripped the sentinel")
+        elif detect_s > window_s + 5.0:
+            # detection latency, not total trip time: engine.run() under a
+            # wedged budget stretches with host load, the window edge must
+            # not (one window + filler-round slop).
+            failures.append(f"sentinel took {detect_s:.2f}s after the "
+                            f"storm landed to trip (window {window_s}s)")
+        finding = dict(snt.SENTINEL.finding or {})
+        if finding.get("reason") not in ("split_regression",
+                                         "compile_storm", "perf_drift",
+                                         "latency_drift"):
+            failures.append(f"verdict {finding.get('reason')!r} is not "
+                            "a REASON_CODES drift verdict")
+        if not {"metric", "observed", "bound",
+                "message"} <= set(finding):
+            failures.append(f"finding not machine-readable: {finding}")
+        st_r, body_r = probe("readyz")
+        if st_r != 503:
+            failures.append(f"readyz not 503 while degraded (st={st_r})")
+        rz_finding = (body_r.get("sentinel") or {}).get("finding") or {}
+        if rz_finding.get("reason") != finding.get("reason"):
+            failures.append("readyz did not attach the sentinel finding")
+        st_s, body_s = probe("sentinel")
+        if st_s != 200 or not {"armed", "degraded", "finding", "windows",
+                               "checks", "history",
+                               "last_record"} <= set(body_s):
+            failures.append("/sentinel snapshot schema incomplete")
+
+        # -- recovery ---------------------------------------------------
+        deadline = time.perf_counter() + 60
+        while snt.SENTINEL.degraded and time.perf_counter() < deadline:
+            filler(engine, n=1)
+        if snt.SENTINEL.degraded:
+            failures.append("sentinel never recovered after the fault "
+                            "cleared")
+        st_h, _ = probe("readyz")
+        if st_h != 200:
+            failures.append(f"readyz did not recover (still {st_h})")
+        recovered = any(h.get("verdict") == "clean"
+                        for h in snt.SENTINEL.history)
+        if not recovered:
+            failures.append("no clean window recorded after recovery")
+        for r, ref in zip(reqs, refs):
+            if r.state != FINISHED or r.generated != ref:
+                failures.append(
+                    f"stream {r.rid} not token-identical through the "
+                    f"storm (state {r.state})")
+        return {"ok": not failures, "failures": failures,
+                "trip_s": round(trip_s, 3),
+                "detect_s": round(detect_s, 3),
+                "verdict": finding.get("reason"),
+                "finding": finding,
+                "windows": snt.SENTINEL.windows,
+                "checks": dict(snt.SENTINEL.checks)}
+    finally:
+        guardian.clear_faults()
+        snt.disarm()
+        snt.SENTINEL.reset()
         telemetry_server.stop()
         set_flags({"FLAGS_serve_step_timeout_ms": 0,
                    "FLAGS_metrics": False})
@@ -1129,7 +1277,8 @@ SCENARIOS = {"nan": scenario_nan, "exception": scenario_exception,
              "serve_fused_fault": scenario_serve_fused_fault,
              "serve_kill": scenario_serve_kill,
              "tenant_swap": scenario_tenant_swap,
-             "telemetry": scenario_telemetry}
+             "telemetry": scenario_telemetry,
+             "sentinel": scenario_sentinel}
 
 
 def main(argv=None):

@@ -153,11 +153,13 @@ def _host_step_p50_ms(metrics, g):
     return 0.0
 
 
-def fleet_view(hosts):
+def fleet_view(hosts, bands=None, leg=None):
     """{host: (metrics snapshot, goodput snapshot)} -> the full fleet
     report: policy-merged totals, host-labeled series, fleet goodput,
     and the drift section (slowest-host step-time ratio, per-host
-    goodput/MFU)."""
+    goodput/MFU, and — when a perf-baseline `bands` entry is given —
+    per-host straggler classification against the SAME tolerance bands
+    the regression sentinel enforces in-process)."""
     from paddle_tpu.profiler.metrics import merge_snapshots
     merged = merge_snapshots([m for m, _ in hosts.values()])
     labeled = merge_snapshots([relabel_snapshot(m, h)
@@ -165,17 +167,27 @@ def fleet_view(hosts):
     fleet_goodput = merge_goodput({h: g for h, (_, g) in hosts.items()})
     per_host = {}
     for h, (m, g) in sorted(hosts.items()):
+        p50 = round(_host_step_p50_ms(m, g), 4)
+        # a host that never finalized a goodput window and never served
+        # is reporting, not running — it must not skew the drift stats
+        active = int((g or {}).get("steps") or 0) > 0 or p50 > 0
         per_host[h] = {
+            "status": "ok" if active else "no_data",
             "goodput": (g or {}).get("goodput"),
             "mfu": (g or {}).get("mfu"),
             "tokens_per_sec": (g or {}).get("tokens_per_sec"),
-            "step_p50_ms": round(_host_step_p50_ms(m, g), 4),
+            "step_p50_ms": p50,
             "step_indices": (g or {}).get("step_indices_pretty") or {},
         }
     stepped = {h: v["step_p50_ms"] for h, v in per_host.items()
-               if v["step_p50_ms"] > 0}
-    drift = {"per_host": per_host}
-    if stepped:
+               if v["status"] == "ok" and v["step_p50_ms"] > 0}
+    drift = {"per_host": per_host,
+             "no_data_hosts": sorted(h for h, v in per_host.items()
+                                     if v["status"] == "no_data")}
+    # the ratio needs two measured hosts: a single host (or one measured
+    # host among no_data peers) has no straggler to name, and a 1.0x
+    # self-ratio would read as a finding
+    if len(stepped) >= 2:
         slowest = max(stepped, key=stepped.get)
         fastest = min(stepped, key=stepped.get)
         drift.update({
@@ -187,8 +199,42 @@ def fleet_view(hosts):
                                      / stepped[fastest], 4)
             if stepped[fastest] > 0 else None,
         })
+    if bands:
+        drift["baseline_leg"] = leg
+        drift["stragglers"] = _classify_hosts(hosts, per_host, bands)
     return {"hosts": sorted(hosts), "fleet_goodput": fleet_goodput,
             "drift": drift, "merged": merged, "labeled": labeled}
+
+
+def _classify_hosts(hosts, per_host, bands):
+    """Run each measured host's goodput snapshot through the sentinel's
+    `classify` against a checked-in leg's bands. Only the dimensions a
+    goodput snapshot carries (goodput floor, step-time bands, throughput
+    floor) can fire — the event-histogram/compile bands need the
+    in-process sentinel. {host: [findings]} for violating hosts only."""
+    from paddle_tpu.profiler.sentinel import classify
+    out = {}
+    for h, (m, g) in sorted(hosts.items()):
+        if per_host[h]["status"] != "ok":
+            continue
+        g = g or {}
+        rec = {
+            "leg": h, "kind": "train",
+            "steps": int(g.get("steps") or 0),
+            "serve_steps": 0,
+            "goodput": float(g.get("goodput") or 0.0),
+            "buckets_s": g.get("buckets_s") or {},
+            "step_ms_p50": float(g.get("step_ms_p50") or 0.0),
+            "step_ms_p99": float(g.get("step_ms_p99") or 0.0),
+            "tokens_per_sec": float(g.get("tokens_per_sec") or 0.0),
+            # closed-set dimensions a remote snapshot cannot see: keep
+            # them band-neutral instead of trivially violating
+            "reasons": {}, "compiles": {}, "hangs": 0, "skips": 0,
+        }
+        fs = classify(rec, bands)
+        if fs:
+            out[h] = fs
+    return out
 
 
 def format_fleet_summary(view):
@@ -207,16 +253,26 @@ def format_fleet_summary(view):
             f"drift   : slowest {drift['slowest_host']} is "
             f"{drift['step_time_ratio']}x {drift['fastest_host']} "
             "(step-time p50 ratio)")
+    if drift.get("no_data_hosts"):
+        lines.append("no data : " + ", ".join(drift["no_data_hosts"])
+                     + " (reporting but not running; excluded from drift)")
     for h, row in drift["per_host"].items():
         extra = ""
         idx = row.get("step_indices") or {}
         if idx:
             extra = " | " + "; ".join(f"{b} steps {s}"
                                       for b, s in sorted(idx.items()))
+        if row["status"] == "no_data":
+            lines.append(f"  {h:<24} no_data")
+            continue
         lines.append(
             f"  {h:<24} goodput={row['goodput']} mfu={row['mfu']} "
             f"p50={row['step_p50_ms']}ms"
             f" tok/s={row['tokens_per_sec']}{extra}")
+    for h, fs in sorted((drift.get("stragglers") or {}).items()):
+        for f in fs:
+            lines.append(f"  !! {h}: {f['reason']} — {f['message']} "
+                         f"(leg {drift.get('baseline_leg')})")
     lines.append("===============================================")
     return "\n".join(lines)
 
@@ -240,9 +296,30 @@ def main(argv=None) -> int:
                          "(no host labels)")
     ap.add_argument("--json", action="store_true",
                     help="print the full fleet view as JSON")
+    ap.add_argument("--leg", default=None,
+                    help="classify every host against this perf-baseline "
+                         "leg's tolerance bands (tools/perf_baselines."
+                         "json) — cross-host straggler detection with "
+                         "the regression sentinel's own classify()")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="with --leg: the perf baseline file (default: "
+                         "tools/perf_baselines.json)")
     args = ap.parse_args(argv)
     if not args.url and not args.sink:
         ap.error("at least one --url or --sink is required")
+
+    bands = None
+    if args.leg:
+        from paddle_tpu.profiler.sentinel import (DEFAULT_PERF_BASELINE,
+                                                  PerfBaseline)
+        bl = PerfBaseline.load(args.baseline or DEFAULT_PERF_BASELINE)
+        entry = bl.match(args.leg)
+        if entry is None:
+            print(f"fleet_metrics: no perf-baseline entry for leg "
+                  f"{args.leg!r} (run tools/perf_baseline.py --list)",
+                  file=sys.stderr)
+            return 1
+        bands = entry.get("bands") or {}
 
     from paddle_tpu.profiler.metrics import exposition
 
@@ -260,7 +337,7 @@ def main(argv=None) -> int:
         print("fleet_metrics: no reachable hosts / readable sinks",
               file=sys.stderr)
         return 1
-    view = fleet_view(hosts)
+    view = fleet_view(hosts, bands=bands, leg=args.leg)
     if args.json:
         print(json.dumps(view, indent=2, sort_keys=True, default=str))
     elif args.prom:

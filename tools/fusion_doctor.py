@@ -458,6 +458,61 @@ def _url_report(args) -> int:
     return 0
 
 
+def _watch_url(args) -> int:
+    """`fusion_doctor --watch --url http://host:port`: poll the live
+    /sentinel endpoint (--steps polls, ~2 s apart), one status line per
+    window plus the full verdict on every latch transition. Exit 1 when
+    drift is still latched at the end, so a supervisor can wire this as
+    a probe."""
+    import time as _time
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/sentinel"
+    was_degraded = None
+    snap = {}
+    for i in range(max(1, args.steps)):
+        try:
+            with urllib.request.urlopen(url, timeout=15) as r:
+                snap = json.loads(r.read().decode())
+        except Exception as e:
+            print(f"fusion_doctor: could not reach {url}: {e}\n"
+                  "is the process running with FLAGS_telemetry_port and "
+                  "FLAGS_sentinel set?", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(snap))
+        else:
+            checks = snap.get("checks") or {}
+            state = "DRIFT" if snap.get("degraded") else (
+                "armed" if snap.get("armed") else "disarmed")
+            print(f"[{i:>3}] {state:<8} leg={snap.get('leg') or '-'} "
+                  f"windows={snap.get('windows', 0)} "
+                  f"checks={json.dumps(checks, sort_keys=True)}")
+            if snap.get("degraded") != was_degraded:
+                f = snap.get("finding")
+                if snap.get("degraded") and f:
+                    print(f"      verdict {f.get('reason')}: "
+                          f"{f.get('message')}")
+                elif was_degraded:
+                    print("      recovered: bands clean again")
+        was_degraded = bool(snap.get("degraded"))
+        if i + 1 < max(1, args.steps):
+            _time.sleep(2.0)
+    return 1 if snap.get("degraded") else 0
+
+
+def _print_sentinel(s):
+    """Text rendering of the sentinel section (`--watch` local runs)."""
+    if not s:
+        return
+    state = "DRIFT" if s.get("degraded") else "clean"
+    print(f"sentinel: {state} | leg {s.get('leg') or '(self-calibrated)'} "
+          f"| {s.get('windows', 0)} window(s), "
+          f"checks {json.dumps(s.get('checks') or {}, sort_keys=True)}")
+    for f in s.get("findings") or []:
+        print(f"          {f.get('reason')}: {f.get('message')}")
+
+
 def _cache_report(args) -> int:
     """`fusion_doctor --cache`: list the AOT executable store (kind,
     digest, size, age, environment-fingerprint match, label), report
@@ -579,6 +634,13 @@ def main(argv=None) -> int:
     ap.add_argument("--gc", action="store_true",
                     help="with --cache: run the size/age eviction now "
                          "(also removes quarantined *.corrupt files)")
+    ap.add_argument("--watch", action="store_true",
+                    help="arm the performance regression sentinel "
+                         "(profiler/sentinel.py). With --url: poll the "
+                         "running process's /sentinel endpoint (--steps "
+                         "polls, one line each, exit 1 if drift is "
+                         "latched). Locally: watch the --demo/script run "
+                         "and append the sentinel verdict to the report")
     args = ap.parse_args(argv)
     if args.demo == "pp" and \
             "xla_force_host_platform_device_count" not in \
@@ -589,6 +651,8 @@ def main(argv=None) -> int:
             os.environ.get("XLA_FLAGS", "") +
             " --xla_force_host_platform_device_count=8").strip()
     if args.url:
+        if args.watch:
+            return _watch_url(args)
         return _url_report(args)
     if args.cache:
         return _cache_report(args)
@@ -601,6 +665,12 @@ def main(argv=None) -> int:
 
     clear_fusion_events()
     set_flags({"FLAGS_profiler_events": True})
+    if args.watch:
+        # short windows for a bounded doctor run: a 20-step demo should
+        # still see a few evaluation windows (FLAGS_sentinel_window_s
+        # governs long-running processes, not this)
+        from paddle_tpu.profiler import sentinel as _sentinel
+        _sentinel.arm(window_s=0.5)
     want_metrics = args.metrics or args.demo == "metrics"
     if want_metrics:
         from paddle_tpu.profiler.metrics import reset_metrics
@@ -642,6 +712,10 @@ def main(argv=None) -> int:
         set_flags({"FLAGS_profiler_events": False})
 
     report = explain(EVENTS.snapshot())
+    if args.watch:
+        from paddle_tpu.profiler import sentinel as _sentinel
+        report["sentinel"] = _sentinel.sentinel_report()
+        _sentinel.disarm()
     if args.lint:
         _attach_lint(report)
     if want_metrics:
@@ -655,6 +729,8 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=2))
     else:
         print(format_report(report))
+        if args.watch:
+            _print_sentinel(report.get("sentinel") or {})
         if args.lint:
             _print_lint(report.get("lint") or {})
         if want_metrics:

@@ -1547,6 +1547,122 @@ def main() -> int:
             "cancel-free pipelined workload (expected 0): the lag-1 "
             "boundary is discarding healthy streams (PR 18 regression)")
 
+    # ---- regression sentinel leg (PR 19 guards) --------------------------
+    # (q) the perf regression sentinel must honor the flight recorder's
+    # cost discipline: DISARMED, every tick site is one module-bool check
+    # (<3%/step at a generous 4 sites/step, and no windows are opened);
+    # ARMED (short evaluation windows, so the probe/classify path really
+    # runs inside the measured loops), the fused train loop and the
+    # serve_8 workload must each stay within 3%/step — interleaved
+    # disarmed-vs-armed min-of-paired-ratio windows with the metrics +
+    # events planes ON in both (their cost is budgeted by legs (d)/(k);
+    # this measures the sentinel's MARGINAL cost). Finally the leg gates
+    # its own whole-run record against the checked-in perf baseline —
+    # perf_smoke is itself a baselined leg.
+    import json
+
+    from paddle_tpu.profiler import sentinel as _snt
+
+    _snt.disarm()
+    N_TICK = 200_000
+    t0 = time.perf_counter()
+    for _ in range(N_TICK):
+        _snt.tick()
+    tick_off_ns = (time.perf_counter() - t0) / N_TICK * 1e9
+    if _snt.SENTINEL.snapshot()["windows"] != 0:
+        failures.append(
+            "disarmed sentinel ticks opened evaluation windows: the "
+            "module-bool gate is broken (PR 19 regression)")
+    snt_overhead_off = tick_off_ns * 4 / max(t_step * 1e9, 1.0)
+    if snt_overhead_off >= 0.03:
+        failures.append(
+            f"disarmed sentinel tick cost {tick_off_ns:.0f}ns x 4 "
+            f"sites/step is {snt_overhead_off * 100:.2f}% of a fused "
+            "step (>=3%): the disarmed watcher got expensive "
+            "(PR 19 regression)")
+
+    set_flags({"FLAGS_metrics": True, "FLAGS_profiler_events": True})
+    q_step = _loop(step_fused=True)
+    for _ in range(WARMUP):
+        q_step()
+    qratios = []
+    for _ in range(6):
+        _snt.disarm()
+        q_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            q_step()
+        q_step.sync()
+        t_qoff = time.perf_counter() - t0
+        _snt.arm(window_s=0.2)
+        q_step.sync()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            q_step()
+        q_step.sync()
+        t_qon = time.perf_counter() - t0
+        qratios.append(t_qon / t_qoff if t_qoff > 0 else float("inf"))
+    _snt.disarm()
+    snt_train_overhead = min(qratios) - 1.0
+    if snt_train_overhead >= 0.03:
+        failures.append(
+            f"the armed sentinel costs {snt_train_overhead * 100:.1f}%"
+            "/step on the fused train loop (>=3%): the window "
+            "probe/classify path is taxing the step it watches "
+            "(PR 19 regression)")
+
+    qsratios = []
+    for _ in range(6):
+        _snt.disarm()
+        t0 = time.perf_counter()
+        for p in sprompts8:
+            mengine.add_request(p, max_new_tokens=6)
+        mengine.run()
+        t_qsoff = time.perf_counter() - t0
+        _snt.arm(window_s=0.2)
+        t0 = time.perf_counter()
+        for p in sprompts8:
+            mengine.add_request(p, max_new_tokens=6)
+        mengine.run()
+        t_qson = time.perf_counter() - t0
+        qsratios.append(t_qson / t_qsoff if t_qsoff > 0
+                        else float("inf"))
+    _snt.disarm()
+    set_flags({"FLAGS_metrics": False, "FLAGS_profiler_events": False})
+    snt_serve_overhead = min(qsratios) - 1.0
+    if snt_serve_overhead >= 0.03:
+        failures.append(
+            f"the armed sentinel costs {snt_serve_overhead * 100:.1f}%"
+            "/step on the serve_8 loop (>=3%) (PR 19 regression)")
+
+    # the self-gate: this very run's whole-process record must sit inside
+    # the checked-in perf_smoke bands (tools/perf_baselines.json — the
+    # same add/match/expire hygiene as the fusion-lint baseline)
+    smoke_rec = _snt.capture_record("perf_smoke", kind="mixed")
+    print(json.dumps({"event": "sentinel_record", "record": smoke_rec}),
+          flush=True)
+    from paddle_tpu.profiler.sentinel import (DEFAULT_PERF_BASELINE,
+                                              PerfBaseline)
+    if not os.path.exists(DEFAULT_PERF_BASELINE):
+        failures.append(
+            "tools/perf_baselines.json is missing: the perf_smoke leg "
+            "has no bands to gate against (PR 19 regression)")
+    else:
+        _blq = PerfBaseline.load(DEFAULT_PERF_BASELINE)
+        _viol, _passed, _unb = _blq.split([smoke_rec])
+        for _rec, _fs in _viol:
+            failures.append(
+                f"perf_smoke's own sentinel record violates its "
+                f"checked-in bands: {_fs[0]['reason']} — "
+                f"{_fs[0]['message']} (PR 19 regression — or a real "
+                "drift; re-seed deliberately with tools/perf_baseline.py "
+                "--write-baseline)")
+        if _unb:
+            failures.append(
+                "perf_smoke has no entry in tools/perf_baselines.json: "
+                "seed it with tools/perf_baseline.py --write-baseline "
+                "(PR 19 regression)")
+
     print(f"perf_smoke: post-warmup retraces={retraces}, "
           f"chain replays={chain_replays}/{MEASURE}, "
           f"fused steps={step_replays}/{MEASURE} "
@@ -1600,7 +1716,11 @@ def main() -> int:
           f"(sampled_tokens={samp_stats['sampled_tokens']}), "
           f"sampled overhead={sampled_overhead * 100:.1f}%/step, "
           f"pipelined speedup={pipe_speedup:.2f}x "
-          f"(rollbacks={pipe_stats['commit_rollbacks']})")
+          f"(rollbacks={pipe_stats['commit_rollbacks']}), "
+          f"sentinel tick-off={tick_off_ns:.0f}ns "
+          f"armed={snt_train_overhead * 100:.1f}%/step train "
+          f"{snt_serve_overhead * 100:.1f}%/step serve "
+          f"(record leg={smoke_rec['leg']} kind={smoke_rec['kind']})")
     if failures:
         for f in failures:
             print(f"FAIL: {f}", file=sys.stderr)

@@ -200,6 +200,13 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
         ev = fusion_events()
         doctor = explain(ev)
         live = serve_live_summary()
+        # sentinel-comparable leg record — captured HERE, while the
+        # engine is still registered (its per-engine tallies die with
+        # it); bench.py re-stamps the leg name with its config name
+        from paddle_tpu.profiler.sentinel import capture_record
+        sentinel_rec = capture_record(
+            f"serve_{streams}" + ("_prefix" if prefix_cache else ""),
+            kind="serve")
     finally:
         set_flags(prev)
 
@@ -234,6 +241,7 @@ def run_serve_bench(streams, on_tpu, max_new_tokens=None, trace_dir=None,
             "queue_wait_p99_ms": round(snap["queue_wait_p99_ms"], 4),
             # live registry view — same numbers a production scrape sees
             "metrics_live": live,
+            "sentinel_record": sentinel_rec,
             "decode_steps": snap["steps"],
             # decode traces INSIDE the measured window — must stay 0
             "decode_compiles": snap["decode_compiles"],
