@@ -727,6 +727,83 @@ def bench_dp8(on_tpu):
     }
 
 
+def bench_dp2x2(on_tpu):
+    """Elastic-fleet DCN leg: two REAL OS processes rendezvous through the
+    fabric Coordinator (distributed/fabric.py), heartbeat a lease, share
+    one AOT artifact store, and drive the same dp super-cycle training
+    loop the chaos fleet scenarios use (2 micro-batches/step). Unlike dp8
+    — one process timing an in-process mesh — the membership protocol,
+    heartbeat thread, shared-store I/O and checkpoint ticks are all IN
+    the measured number. Steady-state fleet steps/s comes from the tail
+    of rank 0's per-step wall clock (the head holds tracing, promotion
+    and the AOT export/store). Children always run JAX_PLATFORMS=cpu
+    with 4 virtual devices: this jaxlib cannot execute cross-process
+    computations, so each member drives the fleet-local mesh exactly as
+    scenario_fleet_kill does."""
+    import tempfile
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import chaos
+    from paddle_tpu.distributed import fabric
+
+    steps = 30
+    hosts = ("a0", "a1")
+    reports = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        aot = os.path.join(tmp, "aot")
+        ck = os.path.join(tmp, "ck")
+        outs = {h: os.path.join(tmp, f"{h}.json") for h in hosts}
+        coord = fabric.Coordinator(lease_s=30.0, expected=len(hosts))
+        try:
+            addr = f"{coord.host}:{coord.port}"
+            procs = {h: chaos._spawn_fleet_child(addr, h, aot, ck,
+                                                 outs[h], steps)
+                     for h in hosts}
+            done = chaos._drain_fleet_children(procs, timeout=280)
+        finally:
+            coord.close()
+        for h, (rc, errs) in done.items():
+            if rc != 0 or not os.path.exists(outs[h]):
+                raise RuntimeError(
+                    f"fleet child {h} failed rc={rc}: {errs[-400:]}")
+            with open(outs[h]) as f:
+                reports[h] = json.load(f)
+    rank0 = next(r for r in reports.values() if r["rank"] == 0)
+    other = next(r for r in reports.values() if r["rank"] != 0)
+    ts = rank0["step_wall_t"]
+    tail = ts[len(ts) // 2:]
+    steady_s = (tail[-1] - tail[0]) / max(1, len(tail) - 1)
+    B = 2 * 6                      # 2 micro-batches x (6, 8) global batch
+    rec = {
+        "metric": "dp2x2_fleet_steps_per_sec",
+        "value": round(1.0 / steady_s, 1),
+        "unit": "steps/s",
+        "vs_baseline": 0.0,
+        "platform": "cpu",         # children are pinned to cpu (see doc)
+        "extra": {
+            "hosts": len(hosts), "devices_per_host": 4,
+            "batch_global": B,
+            "samples_per_sec": round(B / steady_s, 1),
+            "steady_ms_per_step": round(steady_s * 1e3, 3),
+            "steps_measured": len(tail),
+            "first_fired_rel": {r["host"]: r["first_fired_rel"]
+                                for r in reports.values()},
+            "generation": rank0["generation"],
+            "rebuilds": sum(len(r["rebuilds"]) for r in reports.values()),
+            "fused_steps": {r["host"]: r["fused_steps"]
+                            for r in reports.values()},
+            "aot": {"rank0": rank0["aot"], "rank1": other["aot"]},
+            "platform": "cpu",
+        },
+    }
+    # the child captured the goodput sentinel in-engine (where the flags
+    # and accountant live); lift it so _child_config restamps the leg
+    # name instead of capturing this orchestrator process's empty buckets
+    if rank0.get("sentinel_record"):
+        rec["extra"]["sentinel_record"] = rank0["sentinel_record"]
+    return rec
+
+
 def bench_pp2(on_tpu):
     """Pipeline-parallel train leg (hybrid-parallel promotion): a pp=2 x
     virtual=2 interleaved GPT driven through PipelineParallel.train_batch,
@@ -1027,6 +1104,7 @@ CONFIG_FNS = {
     "gpt2_train": bench_gpt2_train,
     "accum4": bench_accum4,
     "dp8": bench_dp8,
+    "dp2x2": bench_dp2x2,
     "pp2": bench_pp2,
     "moe8": bench_moe8,
 }
@@ -1037,8 +1115,8 @@ TPU_CAPS = {"vit": 180, "decode": 150, "serve_1": 120, "serve_8": 120,
             "serve_64": 150, "serve_8_prefix": 120,
             "serve_8_sampled": 120,
             "flash4096": 210, "gpt2_355m": 240,
-            "gpt2_train": 280, "accum4": 240, "dp8": 180, "pp2": 200,
-            "moe8": 180}
+            "gpt2_train": 280, "accum4": 240, "dp8": 180, "dp2x2": 300,
+            "pp2": 200, "moe8": 180}
 CPU_CAP = 150
 HEADLINE = "gpt2_train"
 HEADLINE_RESERVE = 300      # wall-clock held back for the headline config
@@ -1233,7 +1311,7 @@ def main():
     results = {}
     for name in ("vit", "decode", "serve_1", "serve_8", "serve_64",
                  "serve_8_prefix", "serve_8_sampled", "flash4096",
-                 "gpt2_355m", "dp8"):
+                 "gpt2_355m", "dp8", "dp2x2"):
         avail = remaining() - HEADLINE_RESERVE
         if avail < 45:
             results[name] = {"metric": name, "skipped": "budget_exhausted",

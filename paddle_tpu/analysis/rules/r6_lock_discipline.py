@@ -10,8 +10,10 @@ functions taking the same pair of locks in opposite orders deadlock once
 per blue moon under load. The scan is scoped to the modules where a held
 lock sits on the serving/telemetry hot path: `paddle_tpu/serving/`,
 `profiler/metrics.py`, `profiler/goodput.py`,
-`profiler/telemetry_server.py` (fixtures ride along via a
-`serving/`-named directory).
+`profiler/telemetry_server.py`, and the elastic-fabric control plane
+`distributed/fabric.py` — a heartbeat RPC or event emission under the
+membership lock stalls every join/heartbeat/reap on the fleet (fixtures
+ride along via `serving/`- and `distributed/`-named directories).
 
 Lock identity is the attribute/name spelled at the `with` site (any
 name containing "lock"); acquisition order is tracked per module as
@@ -37,7 +39,8 @@ _CALLBACK_CONTAINERS = ("callback", "collector", "hook", "listener",
 def _in_scope(rel):
     return ("/serving/" in "/" + rel or rel.startswith("serving/")
             or rel.endswith(("profiler/metrics.py", "profiler/goodput.py",
-                             "profiler/telemetry_server.py")))
+                             "profiler/telemetry_server.py",
+                             "distributed/fabric.py")))
 
 
 def _lock_token(expr):

@@ -1,0 +1,710 @@
+"""Elastic fleet fabric: rendezvous, heartbeat membership, and
+generation-counted mesh rebuild — lose a host in seconds, not a re-warmup.
+
+Everything below one host already exists: the SPMD step promoter compiles
+the whole train step over a mesh (ops/spmd_fusion.py), `fire_mismatch`
+drops a promoted program whose inputs moved mesh (`mesh_mismatch`) so it
+re-promotes on the next cycle, StepCheckpointer snapshots are atomic and
+restartable (incubate/checkpoint.py), and the AOT store warm-starts every
+executable from disk (ops/aot_cache.py). What is missing is the CONTROL
+PLANE that tells N processes they are one fleet and when that fleet
+changed. This module is that plane — the TCPStore / etcd3-elastic-manager
+analog (SURVEY §2.6) built on stdlib TCP so it runs as CPU multi-process
+in CI with zero native deps:
+
+  * **Coordinator** — a tiny JSON-line TCP service that assigns ranks and
+    publishes a **generation-counted fleet spec** ``{generation, world,
+    hosts: [{host, rank}]}``. Initial rendezvous is a barrier (`expected`
+    hosts join, ONE spec publishes); after that every membership change
+    bumps the generation exactly once per change batch.
+  * **Lease-based membership** — members heartbeat at lease/3; a member
+    silent for a FULL lease is declared lost (`fleet.leave`, reason
+    ``host_lost``), the generation bumps, survivors' ranks compact, and
+    the new spec publishes (`fleet.rebuild`, reason ``mesh_rebuild``). A
+    slow-but-alive host inside its lease never flaps membership.
+  * **Member** — joins, heartbeats in a daemon thread, and exposes the
+    fleet to a training loop as ONE boundary-time call: ``poll()``
+    returns the new spec exactly when the generation changed. The loop
+    then restores the latest checkpoint, rebuilds its mesh
+    (``mesh_for_spec``), and re-places its batches — the promoted
+    program drops through the existing `mesh_mismatch` split path and
+    re-promotes with zero fresh compiles via the shared AOT store.
+  * **Split-brain rules** — members NEVER bump generations themselves;
+    with the coordinator dead they keep training at the current
+    generation; a coordinator answering with a LOWER generation (a
+    rogue/fresh restart) is refused — the member re-registers carrying
+    its own generation and the coordinator fast-forwards, so the fleet
+    generation is monotonic even across coordinator kill-9.
+  * **Coordinator restart** — a replacement coordinator starts in a
+    RECOVERY window (one lease): unknown-host heartbeats trigger silent
+    re-registration; if the recovered membership is exactly the fleet
+    the members already agree on (same generation, distinct ranks,
+    matching world), the spec republishes at the SAME generation and no
+    rebuild fires; anything inconsistent bumps once.
+
+Scale-out rejoin: a restarted worker joins carrying its last generation
+(``fleet.rejoin``), pulls the latest checkpoint, and warm-starts
+compilation from the shared AOT store (``prefetch_artifacts`` readies the
+page cache before the first boundary). The observability surface rides
+the PR 4 flight recorder (`fleet.{join,leave,rebuild,rejoin}`), the
+telemetry server's ``/fleet`` view (`fleet_report`), and
+`tools/fleet_metrics.py`'s per-host generation scrape (`stale_member`
+classification). Chaos acceptance: `tools/chaos.py --scenario
+fleet_kill` (SIGKILL mid-super-cycle; survivors' post-rebuild trajectory
+matches a clean shrunk-mesh run) and `fleet_flap` (in-lease slowness
+rebuilds nothing).
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from ..profiler.events import EVENTS as _EVENTS
+
+__all__ = ["Coordinator", "Member", "mesh_for_spec", "prefetch_artifacts",
+           "fleet_report"]
+
+_IO_TIMEOUT_S = 10.0        # per-request socket budget (control plane only)
+_JOIN_POLL_S = 0.05         # member re-ask cadence while the fleet forms
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: one JSON line per connection, one JSON line back
+# ---------------------------------------------------------------------------
+
+def _call(addr, payload, timeout=_IO_TIMEOUT_S):
+    """One request/response round trip. Raises OSError/ValueError on an
+    unreachable or garbled peer — the caller owns the retry policy."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.settimeout(timeout)
+        f = s.makefile("rwb")
+        f.write(json.dumps(payload).encode() + b"\n")
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise OSError("fabric peer closed the connection mid-request")
+    return json.loads(line.decode())
+
+
+# ---------------------------------------------------------------------------
+# the coordinator
+# ---------------------------------------------------------------------------
+
+class Coordinator:
+    """Rank assignment + lease membership + generation-counted specs.
+
+    ``expected`` makes the initial rendezvous a barrier: generation 0 is
+    the forming state, the first spec publishes at generation 1 once
+    `expected` members joined. ``recovering=True`` is the REPLACEMENT
+    coordinator mode (restart mid-lease): for one ``recovery_s`` window
+    it re-registers whoever heartbeats, then republishes — at the
+    members' own generation when their reports agree (no rebuild), one
+    past the maximum otherwise.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, lease_s=2.0, expected=1,
+                 recovering=False, recovery_s=None):
+        self.lease_s = float(lease_s)
+        self._expected = int(expected)
+        self._recover_until = (time.monotonic()
+                               + (recovery_s if recovery_s is not None
+                                  else self.lease_s)) if recovering else None
+        self._lock = threading.Lock()
+        self._members = {}          # host -> row dict
+        self._generation = 0
+        self._spec = None           # published spec (None while forming)
+        self._formed = recovering   # barrier only applies to fresh fleets
+        self._rebuilds = 0
+        self._lost = []             # [(host, generation_after)]
+        self._stop = threading.Event()
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fabric-coordinator",
+            daemon=True)
+        self._reaper_thread = threading.Thread(
+            target=self._reaper_loop, name="fabric-reaper", daemon=True)
+        self._accept_thread.start()
+        self._reaper_thread.start()
+        _register(coordinator=self)
+
+    @property
+    def address(self):
+        return (self.host, self.port)
+
+    @property
+    def generation(self):
+        with self._lock:
+            return self._generation
+
+    def spec(self):
+        with self._lock:
+            return dict(self._spec) if self._spec else None
+
+    # -- server plumbing ----------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return          # socket closed by close()
+            t = threading.Thread(target=self._serve_one, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve_one(self, conn):
+        try:
+            conn.settimeout(_IO_TIMEOUT_S)
+            f = conn.makefile("rwb")
+            line = f.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line.decode())
+                reply = self._dispatch(req)
+            except Exception as e:   # a garbled request must answer, not kill
+                reply = {"ok": False, "error": repr(e)[:200]}
+            f.write(json.dumps(reply).encode() + b"\n")
+            f.flush()
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reaper_loop(self):
+        tick = max(self.lease_s / 4.0, 0.01)
+        while not self._stop.wait(tick):
+            self._reap()
+
+    # -- request handling ---------------------------------------------------
+
+    def _dispatch(self, req):
+        op = req.get("op")
+        if op == "join":
+            return self._on_join(req)
+        if op == "heartbeat":
+            return self._on_heartbeat(req)
+        if op == "leave":
+            return self._on_leave(req)
+        if op == "spec":
+            with self._lock:
+                return {"ok": True, "generation": self._generation,
+                        "spec": dict(self._spec) if self._spec else None}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _on_join(self, req):
+        host = str(req.get("host"))
+        nonce = req.get("nonce")
+        now = time.monotonic()
+        events = []
+        with self._lock:
+            # monotonic generations across coordinator restarts: a member
+            # that lived through generation g never sees anything lower
+            self._generation = max(self._generation,
+                                   int(req.get("gen_seen") or 0))
+            row = self._members.get(host)
+            if row is not None and row["nonce"] == nonce:
+                row["hb"] = now     # idempotent re-ask while forming
+                row["gen_reported"] = int(req.get("gen_seen") or 0)
+                if self._spec is not None \
+                        and self._spec["generation"] != self._generation:
+                    # fast-forwarded past the cached spec (a member
+                    # refused our stale generation): refresh in place,
+                    # same membership, no rebuild
+                    self._spec = self._build_spec_locked()
+            else:
+                rejoin = row is not None or int(req.get("gen_seen") or 0) > 0
+                self._members[host] = {
+                    "nonce": nonce, "hb": now,
+                    "gen_reported": int(req.get("gen_seen") or 0),
+                    "rank_seen": req.get("rank_seen"),
+                    "world_seen": req.get("world_seen"),
+                    "rank": row["rank"] if row else None,
+                    "joined": now,
+                }
+                events.append(("fleet.rejoin" if rejoin else "fleet.join",
+                               host, None,
+                               {"gen_seen": req.get("gen_seen"),
+                                "world": len(self._members)}))
+                if self._formed and self._recover_until is None:
+                    self._publish_locked(events)
+                elif not self._formed \
+                        and len(self._members) >= self._expected:
+                    self._formed = True
+                    self._publish_locked(events)
+            spec = dict(self._spec) if self._spec else None
+            rank = self._members[host]["rank"]
+            generation = self._generation
+        self._emit(events)
+        if spec is None:
+            return {"ok": True, "forming": True, "generation": generation}
+        return {"ok": True, "rank": rank, "generation": generation,
+                "spec": spec}
+
+    def _on_heartbeat(self, req):
+        host = str(req.get("host"))
+        gen = int(req.get("gen") or 0)
+        with self._lock:
+            row = self._members.get(host)
+            if row is None:
+                # a replacement coordinator meets the incumbent fleet
+                # here: the member re-registers (join) with its state
+                return {"ok": True, "known": False,
+                        "generation": self._generation}
+            row["hb"] = time.monotonic()
+            row["gen_reported"] = gen
+            generation = self._generation
+            spec = dict(self._spec) if (self._spec
+                                        and gen != generation) else None
+        out = {"ok": True, "known": True, "generation": generation}
+        if spec is not None:
+            out["spec"] = spec
+        return out
+
+    def _on_leave(self, req):
+        host = str(req.get("host"))
+        events = []
+        with self._lock:
+            row = self._members.pop(host, None)
+            if row is not None:
+                events.append(("fleet.leave", host, None,
+                               {"clean": True,
+                                "world": len(self._members)}))
+                if self._formed:
+                    self._publish_locked(events)
+        self._emit(events)
+        return {"ok": True}
+
+    # -- membership engine --------------------------------------------------
+
+    def _reap(self):
+        now = time.monotonic()
+        events = []
+        with self._lock:
+            if self._recover_until is not None \
+                    and now >= self._recover_until:
+                self._finish_recovery_locked(events)
+            if not self._formed or self._recover_until is not None:
+                self._emit_after = None
+            else:
+                lost = [h for h, row in self._members.items()
+                        if now - row["hb"] > self.lease_s]
+                if lost:
+                    for h in lost:
+                        self._members.pop(h, None)
+                    # one batch of losses = ONE generation bump: two
+                    # hosts dying in one window cost one rebuild
+                    for h in lost:
+                        events.append(("fleet.leave", h, "host_lost",
+                                       {"lease_s": self.lease_s,
+                                        "world": len(self._members)}))
+                        self._lost.append((h, self._generation + 1))
+                    self._publish_locked(events)
+        self._emit(events)
+
+    def _finish_recovery_locked(self, events):
+        """End of the recovery window: republish. If every re-registered
+        member agrees on one generation g>0, distinct ranks 0..n-1 and
+        world n, the fleet IS consistent — adopt g and the reported
+        ranks, publish silently (no rebuild). Anything else bumps."""
+        self._recover_until = None
+        rows = list(self._members.items())
+        n = len(rows)
+        gens = {row["gen_reported"] for _, row in rows}
+        ranks = [row["rank_seen"] for _, row in rows]
+        worlds = {row["world_seen"] for _, row in rows}
+        consistent = (n > 0 and len(gens) == 1 and min(gens) > 0
+                      and sorted(r for r in ranks
+                                 if r is not None) == list(range(n))
+                      and worlds == {n})
+        if consistent:
+            self._generation = max(self._generation, max(gens))
+            for _, row in rows:
+                row["rank"] = row["rank_seen"]
+            self._spec = self._build_spec_locked()
+        else:
+            self._publish_locked(events)
+
+    def _build_spec_locked(self):
+        ordered = sorted(
+            self._members.items(),
+            key=lambda kv: (kv[1]["rank"] if kv[1]["rank"] is not None
+                            else 1 << 30, kv[1]["joined"], kv[0]))
+        for rank, (_, row) in enumerate(ordered):
+            row["rank"] = rank
+        return {"generation": self._generation,
+                "world": len(ordered),
+                "hosts": [{"host": h, "rank": row["rank"]}
+                          for h, row in ordered],
+                "lease_s": self.lease_s}
+
+    def _publish_locked(self, events):
+        """Membership changed: bump the generation once and rebuild the
+        spec (survivor ranks keep their order, compacted; new hosts
+        append). Caller holds the lock and owns event emission."""
+        self._generation += 1
+        self._spec = self._build_spec_locked()
+        self._rebuilds += 1
+        events.append(("fleet.rebuild", "coordinator", "mesh_rebuild",
+                       {"generation": self._generation,
+                        "world": self._spec["world"],
+                        "hosts": [h["host"]
+                                  for h in self._spec["hosts"]]}))
+
+    @staticmethod
+    def _emit(events):
+        for cat, op, reason, detail in events:
+            _EVENTS.emit(cat, op, reason=reason, detail=detail)
+
+    # -- observability ------------------------------------------------------
+
+    def report(self):
+        now = time.monotonic()
+        with self._lock:
+            hosts = []
+            for h, row in sorted(self._members.items()):
+                stale = row["gen_reported"] < self._generation
+                hosts.append({"host": h, "rank": row["rank"],
+                              "generation": row["gen_reported"],
+                              "heartbeat_age_s": round(now - row["hb"], 3),
+                              "stale": stale})
+            return {
+                "address": f"{self.host}:{self.port}",
+                "generation": self._generation,
+                "state": ("recovering" if self._recover_until is not None
+                          else "live" if self._formed else "forming"),
+                "world": len(self._members),
+                "lease_s": self.lease_s,
+                "rebuilds": self._rebuilds,
+                "hosts": hosts,
+                "stale_hosts": [r["host"] for r in hosts if r["stale"]],
+                "lost": [{"host": h, "generation": g}
+                         for h, g in self._lost[-16:]],
+            }
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        _unregister(coordinator=self)
+
+
+# ---------------------------------------------------------------------------
+# the member
+# ---------------------------------------------------------------------------
+
+class Member:
+    """One process's fleet membership: join, heartbeat in the background,
+    surface generation changes to the training loop via ``poll()``.
+
+    The training loop only touches the fabric at step boundaries; the
+    heartbeat thread keeps the lease alive in between (a long compile
+    does not flap membership). Heartbeats report the generation the loop
+    has ADOPTED — until `poll()` returns, the coordinator truthfully
+    sees this host as stale for the new spec.
+    """
+
+    def __init__(self, address, host_id, gen_seen=0, rank_seen=None,
+                 world_seen=None):
+        self.address = tuple(address)
+        self.host_id = str(host_id)
+        self._nonce = f"{os.getpid()}-{time.monotonic_ns()}"
+        self._lock = threading.Lock()
+        self._generation = int(gen_seen)      # adopted by the loop
+        self._rank = rank_seen
+        self._world = world_seen
+        self._spec = None                     # adopted spec
+        self._pending = None                  # received, not yet adopted
+        self._connected = False
+        self._last_hb = 0.0
+        self._rebuilds = 0
+        self._pause_until = 0.0
+        self._stop = threading.Event()
+        self._hb_thread = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def join(self, timeout=30.0):
+        """Rendezvous: returns (rank, spec) once the fleet formed. A
+        member carrying prior state (gen_seen > 0) is a REJOIN — it
+        lands at the current generation, never a fresh one."""
+        deadline = time.monotonic() + timeout
+        payload = {"op": "join", "host": self.host_id,
+                   "nonce": self._nonce, "gen_seen": self._generation,
+                   "rank_seen": self._rank, "world_seen": self._world}
+        while True:
+            try:
+                reply = _call(self.address, payload)
+            except (OSError, ValueError):
+                reply = None
+            if reply and reply.get("ok") and "spec" in reply:
+                spec = reply["spec"]
+                with self._lock:
+                    self._spec = spec
+                    self._generation = int(spec["generation"])
+                    self._rank = int(reply["rank"])
+                    self._world = int(spec["world"])
+                    self._connected = True
+                    self._last_hb = time.monotonic()
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"fabric join timed out after {timeout}s "
+                    f"(coordinator {self.address})")
+            time.sleep(_JOIN_POLL_S)
+        lease = float(spec.get("lease_s") or 2.0)
+        self._stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, args=(lease / 3.0,),
+            name=f"fabric-hb-{self.host_id}", daemon=True)
+        self._hb_thread.start()
+        _register(member=self)
+        return self._rank, dict(spec)
+
+    def _hb_loop(self, interval):
+        while not self._stop.wait(interval):
+            with self._lock:
+                paused = time.monotonic() < self._pause_until
+            if not paused:
+                self.heartbeat_once()
+
+    def pause_heartbeats(self, seconds):
+        """Suppress lease renewals for `seconds` — the chaos harness's
+        slow-but-alive host (GC stall, slow NFS, a long compile on a
+        thread that shares the GIL). A pause inside the lease grace must
+        NOT flap membership; past it, the host is honestly lost."""
+        with self._lock:
+            self._pause_until = time.monotonic() + float(seconds)
+
+    def heartbeat_once(self):
+        """One lease renewal (also callable inline from tests/loops that
+        pace their own heartbeats)."""
+        with self._lock:
+            gen = self._generation
+        try:
+            reply = _call(self.address,
+                          {"op": "heartbeat", "host": self.host_id,
+                           "gen": gen})
+        except (OSError, ValueError):
+            # split-brain rule: coordinator unreachable -> keep training
+            # at the current generation; never self-bump, never adopt
+            with self._lock:
+                self._connected = False
+            return None
+        events = []
+        with self._lock:
+            self._connected = True
+            self._last_hb = time.monotonic()
+        if not reply.get("known", True):
+            # a replacement coordinator does not know us yet: re-register
+            # carrying our state so it can recover the fleet in place
+            try:
+                _call(self.address,
+                      {"op": "join", "host": self.host_id,
+                       "nonce": self._nonce, "gen_seen": gen,
+                       "rank_seen": self._rank,
+                       "world_seen": self._world})
+            except (OSError, ValueError):
+                pass
+            return reply
+        new_gen = int(reply.get("generation") or 0)
+        if new_gen < gen:
+            # a stale/rogue coordinator answering with a LOWER generation:
+            # refuse it (generations are monotonic) and re-register with
+            # ours so a legitimate restart fast-forwards instead
+            events.append(("fleet.rejoin", self.host_id, "stale_member",
+                           {"refused_generation": new_gen,
+                            "generation": gen}))
+            try:
+                _call(self.address,
+                      {"op": "join", "host": self.host_id,
+                       "nonce": self._nonce, "gen_seen": gen,
+                       "rank_seen": self._rank,
+                       "world_seen": self._world})
+            except (OSError, ValueError):
+                pass
+        elif new_gen > gen and reply.get("spec"):
+            with self._lock:
+                self._pending = reply["spec"]
+        Coordinator._emit(events)
+        return reply
+
+    # -- the training-loop surface ------------------------------------------
+
+    def poll(self):
+        """Boundary-time check: the new fleet spec when the generation
+        changed since the last poll, else None. Returning the spec IS
+        adoption — subsequent heartbeats report the new generation, and
+        the caller must now restore the checkpoint, rebuild the mesh
+        (`mesh_for_spec`), and re-place its batches so the promoted
+        program re-promotes through the mesh_mismatch path."""
+        with self._lock:
+            spec = self._pending
+            if spec is None:
+                return None
+            self._pending = None
+            old = self._generation
+            self._spec = spec
+            self._generation = int(spec["generation"])
+            me = next((h for h in spec["hosts"]
+                       if h["host"] == self.host_id), None)
+            self._rank = me["rank"] if me else None
+            self._world = int(spec["world"])
+            self._rebuilds += 1
+        _EVENTS.emit("fleet.rebuild", self.host_id, reason="mesh_rebuild",
+                     detail={"from_generation": old,
+                             "generation": spec["generation"],
+                             "world": spec["world"],
+                             "rank": self._rank})
+        return dict(spec)
+
+    @property
+    def generation(self):
+        with self._lock:
+            return self._generation
+
+    @property
+    def rank(self):
+        with self._lock:
+            return self._rank
+
+    @property
+    def connected(self):
+        with self._lock:
+            return self._connected
+
+    def report(self):
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "host": self.host_id,
+                "coordinator": f"{self.address[0]}:{self.address[1]}",
+                "rank": self._rank,
+                "generation": self._generation,
+                "world": self._world,
+                "connected": self._connected,
+                "last_heartbeat_age_s": (round(now - self._last_hb, 3)
+                                         if self._last_hb else None),
+                "pending_generation": (self._pending or {}).get(
+                    "generation"),
+                "rebuilds": self._rebuilds,
+            }
+
+    def leave(self):
+        """Clean scale-in: tell the coordinator, stop heartbeating."""
+        self._stop.set()
+        try:
+            _call(self.address, {"op": "leave", "host": self.host_id})
+        except (OSError, ValueError):
+            pass
+        _EVENTS.emit("fleet.leave", self.host_id,
+                     detail={"clean": True, "generation": self.generation})
+        _unregister(member=self)
+
+    def close(self):
+        """Stop the heartbeat thread WITHOUT a clean leave (crash-shaped
+        teardown for tests: the lease, not this call, ends membership)."""
+        self._stop.set()
+        _unregister(member=self)
+
+
+# ---------------------------------------------------------------------------
+# rebuild + warm-start helpers
+# ---------------------------------------------------------------------------
+
+def mesh_for_spec(spec, devices=None, dp_per_host=1):
+    """The fleet spec's mesh under the CPU multi-host emulation contract:
+    one data-parallel slot per live host (times `dp_per_host` local
+    devices), built over THIS process's devices. The control plane spans
+    hosts; the data plane stays process-local — on a real pod the same
+    spec maps to `jax.devices()` spanning hosts instead. Changing the
+    world changes the mesh, which is exactly what drops a promoted
+    program through the `mesh_mismatch` split path on the next fire."""
+    import jax
+    from .mesh import build_mesh
+    devices = list(devices) if devices is not None else jax.devices()
+    dp = int(spec["world"]) * int(dp_per_host)
+    if dp > len(devices):
+        raise ValueError(
+            f"fleet spec wants dp={dp} but only {len(devices)} local "
+            "devices are visible (raise "
+            "--xla_force_host_platform_device_count for CPU emulation)")
+    return build_mesh(dp=dp, pp=1, sharding=1, sep=1, mp=1,
+                      devices=devices[:dp])
+
+
+def prefetch_artifacts(root=None):
+    """Warm a (shared) AOT store before the first training boundary: CRC-
+    verify every artifact carrying THIS process's environment fingerprint
+    so the rejoin's first promotion deserializes straight from the page
+    cache. Returns {"artifacts", "bytes", "corrupt", "other_fingerprint"}
+    — a rejoiner logging artifacts == 0 is about to pay a cold compile
+    (wrong store dir, or a version-skewed fleet)."""
+    from ..ops import aot_cache
+    rows = aot_cache.store_entries(root or aot_cache.cache_dir(),
+                                   verify=True)
+    out = {"artifacts": 0, "bytes": 0, "corrupt": 0,
+           "other_fingerprint": 0}
+    for row in rows:
+        if row["corrupt"] or row["quarantined"]:
+            out["corrupt"] += 1
+        elif row["fingerprint_match"]:
+            out["artifacts"] += 1
+            out["bytes"] += int(row["bytes"])
+        else:
+            out["other_fingerprint"] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# /fleet observability registry
+# ---------------------------------------------------------------------------
+
+_state_lock = threading.Lock()
+_state = {"member": None, "coordinator": None}
+
+
+def _register(member=None, coordinator=None):
+    with _state_lock:
+        if member is not None:
+            _state["member"] = member
+        if coordinator is not None:
+            _state["coordinator"] = coordinator
+
+
+def _unregister(member=None, coordinator=None):
+    with _state_lock:
+        if member is not None and _state["member"] is member:
+            _state["member"] = None
+        if coordinator is not None and _state["coordinator"] is coordinator:
+            _state["coordinator"] = None
+
+
+def fleet_report():
+    """The `/fleet` endpoint body (profiler/telemetry_server.py): this
+    process's membership view and — when it hosts the coordinator — the
+    whole fleet's, including per-host reported generations and the
+    `stale_hosts` the fleet_metrics scraper classifies `stale_member`."""
+    with _state_lock:
+        member, coordinator = _state["member"], _state["coordinator"]
+    out = {"armed": member is not None or coordinator is not None}
+    if member is not None:
+        out["member"] = member.report()
+        out["generation"] = out["member"]["generation"]
+    if coordinator is not None:
+        out["coordinator"] = coordinator.report()
+        out.setdefault("generation", out["coordinator"]["generation"])
+    return out

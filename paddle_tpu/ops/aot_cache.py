@@ -60,6 +60,7 @@ import enum
 import hashlib
 import os
 import pickle
+import socket
 import threading
 import time
 import types
@@ -376,6 +377,9 @@ def store_artifact(kind, digest, label, blobs, meta=None) -> bool:
     payload = pickle.dumps({
         "v": 1, "kind": kind, "digest": digest, "label": label,
         "fingerprint": env_fingerprint(), "created": time.time(),
+        # which fleet host exported this: on a shared store the doctor's
+        # provenance column — who paid the compile the others reuse
+        "host": socket.gethostname(),
         "meta": meta or {}, "blobs": list(blobs),
     }, protocol=4)
     try:
@@ -595,7 +599,8 @@ def store_entries(root=None, verify=True):
                "age_s": round(now - st.st_mtime, 1),
                "quarantined": fn.endswith(".corrupt"),
                "kind": fn.split("-", 1)[0] if "-" in fn else "?",
-               "label": None, "fingerprint_match": None, "corrupt": None}
+               "label": None, "host": None,
+               "fingerprint_match": None, "corrupt": None}
         stem = fn[:-len(".aot")] if fn.endswith(".aot") else fn
         parts = stem.split("-")
         if len(parts) >= 3:
@@ -606,6 +611,7 @@ def store_entries(root=None, verify=True):
                 art = pickle.loads(
                     read_verified_payload(p, require_trailer=True))
                 row["label"] = art.get("label")
+                row["host"] = art.get("host")
                 row["corrupt"] = False
                 row["fingerprint_match"] = \
                     art.get("fingerprint") == env_fingerprint()
@@ -686,11 +692,22 @@ class _Healing:
             return self._impl(*args)
 
 
-def load_callable(kind, digest, label, fallback, donate_argnums=()):
+def load_callable(kind, digest, label, fallback, donate_argnums=(),
+                  accept=None):
     """One-program artifact -> a healing callable, or None (miss / skew /
-    corrupt — all attributed; the caller builds live)."""
+    corrupt — all attributed; the caller builds live). `accept` is an
+    optional predicate over the artifact meta: a False verdict is a miss
+    (the stored program has an incompatible calling convention — e.g. a
+    plain-jit lowering where the live program wants shard_map), never a
+    quarantine."""
     art = load_artifact(kind, digest, label)
     if art is None:
+        return None
+    if accept is not None and not accept(art.get("meta") or {}):
+        _STATS.misses += 1
+        _EVENTS.emit("aot.miss", label,
+                     detail={"kind": kind, "digest": digest[:12],
+                             "why": "lowering_mismatch"})
         return None
     try:
         impl = _deserialize_callable(art["blobs"][0], donate_argnums)
@@ -1114,14 +1131,21 @@ def store_step(program, args):
                    meta={"ops": len(program.chain.ops),
                          "params": len(program.param_names),
                          "check": program.check,
-                         "scaler": program.scaler_consts is not None})
+                         "scaler": program.scaler_consts is not None,
+                         "spmd": program.spmd_plan is not None})
 
 
 def load_step(program, fallback, donate_argnums):
     """Restore the fused whole-step executable (healing; donation
-    re-applied at the wrapper), or None."""
-    return load_callable("step", program.aot_digest, program.label,
-                         fallback, donate_argnums)
+    re-applied at the wrapper), or None. The artifact must match the live
+    program's LOWERING: a plain-jit export (stored by a process whose
+    probation demoted the mesh plan) cannot serve a shard_map caller —
+    the arg conventions differ — so a spmd-ness mismatch is a miss."""
+    want_spmd = program.spmd_plan is not None
+    return load_callable(
+        "step", program.aot_digest, program.label, fallback,
+        donate_argnums,
+        accept=lambda meta: bool(meta.get("spmd")) == want_spmd)
 
 
 def store_super_step(program, sub_args, upd_args):
@@ -1150,7 +1174,8 @@ def store_super_step(program, sub_args, upd_args):
                    meta={"super": True, "ops": len(program.chain.ops),
                          "params": len(program.param_names),
                          "check": program.check,
-                         "scaler": program.scaler_consts is not None})
+                         "scaler": program.scaler_consts is not None,
+                         "spmd": program.spmd_plan is not None})
 
 
 def load_super_step(program, sub_fallback, upd_fallback, upd_donate):
@@ -1159,6 +1184,16 @@ def load_super_step(program, sub_fallback, upd_fallback, upd_donate):
     art = load_artifact("step", program.aot_digest, program.label)
     if art is None or len(art.get("blobs", ())) != 2 \
             or not (art.get("meta") or {}).get("super"):
+        return None, None
+    if bool((art.get("meta") or {}).get("spmd")) \
+            != (program.spmd_plan is not None):
+        # lowering mismatch (plain-jit pair vs shard_map caller or vice
+        # versa): the arg conventions differ — a miss, not corruption
+        _STATS.misses += 1
+        _EVENTS.emit("aot.miss", program.label,
+                     detail={"kind": "step",
+                             "digest": program.aot_digest[:12],
+                             "why": "lowering_mismatch"})
         return None, None
     path = _artifact_path("step", program.aot_digest)
     try:

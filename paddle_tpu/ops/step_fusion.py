@@ -284,6 +284,13 @@ class _StepProgram:
                 self, self._compile,
                 donation_argnums(self.donate_params, 0, 2))
             if self._exe is not None:
+                if self.spmd_plan is not None:
+                    # a stored SPMD artifact only exists because a prior
+                    # process fired it AFTER passing probation on this
+                    # exact cycle + mesh topology (the env fingerprint
+                    # pins both) — the restored program re-validates
+                    # nothing and commits fused from its first replay
+                    self.spmd_ok = True
                 return self._exe
         self._exe = self._compile()
         return self._exe
@@ -494,6 +501,11 @@ class _StepProgram:
         if sub is not None:
             self._sub_exe = sub
             self._upd_exe = upd
+            if self.spmd_plan is not None:
+                # the stored pair proved itself post-probation in the
+                # storing process, on this exact cycle + topology —
+                # skip probation and fire fused immediately
+                self.spmd_ok = True
 
     def zero_state(self):
         """(zero grad accumulators, all-finite True scalar): the round-0
@@ -2615,16 +2627,22 @@ class _StepFusionManager:
         program.donate_params = bool(
             _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
         from . import aot_cache as _aot
-        if _aot.enabled() and plan is None:
-            # SPMD programs opt out of the AOT store for now: jax.export
-            # of manual-mesh programs is not round-trip-safe on every
-            # supported jax, and the mesh topology fingerprint already
-            # guards cross-topology reuse (ROADMAP follow-on)
+        if _aot.enabled():
+            # SPMD programs participate too: the env fingerprint's mesh
+            # topology token keys artifacts to one mesh shape, so a
+            # shard_map module only ever reloads on the topology it was
+            # exported from (same-digest different-sharding is impossible
+            # across topologies, and within one mesh the plan is a pure
+            # function of the cycle)
             dg = st.aot_probe.get(sig, 0)
             program.aot_digest = dg if dg != 0 \
                 else _aot.step_digest(sig, opt, updated)
-        elif plan is not None:
-            program.aot_stored = True
+            if warm:
+                # AOT warm promote: pull the stored executable NOW so the
+                # very next replay fires it — and a restored SPMD program
+                # has probation waived before the replay's probation
+                # check runs (see exe())
+                program.exe()
         STEP_STATS.promoted(program.label)
         _EVENTS.emit("step.promote", program.label,
                      detail={"ops": len(ops), "params": len(updated),
@@ -2842,12 +2860,16 @@ class _StepFusionManager:
         program.donate_params = bool(
             _FLAGS.get("FLAGS_eager_step_fusion_donate_params"))
         from . import aot_cache as _aot
-        if _aot.enabled() and plan is None:
+        if _aot.enabled():
             dg = st.aot_probe.get(sig, 0)
             program.aot_digest = dg if dg != 0 \
                 else _aot.step_digest(sig, opt, updated)
-        else:
-            program.aot_stored = True
+            if warm:
+                # AOT warm promote: restore the (sub, update) pair NOW —
+                # probation defers sub fires, so a lazy load would never
+                # be reached before the probation decision; an eagerly
+                # restored SPMD pair waives probation instead
+                program._maybe_load_super()
         STEP_STATS.promoted(program.label)
         _EVENTS.emit("step.promote", program.label,
                      detail={"ops": len(ops), "params": len(updated),

@@ -111,6 +111,12 @@ CATEGORIES = frozenset({
     # metric in detail), and the recovery transition that clears the
     # /readyz degraded latch
     "sentinel.arm", "sentinel.check", "sentinel.drift", "sentinel.recover",
+    # elastic fleet fabric (distributed/fabric.py, PR 20): a host joined
+    # the fleet / was declared lost or left cleanly / the coordinator
+    # published a new generation (survivors rebuild the mesh through the
+    # mesh_mismatch split path) / a restarted host rendezvoused back at
+    # the current generation and warm-started from the shared stores
+    "fleet.join", "fleet.leave", "fleet.rebuild", "fleet.rejoin",
 })
 
 # Machine-readable causes. Stable across releases: the fusion doctor, the
@@ -230,6 +236,21 @@ REASON_CODES = frozenset({
     # estimate_cycle_flops, or a program-altering FLAGS_* outside the AOT
     # env fingerprint with no fusion-neutral annotation
     "perf_contract",
+    # -- elastic fleet fabric (distributed/fabric.py, PR 20) ---------------
+    "host_lost",           # a member missed its full heartbeat lease: the
+                           # coordinator declared it dead and bumped the
+                           # fleet generation (a slow-but-alive host
+                           # inside its lease never trips this)
+    "mesh_rebuild",        # survivors adopted a new generation's fleet
+                           # spec: the mesh is rebuilt and the promoted
+                           # program re-promotes through the
+                           # mesh_mismatch split path (checkpoint restore
+                           # + AOT warm-start, seconds not a re-warmup)
+    "stale_member",        # a host is heartbeating (alive) but still
+                           # reports an OLDER generation than the fleet:
+                           # it has not adopted the current spec yet —
+                           # persistent staleness means its rebuild hook
+                           # is wedged
 })
 
 

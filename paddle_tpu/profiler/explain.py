@@ -292,6 +292,32 @@ REASON_HINTS = {
         "under the lock and act after release; keep one global lock "
         "order — the chaos harness can only SAMPLE these races, the "
         "linter proves their absence."),
+    # -- elastic fleet fabric (distributed/fabric.py) ----------------------
+    "host_lost": (
+        "a fleet member missed its FULL heartbeat lease and the "
+        "coordinator declared it dead: the generation bumped and the "
+        "survivors rebuild. Expected exactly once per real host "
+        "failure/preemption; host_lost on a machine that is still up "
+        "means the lease (fabric lease_s) is tighter than the host's GC/"
+        "checkpoint pauses — a slow-but-alive host inside its lease "
+        "must never trip this."),
+    "mesh_rebuild": (
+        "the fleet generation changed (scale-in after host_lost, or "
+        "scale-out on a rejoin) and this process adopted the new spec: "
+        "the mesh was rebuilt, the promoted program dropped through the "
+        "mesh_mismatch split path, state restored from the latest "
+        "StepCheckpointer snapshot and executables warm-started from "
+        "the shared AOT store. Expected once per membership change; a "
+        "rebuild storm means membership is flapping — check the "
+        "coordinator's fleet.leave reasons."),
+    "stale_member": (
+        "a host is heartbeating (alive) but still reports an older "
+        "generation than the fleet — it has not run its rebuild hook "
+        "for the current spec. Transient during a rebuild window; "
+        "persistent staleness means the host's training loop is wedged "
+        "between step boundaries (it only polls the fabric at a "
+        "boundary) or its member thread died — check that host's "
+        "/fleet and /healthz."),
     # -- regression sentinel verdicts (profiler/sentinel.py) ---------------
     "perf_drift": (
         "goodput fraction or tokens/sec fell below the baseline floor "

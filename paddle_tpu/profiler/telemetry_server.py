@@ -327,10 +327,17 @@ def _route(path, qs):
     if path == "/sentinel":
         from . import sentinel as _sentinel
         return _json_body(_sentinel.sentinel_report())
+    if path == "/fleet":
+        # this host's elastic-fabric view: membership generation, lease
+        # ages, and (on the coordinator host) the whole fleet including
+        # stale_hosts — what tools/fleet_metrics.py scrapes to classify
+        # stale_member hosts
+        from ..distributed import fabric as _fabric
+        return _json_body(_fabric.fleet_report())
     if path == "/":
         return _json_body({"endpoints": [
             "/metrics", "/metrics.json", "/goodput", "/doctor",
-            "/events", "/healthz", "/readyz", "/sentinel"]})
+            "/events", "/healthz", "/readyz", "/sentinel", "/fleet"]})
     return _json_body({"error": f"unknown endpoint {path!r}"}, 404)
 
 

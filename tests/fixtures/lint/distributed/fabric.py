@@ -1,0 +1,52 @@
+"""R6 golden known-bad, fabric-flavored: blocking work / rebuild
+listener invocation under the membership lock, plus a membership/state
+lock inversion — the races distributed/fabric.py's snapshot-then-emit
+discipline (collect events under the lock, emit after release) avoids."""
+import threading
+import time
+
+
+class BadCoordinator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._members = {}
+        self._listeners = []
+
+    def reap(self):
+        with self._lock:
+            time.sleep(0.05)                    # line 18: lease wait held
+            self._members.clear()
+
+    def publish(self, spec):
+        with self._lock:
+            for listener in self._listeners:
+                listener(spec)                  # line 24: listener held
+            print("fleet.rebuild", spec)        # line 25: blocking held
+
+    def forward(self):
+        with self._lock:
+            with self._state_lock:              # _lock -> _state_lock
+                return dict(self._members)
+
+    def inverted(self):
+        with self._state_lock:
+            with self._lock:                    # line 34: inversion
+                return len(self._members)
+
+
+class GoodCoordinator:
+    """The shipped discipline (fabric._publish_locked + _emit): mutate
+    and collect under the lock, notify after release."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners = []
+        self._spec = None
+
+    def publish(self, spec):
+        with self._lock:
+            self._spec = spec
+            listeners = list(self._listeners)
+        for listener in listeners:
+            listener(spec)

@@ -431,6 +431,85 @@ class TestConcurrentWriters:
         assert dispatch_cache_stats()["retraces"] == 0
 
 
+class TestSharedStoreFleet:
+    """Cross-host shared-store contracts the elastic fabric leans on
+    (distributed/fabric.py): store-if-absent races on one key converge
+    to a single loadable artifact, every artifact records which host
+    exported it, and a stored lowering that does not match the live
+    program's calling convention is a MISS — never a quarantine of a
+    healthy artifact (the plain-jit vs shard_map aliasing a probation
+    demotion can create under one step digest)."""
+
+    @staticmethod
+    def _blob():
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda a: a * 2.0)
+        return f, aot_cache.export_bytes(
+            f, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+
+    def test_same_key_race_converges_with_host_provenance(self, tmp_path):
+        import socket
+        import threading
+        _arm(tmp_path)
+        _, blob = self._blob()
+        digest = "f" * 40
+        errors, results = [], []
+
+        def writer():
+            try:
+                results.append(aot_cache.store_artifact(
+                    "step", digest, "race", [blob], meta={"spmd": False}))
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # no raise, ever. A loser of the tmp-file race reports False
+        # (accounted store_failure) — it must never tear the artifact
+        assert not errors
+        assert any(results)
+        entries = [e for e in aot_cache.store_entries(str(tmp_path))
+                   if e["kind"] == "step"]
+        assert len(entries) == 1            # same key -> ONE file
+        e = entries[0]
+        assert not e["corrupt"] and not e["quarantined"]
+        assert e["host"] == socket.gethostname()
+        art = aot_cache.load_artifact("step", digest, "race")
+        assert bytes(art["blobs"][0]) == bytes(blob)
+        assert art["host"] == socket.gethostname()
+
+    def test_lowering_mismatch_is_miss_not_quarantine(self, tmp_path):
+        _arm(tmp_path)
+        f, blob = self._blob()
+        digest = "e" * 40
+        assert aot_cache.store_artifact("step", digest, "mm", [blob],
+                                        meta={"spmd": False})
+        m0 = aot_cache_stats()["misses"]
+        got = aot_cache.load_callable(
+            "step", digest, "mm", fallback=lambda: f,
+            accept=lambda meta: bool(meta.get("spmd")))
+        assert got is None
+        assert aot_cache_stats()["misses"] == m0 + 1
+        assert aot_cache_stats()["corrupt"] == 0
+        misses = [ev for ev in _events("aot.miss")
+                  if ev["detail"].get("why") == "lowering_mismatch"]
+        assert misses and misses[-1]["detail"]["digest"] == digest[:12]
+        # the artifact survives untouched and a MATCHING caller loads it
+        entries = [e for e in aot_cache.store_entries(str(tmp_path))
+                   if e["kind"] == "step"]
+        assert len(entries) == 1 and not entries[0]["quarantined"]
+        got2 = aot_cache.load_callable(
+            "step", digest, "mm", fallback=lambda: f,
+            accept=lambda meta: not meta.get("spmd"))
+        assert got2 is not None
+        out = got2(np.full((4,), 3.0, np.float32))
+        assert np.allclose(np.asarray(out), 6.0)
+
+
 def _make_state_dim(dim):
     rng = np.random.default_rng(0)
     x = paddle.to_tensor(rng.standard_normal((4, dim)).astype(np.float32))

@@ -1,0 +1,306 @@
+"""Elastic fleet fabric membership edge cases (PR 20,
+distributed/fabric.py).
+
+The chaos scenarios (`tools/chaos.py --scenario fleet_kill /
+fleet_flap`) prove the END-TO-END contract — SIGKILL mid-super-cycle,
+checkpoint restore, AOT warm rejoin. This file pins the membership
+PROTOCOL itself with sub-second leases and no jax training:
+
+  * initial rendezvous is a barrier: the spec publishes once, at
+    generation 1, with distinct compact ranks;
+  * a full-lease silence is a loss: ONE generation bump, survivor ranks
+    compact, `fleet.leave` (host_lost) + `fleet.rebuild` (mesh_rebuild)
+    attributed;
+  * two hosts lost in one reap window cost ONE bump (one rebuild), not
+    two;
+  * slow-but-alive inside the lease flaps NOTHING;
+  * a rejoin lands at the CURRENT generation (fleet.rejoin), never a
+    fresh count;
+  * a replacement coordinator that recovers a consistent incumbent
+    fleet republishes at the SAME generation with zero rebuilds;
+  * members refuse a lower generation (stale/rogue coordinator) and
+    fast-forward it instead — fleet generations are monotonic even
+    across coordinator kill-9.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed import fabric
+from paddle_tpu.framework.flags import set_flags
+from paddle_tpu.profiler.events import clear_fusion_events, fusion_events
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    set_flags({"FLAGS_profiler_events": True})
+    clear_fusion_events()
+    yield
+    clear_fusion_events()
+    set_flags({"FLAGS_profiler_events": False})
+
+
+def _events(cat):
+    return [e for e in fusion_events() if e["cat"] == cat]
+
+
+def _join_all(coord, hosts, **kw):
+    """Concurrent rendezvous (join blocks until the barrier opens)."""
+    members = {h: fabric.Member((coord.host, coord.port), h, **kw)
+               for h in hosts}
+    results = {}
+
+    def run(h):
+        results[h] = members[h].join(timeout=30.0)
+
+    threads = [threading.Thread(target=run, args=(h,)) for h in hosts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert len(results) == len(hosts)
+    return members, results
+
+
+def _wait(pred, timeout=10.0, tick=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def _sync_leases(members):
+    """Line up every member's coordinator-side lease clock so a
+    subsequent batch of silences lands in ONE reap window."""
+    for m in members:
+        m.heartbeat_once()
+
+
+class TestRendezvous:
+    def test_barrier_publishes_once_at_generation_one(self):
+        coord = fabric.Coordinator(lease_s=5.0, expected=3)
+        try:
+            members, results = _join_all(coord, ("a", "b", "c"))
+            ranks = sorted(r for r, _ in results.values())
+            assert ranks == [0, 1, 2]
+            specs = [s for _, s in results.values()]
+            assert all(s["generation"] == 1 for s in specs)
+            assert all(s["world"] == 3 for s in specs)
+            assert coord.generation == 1
+            assert coord.report()["rebuilds"] == 1   # the forming publish
+            assert len(_events("fleet.join")) == 3
+            # nobody has anything to adopt: the forming spec was returned
+            # by join itself
+            assert all(m.poll() is None for m in members.values())
+        finally:
+            for m in members.values():
+                m.close()
+            coord.close()
+
+    def test_forming_fleet_reaps_nothing(self):
+        coord = fabric.Coordinator(lease_s=0.2, expected=2)
+        try:
+            m = fabric.Member((coord.host, coord.port), "only")
+            with pytest.raises(TimeoutError):
+                m.join(timeout=0.8)     # barrier never opens
+            # well past the lease: a FORMING fleet must not reap members
+            assert coord.report()["world"] == 1
+            assert coord.generation == 0
+        finally:
+            m.close()
+            coord.close()
+
+
+class TestLeaseMembership:
+    def test_host_lost_one_bump_ranks_compact(self):
+        coord = fabric.Coordinator(lease_s=0.4, expected=3)
+        members, _ = _join_all(coord, ("a", "b", "c"))
+        try:
+            victim = next(h for h, m in members.items() if m.rank == 1)
+            members[victim].close()              # crash-shaped: no leave
+            assert _wait(lambda: coord.generation == 2, timeout=5.0)
+            rep = coord.report()
+            assert rep["world"] == 2
+            assert rep["lost"] == [{"host": victim, "generation": 2}]
+            leaves = _events("fleet.leave")
+            assert [e["reason"] for e in leaves] == ["host_lost"]
+            assert leaves[0]["op"] == victim
+            # survivors adopt exactly one rebuild with compacted ranks
+            survivors = [m for h, m in members.items() if h != victim]
+            for m in survivors:
+                assert _wait(lambda: m.poll() is not None, timeout=5.0)
+            assert sorted(m.rank for m in survivors) == [0, 1]
+            assert all(m.generation == 2 for m in survivors)
+        finally:
+            for m in members.values():
+                m.close()
+            coord.close()
+
+    def test_two_losses_in_one_window_cost_one_bump(self):
+        coord = fabric.Coordinator(lease_s=0.4, expected=3)
+        members, _ = _join_all(coord, ("a", "b", "c"))
+        try:
+            doomed = [members["a"], members["b"]]
+            # one reap window: align the lease clocks, then silence both
+            _sync_leases(doomed)
+            for m in doomed:
+                m.close()
+            assert _wait(lambda: coord.report()["world"] == 1,
+                         timeout=5.0)
+            rep = coord.report()
+            assert coord.generation == 2         # ONE bump for the batch
+            assert rep["rebuilds"] == 2          # forming + this batch
+            assert {r["generation"] for r in rep["lost"]} == {2}
+            assert len(_events("fleet.leave")) == 2
+            assert _wait(lambda: members["c"].poll() is not None,
+                         timeout=5.0)
+            assert members["c"].rank == 0
+            assert members["c"].generation == 2
+        finally:
+            for m in members.values():
+                m.close()
+            coord.close()
+
+    def test_slow_but_alive_inside_lease_never_flaps(self):
+        coord = fabric.Coordinator(lease_s=0.6, expected=2)
+        members, _ = _join_all(coord, ("a", "b"))
+        try:
+            members["a"].pause_heartbeats(0.3)   # half the lease
+            time.sleep(0.9)                      # several reap ticks
+            assert coord.generation == 1
+            assert coord.report()["world"] == 2
+            assert coord.report()["rebuilds"] == 1
+            assert all(m.poll() is None for m in members.values())
+            assert not _events("fleet.leave")
+        finally:
+            for m in members.values():
+                m.close()
+            coord.close()
+
+
+class TestRejoin:
+    def test_rejoin_lands_at_current_generation(self):
+        coord = fabric.Coordinator(lease_s=0.4, expected=2)
+        members, _ = _join_all(coord, ("a", "b"))
+        try:
+            members["b"].close()
+            assert _wait(lambda: coord.generation == 2, timeout=5.0)
+            assert _wait(lambda: members["a"].poll() is not None,
+                         timeout=5.0)
+            # the restarted host carries its last adopted generation
+            again = fabric.Member((coord.host, coord.port), "b",
+                                  gen_seen=1)
+            rank, spec = again.join(timeout=10.0)
+            assert spec["generation"] == 3       # rejoin bumps once
+            assert spec["world"] == 2
+            assert again.generation == 3
+            rejoins = _events("fleet.rejoin")
+            assert rejoins and rejoins[-1]["op"] == "b"
+            # the incumbent keeps rank 0; the rejoiner appends
+            assert _wait(lambda: members["a"].poll() is not None,
+                         timeout=5.0)
+            assert members["a"].rank == 0 and rank == 1
+            again.close()
+        finally:
+            for m in members.values():
+                m.close()
+            coord.close()
+
+
+class TestCoordinatorRestart:
+    def test_replacement_recovers_consistent_fleet_without_rebuild(self):
+        coord = fabric.Coordinator(lease_s=0.6, expected=2)
+        port = coord.port
+        members, _ = _join_all(coord, ("a", "b"))
+        try:
+            coord.close()                        # kill-9 the control plane
+            time.sleep(0.2)
+            # members keep training at their generation (split-brain rule)
+            assert all(m.generation == 1 for m in members.values())
+            repl = fabric.Coordinator(port=port, lease_s=0.6,
+                                      recovering=True, recovery_s=0.6)
+            try:
+                # unknown-host heartbeats re-register both members inside
+                # the window; the recovered fleet is consistent, so the
+                # spec republishes at the SAME generation, silently
+                assert _wait(lambda: repl.report()["state"] == "live",
+                             timeout=5.0)
+                assert _wait(lambda: repl.report()["world"] == 2,
+                             timeout=5.0)
+                assert repl.generation == 1
+                assert repl.report()["rebuilds"] == 0
+                assert all(m.poll() is None for m in members.values())
+                assert {r["rank"] for r in repl.report()["hosts"]} \
+                    == {0, 1}
+            finally:
+                repl.close()
+        finally:
+            for m in members.values():
+                m.close()
+            coord.close()
+
+    def test_member_refuses_lower_generation_and_fast_forwards(self):
+        coord = fabric.Coordinator(lease_s=5.0, expected=1)
+        m = fabric.Member((coord.host, coord.port), "a")
+        try:
+            m.join(timeout=10.0)
+            assert coord.generation == 1
+            rebuilds_before = coord.report()["rebuilds"]
+            # the member lived through generations this coordinator never
+            # saw (it was restarted from scratch): refuse + fast-forward
+            with m._lock:
+                m._generation = 5
+            m.heartbeat_once()
+            refusals = [e for e in _events("fleet.rejoin")
+                        if e.get("reason") == "stale_member"]
+            assert refusals
+            assert refusals[-1]["detail"]["refused_generation"] == 1
+            assert refusals[-1]["detail"]["generation"] == 5
+            # the coordinator adopted the higher generation in place:
+            # same membership, no rebuild
+            assert _wait(lambda: coord.generation == 5, timeout=5.0)
+            assert coord.report()["rebuilds"] == rebuilds_before
+            assert m.heartbeat_once()["generation"] == 5
+            # and the member never adopted anything lower
+            assert m.generation == 5 and m.poll() is None
+        finally:
+            m.close()
+            coord.close()
+
+
+class TestHelpers:
+    def test_mesh_for_spec_rejects_oversized_world(self):
+        import jax
+        spec = {"generation": 1, "world": len(jax.devices()) + 1,
+                "hosts": []}
+        with pytest.raises(ValueError, match="local"):
+            fabric.mesh_for_spec(spec)
+
+    def test_prefetch_artifacts_empty_store(self, tmp_path):
+        out = fabric.prefetch_artifacts(str(tmp_path))
+        assert out == {"artifacts": 0, "bytes": 0, "corrupt": 0,
+                       "other_fingerprint": 0}
+
+    def test_fleet_report_armed_states(self):
+        assert fabric.fleet_report() == {"armed": False}
+        coord = fabric.Coordinator(lease_s=5.0, expected=1)
+        m = fabric.Member((coord.host, coord.port), "solo")
+        try:
+            m.join(timeout=10.0)
+            # the join recorded the PRE-join generation (0); the first
+            # heartbeat reports the adopted one and clears the stale flag
+            m.heartbeat_once()
+            rep = fabric.fleet_report()
+            assert rep["armed"] and rep["generation"] == 1
+            assert rep["member"]["host"] == "solo"
+            assert rep["coordinator"]["world"] == 1
+            assert rep["coordinator"]["stale_hosts"] == []
+        finally:
+            m.close()
+            coord.close()
+        assert fabric.fleet_report() == {"armed": False}

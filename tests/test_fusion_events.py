@@ -143,6 +143,8 @@ class TestPublicContract:
             # regression sentinel (PR 19, profiler/sentinel.py)
             "sentinel.arm", "sentinel.check", "sentinel.drift",
             "sentinel.recover",
+            # elastic fleet fabric (PR 20, distributed/fabric.py)
+            "fleet.join", "fleet.leave", "fleet.rebuild", "fleet.rejoin",
         })
 
     def test_reason_codes_exact(self):
@@ -186,6 +188,8 @@ class TestPublicContract:
             # + the R7 static perf-contract finding class
             "perf_drift", "split_regression", "compile_storm",
             "latency_drift", "perf_contract",
+            # elastic fleet fabric (PR 20, distributed/fabric.py)
+            "host_lost", "mesh_rebuild", "stale_member",
         })
 
     def test_every_reason_has_a_doctor_hint(self):

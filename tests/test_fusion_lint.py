@@ -43,7 +43,8 @@ _FIXTURE_PATHS = {
     "R4": ["distributed/r4_unkeyed.py",
            "incubate/distributed/r4_lax_unkeyed.py"],
     "R5": ["r5_project"],
-    "R6": ["serving/r6_locks.py", "serving/r6_tenancy.py"],
+    "R6": ["serving/r6_locks.py", "serving/r6_tenancy.py",
+           "distributed/fabric.py"],
     "R7": ["r7_perf_contract.py"],
 }
 
@@ -116,21 +117,30 @@ class TestRuleFixtures:
 
     def test_r6_lock_discipline(self):
         fs = _fixture_findings("R6")
-        assert _triples(fs) == [
-            ("R6", "lock_discipline", 16),     # sleep under lock
-            ("R6", "lock_discipline", 18),     # device sync under lock
-            ("R6", "lock_discipline", 22),     # callback loop under lock
-            ("R6", "lock_discipline", 23),     # on_* callback under lock
-            ("R6", "lock_discipline", 24),     # evict hooks under lock
-            ("R6", "lock_discipline", 25),     # event emit under lock
-            ("R6", "lock_discipline", 35),     # lock-order inversion
-            ("R6", "lock_discipline", 38),     # alloc-lock inversion
-        ]
+        got = {(f.rule, f.reason_code, f.file, f.line) for f in fs}
+        assert got == {
+            # serving/r6_locks.py + r6_tenancy.py
+            ("R6", "lock_discipline", "serving/r6_locks.py", 16),
+            ("R6", "lock_discipline", "serving/r6_tenancy.py", 18),
+            ("R6", "lock_discipline", "serving/r6_locks.py", 22),
+            ("R6", "lock_discipline", "serving/r6_locks.py", 23),
+            ("R6", "lock_discipline", "serving/r6_tenancy.py", 24),
+            ("R6", "lock_discipline", "serving/r6_tenancy.py", 25),
+            ("R6", "lock_discipline", "serving/r6_locks.py", 35),
+            ("R6", "lock_discipline", "serving/r6_tenancy.py", 38),
+            # distributed/fabric.py (the elastic-fabric control plane)
+            ("R6", "lock_discipline", "distributed/fabric.py", 18),
+            ("R6", "lock_discipline", "distributed/fabric.py", 24),
+            ("R6", "lock_discipline", "distributed/fabric.py", 25),
+            ("R6", "lock_discipline", "distributed/fabric.py", 34),
+        }
         # the snapshot-then-invoke pattern stays clean
         assert not any(f.symbol.startswith("GoodRegistry") for f in fs)
         # ...and the tenancy-flavored fixed form (the discipline
         # serving/tenancy.py actually ships) stays clean too
         assert not any(f.symbol.startswith("GoodPrefixIndex") for f in fs)
+        # ...and the fabric-flavored collect-then-emit form
+        assert not any(f.symbol.startswith("GoodCoordinator") for f in fs)
 
     def test_r7_perf_contract(self):
         fs = _fixture_findings("R7")

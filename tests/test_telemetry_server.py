@@ -696,6 +696,80 @@ class TestFleetMerge:
             proc.wait(timeout=30)
 
 
+class TestFleetGenerations:
+    """stale_member classification (elastic fabric, PR 20): a host whose
+    `/fleet` generation trails the fleet's — or that the coordinator
+    lists in stale_hosts — is named stale_member, excluded from the
+    drift ratio, and skipped by the straggler classifier."""
+
+    @staticmethod
+    def _goodput(p50):
+        return {"steps": 6, "goodput": 0.9, "mfu": 0.1,
+                "tokens_per_sec": 0.0, "step_ms_p50": p50,
+                "step_ms_p99": p50, "buckets_s": {"productive": 1.0}}
+
+    def _hosts(self):
+        return {"h0": ({}, self._goodput(10.0)),
+                "h1": ({}, self._goodput(11.0)),
+                "h2": ({}, self._goodput(500.0))}
+
+    def test_trailing_generation_is_stale_member(self):
+        sys.path.insert(0, os.path.join(_ROOT, "tools"))
+        import fleet_metrics
+        fleet = {
+            "h0": {"armed": True, "generation": 2,
+                   "member": {"host": "w0", "generation": 2},
+                   "coordinator": {"generation": 2,
+                                   "stale_hosts": ["w2"]}},
+            "h1": {"armed": True, "generation": 2,
+                   "member": {"host": "w1", "generation": 2}},
+            "h2": {"armed": True, "generation": 1,
+                   "member": {"host": "w2", "generation": 1}},
+        }
+        view = fleet_metrics.fleet_view(self._hosts(), fleet=fleet)
+        drift = view["drift"]
+        assert drift["fleet_generation"] == 2
+        assert drift["generations"] == {"h0": 2, "h1": 2, "h2": 1}
+        # both stale signals (trailing generation, coordinator
+        # stale_hosts with host_id->label mapping) agree on h2
+        assert drift["stale_members"] == ["h2"]
+        per = drift["per_host"]
+        assert per["h2"]["status"] == "stale_member"
+        assert per["h2"]["generation"] == 1
+        assert per["h0"]["status"] == per["h1"]["status"] == "ok"
+        # the 50x-slower h2 is STALE, not the straggler: the ratio must
+        # come from the two live hosts only
+        assert drift["slowest_host"] == "h1"
+        assert drift["step_time_ratio"] == pytest.approx(1.1)
+        text = fleet_metrics.format_fleet_summary(view)
+        assert "stale_member" in text and "generation 2" in text
+
+    def test_coordinator_stale_hosts_without_generations(self):
+        """A member crash leaves no `/fleet` scrape for it — only the
+        coordinator's stale_hosts names it (by fabric host_id, reported
+        as-is when no scraped label matches)."""
+        sys.path.insert(0, os.path.join(_ROOT, "tools"))
+        import fleet_metrics
+        fleet = {"h0": {"armed": True, "generation": 3,
+                        "member": {"host": "w0", "generation": 3},
+                        "coordinator": {"generation": 3,
+                                        "stale_hosts": ["w9"]}}}
+        view = fleet_metrics.fleet_view(self._hosts(), fleet=fleet)
+        assert view["drift"]["stale_members"] == ["w9"]
+        assert view["drift"]["per_host"]["h0"]["status"] == "ok"
+
+    def test_no_fleet_scrape_degrades_to_metrics_view(self):
+        sys.path.insert(0, os.path.join(_ROOT, "tools"))
+        import fleet_metrics
+        for fleet in (None, {}, {"h0": None, "h1": None, "h2": None}):
+            view = fleet_metrics.fleet_view(self._hosts(), fleet=fleet)
+            drift = view["drift"]
+            assert "stale_members" not in drift
+            assert "fleet_generation" not in drift
+            assert all(v["status"] == "ok"
+                       for v in drift["per_host"].values())
+
+
 # ---------------------------------------------------------------------------
 # fusion_doctor --url + bench autopsy probe
 # ---------------------------------------------------------------------------

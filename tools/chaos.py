@@ -86,6 +86,32 @@ Serving scenarios (PR 7), the same methodology against LLMEngine:
                     clean window after the fault clears, and the storm's
                     streams finish token-identically.
 
+Elastic-fleet scenarios (PR 20, distributed/fabric.py), multi-process:
+
+  fleet_kill        N CPU workers rendezvous through the stdlib-TCP
+                    coordinator, train a dp=N data-parallel loop (full
+                    deterministic global batch per step, so every
+                    replica computes identical state), and ONE worker is
+                    SIGKILLed mid-accumulation. Must hold: the
+                    coordinator declares the host lost within its lease
+                    (`host_lost`), bumps the generation exactly once,
+                    and the survivors — within seconds, not a re-warmup
+                    — restore the latest StepCheckpointer snapshot,
+                    rebuild the dp=N-1 mesh through the `mesh_mismatch`
+                    split/re-promote path, and finish with a loss
+                    trajectory allclose to an UNINTERRUPTED run on the
+                    shrunk mesh. Then a restarted worker rejoins at the
+                    current generation and re-promotes with ZERO fresh
+                    compiles — every executable deserializes from the
+                    shared AOT store (`fleet.rejoin`, aot.hit).
+
+  fleet_flap        a slow-but-alive worker suppresses heartbeats for
+                    most of — but less than — its lease while the fleet
+                    trains on. Must hold: ZERO rebuilds, the generation
+                    never moves, and both workers finish with finite,
+                    identical trajectories. Lease grace absorbs slow;
+                    only silence past the lease is loss.
+
 Every decision flows through the PR 4 fusion flight recorder, so each
 scenario's report embeds the doctor's verdict.
 
@@ -1134,6 +1160,454 @@ def scenario_warm_restart(steps=14, kill_at=9):
 
 
 # ---------------------------------------------------------------------------
+# elastic-fleet scenarios (PR 20): coordinator in the parent, one child
+# process per fleet host, dp=world data-parallel training per child
+# ---------------------------------------------------------------------------
+
+def fleet_child_main(args):
+    """One elastic-fleet training worker (invoked as `chaos.py
+    --fleet-child`): rendezvous through the stdlib-TCP coordinator, then
+    a dp=world data-parallel loop over virtual CPU devices with the FULL
+    deterministic global batch each step — every replica computes
+    identical state, so fleet size changes move placement, not math.
+    Gradient accumulation (two microbatches per step) gives `--kill-at`
+    a mid-accumulation SIGKILL point. At every step boundary the worker
+    polls the fabric; a new generation restores the latest shared
+    StepCheckpointer snapshot, rebuilds the mesh for the new world, and
+    re-places its batch — the promoted step drops through the
+    `mesh_mismatch` split path and re-promotes (AOT warm when the
+    topology was seen before). Rank 0 ticks the shared checkpoint.
+    Writes a JSON report of losses, rebuild records, compile/AOT
+    counters, and fleet event counts."""
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.incubate.checkpoint import StepCheckpointer
+    from paddle_tpu.distributed import fabric
+    from paddle_tpu.distributed.mesh import set_global_mesh
+    from paddle_tpu.profiler import (dispatch_cache_stats,
+                                     chain_fusion_stats,
+                                     step_fusion_stats, aot_cache_stats)
+    from paddle_tpu.profiler.events import EVENTS
+
+    set_flags({"FLAGS_aot_cache": True,
+               "FLAGS_aot_cache_dir": args.aot_dir,
+               "FLAGS_eager_chain_fusion_min_count": 3,
+               "FLAGS_eager_step_fusion_min_count": 5,
+               "FLAGS_profiler_events": True,
+               "FLAGS_metrics": True})
+    host, _, port = args.coord.rpartition(":")
+    prev_gen = int(args.prev_gen or 0)
+    member = fabric.Member((host, int(port)), args.host_id,
+                           gen_seen=prev_gen)
+    rank, spec = member.join(timeout=120.0)
+    mesh = fabric.mesh_for_spec(spec)
+    set_global_mesh(mesh)
+    sharding = NamedSharding(mesh, P("data"))
+    # a rejoiner warms the shared store into the page cache before its
+    # first boundary — `artifacts` == 0 here would predict a cold
+    # compile. Must run AFTER set_global_mesh: the store fingerprint
+    # carries the mesh topology token.
+    prefetch = fabric.prefetch_artifacts(args.aot_dir) if prev_gen else None
+
+    def place_params(params, mesh):
+        # checkpoint restore materializes on the default device; the
+        # stored/promoted program expects the parameters replicated on
+        # the live mesh (where committed fused updates leave them)
+        repl = NamedSharding(mesh, P())
+        for p in params:
+            p._value = jax.device_put(p._value, repl)
+
+    paddle.seed(7)
+    rng = np.random.default_rng(11)
+    w = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32),
+                         stop_gradient=False)
+    bias = paddle.to_tensor(rng.standard_normal(8).astype(np.float32),
+                            stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1e-2, parameters=[w, bias])
+    model = {"w": w, "b": bias}
+    ck = StepCheckpointer(args.ckpt_dir, save_every_n_steps=1,
+                          max_checkpoints=3)
+    resumed = ck.restore(model=model, optimizer=opt)
+    if resumed >= 0:
+        place_params([w, bias], mesh)
+    kill_at = None if args.kill_at is None else int(args.kill_at)
+    pause_at = None if args.pause_at is None else int(args.pause_at)
+    losses = {}
+    rebuilds = []
+    step_wall_t = []
+    first_fired_rel = None
+    rel = 0
+    step = resumed + 1
+    opt.clear_grad()
+    while step < int(args.steps):
+        new_spec = member.poll()
+        if new_spec is not None:
+            # the fleet changed under us: back to the last consistent
+            # snapshot, new mesh, re-place — losing a host costs the
+            # steps since the last tick, not a warmup
+            resumed = ck.restore(model=model, optimizer=opt)
+            mesh = fabric.mesh_for_spec(new_spec)
+            set_global_mesh(mesh)
+            sharding = NamedSharding(mesh, P("data"))
+            place_params([w, bias], mesh)
+            rebuilds.append({"at_step": step, "resumed": resumed,
+                             "generation": new_spec["generation"],
+                             "world": new_spec["world"],
+                             "rank": member.rank, "t": time.time()})
+            opt.clear_grad()
+            step = resumed + 1
+            continue
+        if pause_at is not None and step == pause_at:
+            member.pause_heartbeats(float(args.pause_hb))
+            time.sleep(float(args.pause_hb))     # slow-but-alive
+        if args.step_ms:
+            # pace the loop so the fleet is still mid-run when a lease
+            # expires (tiny CPU steps would otherwise outrun detection)
+            time.sleep(float(args.step_ms) / 1e3)
+        mb_losses = []
+        for micro in range(2):
+            srng = np.random.default_rng(10_000 * (micro + 1) + step)
+            xb = srng.standard_normal((6, 8)).astype(np.float32)
+            x = paddle.Tensor(jax.device_put(xb, sharding),
+                              stop_gradient=True)
+            # MEAN-reduced loss: the data-parallel pmean contract
+            # (ops/spmd_fusion.py) needs pmean(local batch means) == the
+            # global batch mean — a sum-reduced loss would diverge under
+            # probation and demote the program to the plain jit lowering
+            loss = F.gelu(paddle.add(paddle.matmul(x, w), bias)).mean()
+            loss.backward()
+            mb_losses.append(loss)
+            if kill_at is not None and step == kill_at and micro == 0:
+                with open(args.out + ".kill", "w") as f:
+                    f.write(repr(time.time()))
+                os.kill(os.getpid(), signal.SIGKILL)
+        opt.step()
+        opt.clear_grad()
+        # read the losses only AFTER the boundary: a host sync inside
+        # the accumulation cycle would split the whole-step observation
+        total = sum(float(l) for l in mb_losses)
+        if first_fired_rel is None \
+                and step_fusion_stats()["fused_steps"] > 0:
+            first_fired_rel = rel
+        losses[str(step)] = total
+        step_wall_t.append(time.perf_counter())
+        if member.rank == 0:
+            ck.tick(step, model=model, optimizer=opt)
+        rel += 1
+        step += 1
+    ev = EVENTS.snapshot()
+    try:
+        # bench.py's dp2x2 leg lifts this into its own record (the
+        # restamp pattern the serve legs use); chaos scenarios ignore it
+        from paddle_tpu.profiler.sentinel import capture_record
+        sentinel = capture_record("fleet_child")
+    except Exception:
+        sentinel = None
+
+    def n(cat):
+        return sum(1 for e in ev if e["cat"] == cat)
+
+    report = {
+        "host": args.host_id,
+        "rank": member.rank,
+        "generation": member.generation,
+        "resumed_step": resumed,
+        "losses": losses,
+        "rebuilds": rebuilds,
+        "step_wall_t": step_wall_t,
+        "sentinel_record": sentinel,
+        "first_fired_rel": first_fired_rel,
+        "prefetch": prefetch,
+        "dispatch_retraces": dispatch_cache_stats()["retraces"],
+        "chain_retraces": chain_fusion_stats()["retraces"],
+        "step_retraces": step_fusion_stats()["retraces"],
+        "steps_promoted": step_fusion_stats()["steps_promoted"],
+        "fused_steps": step_fusion_stats()["fused_steps"],
+        "aot": aot_cache_stats(),
+        "events": {"aot_hit": n("aot.hit"),
+                   "aot_store": n("aot.store"),
+                   "dispatch_retrace": n("dispatch.retrace"),
+                   "chain_compile": n("chain.compile"),
+                   "fleet_rebuild": n("fleet.rebuild"),
+                   "step_split": n("step.split"),
+                   "mesh_mismatch": sum(
+                       1 for e in ev
+                       if e.get("reason") == "mesh_mismatch")},
+    }
+    member.close()
+    with open(args.out, "w") as f:
+        json.dump(report, f)
+    return 0
+
+
+def _spawn_fleet_child(coord, host_id, aot_dir, ckpt_dir, out, steps,
+                       kill_at=None, prev_gen=None, pause_at=None,
+                       pause_hb=None, step_ms=0):
+    cmd = [sys.executable, os.path.abspath(__file__), "--fleet-child",
+           "--coord", coord, "--host-id", host_id, "--aot-dir", aot_dir,
+           "--ckpt-dir", ckpt_dir, "--out", out, "--steps", str(steps),
+           "--step-ms", str(step_ms)]
+    if kill_at is not None:
+        cmd += ["--kill-at", str(kill_at)]
+    if prev_gen:
+        cmd += ["--prev-gen", str(prev_gen)]
+    if pause_at is not None:
+        cmd += ["--pause-at", str(pause_at), "--pause-hb", str(pause_hb)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # every fleet process sees the same virtual device pool, so the mesh
+    # topology token (and with it the AOT fingerprint) matches across
+    # hosts and phases
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4"
+                        ).strip()
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env)
+
+
+def _drain_fleet_children(procs, timeout=600):
+    done = {}
+    for name, p in procs.items():
+        try:
+            outs, errs = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs, errs = p.communicate()
+        done[name] = (p.returncode, errs)
+    return done
+
+
+def scenario_fleet_kill(steps=26, kill_at=8, lease_s=1.5):
+    import numpy as np
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.profiler.events import EVENTS
+    from paddle_tpu.distributed import fabric
+
+    set_flags({"FLAGS_profiler_events": True})
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        aot = os.path.join(tmp, "aot")
+        ck_fleet = os.path.join(tmp, "ck_fleet")
+        outs = {h: os.path.join(tmp, f"{h}.json")
+                for h in ("w0", "w1", "w2", "r0", "r1",
+                          "j0", "j1", "j2")}
+
+        # phase 1: 3 workers rendezvous, w2 is SIGKILLed mid-accumulation
+        seq0 = EVENTS.total
+        coord = fabric.Coordinator(lease_s=lease_s, expected=3)
+        addr = f"{coord.host}:{coord.port}"
+        procs = {h: _spawn_fleet_child(
+                     addr, h, aot, ck_fleet, outs[h], steps,
+                     kill_at=kill_at if h == "w2" else None, step_ms=150)
+                 for h in ("w0", "w1", "w2")}
+        rcs = _drain_fleet_children(procs)
+        gen_after = coord.generation
+        ev = [e for e in EVENTS.snapshot() if e["seq"] > seq0]
+        coord.close()
+        if rcs["w2"][0] != -signal.SIGKILL:
+            failures.append(f"w2 expected SIGKILL death, "
+                            f"rc={rcs['w2'][0]}")
+        for h in ("w0", "w1"):
+            if rcs[h][0] != 0:
+                failures.append(
+                    f"survivor {h} failed: {rcs[h][1][-800:]}")
+        lost = [e for e in ev if e["cat"] == "fleet.leave"
+                and e.get("reason") == "host_lost"]
+        if len(lost) != 1 or lost[0]["op"] != "w2":
+            failures.append(f"expected exactly one host_lost for w2, "
+                            f"got {[(e['op'],) for e in lost]}")
+        if gen_after != 2:
+            failures.append(
+                f"coordinator at generation {gen_after} after one "
+                "rendezvous + one loss (expected 2)")
+        t_kill = None
+        if os.path.exists(outs["w2"] + ".kill"):
+            with open(outs["w2"] + ".kill") as f:
+                t_kill = float(f.read())
+        else:
+            failures.append("w2 never reached its kill point")
+        survivors = {}
+        for h in ("w0", "w1"):
+            if rcs[h][0] == 0 and os.path.exists(outs[h]):
+                with open(outs[h]) as f:
+                    survivors[h] = json.load(f)
+        for h, rep in survivors.items():
+            rb = rep["rebuilds"]
+            if len(rb) != 1 or rb[0]["generation"] != 2 \
+                    or rb[0]["world"] != 2:
+                failures.append(
+                    f"{h} rebuilds {rb}: expected exactly one, at "
+                    "generation 2 / world 2")
+                continue
+            if rb[0]["resumed"] < 0:
+                failures.append(f"{h} did not resume from the shared "
+                                "checkpoint on rebuild")
+            # the lose-a-host-in-SECONDS budget: lease expiry + reaper
+            # tick + heartbeat propagation + one step boundary
+            if t_kill is not None and rb[0]["t"] - t_kill > lease_s * 3:
+                failures.append(
+                    f"{h} adopted the rebuild {rb[0]['t'] - t_kill:.2f}s "
+                    f"after the kill (budget {lease_s * 3:.1f}s)")
+            # the promoted ONE-program step must notice the new mesh
+            # (split and/or retrace — a world change shrinks the device
+            # SET, so it lands in the split/retrace family rather than
+            # the same-pool relayout's mesh_mismatch kill) and keep
+            # firing fused on the shrunk mesh afterwards
+            if rep["events"]["step_split"] < 1 \
+                    and rep["step_retraces"] < 1 \
+                    and rep["events"]["mesh_mismatch"] < 1:
+                failures.append(
+                    f"{h}'s promoted step sailed through the mesh "
+                    "change without a split or retrace")
+            if rep["fused_steps"] < 1:
+                failures.append(f"{h} never fired a fused step")
+            if len(rep["losses"]) != steps:
+                failures.append(f"{h} finished {len(rep['losses'])} of "
+                                f"{steps} steps")
+
+        # phase 2: the reference — an UNINTERRUPTED run on the shrunk
+        # (dp=2) mesh, fresh checkpoints, same shared store
+        if not failures:
+            coord2 = fabric.Coordinator(lease_s=lease_s, expected=2)
+            addr2 = f"{coord2.host}:{coord2.port}"
+            procs2 = {h: _spawn_fleet_child(
+                          addr2, h, aot, os.path.join(tmp, "ck_ref"),
+                          outs[h], steps)
+                      for h in ("r0", "r1")}
+            rcs2 = _drain_fleet_children(procs2)
+            coord2.close()
+            for h in ("r0", "r1"):
+                if rcs2[h][0] != 0:
+                    failures.append(
+                        f"reference {h} failed: {rcs2[h][1][-800:]}")
+        if not failures:
+            with open(outs["r0"]) as f:
+                ref = json.load(f)
+            for h, rep in survivors.items():
+                rb_step = rep["rebuilds"][0]["resumed"] + 1
+                for k, v in rep["losses"].items():
+                    if int(k) < rb_step:
+                        continue
+                    if abs(v - ref["losses"][k]) > 1e-4:
+                        failures.append(
+                            f"{h} post-rebuild loss diverged from the "
+                            f"clean shrunk-mesh run at step {k}: {v} vs "
+                            f"{ref['losses'][k]}")
+                        break
+
+        # phase 3: the restarted worker REJOINS a full fleet at the
+        # current generation and re-promotes with zero fresh compiles —
+        # the dp=3 artifacts it stored before dying serve it back
+        if not failures:
+            seq1 = EVENTS.total
+            coord3 = fabric.Coordinator(lease_s=lease_s, expected=3)
+            addr3 = f"{coord3.host}:{coord3.port}"
+            procs3 = {}
+            for h, prev in (("j0", None), ("j1", None), ("j2", 1)):
+                procs3[h] = _spawn_fleet_child(
+                    addr3, h, aot, ck_fleet, outs[h], steps + 6,
+                    prev_gen=prev)
+            rcs3 = _drain_fleet_children(procs3)
+            ev3 = [e for e in EVENTS.snapshot() if e["seq"] > seq1]
+            coord3.close()
+            for h in ("j0", "j1", "j2"):
+                if rcs3[h][0] != 0:
+                    failures.append(
+                        f"rejoin-phase {h} failed: {rcs3[h][1][-800:]}")
+            if not any(e["cat"] == "fleet.rejoin" and e["op"] == "j2"
+                       for e in ev3):
+                failures.append("coordinator never attributed j2 as a "
+                                "fleet.rejoin")
+        if not failures:
+            with open(outs["j2"]) as f:
+                rej = json.load(f)
+            if rej["resumed_step"] < 0:
+                failures.append("rejoiner did not pull the shared "
+                                "checkpoint")
+            if not rej["prefetch"] or rej["prefetch"]["artifacts"] < 1:
+                failures.append(
+                    f"prefetch warmed {rej.get('prefetch')} — the "
+                    "shared store is invisible to the rejoiner")
+            # THE acceptance: zero fresh compiles in the rejoined worker
+            for k in ("dispatch_retraces", "chain_retraces",
+                      "step_retraces"):
+                if rej[k] != 0:
+                    failures.append(
+                        f"rejoiner paid {rej[k]} {k}: the shared store "
+                        "did not eliminate the warmup")
+            if rej["events"]["dispatch_retrace"] \
+                    or rej["events"]["chain_compile"]:
+                failures.append(f"rejoiner emitted compile events: "
+                                f"{rej['events']}")
+            if rej["events"]["aot_hit"] < 3:
+                failures.append(
+                    f"rejoiner loaded only {rej['events']['aot_hit']} "
+                    "artifacts from the shared store")
+            if rej["first_fired_rel"] is None \
+                    or rej["first_fired_rel"] > 1:
+                failures.append(
+                    f"rejoiner first fused fire at relative step "
+                    f"{rej['first_fired_rel']} (expected <= 1)")
+    return {"ok": not failures, "failures": failures}
+
+
+def scenario_fleet_flap(steps=12, lease_s=2.0, pause_frac=0.6):
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.profiler.events import EVENTS
+    from paddle_tpu.distributed import fabric
+
+    set_flags({"FLAGS_profiler_events": True})
+    failures = []
+    pause = lease_s * pause_frac
+    with tempfile.TemporaryDirectory() as tmp:
+        aot = os.path.join(tmp, "aot")
+        outs = {h: os.path.join(tmp, f"{h}.json") for h in ("f0", "f1")}
+        seq0 = EVENTS.total
+        coord = fabric.Coordinator(lease_s=lease_s, expected=2)
+        addr = f"{coord.host}:{coord.port}"
+        procs = {
+            "f0": _spawn_fleet_child(addr, "f0", aot,
+                                     os.path.join(tmp, "ck"), outs["f0"],
+                                     steps, pause_at=4, pause_hb=pause),
+            "f1": _spawn_fleet_child(addr, "f1", aot,
+                                     os.path.join(tmp, "ck"), outs["f1"],
+                                     steps),
+        }
+        rcs = _drain_fleet_children(procs)
+        ev = [e for e in EVENTS.snapshot() if e["seq"] > seq0]
+        coord.close()
+        for h in ("f0", "f1"):
+            if rcs[h][0] != 0:
+                failures.append(f"{h} failed: {rcs[h][1][-800:]}")
+        if any(e["cat"] == "fleet.leave"
+               and e.get("reason") == "host_lost" for e in ev):
+            failures.append(
+                f"a {pause:.1f}s heartbeat gap inside a {lease_s}s "
+                "lease flapped membership")
+        reports = {}
+        for h in ("f0", "f1"):
+            if os.path.exists(outs[h]):
+                with open(outs[h]) as f:
+                    reports[h] = json.load(f)
+        for h, rep in reports.items():
+            if rep["rebuilds"]:
+                failures.append(f"{h} adopted a rebuild during an "
+                                "in-lease slow spell")
+            if rep["generation"] != 1:
+                failures.append(f"{h} ended at generation "
+                                f"{rep['generation']} (expected 1)")
+        if len(reports) == 2 and not failures:
+            a, b = reports["f0"]["losses"], reports["f1"]["losses"]
+            if a != b:
+                failures.append("replica trajectories diverged across "
+                                "the slow spell")
+    return {"ok": not failures, "failures": failures}
+
+
+# ---------------------------------------------------------------------------
 # kill scenario: child training loop + parent orchestration
 # ---------------------------------------------------------------------------
 
@@ -1278,7 +1752,9 @@ SCENARIOS = {"nan": scenario_nan, "exception": scenario_exception,
              "serve_kill": scenario_serve_kill,
              "tenant_swap": scenario_tenant_swap,
              "telemetry": scenario_telemetry,
-             "sentinel": scenario_sentinel}
+             "sentinel": scenario_sentinel,
+             "fleet_kill": scenario_fleet_kill,
+             "fleet_flap": scenario_fleet_flap}
 
 
 def main(argv=None):
@@ -1298,6 +1774,14 @@ def main(argv=None):
                     help=argparse.SUPPRESS)
     ap.add_argument("--aot-child", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--fleet-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--coord", help=argparse.SUPPRESS)
+    ap.add_argument("--host-id", help=argparse.SUPPRESS)
+    ap.add_argument("--prev-gen", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--pause-at", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--pause-hb", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--step-ms", default=0, help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", help=argparse.SUPPRESS)
     ap.add_argument("--aot-dir", help=argparse.SUPPRESS)
     ap.add_argument("--out", help=argparse.SUPPRESS)
@@ -1314,6 +1798,8 @@ def main(argv=None):
         return tenant_child_main(args)
     if args.aot_child:
         return aot_child_main(args)
+    if args.fleet_child:
+        return fleet_child_main(args)
 
     names = sorted(SCENARIOS) if args.scenario == "all" else [args.scenario]
     report = {}

@@ -49,8 +49,8 @@ from urllib.parse import urlparse
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
-__all__ = ["fetch_host", "sink_hosts", "relabel_snapshot", "fleet_view",
-           "merge_goodput", "format_fleet_summary"]
+__all__ = ["fetch_host", "fetch_fleet", "sink_hosts", "relabel_snapshot",
+           "fleet_view", "merge_goodput", "format_fleet_summary"]
 
 
 def fetch_host(url, timeout=10):
@@ -64,6 +64,22 @@ def fetch_host(url, timeout=10):
         with urllib.request.urlopen(base + ep, timeout=timeout) as r:
             out.append(json.loads(r.read().decode()))
     return out[0], out[1]
+
+
+def fetch_fleet(url, timeout=10):
+    """Scrape one host's `/fleet` elastic-fabric view
+    (distributed/fabric.fleet_report): its membership generation plus —
+    on the coordinator host — the whole fleet's per-host reported
+    generations and `stale_hosts`. Returns None when the endpoint is
+    absent (a pre-fabric server), unreachable, or unarmed; the fleet
+    view then degrades to the metrics-only classification."""
+    base = url.rstrip("/")
+    try:
+        with urllib.request.urlopen(base + "/fleet", timeout=timeout) as r:
+            doc = json.loads(r.read().decode())
+    except Exception:
+        return None
+    return doc if isinstance(doc, dict) and doc.get("armed") else None
 
 
 def sink_hosts(patterns):
@@ -153,18 +169,51 @@ def _host_step_p50_ms(metrics, g):
     return 0.0
 
 
-def fleet_view(hosts, bands=None, leg=None):
+def _fleet_generations(hosts, fleet):
+    """{label: generation} + the stale label set, from per-host `/fleet`
+    scrapes. Two stale signals agree by construction and are OR-ed here:
+    a host's own reported generation trailing the fleet max, and the
+    coordinator's `stale_hosts` list (fabric host_ids, mapped back to
+    scrape labels via each member report's `host` field)."""
+    generations = {}
+    stale = set()
+    host_id_to_label = {}
+    coord_stale_ids = set()
+    for label, rep in (fleet or {}).items():
+        if not rep or label not in hosts:
+            continue
+        if rep.get("generation") is not None:
+            generations[label] = int(rep["generation"])
+        member = rep.get("member") or {}
+        if member.get("host"):
+            host_id_to_label[str(member["host"])] = label
+        coord = rep.get("coordinator") or {}
+        coord_stale_ids.update(str(h) for h in coord.get("stale_hosts")
+                               or ())
+    gmax = max(generations.values(), default=0)
+    stale.update(h for h, g in generations.items() if g < gmax)
+    stale.update(host_id_to_label.get(h, h) for h in coord_stale_ids)
+    return generations, stale
+
+
+def fleet_view(hosts, bands=None, leg=None, fleet=None):
     """{host: (metrics snapshot, goodput snapshot)} -> the full fleet
     report: policy-merged totals, host-labeled series, fleet goodput,
     and the drift section (slowest-host step-time ratio, per-host
     goodput/MFU, and — when a perf-baseline `bands` entry is given —
     per-host straggler classification against the SAME tolerance bands
-    the regression sentinel enforces in-process)."""
+    the regression sentinel enforces in-process). `fleet` optionally
+    maps host labels to their `/fleet` scrapes (fetch_fleet): a host
+    whose elastic-fabric generation trails the fleet's — or that the
+    coordinator lists in `stale_hosts` — is classified `stale_member`
+    and excluded from the drift ratio (its step times describe a mesh
+    the fleet already rebuilt away from)."""
     from paddle_tpu.profiler.metrics import merge_snapshots
     merged = merge_snapshots([m for m, _ in hosts.values()])
     labeled = merge_snapshots([relabel_snapshot(m, h)
                                for h, (m, _) in hosts.items()])
     fleet_goodput = merge_goodput({h: g for h, (_, g) in hosts.items()})
+    generations, stale = _fleet_generations(hosts, fleet)
     per_host = {}
     for h, (m, g) in sorted(hosts.items()):
         p50 = round(_host_step_p50_ms(m, g), 4)
@@ -172,18 +221,26 @@ def fleet_view(hosts, bands=None, leg=None):
         # is reporting, not running — it must not skew the drift stats
         active = int((g or {}).get("steps") or 0) > 0 or p50 > 0
         per_host[h] = {
-            "status": "ok" if active else "no_data",
+            "status": ("stale_member" if h in stale
+                       else "ok" if active else "no_data"),
             "goodput": (g or {}).get("goodput"),
             "mfu": (g or {}).get("mfu"),
             "tokens_per_sec": (g or {}).get("tokens_per_sec"),
             "step_p50_ms": p50,
             "step_indices": (g or {}).get("step_indices_pretty") or {},
         }
+        if h in generations:
+            per_host[h]["generation"] = generations[h]
     stepped = {h: v["step_p50_ms"] for h, v in per_host.items()
                if v["status"] == "ok" and v["step_p50_ms"] > 0}
     drift = {"per_host": per_host,
              "no_data_hosts": sorted(h for h, v in per_host.items()
                                      if v["status"] == "no_data")}
+    if generations:
+        drift["generations"] = generations
+        drift["fleet_generation"] = max(generations.values())
+    if stale:
+        drift["stale_members"] = sorted(stale)
     # the ratio needs two measured hosts: a single host (or one measured
     # host among no_data peers) has no straggler to name, and a 1.0x
     # self-ratio would read as a finding
@@ -248,6 +305,12 @@ def format_fleet_summary(view):
              f"buckets : " + " ".join(f"{b}={v}" for b, v
                                       in fg["buckets_s"].items() if v)]
     drift = view["drift"]
+    if drift.get("fleet_generation") is not None:
+        gens = drift.get("generations") or {}
+        lines.append(
+            f"fabric  : generation {drift['fleet_generation']} ("
+            + ", ".join(f"{h}=g{g}" for h, g in sorted(gens.items()))
+            + ")")
     if drift.get("step_time_ratio") is not None:
         lines.append(
             f"drift   : slowest {drift['slowest_host']} is "
@@ -256,6 +319,11 @@ def format_fleet_summary(view):
     if drift.get("no_data_hosts"):
         lines.append("no data : " + ", ".join(drift["no_data_hosts"])
                      + " (reporting but not running; excluded from drift)")
+    if drift.get("stale_members"):
+        lines.append(
+            "stale   : " + ", ".join(drift["stale_members"])
+            + " (heartbeating a generation the fleet rebuilt past; "
+            "excluded from drift — restart or let the member rejoin)")
     for h, row in drift["per_host"].items():
         extra = ""
         idx = row.get("step_indices") or {}
@@ -264,6 +332,10 @@ def format_fleet_summary(view):
                                       for b, s in sorted(idx.items()))
         if row["status"] == "no_data":
             lines.append(f"  {h:<24} no_data")
+            continue
+        if row["status"] == "stale_member":
+            lines.append(f"  {h:<24} stale_member "
+                         f"(generation {row.get('generation')})")
             continue
         lines.append(
             f"  {h:<24} goodput={row['goodput']} mfu={row['mfu']} "
@@ -324,6 +396,7 @@ def main(argv=None) -> int:
     from paddle_tpu.profiler.metrics import exposition
 
     hosts = {}
+    fleet = {}
     if args.sink:
         hosts.update(sink_hosts(args.sink))
     for url in args.url:
@@ -333,11 +406,15 @@ def main(argv=None) -> int:
         except Exception as e:
             print(f"fleet_metrics: {url} unreachable ({e}); continuing "
                   "with the rest of the fleet", file=sys.stderr)
+            continue
+        # best-effort elastic-fabric scrape: absent/unarmed -> None, and
+        # the view degrades to the metrics-only classification
+        fleet[label] = fetch_fleet(url)
     if not hosts:
         print("fleet_metrics: no reachable hosts / readable sinks",
               file=sys.stderr)
         return 1
-    view = fleet_view(hosts, bands=bands, leg=args.leg)
+    view = fleet_view(hosts, bands=bands, leg=args.leg, fleet=fleet)
     if args.json:
         print(json.dumps(view, indent=2, sort_keys=True, default=str))
     elif args.prom:

@@ -556,8 +556,10 @@ def _cache_report(args) -> int:
               + ", ".join(removed[:8])
               + (" …" if len(removed) > 8 else ""))
     if entries:
+        # provenance on a fleet-shared store: `host` names the member
+        # that paid the export the rest of the fleet warm-starts from
         print(f"  {'kind':<7} {'digest':<12} {'size':>9} {'age':>8} "
-              f"{'fp':>4} {'state':<8} label")
+              f"{'fp':>4} {'state':<8} {'host':<12} label")
         for e in entries:
             state = ("QUARANT" if e["quarantined"]
                      else "CORRUPT" if e["corrupt"] else "ok")
@@ -567,6 +569,7 @@ def _cache_report(args) -> int:
             age_s = f"{age / 3600:.1f}h" if age >= 3600 else f"{age:.0f}s"
             print(f"  {e['kind']:<7} {e.get('digest', '?')[:12]:<12} "
                   f"{e['bytes']:>9} {age_s:>8} {fp:>4} {state:<8} "
+                  f"{(e.get('host') or '?')[:12]:<12} "
                   f"{e['label'] or ''}")
     return 0
 
